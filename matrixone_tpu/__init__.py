@@ -1,0 +1,39 @@
+"""matrixone_tpu — a TPU-native data framework with MatrixOne's capabilities.
+
+A brand-new design (NOT a port) re-architecting the reference's compute-dense
+core (reference: matrixorigin/matrixone, pkg/container + pkg/vectorize +
+pkg/sql/colexec + pkg/vectorindex + cgo/) as an idiomatic JAX/XLA/Pallas
+program:
+
+- columnar batches live on device as (data, validity) array pairs
+  (`matrixone_tpu.container`), mirroring the reference's
+  `container/vector/vector.go:43` data/nulls/area triple;
+- scalar/aggregate kernels are jitted jnp/Pallas functions with SQL null
+  semantics (`matrixone_tpu.ops`), replacing `pkg/vectorize` + `cgo/xcall.c`;
+- group-by / join / top-k are sort- and matmul-based formulations that map
+  onto the MXU instead of pointer-chasing hash tables
+  (reference: `pkg/sql/colexec`, `pkg/container/hashtable`);
+- vector search (IVF-Flat build + search, k-means) runs as batched matmul
+  distance kernels (`matrixone_tpu.vectorindex`), replacing
+  `pkg/vectorindex` + the `cgo/cuvs` CUDA worker;
+- SQL text -> plan -> pipeline compilation is host-side Python
+  (`matrixone_tpu.sql`, `matrixone_tpu.vm`), with the device kept fed by a
+  host-driven batch loop (reference: `pkg/sql/compile`, `pkg/vm`);
+- storage / MVCC / WAL are host-side (`matrixone_tpu.storage`,
+  `matrixone_tpu.txn`), preserving the reference's behavior contracts
+  (`pkg/vm/engine/tae`, `pkg/txn`);
+- multi-device distribution uses `jax.sharding.Mesh` + `shard_map` with XLA
+  collectives over ICI (`matrixone_tpu.parallel`), replacing morpc shuffle /
+  RemoteRun (`pkg/common/morpc`, `pkg/sql/compile/remoterun.go`).
+"""
+
+import jax
+
+# SQL needs exact 64-bit integer arithmetic (BIGINT, DECIMAL as scaled int64,
+# 64-bit hashes for group-by/join). TPU emulates int64 with int32 pairs; the
+# hot float kernels below explicitly use f32/bf16 so MXU throughput is not
+# affected. (Reference keeps the same split: exact Go int64/decimal kernels in
+# pkg/vectorize, float SIMD in cgo/.)
+jax.config.update("jax_enable_x64", True)
+
+from matrixone_tpu.version import __version__  # noqa: E402,F401

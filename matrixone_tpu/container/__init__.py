@@ -1,0 +1,9 @@
+from matrixone_tpu.container import dtypes
+from matrixone_tpu.container.batch import Batch, from_device
+from matrixone_tpu.container.device import (DeviceBatch, DeviceColumn,
+                                            bucket_length, from_numpy)
+from matrixone_tpu.container.dtypes import DType, TypeOid
+from matrixone_tpu.container.vector import Vector
+
+__all__ = ["dtypes", "Batch", "from_device", "DeviceBatch", "DeviceColumn",
+           "bucket_length", "from_numpy", "DType", "TypeOid", "Vector"]

@@ -1,0 +1,111 @@
+"""Host-side Batch: named Vectors + row count, and the host<->device bridge.
+
+Redesign of `pkg/container/batch/types.go:45`. `Batch.to_device()` is the
+seam the reference implements with cgo pointer-marshalling
+(`pkg/sql/plan/function/cxcall.go:65` ships 6 raw ptr/len words per vector);
+here it is numpy -> padded jnp arrays, with varlena columns
+dictionary-encoded (codes on device, dictionary kept host-side in the
+returned `HostDicts`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from matrixone_tpu.container import device as dev
+from matrixone_tpu.container.dtypes import DType, varchar
+from matrixone_tpu.container.vector import Vector, arrow_type_to_dtype
+
+#: host-side dictionaries for device dictionary-encoded varlena columns
+HostDicts = Dict[str, List[str]]
+
+
+@dataclasses.dataclass
+class Batch:
+    columns: Dict[str, Vector]
+
+    def __len__(self) -> int:
+        for v in self.columns.values():
+            return len(v)
+        return 0
+
+    @property
+    def schema(self) -> Dict[str, DType]:
+        return {n: v.dtype for n, v in self.columns.items()}
+
+    @classmethod
+    def from_pydict(cls, data: Dict[str, list], schema: Dict[str, DType]) -> "Batch":
+        return cls({n: Vector.from_values(data[n], schema[n]) for n in schema})
+
+    def to_device(self, pad_to: Optional[int] = None):
+        """-> (DeviceBatch, HostDicts). Varlena columns become int32 codes."""
+        n = len(self)
+        arrays, dtypes, validity, dicts = {}, {}, {}, {}
+        for name, vec in self.columns.items():
+            if vec.dtype.is_varlen:
+                codes, dictionary = vec.encode_dictionary()
+                arrays[name] = codes
+                from matrixone_tpu.container import dtypes as dt
+                dtypes[name] = dt.INT32
+                dicts[name] = dictionary
+            else:
+                arrays[name] = vec.data
+                dtypes[name] = vec.dtype
+            validity[name] = vec.valid_mask()
+        dbatch = dev.from_numpy(arrays, dtypes, validity, n_rows=n, pad_to=pad_to)
+        # remember the SQL-level type on the device column for varlena cols
+        for name, vec in self.columns.items():
+            if vec.dtype.is_varlen:
+                col = dbatch.columns[name]
+                dbatch.columns[name] = dev.DeviceColumn(
+                    data=col.data, validity=col.validity, dtype=vec.dtype)
+        return dbatch, dicts
+
+    # ---- Arrow interop ----
+
+    def to_arrow(self) -> pa.RecordBatch:
+        names = list(self.columns)
+        return pa.RecordBatch.from_arrays(
+            [self.columns[n].to_arrow() for n in names], names=names)
+
+    @classmethod
+    def from_arrow(cls, rb, schema: Optional[Dict[str, DType]] = None) -> "Batch":
+        cols = {}
+        for i, name in enumerate(rb.schema.names):
+            arr = rb.column(i)
+            dtype = schema[name] if schema else arrow_type_to_dtype(arr.type)
+            cols[name] = Vector.from_arrow(arr, dtype)
+        return cls(cols)
+
+
+def from_device(dbatch: dev.DeviceBatch, dicts: Optional[HostDicts] = None,
+                schema: Optional[Dict[str, DType]] = None) -> Batch:
+    """Pull a DeviceBatch back to host, trimming padding and decoding dicts."""
+    import jax
+    dicts = dicts or {}
+    n = int(jax.device_get(dbatch.n_rows))
+    cols: Dict[str, Vector] = {}
+    for name, col in dbatch.columns.items():
+        data = np.asarray(jax.device_get(col.data))
+        val = np.asarray(jax.device_get(col.validity))
+        if col.is_const and n > 1:
+            data = np.broadcast_to(data, (n,) + data.shape[1:]).copy()
+            val = np.broadcast_to(val, (n,)).copy()
+        data, val = data[:n], val[:n]
+        dtype = (schema or {}).get(name, col.dtype)
+        if name in dicts:
+            lut = np.asarray(dicts[name], dtype=object)
+            strings = pa.array(
+                [lut[c] if v else None for c, v in zip(data, val)],
+                type=pa.string())
+            cols[name] = Vector(dtype=dtype if dtype.is_varlen else varchar(),
+                                strings=strings,
+                                validity=None if val.all() else val)
+        else:
+            cols[name] = Vector(dtype=dtype, data=data,
+                                validity=None if val.all() else val)
+    return Batch(cols)

@@ -1,0 +1,137 @@
+"""Host-side columnar Vector — the CPU half of the container layer.
+
+Redesign of `pkg/container/vector/vector.go:43` for a host that feeds a TPU:
+fixed-width data is a numpy array + bool validity; varlena (VARCHAR/TEXT)
+is a pyarrow string array. `encode_dictionary()` produces the device
+representation of strings: int32 codes + a host dictionary — the TPU never
+sees the varlena heap (the reference's `area`), only dense codes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+
+from matrixone_tpu.container import dtypes as dt
+from matrixone_tpu.container.dtypes import DType, TypeOid
+
+
+@dataclasses.dataclass
+class Vector:
+    """Host column: fixed-width numpy data or pyarrow varlena, + validity."""
+
+    dtype: DType
+    data: Optional[np.ndarray] = None       # fixed-width types
+    strings: Optional[pa.Array] = None      # varlena types
+    validity: Optional[np.ndarray] = None   # bool; None => all valid
+
+    def __len__(self) -> int:
+        if self.dtype.is_varlen:
+            return len(self.strings)
+        return len(self.data)
+
+    @classmethod
+    def from_values(cls, values, dtype: DType) -> "Vector":
+        if dtype.is_varlen:
+            arr = pa.array(values, type=pa.string())
+            val = None
+            if arr.null_count:
+                val = ~np.asarray(arr.is_null())
+            return cls(dtype=dtype, strings=arr, validity=val)
+        values = list(values)
+        val = np.array([v is not None for v in values], dtype=np.bool_)
+        filled = [v if v is not None else 0 for v in values]
+        if dtype.oid == TypeOid.DECIMAL64:
+            scaled = [int(round(float(v) * 10 ** dtype.scale)) for v in filled]
+            data = np.array(scaled, dtype=np.int64)
+        else:
+            data = np.asarray(filled, dtype=dtype.np_dtype)
+        return cls(dtype=dtype, data=data,
+                   validity=None if val.all() else val)
+
+    def valid_mask(self) -> np.ndarray:
+        if self.validity is not None:
+            return self.validity
+        return np.ones(len(self), dtype=np.bool_)
+
+    def encode_dictionary(self):
+        """-> (codes int32 [n], dictionary list[str]); device ships the codes.
+
+        Null rows get code 0 (masked by validity). The reference's group-by
+        hashes raw bytes (container/hashtable/string_hash_map.go); we instead
+        dictionary-encode once on host and group by dense codes on device.
+        """
+        assert self.dtype.is_varlen
+        enc = self.strings.dictionary_encode()
+        codes = np.asarray(enc.indices.fill_null(0), dtype=np.int32)
+        dictionary = enc.dictionary.to_pylist()
+        if not dictionary:
+            dictionary = [""]
+        return codes, dictionary
+
+    def to_pylist(self):
+        if self.dtype.is_varlen:
+            return self.strings.to_pylist()
+        mask = self.valid_mask()
+        if self.dtype.oid == TypeOid.DECIMAL64:
+            scale = 10 ** self.dtype.scale
+            return [int(v) / scale if m else None
+                    for v, m in zip(self.data, mask)]
+        return [self.data[i].item() if mask[i] else None
+                for i in range(len(self))]
+
+    # ---- Arrow interop (objectio serialization + client results) ----
+
+    def to_arrow(self) -> pa.Array:
+        if self.dtype.is_varlen:
+            return self.strings
+        mask = None
+        if self.validity is not None:
+            mask = ~self.validity
+        if self.dtype.is_vector:
+            n, d = self.data.shape
+            flat = pa.array(self.data.reshape(-1))
+            return pa.FixedSizeListArray.from_arrays(flat, d)
+        return pa.array(self.data, mask=mask)
+
+    @classmethod
+    def from_arrow(cls, arr: pa.Array, dtype: DType) -> "Vector":
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        if dtype.is_varlen:
+            if pa.types.is_dictionary(arr.type):
+                arr = arr.dictionary_decode()
+            val = None
+            if arr.null_count:
+                val = ~np.asarray(arr.is_null())
+            return cls(dtype=dtype, strings=arr.cast(pa.string()), validity=val)
+        if dtype.is_vector:
+            d = arr.type.list_size
+            data = np.asarray(arr.flatten(), dtype=dtype.np_dtype).reshape(-1, d)
+            return cls(dtype=dtype, data=data)
+        val = None
+        if arr.null_count:
+            val = ~np.asarray(arr.is_null())
+            arr = arr.fill_null(0)
+        data = np.asarray(arr, dtype=dtype.np_dtype)
+        return cls(dtype=dtype, data=data, validity=val)
+
+
+def arrow_type_to_dtype(t: pa.DataType) -> DType:
+    m = {pa.bool_(): dt.BOOL, pa.int8(): dt.INT8, pa.int16(): dt.INT16,
+         pa.int32(): dt.INT32, pa.int64(): dt.INT64, pa.uint8(): dt.UINT8,
+         pa.uint16(): dt.UINT16, pa.uint32(): dt.UINT32, pa.uint64(): dt.UINT64,
+         pa.float32(): dt.FLOAT32, pa.float64(): dt.FLOAT64,
+         pa.date32(): dt.DATE}
+    if t in m:
+        return m[t]
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return dt.VARCHAR
+    if pa.types.is_fixed_size_list(t):
+        return dt.vecf32(t.list_size)
+    if pa.types.is_timestamp(t):
+        return dt.TIMESTAMP
+    raise TypeError(f"unsupported arrow type {t}")

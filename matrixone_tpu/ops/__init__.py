@@ -1,0 +1,3 @@
+from matrixone_tpu.ops import agg, distance, filter, hash, scalar, sort
+
+__all__ = ["agg", "distance", "filter", "hash", "scalar", "sort"]

@@ -1,0 +1,60 @@
+"""Selection / compaction kernels (reference: colexec/filter + Vector.Shrink).
+
+Filters produce a *mask*, not a compacted batch — downstream kernels
+(aggregate, join, top-k) consume masks directly so the filter fuses into
+them and no data moves. `compact()` exists for when cardinality drops
+enough that shipping the dense remainder is worth a scatter (the reference
+always compacts because CPU SIMD wants dense rows; TPUs prefer masks).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from matrixone_tpu.container.device import DeviceBatch, DeviceColumn
+
+
+def predicate_mask(pred: DeviceColumn, batch: DeviceBatch) -> jnp.ndarray:
+    """bool mask of rows passing a predicate column (NULL -> excluded)."""
+    data = pred.data
+    valid = pred.validity
+    if pred.is_const:
+        n = batch.padded_len
+        data = jnp.broadcast_to(data, (n,))
+        valid = jnp.broadcast_to(valid, (n,))
+    return data & valid & batch.row_mask()
+
+
+def compact(batch: DeviceBatch, mask: jnp.ndarray, capacity: int) -> DeviceBatch:
+    """Gather masked rows to the front; result padded to `capacity` rows."""
+    (idx,) = jnp.nonzero(mask, size=capacity, fill_value=0)
+    n_out = jnp.sum(mask.astype(jnp.int32))
+    out_cols = {}
+    for name, col in batch.columns.items():
+        if col.is_const:
+            out_cols[name] = col
+            continue
+        keep = jnp.arange(capacity, dtype=jnp.int32) < n_out
+        out_cols[name] = DeviceColumn(
+            data=col.data[idx],
+            validity=col.validity[idx] & keep,
+            dtype=col.dtype)
+    return DeviceBatch(columns=out_cols, n_rows=n_out)
+
+
+def gather(batch: DeviceBatch, indices: jnp.ndarray,
+           n_rows: jnp.ndarray) -> DeviceBatch:
+    """Row gather (ORDER BY / top-k materialization)."""
+    out_cols = {}
+    keep = jnp.arange(indices.shape[0], dtype=jnp.int32) < n_rows
+    for name, col in batch.columns.items():
+        if col.is_const:
+            out_cols[name] = col
+            continue
+        out_cols[name] = DeviceColumn(
+            data=col.data[indices],
+            validity=col.validity[indices] & keep,
+            dtype=col.dtype)
+    return DeviceBatch(columns=out_cols, n_rows=n_rows.astype(jnp.int32))

@@ -1,0 +1,57 @@
+"""64-bit hashing on device.
+
+Replaces the reference's hand-written amd64/arm64 assembly hashers
+(`pkg/container/hashtable/hash_amd64.s`, xxHash in `thirdparties/`) with a
+splitmix64-style finalizer expressed in jnp uint64 ops — XLA lowers these to
+int32 pairs on TPU; throughput is fine because hashing always fuses into the
+surrounding sort/aggregate pipeline instead of being a separate pass.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_GOLDEN = jnp.uint64(0x9E3779B97F4A7C15)
+_MIX1 = jnp.uint64(0xBF58476D1CE4E5B9)
+_MIX2 = jnp.uint64(0x94D049BB133111EB)
+
+
+def splitmix64(x: jnp.ndarray) -> jnp.ndarray:
+    """Finalizer of splitmix64 (public-domain PRNG): uint64 -> uint64."""
+    x = x.astype(jnp.uint64)
+    x = (x + _GOLDEN)
+    x = (x ^ (x >> jnp.uint64(30))) * _MIX1
+    x = (x ^ (x >> jnp.uint64(27))) * _MIX2
+    x = x ^ (x >> jnp.uint64(31))
+    return x
+
+
+def hash_column(data: jnp.ndarray) -> jnp.ndarray:
+    """Hash one column's values to uint64 (floats hashed by bit pattern)."""
+    if data.dtype == jnp.float64:
+        bits = data.view(jnp.uint64)
+    elif data.dtype == jnp.float32:
+        bits = data.view(jnp.uint32).astype(jnp.uint64)
+    elif data.dtype == jnp.bool_:
+        bits = data.astype(jnp.uint64)
+    else:
+        bits = data.astype(jnp.int64).view(jnp.uint64)
+    return splitmix64(bits)
+
+
+def combine(h1: jnp.ndarray, h2: jnp.ndarray) -> jnp.ndarray:
+    """Order-dependent hash combine (boost::hash_combine shape)."""
+    return splitmix64(h1 ^ (h2 + _GOLDEN + (h1 << jnp.uint64(6)) + (h1 >> jnp.uint64(2))))
+
+
+def hash_columns(columns, validities=None) -> jnp.ndarray:
+    """Row hash over multiple key columns; NULLs hash to a fixed sentinel so
+    `NULL` groups together (SQL GROUP BY treats NULLs as equal —
+    reference: hashmap's hasNull handling)."""
+    out = None
+    for i, data in enumerate(columns):
+        h = hash_column(data)
+        if validities is not None and validities[i] is not None:
+            h = jnp.where(validities[i], h, jnp.uint64(0xDEADBEEFCAFEF00D))
+        out = h if out is None else combine(out, h)
+    return out

@@ -1,0 +1,70 @@
+"""Sort and top-k kernels (reference: pkg/sort, colexec/{order,top}).
+
+Multi-column ORDER BY is a sequence of stable argsorts applied from the
+least-significant key to the most-significant (radix-style composition) —
+XLA's sort is a stable bitonic/merge network on TPU. Top-k uses
+`jax.lax.top_k`, the TPU-native primitive the reference approximates with a
+heap per pipeline (`colexec/top`).
+
+NULL ordering follows MySQL: NULLs first on ASC, last on DESC.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+def _sort_key(data: jnp.ndarray, validity: Optional[jnp.ndarray],
+              descending: bool, row_mask: jnp.ndarray) -> jnp.ndarray:
+    """Build a float64 key with MySQL null ordering; padding rows go last."""
+    if jnp.issubdtype(data.dtype, jnp.bool_):
+        key = data.astype(jnp.float64)
+    else:
+        key = data.astype(jnp.float64)
+    if descending:
+        key = -key
+    if validity is not None:
+        null_key = jnp.float64(jnp.inf) if descending else jnp.float64(-jnp.inf)
+        key = jnp.where(validity, key, null_key)
+    # padding rows always sort to the very end
+    key = jnp.where(row_mask, key, jnp.inf)
+    return key
+
+
+def sort_indices(columns: Sequence[jnp.ndarray],
+                 validities: Sequence[Optional[jnp.ndarray]],
+                 descendings: Sequence[bool],
+                 row_mask: jnp.ndarray) -> jnp.ndarray:
+    """Row permutation realizing a multi-column ORDER BY (stable)."""
+    n = row_mask.shape[0]
+    order = jnp.arange(n, dtype=jnp.int32)
+    # apply least-significant key first; stable sorts preserve prior order
+    for data, valid, desc in reversed(list(zip(columns, validities, descendings))):
+        key = _sort_key(data[order], None if valid is None else valid[order],
+                        desc, row_mask[order])
+        perm = jnp.argsort(key, stable=True)
+        order = order[perm]
+    return order
+
+
+def top_k_indices(key: jnp.ndarray, validity: Optional[jnp.ndarray],
+                  descending: bool, row_mask: jnp.ndarray,
+                  k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Indices of the top/bottom k rows by a single numeric key.
+
+    Returns (indices [k], count) where count = min(k, n_valid_rows).
+    `lax.top_k` selects maxima, so ASC keys are negated.
+    """
+    keyf = key.astype(jnp.float32) if key.dtype != jnp.float64 else key
+    score = keyf if descending else -keyf
+    if validity is not None:
+        # MySQL: NULLs first on ASC (selected ahead of values), last on DESC
+        null_score = -jnp.inf if descending else jnp.inf
+        score = jnp.where(validity, score, null_score)
+    score = jnp.where(row_mask, score, -jnp.inf)
+    import jax.lax as lax
+    _, idx = lax.top_k(score, k)
+    count = jnp.minimum(jnp.sum(row_mask.astype(jnp.int32)), k)
+    return idx.astype(jnp.int32), count

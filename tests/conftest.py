@@ -1,0 +1,29 @@
+"""Test rig: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's in-process cluster testing strategy
+(`pkg/embed/cluster.go:73` — multi-service cluster in one process): here the
+"cluster" is 8 XLA host devices, so sharding/collective paths compile and
+run without TPU hardware. Must set env before the first jax import.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The image's sitecustomize pins JAX_PLATFORMS=axon (real TPU); tests must
+# run on the virtual 8-device CPU mesh, so force it here (env var alone is
+# not enough once the axon plugin registered).
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,61 @@
+"""Container layer: host<->device round trips, nulls, dictionary encoding."""
+
+import numpy as np
+
+from matrixone_tpu.container import Batch, Vector, dtypes as dt, from_device
+from matrixone_tpu.container.device import bucket_length
+
+
+def test_bucket_length():
+    assert bucket_length(1) == 1024
+    assert bucket_length(1024) == 1024
+    assert bucket_length(1025) == 2048
+    assert bucket_length(1 << 20) == 1 << 20
+    assert bucket_length((1 << 20) + 1) == 2 << 20
+
+
+def test_fixed_roundtrip():
+    b = Batch.from_pydict(
+        {"a": [1, 2, None, 4], "b": [1.5, None, 3.5, 4.5]},
+        {"a": dt.INT64, "b": dt.FLOAT64})
+    db, dicts = b.to_device()
+    assert db.padded_len == 1024
+    assert int(db.n_rows) == 4
+    out = from_device(db, dicts)
+    assert out.columns["a"].to_pylist() == [1, 2, None, 4]
+    assert out.columns["b"].to_pylist() == [1.5, None, 3.5, 4.5]
+
+
+def test_decimal_scaling():
+    v = Vector.from_values([1.23, 45.6, None], dt.decimal64(18, 2))
+    assert v.data.tolist() == [123, 4560, 0]
+    assert v.to_pylist() == [1.23, 45.6, None]
+
+
+def test_varchar_dictionary_roundtrip():
+    b = Batch.from_pydict(
+        {"s": ["x", "y", "x", None, "z"]},
+        {"s": dt.VARCHAR})
+    db, dicts = b.to_device()
+    assert "s" in dicts
+    assert db.columns["s"].data.dtype == np.int32
+    out = from_device(db, dicts)
+    assert out.columns["s"].to_pylist() == ["x", "y", "x", None, "z"]
+
+
+def test_arrow_roundtrip():
+    b = Batch.from_pydict(
+        {"i": [1, None, 3], "s": ["a", "b", None]},
+        {"i": dt.INT32, "s": dt.VARCHAR})
+    rb = b.to_arrow()
+    b2 = Batch.from_arrow(rb)
+    assert b2.columns["i"].to_pylist() == [1, None, 3]
+    assert b2.columns["s"].to_pylist() == ["a", "b", None]
+
+
+def test_vecf32_arrow_roundtrip():
+    emb = np.arange(12, dtype=np.float32).reshape(3, 4)
+    v = Vector(dtype=dt.vecf32(4), data=emb)
+    b = Batch({"e": v})
+    b2 = Batch.from_arrow(b.to_arrow())
+    np.testing.assert_array_equal(b2.columns["e"].data, emb)

@@ -1,0 +1,121 @@
+"""Scalar kernels vs numpy oracle, incl. SQL null semantics.
+
+Mirrors the reference's kernel tests (`pkg/vectorize/*_test.go`,
+`cgo/test/`): every kernel is compared against an independent host
+implementation.
+"""
+
+import numpy as np
+
+from matrixone_tpu.container import Batch, dtypes as dt, from_device
+from matrixone_tpu.container.device import DeviceColumn
+from matrixone_tpu.ops import scalar as S
+
+
+def _col(values, dtype):
+    b = Batch.from_pydict({"x": values}, {"x": dtype})
+    db, _ = b.to_device()
+    return db.columns["x"], db
+
+
+def _pull(col: DeviceColumn, dtype, n):
+    from matrixone_tpu.container.device import DeviceBatch
+    import jax.numpy as jnp
+    db = DeviceBatch(columns={"r": col}, n_rows=jnp.asarray(n, jnp.int32))
+    return from_device(db).columns["r"].to_pylist()
+
+
+def test_add_nulls():
+    a, _ = _col([1, None, 3, 4], dt.INT64)
+    b, _ = _col([10, 20, None, 40], dt.INT64)
+    r = S.add(a, b)
+    assert _pull(r, dt.INT64, 4) == [11, None, None, 44]
+
+
+def test_decimal_add_rescale():
+    a, _ = _col([1.25, 2.50], dt.decimal64(18, 2))
+    b, _ = _col([0.125, 0.375], dt.decimal64(18, 3))
+    r = S.add(a, b)
+    assert r.dtype.scale == 3
+    assert _pull(r, r.dtype, 2) == [1.375, 2.875]
+
+
+def test_decimal_mul_scale_adds():
+    a, _ = _col([1.5], dt.decimal64(18, 1))
+    b, _ = _col([2.05], dt.decimal64(18, 2))
+    r = S.mul(a, b)
+    assert r.dtype.scale == 3
+    assert _pull(r, r.dtype, 1) == [3.075]
+
+
+def test_div_by_zero_is_null():
+    a, _ = _col([10, 20, 30], dt.INT64)
+    b, _ = _col([2, 0, 5], dt.INT64)
+    r = S.div(a, b)
+    assert _pull(r, dt.FLOAT64, 3) == [5.0, None, 6.0]
+
+
+def test_mod_sign_semantics():
+    # MySQL: -7 % 3 = -1 (dividend sign)
+    a, _ = _col([-7, 7, -7], dt.INT64)
+    b, _ = _col([3, -3, -3], dt.INT64)
+    r = S.mod(a, b)
+    assert _pull(r, dt.INT64, 3) == [-1, 1, -1]
+
+
+def test_compare_promotes():
+    a, _ = _col([1, 2, 3], dt.INT32)
+    b, _ = _col([1.5, 2.0, 2.5], dt.FLOAT64)
+    r = S.lt(a, b)
+    assert _pull(r, dt.BOOL, 3) == [True, False, False]
+
+
+def test_kleene_and_or():
+    t, _ = _col([True, True, True], dt.BOOL)
+    f, _ = _col([False, False, False], dt.BOOL)
+    n, _ = _col([None, None, None], dt.BOOL)
+    # FALSE AND NULL = FALSE ; TRUE AND NULL = NULL
+    assert _pull(S.logical_and(f, n), dt.BOOL, 3) == [False] * 3
+    assert _pull(S.logical_and(t, n), dt.BOOL, 3) == [None] * 3
+    # TRUE OR NULL = TRUE ; FALSE OR NULL = NULL
+    assert _pull(S.logical_or(t, n), dt.BOOL, 3) == [True] * 3
+    assert _pull(S.logical_or(f, n), dt.BOOL, 3) == [None] * 3
+
+
+def test_const_broadcast():
+    a, _ = _col([1, 2, 3, 4], dt.INT64)
+    c = DeviceColumn.const(10, dt.INT64)
+    r = S.mul(a, c)
+    assert _pull(r, dt.INT64, 4) == [10, 20, 30, 40]
+
+
+def test_between_and_in():
+    a, _ = _col([1, 5, 9, None], dt.INT64)
+    lo = DeviceColumn.const(2, dt.INT64)
+    hi = DeviceColumn.const(8, dt.INT64)
+    assert _pull(S.between(a, lo, hi), dt.BOOL, 4) == [False, True, False, None]
+    assert _pull(S.in_list(a, [1, 9]), dt.BOOL, 4) == [True, False, True, None]
+
+
+def test_cast_decimal_float():
+    a, _ = _col([1.25, -2.5], dt.decimal64(18, 2))
+    r = S.cast(a, dt.FLOAT64)
+    assert _pull(r, dt.FLOAT64, 2) == [1.25, -2.5]
+    back = S.cast(r, dt.decimal64(18, 2))
+    assert _pull(back, back.dtype, 2) == [1.25, -2.5]
+
+
+def test_coalesce_case():
+    a, _ = _col([None, 2, None], dt.INT64)
+    b, _ = _col([10, 20, None], dt.INT64)
+    assert _pull(S.coalesce(a, b), dt.INT64, 3) == [10, 2, None]
+    cond, _ = _col([True, False, True], dt.BOOL)
+    assert _pull(S.case_when(cond, a, b), dt.INT64, 3) == [None, 20, None]
+
+
+def test_math_builtins():
+    a, _ = _col([4.0, 9.0], dt.FLOAT64)
+    assert _pull(S.sqrt(a), dt.FLOAT64, 2) == [2.0, 3.0]
+    assert _pull(S.floor(a), dt.FLOAT64, 2) == [4.0, 9.0]
+    b, _ = _col([-3, 5], dt.INT64)
+    assert _pull(S.abs_(b), dt.INT64, 2) == [3, 5]
