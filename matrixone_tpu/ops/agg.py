@@ -85,24 +85,32 @@ def seg_count(gids, mask, max_groups: int):
                                num_segments=max_groups)
 
 
+def _reduce_fill(dtype, for_min: bool):
+    """Identity element for min/max over dtype (BOOL included)."""
+    if jnp.issubdtype(dtype, jnp.bool_):
+        return True if for_min else False
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.inf if for_min else -jnp.inf
+    info = jnp.iinfo(dtype)
+    return info.max if for_min else info.min
+
+
 @partial(jax.jit, static_argnames=("max_groups",))
 def seg_min(values, gids, mask, max_groups: int):
-    if jnp.issubdtype(values.dtype, jnp.floating):
-        fill = jnp.inf
-    else:
-        fill = jnp.iinfo(values.dtype).max
-    v = _masked(values, mask, fill)
-    return jax.ops.segment_min(v, gids, num_segments=max_groups)
+    is_bool = jnp.issubdtype(values.dtype, jnp.bool_)
+    v = _masked(values.astype(jnp.int32) if is_bool else values, mask,
+                _reduce_fill(values.dtype, True))
+    out = jax.ops.segment_min(v, gids, num_segments=max_groups)
+    return out.astype(jnp.bool_) if is_bool else out
 
 
 @partial(jax.jit, static_argnames=("max_groups",))
 def seg_max(values, gids, mask, max_groups: int):
-    if jnp.issubdtype(values.dtype, jnp.floating):
-        fill = -jnp.inf
-    else:
-        fill = jnp.iinfo(values.dtype).min
-    v = _masked(values, mask, fill)
-    return jax.ops.segment_max(v, gids, num_segments=max_groups)
+    is_bool = jnp.issubdtype(values.dtype, jnp.bool_)
+    v = _masked(values.astype(jnp.int32) if is_bool else values, mask,
+                _reduce_fill(values.dtype, False))
+    out = jax.ops.segment_max(v, gids, num_segments=max_groups)
+    return out.astype(jnp.bool_) if is_bool else out
 
 
 def gather_keys(key_columns: Sequence[jnp.ndarray],
@@ -130,16 +138,14 @@ def scalar_count(mask):
 
 
 def scalar_min(values, mask):
-    if jnp.issubdtype(values.dtype, jnp.floating):
-        fill = jnp.inf
-    else:
-        fill = jnp.iinfo(values.dtype).max
-    return jnp.min(_masked(values, mask, fill))
+    is_bool = jnp.issubdtype(values.dtype, jnp.bool_)
+    v = values.astype(jnp.int32) if is_bool else values
+    out = jnp.min(_masked(v, mask, _reduce_fill(values.dtype, True)))
+    return out.astype(jnp.bool_) if is_bool else out
 
 
 def scalar_max(values, mask):
-    if jnp.issubdtype(values.dtype, jnp.floating):
-        fill = -jnp.inf
-    else:
-        fill = jnp.iinfo(values.dtype).min
-    return jnp.max(_masked(values, mask, fill))
+    is_bool = jnp.issubdtype(values.dtype, jnp.bool_)
+    v = values.astype(jnp.int32) if is_bool else values
+    out = jnp.max(_masked(v, mask, _reduce_fill(values.dtype, False)))
+    return out.astype(jnp.bool_) if is_bool else out

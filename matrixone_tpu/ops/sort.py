@@ -6,31 +6,45 @@ XLA's sort is a stable bitonic/merge network on TPU. Top-k uses
 `jax.lax.top_k`, the TPU-native primitive the reference approximates with a
 heap per pipeline (`colexec/top`).
 
-NULL ordering follows MySQL: NULLs first on ASC, last on DESC.
+Integer/decimal keys are sorted and top-k'd **in their native integer
+domain** (descending via bitwise-not, which is total and overflow-free);
+casting int64 to float would corrupt ordering above 2^53 (and float32 above
+2^24). NULL ordering follows MySQL: NULLs first on ASC, last on DESC; it is
+applied as a separate stable class-key pass so no sentinel value can
+collide with real data.
 """
 
 from __future__ import annotations
 
 from typing import Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 
 
-def _sort_key(data: jnp.ndarray, validity: Optional[jnp.ndarray],
-              descending: bool, row_mask: jnp.ndarray) -> jnp.ndarray:
-    """Build a float64 key with MySQL null ordering; padding rows go last."""
-    if jnp.issubdtype(data.dtype, jnp.bool_):
-        key = data.astype(jnp.float64)
-    else:
-        key = data.astype(jnp.float64)
-    if descending:
-        key = -key
+def _is_int(dtype) -> bool:
+    return (jnp.issubdtype(dtype, jnp.integer)
+            or jnp.issubdtype(dtype, jnp.bool_))
+
+
+def _value_key(data: jnp.ndarray, descending: bool) -> jnp.ndarray:
+    """Order-preserving transform so ascending argsort realizes the order."""
+    if _is_int(data.dtype):
+        d = data.astype(jnp.int64) if data.dtype == jnp.bool_ else data
+        return ~d if descending else d
+    key = data.astype(jnp.float64) if data.dtype != jnp.float64 else data
+    return -key if descending else key
+
+
+def _class_key(validity: Optional[jnp.ndarray], descending: bool,
+               row_mask: jnp.ndarray) -> jnp.ndarray:
+    """0/1/2 class: nulls-first-or-last per MySQL, padding always last."""
+    n = row_mask.shape[0]
+    cls = jnp.ones((n,), jnp.int32)
     if validity is not None:
-        null_key = jnp.float64(jnp.inf) if descending else jnp.float64(-jnp.inf)
-        key = jnp.where(validity, key, null_key)
-    # padding rows always sort to the very end
-    key = jnp.where(row_mask, key, jnp.inf)
-    return key
+        null_cls = 2 if descending else 0   # DESC: nulls after values
+        cls = jnp.where(validity, cls, null_cls)
+    return jnp.where(row_mask, cls, 3)
 
 
 def sort_indices(columns: Sequence[jnp.ndarray],
@@ -40,11 +54,14 @@ def sort_indices(columns: Sequence[jnp.ndarray],
     """Row permutation realizing a multi-column ORDER BY (stable)."""
     n = row_mask.shape[0]
     order = jnp.arange(n, dtype=jnp.int32)
-    # apply least-significant key first; stable sorts preserve prior order
+    # least-significant key first; stable sorts preserve prior order.
+    # each key = value pass then null/padding class pass (both stable).
     for data, valid, desc in reversed(list(zip(columns, validities, descendings))):
-        key = _sort_key(data[order], None if valid is None else valid[order],
-                        desc, row_mask[order])
-        perm = jnp.argsort(key, stable=True)
+        vkey = _value_key(data, desc)[order]
+        perm = jnp.argsort(vkey, stable=True)
+        order = order[perm]
+        ckey = _class_key(None if valid is None else valid, desc, row_mask)[order]
+        perm = jnp.argsort(ckey, stable=True)
         order = order[perm]
     return order
 
@@ -52,19 +69,29 @@ def sort_indices(columns: Sequence[jnp.ndarray],
 def top_k_indices(key: jnp.ndarray, validity: Optional[jnp.ndarray],
                   descending: bool, row_mask: jnp.ndarray,
                   k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Indices of the top/bottom k rows by a single numeric key.
+    """Indices of the first k rows under ORDER BY key [DESC] LIMIT k.
 
-    Returns (indices [k], count) where count = min(k, n_valid_rows).
-    `lax.top_k` selects maxima, so ASC keys are negated.
+    Returns (indices [k], count) with count = min(k, n_real_rows).
+    `lax.top_k` selects maxima, so the key is transformed so that "comes
+    first" == "largest", staying in the integer domain for int keys.
     """
-    keyf = key.astype(jnp.float32) if key.dtype != jnp.float64 else key
-    score = keyf if descending else -keyf
-    if validity is not None:
-        # MySQL: NULLs first on ASC (selected ahead of values), last on DESC
-        null_score = -jnp.inf if descending else jnp.inf
-        score = jnp.where(validity, score, null_score)
-    score = jnp.where(row_mask, score, -jnp.inf)
-    import jax.lax as lax
-    _, idx = lax.top_k(score, k)
+    if _is_int(key.dtype):
+        d = key.astype(jnp.int64) if key.dtype == jnp.bool_ else key
+        score = d if descending else ~d
+        lo = jnp.iinfo(score.dtype).min
+        if validity is not None:
+            # ASC: nulls first -> top priority; DESC: nulls last but ahead
+            # of padding
+            null_score = jnp.iinfo(score.dtype).max if not descending else lo + 1
+            score = jnp.where(validity, score, null_score)
+        score = jnp.where(row_mask, score, lo)
+    else:
+        keyf = key.astype(jnp.float64) if key.dtype != jnp.float64 else key
+        score = keyf if descending else -keyf
+        if validity is not None:
+            null_score = -jnp.finfo(jnp.float64).max if descending else jnp.inf
+            score = jnp.where(validity, score, null_score)
+        score = jnp.where(row_mask, score, -jnp.inf)
+    _, idx = jax.lax.top_k(score, k)
     count = jnp.minimum(jnp.sum(row_mask.astype(jnp.int32)), k)
     return idx.astype(jnp.int32), count

@@ -1,0 +1,4 @@
+from matrixone_tpu.parallel import dist_query, mesh
+from matrixone_tpu.parallel.mesh import make_mesh, replicate, shard_rows
+
+__all__ = ["dist_query", "mesh", "make_mesh", "replicate", "shard_rows"]

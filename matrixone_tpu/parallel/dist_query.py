@@ -1,0 +1,156 @@
+"""Distributed query execution over a device mesh.
+
+TPU-native re-architecture of the reference's multi-CN execution
+(`compile/scope.go:504 ParallelRun`, `:423 RemoteRun`, `colexec/shuffle` +
+`dispatch` + `merge*`): instead of serializing operator subtrees over morpc,
+the whole plan is one `shard_map`-ed XLA program and the exchange operators
+become collectives on the ICI:
+
+  reference                      here
+  ---------------------------    -----------------------------------
+  ParallelRun DOP pipelines      rows sharded over mesh axis "shard"
+  shuffle (hash repartition)     ppermute/all_to_all inside shard_map
+  broadcast join / joinmap       all_gather of build side
+  merge group (two-phase agg)    local segment agg + psum
+  merge top-k                    local top_k + all_gather + global top_k
+
+Three canonical steps live here:
+  * sharded_group_aggregate — two-phase distributed GROUP BY
+  * sharded_topk            — distributed vector search (cuvs "sharded
+                              multi-GPU" mode, cgo/cuvs/README.md)
+  * hash_shuffle            — all_to_all repartition by key hash
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from matrixone_tpu.ops import agg as A, distance as D, hash as H
+
+
+# ---------------------------------------------------------------- group by
+
+def sharded_group_aggregate(mesh: Mesh, keys: jnp.ndarray, values: jnp.ndarray,
+                            row_mask: jnp.ndarray, max_groups: int,
+                            axis: str = "shard"):
+    """Distributed `SELECT key, sum(v), count(*) GROUP BY key`.
+
+    Phase 1 (per shard): local dense-bucket segment aggregation.
+    Phase 2: psum of the partial group tables across shards — the two-phase
+    group/mergegroup pattern (`colexec/group` + `colexec/mergegroup`),
+    with psum playing mergegroup.
+
+    EXACT when keys are dense codes in [0, max_groups) — which is how the
+    SQL layer calls it (group keys are dictionary codes / small ints). For
+    large-domain keys use hash_shuffle + per-shard ops.agg.group_ids
+    instead (co-locates equal keys, stays exact).
+
+    Returns (group_keys [max_groups], sums, counts, present_mask) replicated.
+    """
+    def step(k_sh, v_sh, m_sh):
+        bucket = jnp.clip(k_sh, 0, max_groups - 1).astype(jnp.int32)
+        sums = jax.ops.segment_sum(jnp.where(m_sh, v_sh, 0), bucket,
+                                   num_segments=max_groups)
+        counts = jax.ops.segment_sum(m_sh.astype(jnp.int64), bucket,
+                                     num_segments=max_groups)
+        keys_tbl = jax.ops.segment_max(
+            jnp.where(m_sh, k_sh, jnp.iinfo(k_sh.dtype).min), bucket,
+            num_segments=max_groups)
+        # merge partial tables across shards (mergegroup)
+        sums = jax.lax.psum(sums, axis)
+        counts = jax.lax.psum(counts, axis)
+        keys_tbl = jax.lax.pmax(keys_tbl, axis)
+        return keys_tbl, sums, counts, counts > 0
+
+    fn = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=(P(), P(), P(), P()))
+    return fn(keys, values, row_mask)
+
+
+# ----------------------------------------------------------------- top-k
+
+def sharded_topk(mesh: Mesh, vectors: jnp.ndarray, queries: jnp.ndarray,
+                 k: int, axis: str = "shard") -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Distributed exact top-k: vectors row-sharded, queries replicated.
+
+    Local matmul distances + local top_k, then all_gather(k per shard) and a
+    global top_k — the cuvs sharded-mode consolidation
+    (`pkg/cuvs/multi_index.go`) as two XLA collectives.
+    """
+    n_per, d = vectors.shape[0] // mesh.devices.size, vectors.shape[1]
+
+    def step(v_sh, q):
+        dist = D.l2_distance_sq(v_sh, q)                  # [n_sh, b]
+        top_s, top_i = jax.lax.top_k(-dist.T, k)          # [b, k] local
+        shard_no = jax.lax.axis_index(axis)
+        gids = top_i + shard_no * n_per                   # global row ids
+        all_s = jax.lax.all_gather(top_s, axis, axis=1).reshape(q.shape[0], -1)
+        all_i = jax.lax.all_gather(gids, axis, axis=1).reshape(q.shape[0], -1)
+        best_s, pos = jax.lax.top_k(all_s, k)
+        best_i = jnp.take_along_axis(all_i, pos, axis=1)
+        return -best_s, best_i
+
+    fn = jax.shard_map(step, mesh=mesh, in_specs=(P(axis), P()),
+                       out_specs=(P(), P()), check_vma=False)
+    return fn(vectors, queries)
+
+
+# ---------------------------------------------------------------- shuffle
+
+def hash_shuffle(mesh: Mesh, keys: jnp.ndarray, values: jnp.ndarray,
+                 axis: str = "shard",
+                 cap_per_dest: int | None = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """all_to_all hash repartition: row (k,v) moves to shard hash(k)%P.
+
+    The reference's `colexec/shuffle` (hash mode, shuffle.go:200) + dispatch
+    over morpc, as one ICI all_to_all. `cap_per_dest` is each destination
+    bucket's capacity per source shard: default n_per_shard (lossless but
+    output is n_dev x input rows per shard — all padding); size it to
+    ~ (n_per_shard / n_dev) * skew_factor to bound memory, accepting that
+    overflow rows beyond the cap are dropped (callers needing exactness
+    keep the default).
+
+    Returns (keys', values') re-sharded so equal keys are co-located, with
+    key == -1 marking padding slots.
+    """
+    n_dev = mesh.devices.size
+
+    def step(k_sh, v_sh):
+        n = k_sh.shape[0]
+        cap = n if cap_per_dest is None else cap_per_dest
+        dest = (H.hash_column(k_sh) % jnp.uint64(n_dev)).astype(jnp.int32)
+        # stable order by destination, then slot within destination
+        order = jnp.argsort(dest, stable=True)
+        k_srt, v_srt, d_srt = k_sh[order], v_sh[order], dest[order]
+        # position within destination bucket
+        same = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                (d_srt[1:] == d_srt[:-1]).astype(jnp.int32)])
+        # rank via cumsum segmented by destination
+        idx = jnp.arange(n)
+        seg_start = jnp.where(same == 0, idx, 0)
+        start_of_dest = jax.lax.associative_scan(jnp.maximum, seg_start)
+        rank = idx - start_of_dest
+        # scatter into [n_dev, cap] buckets (overflow rows dropped; caller
+        # sizes cap for skew)
+        slot_k = jnp.full((n_dev, cap), -1, k_sh.dtype)
+        slot_v = jnp.zeros((n_dev, cap), v_sh.dtype)
+        ok = rank < cap
+        slot_k = slot_k.at[d_srt, jnp.where(ok, rank, cap - 1)].set(
+            jnp.where(ok, k_srt, -1), mode="drop")
+        slot_v = slot_v.at[d_srt, jnp.where(ok, rank, cap - 1)].set(
+            jnp.where(ok, v_srt, 0), mode="drop")
+        # exchange: bucket p goes to device p
+        k_out = jax.lax.all_to_all(slot_k, axis, split_axis=0, concat_axis=0)
+        v_out = jax.lax.all_to_all(slot_v, axis, split_axis=0, concat_axis=0)
+        return k_out.reshape(-1), v_out.reshape(-1)
+
+    fn = jax.shard_map(step, mesh=mesh, in_specs=(P(axis), P(axis)),
+                       out_specs=(P(axis), P(axis)))
+    return fn(keys, values)

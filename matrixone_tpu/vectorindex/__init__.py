@@ -1,0 +1,5 @@
+from matrixone_tpu.vectorindex import brute_force, ivf_flat, kmeans, recall
+from matrixone_tpu.vectorindex.ivf_flat import IvfFlatIndex, build, search
+
+__all__ = ["brute_force", "ivf_flat", "kmeans", "recall",
+           "IvfFlatIndex", "build", "search"]

@@ -1,0 +1,86 @@
+"""Exact (brute-force) nearest neighbour search on TPU.
+
+Replaces the reference's cuVS brute-force path (`cgo/cuvs/` bfknn, used for
+ground truth + centroid assignment, blog.md:44) and the CPU fallback in
+`pkg/vectorindex/brute_force/`. One MXU matmul per (row-chunk x query-batch)
+with a running top-k merge carried through a `lax.scan` — memory stays
+bounded at chunk_size x batch regardless of collection size.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from matrixone_tpu.ops import distance as D
+
+METRIC_L2 = "l2"
+METRIC_COSINE = "cosine"
+METRIC_IP = "ip"
+
+
+def _chunk_scores(chunk: jnp.ndarray, queries: jnp.ndarray, metric: str,
+                  compute_dtype) -> jnp.ndarray:
+    """Lower-is-better scores [chunk, b]."""
+    if metric == METRIC_L2:
+        return D.l2_distance_sq(chunk, queries, compute_dtype=compute_dtype)
+    if metric == METRIC_COSINE:
+        # both sides pre-normalized by caller -> score = -ip
+        return -D.inner_product(chunk, queries, compute_dtype=compute_dtype)
+    if metric == METRIC_IP:
+        return -D.inner_product(chunk, queries, compute_dtype=compute_dtype)
+    raise ValueError(metric)
+
+
+@partial(jax.jit, static_argnames=("k", "metric", "chunk_size", "compute_dtype"))
+def search(dataset: jnp.ndarray, queries: jnp.ndarray, k: int,
+           n_valid=None, metric: str = METRIC_L2, chunk_size: int = 65536,
+           compute_dtype=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact top-k: -> (scores [b,k] lower-better, indices [b,k]).
+
+    dataset [n,d] must have n % chunk_size == 0 (`pad_dataset`); rows with
+    id >= n_valid are masked out (metric-independent, unlike sentinel
+    values); queries [b,d].
+    """
+    n, d = dataset.shape
+    b = queries.shape[0]
+    assert n % chunk_size == 0, "pad dataset to a chunk multiple"
+    if n_valid is None:
+        n_valid = n
+    n_valid = jnp.asarray(n_valid, jnp.int32)
+    n_chunks = n // chunk_size
+    chunks = dataset.reshape(n_chunks, chunk_size, d)
+
+    init_scores = jnp.full((b, k), jnp.inf, jnp.float32)
+    init_idx = jnp.full((b, k), -1, jnp.int32)
+
+    def step(carry, inp):
+        best_s, best_i = carry
+        chunk, chunk_no = inp
+        s = _chunk_scores(chunk, queries, metric, compute_dtype).T  # [b, chunk]
+        row_ids = chunk_no * chunk_size + jnp.arange(chunk_size, dtype=jnp.int32)
+        s = jnp.where(row_ids[None, :] < n_valid, s, jnp.inf)
+        cand_s = jnp.concatenate([best_s, s], axis=1)
+        cand_i = jnp.concatenate([best_i, jnp.broadcast_to(row_ids, (b, chunk_size))], axis=1)
+        top_s, pos = jax.lax.top_k(-cand_s, k)
+        new_i = jnp.take_along_axis(cand_i, pos, axis=1)
+        return (-top_s, new_i), None
+
+    (scores, idx), _ = jax.lax.scan(
+        step, (init_scores, init_idx),
+        (chunks, jnp.arange(n_chunks, dtype=jnp.int32)))
+    return scores, idx
+
+
+def pad_dataset(dataset: jnp.ndarray, chunk_size: int = 65536):
+    """Pad rows (zeros) to a chunk multiple; returns (padded [m,d], n_real).
+    Pass n_real as `search(n_valid=...)` so pad rows are masked out."""
+    n, d = dataset.shape
+    m = ((n + chunk_size - 1) // chunk_size) * chunk_size
+    if m == n:
+        return dataset, n
+    pad = jnp.zeros((m - n, d), dataset.dtype)
+    return jnp.concatenate([dataset, pad]), n

@@ -1,0 +1,193 @@
+"""IVF-Flat index: build + batched search, all on TPU.
+
+TPU-native replacement for the reference's IVF-Flat stack:
+`pkg/vectorindex/ivfflat/{build,search}.go` (CPU, SQL re-entry per query),
+`cgo/cuvs/ivf_flat_c.cpp` (GPU worker). Design differences, all deliberate:
+
+ * build = k-means on the MXU (kmeans.py) + one argsort: vectors are stored
+   *cluster-major* (sorted by label) with CSR offsets — the "inverted lists"
+   are contiguous slices, so probing a cluster is a dense dynamic-slice
+   gather, never pointer chasing;
+ * search is batched: queries are processed in fixed-size chunks; each chunk
+   top-nprobes the centroid table (one matmul), gathers its probed clusters
+   into a padded [chunk, nprobe*pad, d] tensor, and scores candidates with
+   one more matmul. `pad` = max cluster size, kept near the mean by the
+   balanced k-means penalty (same reason cuVS balances: blog.md:36);
+ * optional exact re-rank of the final k in f64 sequential order makes
+   results bit-identical to the CPU scalar path (BASELINE.json requirement).
+
+The index is a pytree of device arrays — it lives in HBM between queries,
+exactly like the cuvs_worker_t's persistent device-resident indexes
+(`cgo/cuvs/README.md`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from matrixone_tpu.ops import distance as D
+from matrixone_tpu.vectorindex import kmeans
+
+METRIC_L2 = "l2"
+METRIC_COSINE = "cosine"
+METRIC_IP = "ip"
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class IvfFlatIndex:
+    centroids: jnp.ndarray   # [nlist, d] f32
+    vectors: jnp.ndarray     # [n_pad, d] cluster-major (storage dtype)
+    norms2: jnp.ndarray      # [n_pad] f32 squared norms (l2 metric)
+    ids: jnp.ndarray         # [n_pad] int32 original row position (-1 pad)
+    offsets: jnp.ndarray     # [nlist+1] int32 CSR into vectors
+    # static:
+    metric: str = METRIC_L2
+    max_cluster_size: int = 0
+    n: int = 0
+
+    def tree_flatten(self):
+        return ((self.centroids, self.vectors, self.norms2, self.ids,
+                 self.offsets),
+                (self.metric, self.max_cluster_size, self.n))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        metric, mcs, n = aux
+        c, v, nr, i, o = children
+        return cls(centroids=c, vectors=v, norms2=nr, ids=i, offsets=o,
+                   metric=metric, max_cluster_size=mcs, n=n)
+
+    @property
+    def nlist(self) -> int:
+        return self.centroids.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centroids.shape[1]
+
+
+def build(dataset: jnp.ndarray, nlist: int, metric: str = METRIC_L2,
+          n_iter: int = 10, seed: int = 0, storage_dtype=None,
+          balance_weight: float = 0.3, kmeans_sample: Optional[int] = 262144,
+          compute_dtype=jnp.bfloat16) -> IvfFlatIndex:
+    """Build an IVF-Flat index on device.
+
+    cosine metric stores normalized vectors (cosine -> inner product), the
+    same trick the reference applies in vectorindex/metric.
+    """
+    n, d = dataset.shape
+    data = jnp.asarray(dataset)
+    if metric == METRIC_COSINE:
+        data = D.normalize(data)
+    km = kmeans.fit(data, nlist, n_iter=n_iter, seed=seed,
+                    balance_weight=balance_weight, sample=kmeans_sample,
+                    compute_dtype=compute_dtype)
+    labels = km.labels
+    order = jnp.argsort(labels).astype(jnp.int32)
+    counts = km.cluster_sizes
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(counts).astype(jnp.int32)])
+    sorted_vecs = data[order]
+    norms2 = jnp.sum(jnp.square(sorted_vecs.astype(jnp.float32)), axis=-1)
+    if storage_dtype is not None:
+        sorted_vecs = sorted_vecs.astype(storage_dtype)
+    max_cs = int(jnp.max(counts))
+    max_cs = ((max_cs + 127) // 128) * 128  # lane-align the gather budget
+    return IvfFlatIndex(centroids=km.centroids, vectors=sorted_vecs,
+                        norms2=norms2, ids=order, offsets=offsets,
+                        metric=metric, max_cluster_size=max_cs, n=n)
+
+
+@partial(jax.jit, static_argnames=("k", "nprobe", "query_chunk",
+                                   "compute_dtype"))
+def search(index: IvfFlatIndex, queries: jnp.ndarray, k: int, nprobe: int,
+           query_chunk: int = 32,
+           compute_dtype=jnp.bfloat16) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched IVF search -> (distances [b,k], row_positions [b,k] int32).
+
+    Distances are squared l2 (metric=l2) or 1-ip (cosine/ip). b must be a
+    multiple of query_chunk (pad queries host-side).
+    """
+    b, d = queries.shape
+    assert b % query_chunk == 0, (
+        f"query batch {b} must be a multiple of query_chunk={query_chunk}; "
+        f"pad queries host-side (ids of pad rows are discarded)")
+    q = queries.astype(jnp.float32)
+    if index.metric == METRIC_COSINE:
+        q = D.normalize(q)
+    # 1) probe centroids: [b, nlist] -> top-nprobe clusters per query
+    if index.metric == METRIC_L2:
+        cdist = D.l2_distance_sq(q, index.centroids)   # [b, nlist]
+    else:
+        cdist = -D.inner_product(q, index.centroids)
+    _, probes = jax.lax.top_k(-cdist, nprobe)  # [b, nprobe]
+
+    pad = index.max_cluster_size
+    n_chunks = b // query_chunk
+    q_chunks = q.reshape(n_chunks, query_chunk, d)
+    probe_chunks = probes.reshape(n_chunks, query_chunk, nprobe)
+
+    def step(_, inp):
+        qc, pc = inp  # [qc, d], [qc, nprobe]
+        starts = index.offsets[pc]                     # [qc, nprobe]
+        ends = index.offsets[pc + 1]
+        lane = jnp.arange(pad, dtype=jnp.int32)
+        cand = starts[:, :, None] + lane[None, None, :]   # [qc, nprobe, pad]
+        valid = cand < ends[:, :, None]
+        cand = jnp.where(valid, cand, 0)
+        m = nprobe * pad
+        cand_flat = cand.reshape(query_chunk, m)          # [qc, m]
+        vecs = index.vectors[cand_flat]                   # [qc, m, d]
+        # score all chunk queries against all candidates in one MXU matmul,
+        # then take each query's own row (flops are cheaper than a second
+        # HBM pass; see module docstring)
+        dots = jax.lax.dot_general(
+            vecs.astype(compute_dtype), qc.astype(compute_dtype),
+            dimension_numbers=(((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [qc, m, qc]
+        own = jnp.take_along_axis(
+            dots, jnp.arange(query_chunk)[:, None, None], axis=2)[:, :, 0]
+        if index.metric == METRIC_L2:
+            v2 = index.norms2[cand_flat]                  # [qc, m]
+            q2 = jnp.sum(qc * qc, axis=-1)                # [qc]
+            dist = jnp.maximum(v2 + q2[:, None] - 2.0 * own, 0.0)
+        else:
+            dist = 1.0 - own
+        dist = jnp.where(valid.reshape(query_chunk, m), dist, jnp.inf)
+        top_s, top_pos = jax.lax.top_k(-dist, k)          # [qc, k]
+        top_cand = jnp.take_along_axis(cand_flat, top_pos, axis=1)
+        top_ids = index.ids[top_cand]
+        return None, (-top_s, top_ids.astype(jnp.int32))
+
+    _, (dists, ids) = jax.lax.scan(step, None, (q_chunks, probe_chunks))
+    return dists.reshape(b, k), ids.reshape(b, k)
+
+
+def rerank_exact(dataset: jnp.ndarray, queries: jnp.ndarray,
+                 ids: jnp.ndarray, metric: str = METRIC_L2
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Re-score candidate ids with the f64 sequential-order rowwise kernel
+    and re-sort — final (distances, ids) are bit-identical to the CPU scalar
+    path (`l2_distance` SQL function) applied to the same candidates."""
+    b, k = ids.shape
+    cand = dataset[ids.reshape(-1)].reshape(b, k, -1)
+    qe = jnp.repeat(queries[:, None, :], k, axis=1)
+    if metric == METRIC_L2:
+        dist = D.l2_distance_rowwise(cand.reshape(b * k, -1),
+                                     qe.reshape(b * k, -1)).reshape(b, k)
+    elif metric == METRIC_COSINE:
+        dist = D.cosine_distance_rowwise(cand.reshape(b * k, -1),
+                                         qe.reshape(b * k, -1)).reshape(b, k)
+    else:
+        dist = -D.inner_product_rowwise(cand.reshape(b * k, -1),
+                                        qe.reshape(b * k, -1)).reshape(b, k)
+    order = jnp.argsort(dist, axis=1)
+    return (jnp.take_along_axis(dist, order, axis=1),
+            jnp.take_along_axis(ids, order, axis=1))

@@ -1,0 +1,101 @@
+"""K-means clustering on the MXU (IVF index build).
+
+TPU-native replacement for the reference's CPU k-means
+(`pkg/vectorindex/ivfflat/kmeans/`) and cuVS balanced k-means
+(`cgo/cuvs/kmeans_c.cpp`, blog.md:36 — the 5min->5s win this design chases).
+Lloyd iterations where the assignment step is one big matmul
+(argmin over l2_distance_sq) and the update step is a segment-sum — both
+native XLA. Includes the cuVS-style balancing nudge: oversized clusters'
+points are repelled by a size penalty so `max_cluster_size` (which sets the
+padded gather budget in ivf_flat.search) stays near the mean.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from matrixone_tpu.ops import distance as D
+
+
+class KMeansResult(NamedTuple):
+    centroids: jnp.ndarray   # [k, d] float32
+    labels: jnp.ndarray      # [n] int32
+    cluster_sizes: jnp.ndarray  # [k] int32
+
+
+@partial(jax.jit, static_argnames=("chunk_size", "compute_dtype"))
+def assign(data: jnp.ndarray, centroids: jnp.ndarray,
+           chunk_size: int = 131072, compute_dtype=None) -> jnp.ndarray:
+    """Nearest-centroid labels [n] via chunked matmul distances."""
+    n, d = data.shape
+    pad = (-n) % chunk_size
+    padded = jnp.concatenate([data, jnp.zeros((pad, d), data.dtype)]) if pad else data
+    chunks = padded.reshape(-1, chunk_size, d)
+
+    def step(_, chunk):
+        dist = D.l2_distance_sq(chunk, centroids, compute_dtype=compute_dtype)
+        return None, jnp.argmin(dist, axis=1).astype(jnp.int32)
+
+    _, labels = jax.lax.scan(step, None, chunks)
+    return labels.reshape(-1)[:n]
+
+
+@partial(jax.jit, static_argnames=("k", "balance_weight", "chunk_size",
+                                   "compute_dtype"))
+def _lloyd_step(data, centroids, sizes, k: int, balance_weight: float,
+                chunk_size: int, compute_dtype):
+    n, d = data.shape
+    pad = (-n) % chunk_size
+    padded = jnp.concatenate([data, jnp.zeros((pad, d), data.dtype)]) if pad else data
+    chunks = padded.reshape(-1, chunk_size, d)
+    mean_size = n / k
+    # size penalty (soft balancing): distance += w * mean_dist * size/mean
+    penalty = balance_weight * (sizes.astype(jnp.float32) / mean_size)
+
+    def step(_, chunk):
+        dist = D.l2_distance_sq(chunk, centroids, compute_dtype=compute_dtype)
+        scale = jnp.mean(dist, axis=1, keepdims=True)
+        return None, jnp.argmin(dist + penalty[None, :] * scale, axis=1).astype(jnp.int32)
+
+    _, labels = jax.lax.scan(step, None, chunks)
+    labels = labels.reshape(-1)[:n]
+    counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), labels, num_segments=k)
+    sums = jax.ops.segment_sum(data.astype(jnp.float32), labels, num_segments=k)
+    nonzero = counts > 0
+    new_centroids = jnp.where(
+        nonzero[:, None], sums / jnp.maximum(counts, 1)[:, None].astype(jnp.float32),
+        centroids)
+    return new_centroids, labels, counts
+
+
+def fit(data: jnp.ndarray, k: int, n_iter: int = 10, seed: int = 0,
+        balance_weight: float = 0.0, chunk_size: int = 131072,
+        compute_dtype=None, sample: int | None = 262144) -> KMeansResult:
+    """Train k-means; optionally on a row sample (centroid quality needs far
+    fewer points than assignment — the reference trains on a sample too,
+    ivfflat/kmeans). Final labels are assigned over the full dataset."""
+    n, d = data.shape
+    key = jax.random.PRNGKey(seed)
+    train = data
+    if sample is not None and sample < n:
+        idx = jax.random.choice(key, n, (sample,), replace=False)
+        train = data[idx]
+    # init: random distinct points
+    init_idx = jax.random.choice(jax.random.fold_in(key, 1),
+                                 train.shape[0], (k,), replace=False)
+    centroids = train[init_idx].astype(jnp.float32)
+    sizes = jnp.zeros((k,), jnp.int32)
+    for i in range(n_iter):
+        w = balance_weight if i >= n_iter // 2 else 0.0  # balance late iters
+        centroids, labels, sizes = _lloyd_step(
+            train, centroids, sizes, k, w, chunk_size, compute_dtype)
+    full_labels = assign(data, centroids, chunk_size=chunk_size,
+                         compute_dtype=compute_dtype)
+    counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), full_labels,
+                                 num_segments=k)
+    return KMeansResult(centroids=centroids, labels=full_labels,
+                        cluster_sizes=counts)

@@ -1,0 +1,14 @@
+"""Recall@k harness (reference: pkg/cuvs/recall_test.go)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def recall_at_k(found_ids: np.ndarray, truth_ids: np.ndarray) -> float:
+    """Mean |found ∩ truth| / k over queries; inputs [b, k]."""
+    b, k = truth_ids.shape
+    hits = 0
+    for i in range(b):
+        hits += len(set(found_ids[i, :k].tolist()) & set(truth_ids[i].tolist()))
+    return hits / (b * k)

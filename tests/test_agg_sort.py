@@ -111,3 +111,63 @@ def test_compact_and_gather(rng):
     assert n_out == len([v for v in vals if v % 3 == 0])
     np.testing.assert_array_equal(
         np.asarray(out.columns["x"].data)[:n_out], vals[vals % 3 == 0])
+
+
+def test_top_k_bigint_precision():
+    # int keys >= 2^53 must not collapse (regression: f32/f64 cast bug)
+    import jax.numpy as jnp
+    base = 2 ** 60
+    vals = np.array([base, base + 1, base + 2, base - 1], dtype=np.int64)
+    padded = 1024
+    row_mask = jnp.asarray(_pad(np.ones(4, bool), padded, False))
+    key = jnp.asarray(_pad(vals, padded))
+    idx, cnt = msort.top_k_indices(key, None, descending=True,
+                                   row_mask=row_mask, k=2)
+    assert np.asarray(idx).tolist() == [2, 1]
+    idx, _ = msort.top_k_indices(key, None, descending=False,
+                                 row_mask=row_mask, k=2)
+    assert np.asarray(idx).tolist() == [3, 0]
+
+
+def test_sort_bigint_precision():
+    import jax.numpy as jnp
+    base = 2 ** 60
+    vals = np.array([base + 2, base, base + 1], dtype=np.int64)
+    padded = 1024
+    row_mask = jnp.asarray(_pad(np.ones(3, bool), padded, False))
+    order = msort.sort_indices([jnp.asarray(_pad(vals, padded))], [None],
+                               [False], row_mask)
+    assert np.asarray(order)[:3].tolist() == [1, 2, 0]
+
+
+def test_minmax_bool():
+    import jax.numpy as jnp
+    vals = np.array([True, False, True, False])
+    keys = np.array([0, 0, 1, 1], dtype=np.int64)
+    padded = 1024
+    mask = jnp.asarray(_pad(np.ones(4, bool), padded, False))
+    gi = agg.group_ids([jnp.asarray(_pad(keys, padded))], [None], mask, 16)
+    mn = agg.seg_min(jnp.asarray(_pad(vals, padded)), gi.gids, mask, 16)
+    mx = agg.seg_max(jnp.asarray(_pad(vals, padded)), gi.gids, mask, 16)
+    rep_keys = np.asarray(jnp.asarray(_pad(keys, padded))[gi.rep_rows])[:2]
+    for g, k in enumerate(rep_keys):
+        assert bool(mn[g]) == False  # both groups contain a False
+        assert bool(mx[g]) == True
+    assert bool(agg.scalar_min(jnp.asarray(_pad(vals, padded)), mask)) == False
+    assert bool(agg.scalar_max(jnp.asarray(_pad(vals, padded)), mask)) == True
+
+
+def test_sort_nulls_ordering():
+    import jax.numpy as jnp
+    vals = np.array([5, 3, 9, 7], dtype=np.int64)
+    valid = np.array([True, False, True, True])
+    padded = 1024
+    row_mask = jnp.asarray(_pad(np.ones(4, bool), padded, False))
+    v = jnp.asarray(_pad(vals, padded))
+    va = jnp.asarray(_pad(valid, padded, False))
+    # ASC: nulls first
+    order = msort.sort_indices([v], [va], [False], row_mask)
+    assert np.asarray(order)[:4].tolist() == [1, 0, 3, 2]
+    # DESC: nulls last
+    order = msort.sort_indices([v], [va], [True], row_mask)
+    assert np.asarray(order)[:4].tolist() == [2, 3, 0, 1]

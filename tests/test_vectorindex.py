@@ -1,0 +1,105 @@
+"""IVF-Flat / k-means / brute force on small data (CPU mesh), recall checks."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from matrixone_tpu.vectorindex import brute_force, ivf_flat, kmeans
+from matrixone_tpu.vectorindex.recall import recall_at_k
+
+
+def _clustered_data(rng, n=20000, d=32, n_clusters=50):
+    centers = rng.standard_normal((n_clusters, d)) * 5
+    labels = rng.integers(0, n_clusters, n)
+    return (centers[labels] + rng.standard_normal((n, d))).astype(np.float32)
+
+
+def test_brute_force_exact(rng):
+    x = rng.standard_normal((5000, 16)).astype(np.float32)
+    q = rng.standard_normal((8, 16)).astype(np.float32)
+    padded, n = brute_force.pad_dataset(jnp.asarray(x), chunk_size=1024)
+    scores, idx = brute_force.search(padded, jnp.asarray(q), k=10,
+                                     n_valid=n, chunk_size=1024)
+    oracle = np.argsort(((x[:, None].astype(np.float64)
+                          - q[None].astype(np.float64)) ** 2).sum(-1), axis=0)[:10].T
+    assert recall_at_k(np.asarray(idx), oracle) == 1.0
+    assert np.asarray(idx).max() < n  # padding never returned
+
+
+def test_kmeans_clusters(rng):
+    x = _clustered_data(rng)
+    km = kmeans.fit(jnp.asarray(x), 50, n_iter=8, sample=None)
+    assert int(km.cluster_sizes.sum()) == len(x)
+    # every point's centroid is closer than a random centroid on average
+    c = np.asarray(km.centroids)
+    lab = np.asarray(km.labels)
+    own = np.linalg.norm(x - c[lab], axis=1).mean()
+    rnd = np.linalg.norm(x - c[(lab + 7) % 50], axis=1).mean()
+    assert own < rnd * 0.6
+
+
+def test_kmeans_balance(rng):
+    x = _clustered_data(rng, n=10000)
+    km_bal = kmeans.fit(jnp.asarray(x), 32, n_iter=10, balance_weight=0.5,
+                        sample=None)
+    sizes = np.asarray(km_bal.cluster_sizes)
+    assert sizes.max() <= sizes.mean() * 4  # no degenerate mega-cluster
+
+
+def test_ivf_flat_recall_and_structure(rng):
+    x = _clustered_data(rng, n=20000, d=32)
+    q = x[rng.integers(0, len(x), 32)] + 0.01 * rng.standard_normal((32, 32)).astype(np.float32)
+    q = q.astype(np.float32)
+    index = ivf_flat.build(jnp.asarray(x), nlist=64, n_iter=8,
+                           kmeans_sample=None, compute_dtype=None)
+    # CSR structure invariants
+    offs = np.asarray(index.offsets)
+    assert offs[0] == 0 and offs[-1] == len(x)
+    assert (np.diff(offs) >= 0).all()
+    assert (np.diff(offs).max()) <= index.max_cluster_size
+    assert sorted(np.asarray(index.ids).tolist()) == list(range(len(x)))
+
+    dist, ids = ivf_flat.search(index, jnp.asarray(q), k=10, nprobe=8,
+                                query_chunk=16, compute_dtype=jnp.float32)
+    padded, n = brute_force.pad_dataset(jnp.asarray(x), chunk_size=4096)
+    _, truth = brute_force.search(padded, jnp.asarray(q), k=10, n_valid=n,
+                                  chunk_size=4096)
+    r = recall_at_k(np.asarray(ids), np.asarray(truth))
+    assert r >= 0.9, r
+    # distances must be sorted ascending per query
+    dd = np.asarray(dist)
+    assert (np.diff(dd, axis=1) >= -1e-5).all()
+
+
+def test_ivf_cosine_metric(rng):
+    x = rng.standard_normal((8000, 24)).astype(np.float32)
+    q = rng.standard_normal((16, 24)).astype(np.float32)
+    index = ivf_flat.build(jnp.asarray(x), nlist=32, metric="cosine",
+                           n_iter=8, kmeans_sample=None, compute_dtype=None)
+    dist, ids = ivf_flat.search(index, jnp.asarray(q), k=5, nprobe=16,
+                                query_chunk=16, compute_dtype=jnp.float32)
+    # oracle cosine
+    xn = x / np.linalg.norm(x, axis=1, keepdims=True)
+    qn = q / np.linalg.norm(q, axis=1, keepdims=True)
+    truth = np.argsort(1 - xn @ qn.T, axis=0)[:5].T
+    assert recall_at_k(np.asarray(ids), truth) >= 0.85
+
+
+def test_rerank_exact_orders_bit_identically(rng):
+    x = rng.standard_normal((2000, 16)).astype(np.float32)
+    q = rng.standard_normal((4, 16)).astype(np.float32)
+    index = ivf_flat.build(jnp.asarray(x), nlist=16, n_iter=5,
+                           kmeans_sample=None, compute_dtype=None)
+    _, ids = ivf_flat.search(index, jnp.asarray(q), k=10, nprobe=16,
+                             query_chunk=4, compute_dtype=jnp.float32)
+    dist, ids2 = ivf_flat.rerank_exact(jnp.asarray(x), jnp.asarray(q), ids)
+    # oracle: same sequential f64 fold on host
+    for i in range(4):
+        cand = x[np.asarray(ids)[i]].astype(np.float64)
+        sq = (cand - q[i].astype(np.float64)) ** 2
+        acc = np.zeros(len(cand))
+        for j in range(sq.shape[1]):
+            acc = acc + sq[:, j]
+        exp = np.sqrt(acc)
+        order = np.argsort(exp)
+        np.testing.assert_array_equal(np.asarray(ids2)[i], np.asarray(ids)[i][order])
+        np.testing.assert_array_equal(np.asarray(dist)[i], exp[order])
