@@ -97,8 +97,12 @@ def from_device(dbatch: dev.DeviceBatch, dicts: Optional[HostDicts] = None,
             val = np.broadcast_to(val, (n,)).copy()
         data, val = data[:n], val[:n]
         dtype = (schema or {}).get(name, col.dtype)
-        if name in dicts:
-            lut = np.asarray(dicts[name], dtype=object)
+        if name in dicts or dtype.is_varlen:
+            if name not in dicts and n > 0 and val.any():
+                raise ValueError(
+                    f"varchar column {name!r} reached the host without a "
+                    f"dictionary — an operator dropped dict propagation")
+            lut = np.asarray(dicts.get(name, []), dtype=object)
             strings = pa.array(
                 [lut[c] if v else None for c, v in zip(data, val)],
                 type=pa.string())

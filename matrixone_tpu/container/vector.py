@@ -47,6 +47,19 @@ class Vector:
         if dtype.oid == TypeOid.DECIMAL64:
             scaled = [int(round(float(v) * 10 ** dtype.scale)) for v in filled]
             data = np.array(scaled, dtype=np.int64)
+        elif dtype.oid == TypeOid.DATE:
+            import datetime
+            epoch = datetime.date(1970, 1, 1)
+            days = [(v - epoch).days if isinstance(v, datetime.date) else int(v)
+                    for v in filled]
+            data = np.asarray(days, dtype=np.int32)
+        elif dtype.oid in (TypeOid.DATETIME, TypeOid.TIMESTAMP):
+            import datetime
+            epoch = datetime.datetime(1970, 1, 1)
+            us = [int((v - epoch).total_seconds() * 1e6)
+                  if isinstance(v, datetime.datetime) else int(v)
+                  for v in filled]
+            data = np.asarray(us, dtype=np.int64)
         else:
             data = np.asarray(filled, dtype=dtype.np_dtype)
         return cls(dtype=dtype, data=data,
@@ -80,6 +93,16 @@ class Vector:
             scale = 10 ** self.dtype.scale
             return [int(v) / scale if m else None
                     for v, m in zip(self.data, mask)]
+        if self.dtype.oid == TypeOid.DATE:
+            import datetime
+            epoch = datetime.date(1970, 1, 1)
+            return [epoch + datetime.timedelta(days=int(v)) if m else None
+                    for v, m in zip(self.data, mask)]
+        if self.dtype.oid in (TypeOid.DATETIME, TypeOid.TIMESTAMP):
+            import datetime
+            epoch = datetime.datetime(1970, 1, 1)
+            return [epoch + datetime.timedelta(microseconds=int(v)) if m
+                    else None for v, m in zip(self.data, mask)]
         return [self.data[i].item() if mask[i] else None
                 for i in range(len(self))]
 

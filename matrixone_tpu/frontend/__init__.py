@@ -1,0 +1,3 @@
+from matrixone_tpu.frontend.session import Result, Session
+
+__all__ = ["Result", "Session"]

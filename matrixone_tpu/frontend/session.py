@@ -1,0 +1,236 @@
+"""Session: SQL text in, result batches out.
+
+Reference analogue: the frontend's doComQuery -> buildPlan -> Compile -> Run
+chain (`frontend/mysql_cmd_executor.go:4160`) minus the wire protocol (the
+server lives in matrixone_tpu.frontend.server). DDL/DML execute directly
+against the catalog; SELECT goes parse -> bind -> compile -> pull loop ->
+host Batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from matrixone_tpu.container import Batch, Vector, dtypes as dt, from_device
+from matrixone_tpu.container.dtypes import DType, TypeOid
+from matrixone_tpu.sql import ast, plan as P
+from matrixone_tpu.sql.binder import Binder, BindError, type_from_name
+from matrixone_tpu.sql.parser import parse
+from matrixone_tpu.storage.memtable import Catalog, IndexMeta, MemTable, TableMeta
+from matrixone_tpu.vm.compile import compile_plan
+
+
+@dataclasses.dataclass
+class Result:
+    batch: Optional[Batch] = None        # SELECT results
+    affected: int = 0                    # DML row count
+    text: Optional[str] = None           # EXPLAIN / SHOW output
+
+    def rows(self) -> List[tuple]:
+        if self.batch is None:
+            return []
+        names = list(self.batch.columns)
+        cols = [self.batch.columns[n].to_pylist() for n in names]
+        return [tuple(vals) for vals in zip(*cols)] if cols else []
+
+    @property
+    def column_names(self) -> List[str]:
+        return list(self.batch.columns) if self.batch else []
+
+
+class Session:
+    """One client session (reference: frontend.Session); system variables
+    and (later) transaction state hang off this object."""
+
+    def __init__(self, catalog: Optional[Catalog] = None):
+        self.catalog = catalog if catalog is not None else Catalog()
+        self.variables = {"gpu_mode": 1, "batch_rows": 1 << 20}
+
+    # ------------------------------------------------------------ execute
+    def execute(self, sql: str, params: Optional[list] = None) -> Result:
+        stmts = parse(sql)
+        if params is not None:
+            stmts = [_substitute_params(st, params) for st in stmts]
+        results = [self._execute_stmt(s) for s in stmts]
+        return results[-1] if results else Result()
+
+    def _execute_stmt(self, stmt: ast.Node) -> Result:
+        if isinstance(stmt, ast.Select):
+            return self._select(stmt)
+        if isinstance(stmt, ast.CreateTable):
+            return self._create_table(stmt)
+        if isinstance(stmt, ast.DropTable):
+            self.catalog.drop_table(stmt.name, stmt.if_exists)
+            return Result()
+        if isinstance(stmt, ast.CreateIndex):
+            return self._create_index(stmt)
+        if isinstance(stmt, ast.Insert):
+            return self._insert(stmt)
+        if isinstance(stmt, ast.Explain):
+            binder = Binder(self.catalog)
+            if not isinstance(stmt.stmt, ast.Select):
+                raise BindError("EXPLAIN supports SELECT only for now")
+            node = binder.bind_select(stmt.stmt)
+            return Result(text=P.explain(node))
+        if isinstance(stmt, ast.ShowTables):
+            names = sorted(self.catalog.tables)
+            b = Batch.from_pydict({"Tables": names},
+                                  {"Tables": dt.VARCHAR})
+            return Result(batch=b)
+        if isinstance(stmt, ast.SetVariable):
+            if isinstance(stmt.value, ast.Literal):
+                self.variables[stmt.name] = stmt.value.value
+            return Result()
+        if isinstance(stmt, (ast.BeginTxn, ast.CommitTxn, ast.RollbackTxn)):
+            return Result()   # txn layer lands with the MVCC storage engine
+        raise BindError(f"unsupported statement {type(stmt).__name__}")
+
+    # ------------------------------------------------------------- select
+    def _select(self, sel: ast.Select) -> Result:
+        node = Binder(self.catalog).bind_select(sel)
+        op = compile_plan(node, self.catalog)
+        out_batches = []
+        for ex in op.execute():
+            out_batches.append(self._to_host(ex, node.schema))
+        if not out_batches:
+            empty = {n: Vector.from_values([], d) for n, d in node.schema}
+            return Result(batch=Batch(empty))
+        if len(out_batches) == 1:
+            return Result(batch=out_batches[0])
+        # concatenate host batches
+        cols = {}
+        for n, d in node.schema:
+            vals = []
+            for b in out_batches:
+                vals.extend(b.columns[n].to_pylist())
+            cols[n] = Vector.from_values(vals, d)
+        return Result(batch=Batch(cols))
+
+    def _to_host(self, ex, schema) -> Batch:
+        from matrixone_tpu.ops import filter as F
+        # compact masked rows before leaving device
+        n_out = jnp.sum(ex.mask.astype(jnp.int32))
+        cap = ex.padded_len
+        db = F.compact(ex.batch, ex.mask, cap)
+        return from_device(db, ex.dicts, schema=dict(schema))
+
+    # --------------------------------------------------------------- ddl
+    def _create_table(self, stmt: ast.CreateTable) -> Result:
+        schema = [(c.name, type_from_name(c.type_name, c.type_args))
+                  for c in stmt.columns]
+        self.catalog.create_table(
+            TableMeta(stmt.name, schema, stmt.primary_key),
+            if_not_exists=stmt.if_not_exists)
+        return Result()
+
+    def _create_index(self, stmt: ast.CreateIndex) -> Result:
+        table = self.catalog.get_table(stmt.table)
+        algo = (stmt.using or "").lower()
+        if algo in ("ivfflat", "ivf_flat"):
+            from matrixone_tpu.vectorindex import ivf_flat
+            col = stmt.columns[0]
+            coltype = dict(table.meta.schema)[col]
+            if not coltype.is_vector:
+                raise BindError(f"ivfflat index requires a vecf32 column")
+            data = table.read_column_f32(col)
+            nlist = int(stmt.options.get("lists", 64))
+            op_type = stmt.options.get("op_type", "vector_l2_ops")
+            metric = {"vector_l2_ops": "l2", "vector_cosine_ops": "cosine",
+                      "vector_ip_ops": "ip"}.get(op_type, "l2")
+            idx = ivf_flat.build(jnp.asarray(data), nlist=nlist,
+                                 metric=metric)
+            self.catalog.indexes[stmt.name] = IndexMeta(
+                stmt.name, stmt.table, stmt.columns, "ivfflat",
+                dict(stmt.options), index_obj=idx)
+            return Result()
+        raise BindError(f"unsupported index algo {stmt.using!r}")
+
+    # --------------------------------------------------------------- dml
+    def _insert(self, stmt: ast.Insert) -> Result:
+        table = self.catalog.get_table(stmt.table)
+        schema = table.meta.schema
+        cols = stmt.columns or [c for c, _ in schema]
+        if stmt.select is not None:
+            sub = self._select(stmt.select)
+            data = {c: sub.batch.columns[n].to_pylist()
+                    for c, n in zip(cols, sub.column_names)}
+        else:
+            data = {c: [] for c in cols}
+            for row in stmt.rows:
+                if len(row) != len(cols):
+                    raise BindError("INSERT arity mismatch")
+                for c, v in zip(cols, row):
+                    data[c].append(_literal_value(v))
+        full = {}
+        n = len(next(iter(data.values()))) if data else 0
+        for c, d in schema:
+            vals = data.get(c, [None] * n)
+            if d.oid == TypeOid.DATE:
+                vals = [(datetime.date.fromisoformat(v)
+                         - datetime.date(1970, 1, 1)).days
+                        if isinstance(v, str) else v for v in vals]
+            elif d.is_vector:
+                vals = [[float(x) for x in v.strip()[1:-1].split(",")]
+                        if isinstance(v, str) else v for v in vals]
+            full[c] = vals
+        batch = Batch.from_pydict(full, {c: d for c, d in schema})
+        n = table.insert_batch(batch)
+        return Result(affected=n)
+
+
+def _param_literal(v) -> ast.Node:
+    if v is None:
+        return ast.Literal(None, "null")
+    if isinstance(v, bool):
+        return ast.Literal(v, "bool")
+    if isinstance(v, int):
+        return ast.Literal(v, "int")
+    if isinstance(v, float):
+        return ast.Literal(repr(v), "float")
+    if isinstance(v, str):
+        return ast.Literal(v, "str")
+    if isinstance(v, datetime.date):
+        return ast.DateLiteral((v - datetime.date(1970, 1, 1)).days)
+    raise BindError(f"unsupported parameter type {type(v).__name__}")
+
+
+def _substitute_params(node, params: list):
+    """Replace ? placeholders (ast.Param) with literal values."""
+    import dataclasses as dc
+    if isinstance(node, ast.Param):
+        if node.index >= len(params):
+            raise BindError(f"missing value for parameter {node.index + 1}")
+        return _param_literal(params[node.index])
+    if dc.is_dataclass(node) and isinstance(node, ast.Node):
+        def sub(x):
+            if isinstance(x, ast.Node):
+                return _substitute_params(x, params)
+            if isinstance(x, tuple):
+                return tuple(sub(y) for y in x)
+            if isinstance(x, list):
+                return [sub(y) for y in x]
+            return x
+        for f in dc.fields(node):
+            setattr(node, f.name, sub(getattr(node, f.name)))
+    return node
+
+
+def _literal_value(v: ast.Node):
+    if isinstance(v, ast.Literal):
+        if v.kind == "float":
+            return float(v.value)
+        return v.value
+    if isinstance(v, ast.DateLiteral):
+        return v.days
+    if isinstance(v, ast.UnaryOp) and v.op == "-":
+        inner = _literal_value(v.operand)
+        return -inner
+    if isinstance(v, ast.Cast):
+        return _literal_value(v.expr)
+    raise BindError("INSERT VALUES must be literals")

@@ -17,14 +17,18 @@ from matrixone_tpu.container.device import DeviceBatch, DeviceColumn
 
 
 def predicate_mask(pred: DeviceColumn, batch: DeviceBatch) -> jnp.ndarray:
-    """bool mask of rows passing a predicate column (NULL -> excluded)."""
+    """bool mask of rows passing a predicate column (NULL -> excluded).
+
+    Deliberately does NOT fold in batch.row_mask(): the pipeline's
+    ExecBatch.mask tracks live rows (which are non-contiguous after joins);
+    callers AND this mask into it."""
     data = pred.data
     valid = pred.validity
     if pred.is_const:
         n = batch.padded_len
         data = jnp.broadcast_to(data, (n,))
         valid = jnp.broadcast_to(valid, (n,))
-    return data & valid & batch.row_mask()
+    return data & valid
 
 
 def compact(batch: DeviceBatch, mask: jnp.ndarray, capacity: int) -> DeviceBatch:

@@ -1,0 +1,3 @@
+from matrixone_tpu.sql import ast, binder, expr, lexer, parser, plan
+
+__all__ = ["ast", "binder", "expr", "lexer", "parser", "plan"]

@@ -1,0 +1,255 @@
+"""SQL AST (reference: pkg/sql/parsers/tree — redesigned, minimal dataclasses).
+
+The reference generates its parser from a 15k-line goyacc grammar
+(`parsers/dialect/mysql/mysql_sql.y`); this project uses a hand-written
+recursive-descent parser over a small AST — the grammar subset grows with
+the engine instead of importing MySQL's full surface up front.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+
+class Node:
+    pass
+
+
+# ----------------------------------------------------------------- exprs
+
+@dataclasses.dataclass
+class Literal(Node):
+    value: object            # int | float | str | bool | None
+    kind: str                # 'int' | 'float' | 'str' | 'bool' | 'null'
+
+
+@dataclasses.dataclass
+class DateLiteral(Node):
+    days: int                # days since unix epoch
+
+
+@dataclasses.dataclass
+class IntervalLiteral(Node):
+    value: int
+    unit: str                # 'day' | 'month' | 'year'
+
+
+@dataclasses.dataclass
+class ColumnRef(Node):
+    name: str
+    table: Optional[str] = None
+
+
+@dataclasses.dataclass
+class BinaryOp(Node):
+    op: str                  # + - * / % and or = != < <= > >= like
+    left: Node
+    right: Node
+
+
+@dataclasses.dataclass
+class UnaryOp(Node):
+    op: str                  # - not
+    operand: Node
+
+
+@dataclasses.dataclass
+class FuncCall(Node):
+    name: str
+    args: List[Node]
+    distinct: bool = False
+    star: bool = False       # count(*)
+
+
+@dataclasses.dataclass
+class Cast(Node):
+    expr: Node
+    type_name: str
+    type_args: Tuple[int, ...] = ()
+
+
+@dataclasses.dataclass
+class Case(Node):
+    whens: List[Tuple[Node, Node]]
+    else_: Optional[Node]
+
+
+@dataclasses.dataclass
+class InList(Node):
+    expr: Node
+    items: List[Node]
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class Between(Node):
+    expr: Node
+    low: Node
+    high: Node
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class IsNull(Node):
+    expr: Node
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class Star(Node):
+    table: Optional[str] = None
+
+
+@dataclasses.dataclass
+class Subquery(Node):
+    select: "Select"
+
+
+@dataclasses.dataclass
+class Exists(Node):
+    select: "Select"
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class Param(Node):
+    index: int               # ? placeholders for prepared statements
+
+
+# ------------------------------------------------------------ statements
+
+@dataclasses.dataclass
+class SelectItem(Node):
+    expr: Node
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass
+class TableRef(Node):
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass
+class SubqueryRef(Node):
+    select: "Select"
+    alias: str
+
+
+@dataclasses.dataclass
+class Join(Node):
+    kind: str                # 'inner' | 'left' | 'right' | 'cross'
+    left: Node
+    right: Node
+    on: Optional[Node] = None
+
+
+@dataclasses.dataclass
+class OrderItem(Node):
+    expr: Node
+    descending: bool = False
+
+
+@dataclasses.dataclass
+class Select(Node):
+    items: List[SelectItem]
+    from_: Optional[Node] = None
+    where: Optional[Node] = None
+    group_by: List[Node] = dataclasses.field(default_factory=list)
+    having: Optional[Node] = None
+    order_by: List[OrderItem] = dataclasses.field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    distinct: bool = False
+
+
+@dataclasses.dataclass
+class ColumnDef(Node):
+    name: str
+    type_name: str
+    type_args: Tuple[int, ...] = ()
+    not_null: bool = False
+    primary_key: bool = False
+    default: Optional[Node] = None
+
+
+@dataclasses.dataclass
+class CreateTable(Node):
+    name: str
+    columns: List[ColumnDef]
+    primary_key: List[str] = dataclasses.field(default_factory=list)
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass
+class DropTable(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
+class CreateIndex(Node):
+    name: str
+    table: str
+    columns: List[str]
+    using: Optional[str] = None          # 'ivfflat' | 'hnsw' | 'fulltext' ...
+    options: dict = dataclasses.field(default_factory=dict)  # lists=..., op_type=...
+
+
+@dataclasses.dataclass
+class Insert(Node):
+    table: str
+    columns: List[str]
+    rows: Optional[List[List[Node]]] = None   # VALUES
+    select: Optional[Select] = None           # INSERT ... SELECT
+
+
+@dataclasses.dataclass
+class Delete(Node):
+    table: str
+    where: Optional[Node] = None
+
+
+@dataclasses.dataclass
+class Update(Node):
+    table: str
+    assignments: List[Tuple[str, Node]]
+    where: Optional[Node] = None
+
+
+@dataclasses.dataclass
+class Explain(Node):
+    stmt: Node
+    analyze: bool = False
+
+
+@dataclasses.dataclass
+class ShowTables(Node):
+    pass
+
+
+@dataclasses.dataclass
+class ShowCreateTable(Node):
+    name: str
+
+
+@dataclasses.dataclass
+class SetVariable(Node):
+    name: str
+    value: Node
+
+
+@dataclasses.dataclass
+class BeginTxn(Node):
+    pass
+
+
+@dataclasses.dataclass
+class CommitTxn(Node):
+    pass
+
+
+@dataclasses.dataclass
+class RollbackTxn(Node):
+    pass

@@ -1,0 +1,646 @@
+"""Binder: AST -> typed logical plan.
+
+Reference analogue: `pkg/sql/plan/query_builder.go:3555 bindSelect` +
+`build.go:378 BuildPlan`, compressed to the passes that matter for a
+vectorized TPU pipeline:
+
+  bind FROM/joins -> WHERE -> two-phase aggregate binding -> HAVING ->
+  projection -> DISTINCT -> ORDER BY (alias/ordinal/hidden-column) ->
+  LIMIT; then: filter pushdown into Scan, ORDER BY+LIMIT -> TopK fusion,
+  vector-index rewrite (apply_indices_ivfflat.go analogue, done in
+  compile when an index exists).
+
+Literal typing is MySQL-flavored: `0.05` is DECIMAL(_,2), not float, so
+decimal comparisons and arithmetic stay in the exact int64 domain.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Dict, List, Optional, Tuple
+
+from matrixone_tpu.container import dtypes as dt
+from matrixone_tpu.container.dtypes import DType, TypeOid
+from matrixone_tpu.sql import ast, plan
+from matrixone_tpu.sql.expr import (AggCall, BoundCase, BoundCast, BoundCol,
+                                    BoundExpr, BoundFunc, BoundInList,
+                                    BoundIsNull, BoundLike, BoundLiteral)
+
+AGG_FUNCS = {"count", "sum", "avg", "min", "max"}
+
+_TYPE_NAMES = {
+    "bool": lambda a: dt.BOOL, "boolean": lambda a: dt.BOOL,
+    "tinyint": lambda a: dt.INT8, "smallint": lambda a: dt.INT16,
+    "int": lambda a: dt.INT32, "integer": lambda a: dt.INT32,
+    "bigint": lambda a: dt.INT64,
+    "float": lambda a: dt.FLOAT32, "double": lambda a: dt.FLOAT64,
+    "real": lambda a: dt.FLOAT64,
+    "decimal": lambda a: dt.decimal64(*(a or (18, 2))),
+    "numeric": lambda a: dt.decimal64(*(a or (18, 2))),
+    "date": lambda a: dt.DATE, "datetime": lambda a: dt.DATETIME,
+    "timestamp": lambda a: dt.TIMESTAMP,
+    "char": lambda a: dt.DType(dt.TypeOid.CHAR, width=(a[0] if a else 1)),
+    "varchar": lambda a: dt.varchar(a[0] if a else 65535),
+    "text": lambda a: dt.TEXT,
+    "vecf32": lambda a: dt.vecf32(a[0] if a else 0),
+    "vecf64": lambda a: dt.vecf64(a[0] if a else 0),
+}
+
+
+class BindError(ValueError):
+    pass
+
+
+def type_from_name(name: str, args: Tuple[int, ...]) -> DType:
+    try:
+        return _TYPE_NAMES[name](args)
+    except KeyError:
+        raise BindError(f"unknown type {name!r}")
+
+
+class Scope:
+    """Name resolution scope: (table_alias, column, dtype) entries."""
+
+    def __init__(self):
+        self.entries: List[Tuple[Optional[str], str, DType]] = []
+
+    def add(self, table: Optional[str], col: str, dtype: DType):
+        self.entries.append((table, col, dtype))
+
+    def resolve(self, name: str, table: Optional[str]) -> Tuple[str, DType]:
+        hits = [(t, c, d) for (t, c, d) in self.entries
+                if c == name and (table is None or t == table)]
+        if not hits:
+            raise BindError(f"unknown column {table + '.' if table else ''}{name}")
+        if len(hits) > 1:
+            raise BindError(f"ambiguous column {name}")
+        t, c, d = hits[0]
+        return (f"{t}.{c}" if t else c), d
+
+    def qualified_names(self) -> List[str]:
+        # output column key used in DeviceBatch dicts
+        return [f"{t}.{c}" if t else c for (t, c, _) in self.entries]
+
+
+class Binder:
+    def __init__(self, catalog):
+        self.catalog = catalog
+
+    # ------------------------------------------------------------- select
+    def bind_select(self, sel: ast.Select) -> plan.PlanNode:
+        node, scope = self._bind_from(sel.from_)
+
+        if sel.where is not None:
+            pred = self.bind_expr(sel.where, scope)
+            _require_bool(pred, "WHERE")
+            node = plan.Filter(node, pred, node.schema)
+
+        # expand stars early
+        items: List[ast.SelectItem] = []
+        for it in sel.items:
+            if isinstance(it.expr, ast.Star):
+                for (t, c, d) in scope.entries:
+                    if it.expr.table is None or t == it.expr.table:
+                        items.append(ast.SelectItem(
+                            ast.ColumnRef(c, t), alias=c))
+            else:
+                items.append(it)
+
+        has_aggs = any(self._contains_agg(it.expr) for it in items) \
+            or (sel.having is not None and self._contains_agg(sel.having)) \
+            or any(self._contains_agg(o.expr) for o in sel.order_by) \
+            or bool(sel.group_by)
+
+        alias_map = {it.alias: it.expr for it in items if it.alias}
+
+        if has_aggs:
+            node, scope, agg_sub = self._bind_aggregate(
+                node, scope, sel, items, alias_map)
+        else:
+            agg_sub = None
+            if sel.having is not None:
+                raise BindError("HAVING without aggregation")
+
+        # projection
+        exprs, names = [], []
+        for idx, it in enumerate(items):
+            e = self._bind_post_agg(it.expr, scope, agg_sub) if agg_sub \
+                else self.bind_expr(it.expr, scope)
+            exprs.append(e)
+            names.append(it.alias or _expr_name(it.expr, idx))
+        out_schema = list(zip(names, [e.dtype for e in exprs]))
+        node = plan.Project(node, exprs, out_schema)
+
+        if sel.distinct:
+            node = plan.Distinct(node, node.schema)
+
+        # ORDER BY: resolve by ordinal, output alias, or expression
+        n_visible = len(names)
+        if sel.order_by:
+            keys, descs = [], []
+            for o in sel.order_by:
+                descs.append(o.descending)
+                k = self._bind_order_key(o.expr, node, names, exprs, scope,
+                                         agg_sub, alias_map)
+                keys.append(k)
+            if sel.limit is not None:
+                node = plan.TopK(node, keys, descs, sel.limit,
+                                 sel.offset or 0, node.schema)
+            else:
+                node = plan.Sort(node, keys, descs, node.schema)
+            if len(names) > n_visible:   # drop hidden sort columns
+                vis = node.schema[:n_visible]
+                node = plan.Project(
+                    node, [BoundCol(n, d) for n, d in vis], list(vis))
+        elif sel.limit is not None or sel.offset:
+            node = plan.Limit(node, sel.limit, sel.offset or 0, node.schema)
+
+        return self._pushdown_filters(node)
+
+    # -------------------------------------------------------------- from
+    def _bind_from(self, from_) -> Tuple[plan.PlanNode, Scope]:
+        if from_ is None:
+            # SELECT without FROM: single-row dual table
+            sc = Scope()
+            return plan.Values([[1]], [("__dual", dt.INT64)]), sc
+        if isinstance(from_, ast.TableRef):
+            meta = self.catalog.get_table(from_.name)
+            alias = from_.alias or from_.name
+            sc = Scope()
+            for col, dtype in meta.schema:
+                sc.add(alias, col, dtype)
+            scan = plan.Scan(from_.name,
+                             [c for c, _ in meta.schema],
+                             [(f"{alias}.{c}", d) for c, d in meta.schema])
+            return scan, sc
+        if isinstance(from_, ast.SubqueryRef):
+            child = self.bind_select(from_.select)
+            sc = Scope()
+            for col, dtype in child.schema:
+                sc.add(from_.alias, col, dtype)
+            # rename child outputs into alias namespace
+            exprs = [BoundCol(c, d) for c, d in child.schema]
+            schema = [(f"{from_.alias}.{c}", d) for c, d in child.schema]
+            return plan.Project(child, exprs, schema), sc
+        if isinstance(from_, ast.Join):
+            lnode, lscope = self._bind_from(from_.left)
+            rnode, rscope = self._bind_from(from_.right)
+            sc = Scope()
+            sc.entries = lscope.entries + rscope.entries
+            schema = lnode.schema + rnode.schema
+            kind = from_.kind
+            if kind == "right":
+                lnode, rnode = rnode, lnode
+                lscope, rscope = rscope, lscope
+                schema = lnode.schema + rnode.schema
+                sc.entries = lscope.entries + rscope.entries
+                kind = "left"
+            lkeys, rkeys, residual = [], [], None
+            if from_.on is not None:
+                lkeys, rkeys, residual = self._split_join_on(
+                    from_.on, lscope, rscope, sc)
+            elif kind != "cross":
+                kind = "cross"
+            return plan.Join(kind, lnode, rnode, lkeys, rkeys, residual,
+                             schema), sc
+        raise BindError(f"unsupported FROM clause {type(from_).__name__}")
+
+    def _split_join_on(self, on, lscope, rscope, full_scope):
+        """Split ON into equi-key pairs + residual predicate."""
+        conjuncts = _split_and(on)
+        lkeys, rkeys, residual = [], [], []
+        for c in conjuncts:
+            if isinstance(c, ast.BinaryOp) and c.op == "=":
+                try:
+                    le = self.bind_expr(c.left, lscope)
+                    re_ = self.bind_expr(c.right, rscope)
+                    lkeys.append(le)
+                    rkeys.append(re_)
+                    continue
+                except BindError:
+                    pass
+                try:
+                    le = self.bind_expr(c.right, lscope)
+                    re_ = self.bind_expr(c.left, rscope)
+                    lkeys.append(le)
+                    rkeys.append(re_)
+                    continue
+                except BindError:
+                    pass
+            residual.append(c)
+        res = None
+        if residual:
+            e = residual[0]
+            for r in residual[1:]:
+                e = ast.BinaryOp("and", e, r)
+            res = self.bind_expr(e, full_scope)
+        return lkeys, rkeys, res
+
+    # --------------------------------------------------------- aggregates
+    def _contains_agg(self, e: ast.Node) -> bool:
+        if isinstance(e, ast.FuncCall) and e.name in AGG_FUNCS:
+            return True
+        for f in dataclasses_fields_values(e):
+            if isinstance(f, ast.Node) and self._contains_agg(f):
+                return True
+            if isinstance(f, list):
+                for x in f:
+                    if isinstance(x, ast.Node) and self._contains_agg(x):
+                        return True
+                    if isinstance(x, tuple):
+                        for y in x:
+                            if isinstance(y, ast.Node) and self._contains_agg(y):
+                                return True
+        return False
+
+    def _bind_aggregate(self, node, scope, sel, items, alias_map):
+        # group keys (support alias + ordinal)
+        group_asts: List[ast.Node] = []
+        for g in sel.group_by:
+            if isinstance(g, ast.Literal) and g.kind == "int":
+                idx = int(g.value) - 1
+                if not 0 <= idx < len(items):
+                    raise BindError(
+                        f"GROUP BY ordinal {int(g.value)} out of range")
+                group_asts.append(items[idx].expr)
+            elif isinstance(g, ast.ColumnRef) and g.table is None \
+                    and g.name in alias_map:
+                group_asts.append(alias_map[g.name])
+            else:
+                group_asts.append(g)
+        group_keys = [self.bind_expr(g, scope) for g in group_asts]
+
+        # collect agg calls from items + having + order by
+        agg_calls: List[ast.FuncCall] = []
+
+        def collect(e):
+            if isinstance(e, ast.FuncCall) and e.name in AGG_FUNCS:
+                agg_calls.append(e)
+                return
+            for f in dataclasses_fields_values(e):
+                if isinstance(f, ast.Node):
+                    collect(f)
+                elif isinstance(f, list):
+                    for x in f:
+                        if isinstance(x, ast.Node):
+                            collect(x)
+                        elif isinstance(x, tuple):
+                            for y in x:
+                                if isinstance(y, ast.Node):
+                                    collect(y)
+
+        for it in items:
+            collect(it.expr)
+        if sel.having is not None:
+            collect(sel.having)
+        for o in sel.order_by:
+            collect(o.expr)
+
+        # dedupe by AST equality
+        uniq: List[ast.FuncCall] = []
+        for a in agg_calls:
+            if not any(a == u for u in uniq):
+                uniq.append(a)
+
+        bound_aggs: List[AggCall] = []
+        for i, a in enumerate(uniq):
+            if a.distinct:
+                raise BindError(
+                    f"{a.name}(DISTINCT ...) is not supported yet")
+            if a.star or (not a.args):
+                if a.name != "count":
+                    raise BindError(f"{a.name}(*) is not valid")
+                bound_aggs.append(AggCall("count", None, False, dt.INT64,
+                                          out_name=f"_agg{i}"))
+                continue
+            arg = self.bind_expr(a.args[0], scope)
+            out_t = _agg_result_type(a.name, arg.dtype)
+            bound_aggs.append(AggCall(a.name, arg, a.distinct, out_t,
+                                      out_name=f"_agg{i}"))
+
+        key_names = [f"_g{i}" for i in range(len(group_keys))]
+        schema = list(zip(key_names, [k.dtype for k in group_keys])) + \
+            [(a.out_name, a.dtype) for a in bound_aggs]
+        agg_node = plan.Aggregate(node, group_keys, bound_aggs, schema)
+
+        # post-agg scope: group keys by their source AST, aggs by AST
+        new_scope = Scope()
+        for name, dtype in schema:
+            new_scope.add(None, name, dtype)
+        agg_sub = {"group_asts": group_asts, "key_names": key_names,
+                   "agg_asts": uniq, "aggs": bound_aggs,
+                   "scope": new_scope}
+
+        out = agg_node
+        if sel.having is not None:
+            pred = self._bind_post_agg(sel.having, new_scope, agg_sub)
+            _require_bool(pred, "HAVING")
+            out = plan.Filter(out, pred, out.schema)
+        return out, new_scope, agg_sub
+
+    def _bind_post_agg(self, e: ast.Node, scope: Scope, agg_sub) -> BoundExpr:
+        """Bind an expression above an Aggregate: column refs must match a
+        group key AST; agg calls become refs to agg outputs."""
+        for g_ast, name in zip(agg_sub["group_asts"], agg_sub["key_names"]):
+            if e == g_ast:
+                dtype = {c: d for (_, c, d) in agg_sub["scope"].entries}[name]
+                return BoundCol(name, dtype)
+        if isinstance(e, ast.FuncCall) and e.name in AGG_FUNCS:
+            for a_ast, bound in zip(agg_sub["agg_asts"], agg_sub["aggs"]):
+                if e == a_ast:
+                    return BoundCol(bound.out_name, bound.dtype)
+            raise BindError("aggregate not collected (internal)")
+        if isinstance(e, ast.ColumnRef):
+            raise BindError(
+                f"column {e.name} must appear in GROUP BY or an aggregate")
+        return self._bind_generic(e, scope,
+                                  lambda x: self._bind_post_agg(x, scope, agg_sub))
+
+    # ------------------------------------------------------------ order by
+    def _bind_order_key(self, e, node, names, exprs, scope, agg_sub,
+                        alias_map):
+        if isinstance(e, ast.Literal) and e.kind == "int":
+            idx = int(e.value) - 1
+            if not 0 <= idx < len(names):
+                raise BindError(f"ORDER BY ordinal {idx + 1} out of range")
+            return BoundCol(names[idx], exprs[idx].dtype)
+        if isinstance(e, ast.ColumnRef) and e.table is None and e.name in names:
+            i = names.index(e.name)
+            return BoundCol(names[i], exprs[i].dtype)
+        bound = self._bind_post_agg(e, scope, agg_sub) if agg_sub \
+            else self.bind_expr(e, scope)
+        # match an existing projected expression
+        for i, pe in enumerate(exprs):
+            if pe == bound:
+                return BoundCol(names[i], pe.dtype)
+        # hidden sort column: widen the projection
+        if not isinstance(node, plan.Project):
+            raise BindError(
+                "ORDER BY expression must appear in the select list when "
+                "using DISTINCT")
+        hidden = f"_sort{len(node.exprs)}"
+        node.exprs.append(bound)
+        node.schema.append((hidden, bound.dtype))
+        names.append(hidden)
+        exprs.append(bound)
+        return BoundCol(hidden, bound.dtype)
+
+    # ------------------------------------------------------------- exprs
+    def bind_expr(self, e: ast.Node, scope: Scope) -> BoundExpr:
+        return self._bind_generic(e, scope,
+                                  lambda x: self.bind_expr(x, scope))
+
+    def _bind_generic(self, e: ast.Node, scope: Scope, rec) -> BoundExpr:
+        if isinstance(e, ast.Literal):
+            return _bind_literal(e)
+        if isinstance(e, ast.DateLiteral):
+            return BoundLiteral(e.days, dt.DATE)
+        if isinstance(e, ast.ColumnRef):
+            qname, dtype = scope.resolve(e.name, e.table)
+            return BoundCol(qname, dtype)
+        if isinstance(e, ast.BinaryOp):
+            return self._bind_binary(e, rec)
+        if isinstance(e, ast.UnaryOp):
+            a = rec(e.operand)
+            if e.op == "not":
+                _require_bool(a, "NOT")
+                return BoundFunc("not", [a], dt.BOOL)
+            return BoundFunc("neg", [a], a.dtype)
+        if isinstance(e, ast.FuncCall):
+            return self._bind_func(e, rec)
+        if isinstance(e, ast.Cast):
+            a = rec(e.expr)
+            return BoundCast(a, type_from_name(e.type_name, e.type_args))
+        if isinstance(e, ast.Case):
+            whens = [(rec(c), rec(v)) for c, v in e.whens]
+            else_ = rec(e.else_) if e.else_ is not None else None
+            out_t = whens[0][1].dtype
+            for _, v in whens[1:]:
+                out_t = dt.promote(out_t, v.dtype) if v.dtype.is_numeric \
+                    and out_t.is_numeric else out_t
+            return BoundCase(whens, else_, out_t)
+        if isinstance(e, ast.InList):
+            arg = rec(e.expr)
+            vals = []
+            for item in e.items:
+                b = self._bind_generic(item, scope, rec)
+                if not isinstance(b, BoundLiteral):
+                    raise BindError("IN list items must be literals")
+                vals.append(_literal_in_arg_domain(b, arg.dtype))
+            return BoundInList(arg, vals, e.negated, dt.BOOL)
+        if isinstance(e, ast.Between):
+            arg = rec(e.expr)
+            lo, hi = rec(e.low), rec(e.high)
+            ge = BoundFunc("ge", [arg, lo], dt.BOOL)
+            le = BoundFunc("le", [arg, hi], dt.BOOL)
+            both = BoundFunc("and", [ge, le], dt.BOOL)
+            if e.negated:
+                return BoundFunc("not", [both], dt.BOOL)
+            return both
+        if isinstance(e, ast.IsNull):
+            return BoundIsNull(rec(e.expr), e.negated, dt.BOOL)
+        raise BindError(f"unsupported expression {type(e).__name__}")
+
+    def _bind_binary(self, e: ast.BinaryOp, rec) -> BoundExpr:
+        if e.op in ("date+", "date-"):
+            left = rec(e.left)
+            iv = e.right
+            assert isinstance(iv, ast.IntervalLiteral)
+            if isinstance(left, BoundLiteral) and left.dtype.oid == TypeOid.DATE:
+                base = datetime.date(1970, 1, 1) + datetime.timedelta(days=left.value)
+                sign = 1 if e.op == "date+" else -1
+                if iv.unit == "day":
+                    nd = base + datetime.timedelta(days=sign * iv.value)
+                elif iv.unit == "month":
+                    m = base.month - 1 + sign * iv.value
+                    nd = base.replace(year=base.year + m // 12,
+                                      month=m % 12 + 1)
+                elif iv.unit == "year":
+                    nd = base.replace(year=base.year + sign * iv.value)
+                else:
+                    raise BindError(f"unsupported interval unit {iv.unit}")
+                return BoundLiteral((nd - datetime.date(1970, 1, 1)).days,
+                                    dt.DATE)
+            if iv.unit != "day":
+                raise BindError("non-literal date +/- month/year not supported yet")
+            delta = BoundLiteral(iv.value if e.op == "date+" else -iv.value,
+                                 dt.INT32)
+            return BoundFunc("date_add_days", [left, delta], dt.DATE)
+
+        left, right = rec(e.left), rec(e.right)
+        if e.op == "like":
+            if not isinstance(right, BoundLiteral):
+                raise BindError("LIKE pattern must be a literal")
+            return BoundLike(left, str(right.value), False, dt.BOOL)
+        if e.op in ("and", "or"):
+            _require_bool(left, e.op.upper())
+            _require_bool(right, e.op.upper())
+            return BoundFunc(e.op, [left, right], dt.BOOL)
+        if e.op in ("=", "!=", "<", "<=", ">", ">="):
+            op = {"=": "eq", "!=": "ne", "<": "lt", "<=": "le",
+                  ">": "gt", ">=": "ge"}[e.op]
+            return BoundFunc(op, [left, right], dt.BOOL)
+        if e.op in ("+", "-", "*", "/", "%"):
+            op = {"+": "add", "-": "sub", "*": "mul", "/": "div",
+                  "%": "mod"}[e.op]
+            out = _arith_result(op, left.dtype, right.dtype)
+            return BoundFunc(op, [left, right], out)
+        raise BindError(f"unsupported operator {e.op}")
+
+    def _bind_func(self, e: ast.FuncCall, rec) -> BoundExpr:
+        if e.name in AGG_FUNCS:
+            raise BindError(f"aggregate {e.name}() not allowed here")
+        args = [rec(a) for a in e.args]
+        return bind_scalar_function(e.name, args)
+
+    # --------------------------------------------------------- pushdown
+    def _pushdown_filters(self, node: plan.PlanNode) -> plan.PlanNode:
+        """Move Filter conjuncts directly above a Scan into Scan.filters
+        (feeds zonemap pruning in the reader — readutil analogue)."""
+        for attr in ("child", "left", "right"):
+            c = getattr(node, attr, None)
+            if c is not None:
+                setattr(node, attr, self._pushdown_filters(c))
+        if isinstance(node, plan.Filter) and isinstance(node.child, plan.Scan):
+            scan = node.child
+            scan.filters = scan.filters + _split_bound_and(node.pred)
+            return scan
+        return node
+
+
+# ------------------------------------------------------------------ helpers
+
+def dataclasses_fields_values(e):
+    import dataclasses as dc
+    if not dc.is_dataclass(e):
+        return []
+    return [getattr(e, f.name) for f in dc.fields(e)]
+
+
+def _expr_name(e: ast.Node, idx: int) -> str:
+    if isinstance(e, ast.ColumnRef):
+        return e.name
+    if isinstance(e, ast.FuncCall):
+        return f"{e.name}(*)" if e.star else f"{e.name}(...)"
+    return f"_col{idx}"
+
+
+def _split_and(e: ast.Node) -> List[ast.Node]:
+    if isinstance(e, ast.BinaryOp) and e.op == "and":
+        return _split_and(e.left) + _split_and(e.right)
+    return [e]
+
+
+def _split_bound_and(e: BoundExpr) -> List[BoundExpr]:
+    if isinstance(e, BoundFunc) and e.op == "and":
+        return _split_bound_and(e.args[0]) + _split_bound_and(e.args[1])
+    return [e]
+
+
+def _require_bool(e: BoundExpr, where: str):
+    if e.dtype.oid != TypeOid.BOOL:
+        raise BindError(f"{where} requires a boolean expression")
+
+
+def _bind_literal(e: ast.Literal) -> BoundLiteral:
+    if e.kind == "int":
+        return BoundLiteral(int(e.value), dt.INT64)
+    if e.kind == "float":
+        text = str(e.value)
+        if "e" not in text.lower() and "." in text:
+            frac = text.split(".", 1)[1]
+            if len(frac) <= 8:
+                scale = len(frac)
+                scaled = int(round(float(text) * 10 ** scale))
+                return BoundLiteral(scaled, dt.decimal64(18, scale))
+        return BoundLiteral(float(text), dt.FLOAT64)
+    if e.kind == "str":
+        return BoundLiteral(str(e.value), dt.VARCHAR)
+    if e.kind == "bool":
+        return BoundLiteral(bool(e.value), dt.BOOL)
+    if e.kind == "null":
+        return BoundLiteral(None, dt.INT64)  # typeless null; cast on use
+    raise BindError(f"unknown literal kind {e.kind}")
+
+
+def _literal_in_arg_domain(lit: BoundLiteral, arg_t: DType):
+    if arg_t.oid == TypeOid.DECIMAL64 and lit.dtype.oid == TypeOid.DECIMAL64:
+        return lit.value * 10 ** (arg_t.scale - lit.dtype.scale)
+    if arg_t.oid == TypeOid.DECIMAL64 and lit.dtype.is_integer:
+        return lit.value * 10 ** arg_t.scale
+    return lit.value
+
+
+def _arith_result(op: str, a: DType, b: DType) -> DType:
+    if op == "div":
+        return dt.FLOAT64
+    if op in ("add", "sub"):
+        if TypeOid.DECIMAL64 in (a.oid, b.oid) and not (a.is_float or b.is_float):
+            sa = a.scale if a.oid == TypeOid.DECIMAL64 else 0
+            sb = b.scale if b.oid == TypeOid.DECIMAL64 else 0
+            return dt.decimal64(18, max(sa, sb))
+        if a.oid == TypeOid.DATE and b.is_integer:
+            return dt.DATE
+    if op == "mul":
+        if TypeOid.DECIMAL64 in (a.oid, b.oid) and not (a.is_float or b.is_float):
+            sa = a.scale if a.oid == TypeOid.DECIMAL64 else 0
+            sb = b.scale if b.oid == TypeOid.DECIMAL64 else 0
+            return dt.decimal64(18, sa + sb)
+    if not (a.is_numeric and b.is_numeric):
+        if a.oid == b.oid:
+            return a
+        raise BindError(f"cannot apply {op} to {a} and {b}")
+    return dt.promote(a, b)
+
+
+def _agg_result_type(func: str, arg: DType) -> DType:
+    if func == "count":
+        return dt.INT64
+    if func == "avg":
+        return dt.FLOAT64
+    if func == "sum":
+        if arg.oid == TypeOid.DECIMAL64:
+            return arg
+        if arg.is_integer:
+            return dt.INT64
+        return dt.FLOAT64
+    return arg  # min / max
+
+
+_SCALAR_FUNCS = {
+    "abs": ("abs", lambda ts: ts[0]),
+    "floor": ("floor", lambda ts: dt.FLOAT64),
+    "ceil": ("ceil", lambda ts: dt.FLOAT64),
+    "ceiling": ("ceil", lambda ts: dt.FLOAT64),
+    "sqrt": ("sqrt", lambda ts: dt.FLOAT64),
+    "exp": ("exp", lambda ts: dt.FLOAT64),
+    "ln": ("ln", lambda ts: dt.FLOAT64),
+    "log": ("ln", lambda ts: dt.FLOAT64),
+    "sin": ("sin", lambda ts: dt.FLOAT64),
+    "cos": ("cos", lambda ts: dt.FLOAT64),
+    "power": ("power", lambda ts: dt.FLOAT64),
+    "pow": ("power", lambda ts: dt.FLOAT64),
+    "round": ("round", lambda ts: ts[0]),
+    "coalesce": ("coalesce", lambda ts: ts[0]),
+    "year": ("year", lambda ts: dt.INT32),
+    "month": ("month", lambda ts: dt.INT32),
+    "day": ("day", lambda ts: dt.INT32),
+    "l2_distance": ("l2_distance", lambda ts: dt.FLOAT64),
+    "l2_distance_sq": ("l2_distance_sq", lambda ts: dt.FLOAT64),
+    "cosine_distance": ("cosine_distance", lambda ts: dt.FLOAT64),
+    "inner_product": ("inner_product", lambda ts: dt.FLOAT64),
+    "cosine_similarity": ("cosine_similarity", lambda ts: dt.FLOAT64),
+}
+
+
+def bind_scalar_function(name: str, args: List[BoundExpr]) -> BoundExpr:
+    if name not in _SCALAR_FUNCS:
+        raise BindError(f"unknown function {name}()")
+    op, result = _SCALAR_FUNCS[name]
+    # vector literals arrive as '[1,2,...]' strings (MySQL-client style)
+    for i, a in enumerate(args):
+        if isinstance(a, BoundLiteral) and isinstance(a.value, str) \
+                and a.value.lstrip().startswith("["):
+            vec = [float(x) for x in a.value.strip()[1:-1].split(",") if x]
+            args[i] = BoundLiteral(vec, dt.vecf32(len(vec)))
+    return BoundFunc(op, args, result([a.dtype for a in args]))

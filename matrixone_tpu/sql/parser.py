@@ -1,0 +1,570 @@
+"""Recursive-descent SQL parser (reference: pkg/sql/parsers — redesigned;
+the reference compiles a goyacc grammar, this is a hand-written parser over
+the same dialect surface, grown feature-by-feature with the engine)."""
+
+from __future__ import annotations
+
+import datetime
+from typing import List, Optional
+
+from matrixone_tpu.sql import ast
+from matrixone_tpu.sql.lexer import Token, tokenize
+
+
+class ParseError(ValueError):
+    pass
+
+
+AGG_FUNCS = {"count", "sum", "avg", "min", "max"}
+
+
+def parse(sql: str) -> List[ast.Node]:
+    """Parse a semicolon-separated script -> list of statements."""
+    return Parser(tokenize(sql)).parse_script()
+
+
+def parse_one(sql: str) -> ast.Node:
+    stmts = parse(sql)
+    if len(stmts) != 1:
+        raise ParseError(f"expected one statement, got {len(stmts)}")
+    return stmts[0]
+
+
+class Parser:
+    def __init__(self, tokens: List[Token]):
+        self.toks = tokens
+        self.i = 0
+
+    # ---- token helpers
+    def peek(self, ahead: int = 0) -> Token:
+        return self.toks[min(self.i + ahead, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind != "eof":
+            self.i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == "kw" and t.value in kws
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind == "op" and t.value in ops
+
+    def accept_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.next()
+            return True
+        return False
+
+    def accept_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.accept_kw(kw):
+            raise ParseError(f"expected {kw.upper()} near {self.peek().value!r}"
+                             f" (pos {self.peek().pos})")
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            raise ParseError(f"expected {op!r} near {self.peek().value!r}"
+                             f" (pos {self.peek().pos})")
+
+    def ident(self) -> str:
+        t = self.peek()
+        # allow non-reserved keywords as identifiers in name position
+        if t.kind in ("ident", "kw"):
+            self.next()
+            return t.value
+        raise ParseError(f"expected identifier near {t.value!r} (pos {t.pos})")
+
+    # ---- script / statements
+    def parse_script(self) -> List[ast.Node]:
+        out = []
+        while self.peek().kind != "eof":
+            out.append(self.statement())
+            while self.accept_op(";"):
+                pass
+        return out
+
+    def statement(self) -> ast.Node:
+        if self.at_kw("select"):
+            return self.select()
+        if self.at_kw("create"):
+            return self.create()
+        if self.at_kw("drop"):
+            return self.drop()
+        if self.at_kw("insert"):
+            return self.insert()
+        if self.at_kw("delete"):
+            return self.delete()
+        if self.at_kw("update"):
+            return self.update()
+        if self.at_kw("explain"):
+            self.next()
+            analyze = self.accept_kw("analyze")
+            return ast.Explain(self.statement(), analyze=analyze)
+        if self.at_kw("show"):
+            return self.show()
+        if self.at_kw("set"):
+            self.next()
+            name = self.ident()
+            self.expect_op("=")
+            return ast.SetVariable(name, self.expr())
+        if self.accept_kw("begin"):
+            return ast.BeginTxn()
+        if self.accept_kw("commit"):
+            return ast.CommitTxn()
+        if self.accept_kw("rollback"):
+            return ast.RollbackTxn()
+        raise ParseError(f"unsupported statement near {self.peek().value!r}")
+
+    def show(self) -> ast.Node:
+        self.expect_kw("show")
+        if self.accept_kw("tables"):
+            return ast.ShowTables()
+        if self.accept_kw("create"):
+            self.expect_kw("table")
+            return ast.ShowCreateTable(self.ident())
+        raise ParseError("unsupported SHOW")
+
+    # ---- SELECT
+    def select(self) -> ast.Select:
+        self.expect_kw("select")
+        distinct = self.accept_kw("distinct")
+        items = [self.select_item()]
+        while self.accept_op(","):
+            items.append(self.select_item())
+        from_ = None
+        if self.accept_kw("from"):
+            from_ = self.table_expr()
+        where = self.expr() if self.accept_kw("where") else None
+        group_by: List[ast.Node] = []
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            group_by.append(self.expr())
+            while self.accept_op(","):
+                group_by.append(self.expr())
+        having = self.expr() if self.accept_kw("having") else None
+        order_by: List[ast.OrderItem] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self.order_item())
+            while self.accept_op(","):
+                order_by.append(self.order_item())
+        limit = offset = None
+        if self.accept_kw("limit"):
+            limit = int(self.next().value)
+            if self.accept_op(","):  # LIMIT off, n
+                offset = limit
+                limit = int(self.next().value)
+            elif self.accept_kw("offset"):
+                offset = int(self.next().value)
+        return ast.Select(items=items, from_=from_, where=where,
+                          group_by=group_by, having=having,
+                          order_by=order_by, limit=limit, offset=offset,
+                          distinct=distinct)
+
+    def select_item(self) -> ast.SelectItem:
+        if self.at_op("*"):
+            self.next()
+            return ast.SelectItem(ast.Star())
+        e = self.expr()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.ident()
+        elif self.peek().kind == "ident":
+            alias = self.ident()
+        return ast.SelectItem(e, alias)
+
+    def order_item(self) -> ast.OrderItem:
+        e = self.expr()
+        desc = False
+        if self.accept_kw("desc"):
+            desc = True
+        else:
+            self.accept_kw("asc")
+        return ast.OrderItem(e, desc)
+
+    def table_expr(self) -> ast.Node:
+        left = self.table_primary()
+        while True:
+            if self.accept_op(","):
+                right = self.table_primary()
+                left = ast.Join("cross", left, right)
+                continue
+            kind = None
+            if self.at_kw("join", "inner", "left", "right", "cross"):
+                if self.accept_kw("inner"):
+                    kind = "inner"
+                elif self.accept_kw("left"):
+                    self.accept_kw("outer")
+                    kind = "left"
+                elif self.accept_kw("right"):
+                    self.accept_kw("outer")
+                    kind = "right"
+                elif self.accept_kw("cross"):
+                    kind = "cross"
+                else:
+                    kind = "inner"
+                self.expect_kw("join")
+                right = self.table_primary()
+                on = self.expr() if self.accept_kw("on") else None
+                left = ast.Join(kind, left, right, on)
+                continue
+            return left
+
+    def table_primary(self) -> ast.Node:
+        if self.accept_op("("):
+            sel = self.select()
+            self.expect_op(")")
+            has_as = self.accept_kw("as")
+            if not has_as and self.peek().kind != "ident":
+                raise ParseError(
+                    f"derived table requires an alias (near "
+                    f"{self.peek().value!r}, pos {self.peek().pos})")
+            alias = self.ident()
+            return ast.SubqueryRef(sel, alias)
+        name = self.ident()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.ident()
+        elif self.peek().kind == "ident":
+            alias = self.ident()
+        return ast.TableRef(name, alias)
+
+    # ---- DDL / DML
+    def create(self) -> ast.Node:
+        self.expect_kw("create")
+        if self.accept_kw("table"):
+            if_not = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                if_not = True
+            name = self.ident()
+            self.expect_op("(")
+            cols: List[ast.ColumnDef] = []
+            pk: List[str] = []
+            while True:
+                if self.accept_kw("primary"):
+                    self.expect_kw("key")
+                    self.expect_op("(")
+                    pk.append(self.ident())
+                    while self.accept_op(","):
+                        pk.append(self.ident())
+                    self.expect_op(")")
+                else:
+                    cols.append(self.column_def())
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            for c in cols:
+                if c.primary_key and c.name not in pk:
+                    pk.append(c.name)
+            return ast.CreateTable(name, cols, pk, if_not)
+        if self.accept_kw("index"):
+            name = self.ident()
+            using = None
+            if self.accept_kw("using"):
+                using = self.ident()
+            self.expect_kw("on")
+            table = self.ident()
+            self.expect_op("(")
+            columns = [self.ident()]
+            while self.accept_op(","):
+                columns.append(self.ident())
+            self.expect_op(")")
+            options = {}
+            while self.peek().kind in ("ident", "kw") and self.peek().value not in (";",):
+                if self.peek().kind == "eof":
+                    break
+                key = self.ident()
+                self.expect_op("=")
+                t = self.next()
+                options[key] = t.value
+            return ast.CreateIndex(name, table, columns, using, options)
+        raise ParseError("unsupported CREATE")
+
+    def column_def(self) -> ast.ColumnDef:
+        name = self.ident()
+        type_name = self.ident()
+        args: tuple = ()
+        if self.accept_op("("):
+            vals = [int(self.next().value)]
+            while self.accept_op(","):
+                vals.append(int(self.next().value))
+            self.expect_op(")")
+            args = tuple(vals)
+        not_null = False
+        primary = False
+        default = None
+        while True:
+            if self.accept_kw("not"):
+                self.expect_kw("null")
+                not_null = True
+            elif self.accept_kw("null"):
+                pass
+            elif self.accept_kw("primary"):
+                self.expect_kw("key")
+                primary = True
+            elif self.accept_kw("default"):
+                default = self.expr()
+            else:
+                break
+        return ast.ColumnDef(name, type_name.lower(), args, not_null, primary,
+                             default)
+
+    def drop(self) -> ast.Node:
+        self.expect_kw("drop")
+        self.expect_kw("table")
+        if_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("exists")
+            if_exists = True
+        return ast.DropTable(self.ident(), if_exists)
+
+    def insert(self) -> ast.Node:
+        self.expect_kw("insert")
+        self.expect_kw("into")
+        table = self.ident()
+        columns: List[str] = []
+        if self.accept_op("("):
+            columns.append(self.ident())
+            while self.accept_op(","):
+                columns.append(self.ident())
+            self.expect_op(")")
+        if self.accept_kw("values"):
+            rows = []
+            while True:
+                self.expect_op("(")
+                row = [self.expr()]
+                while self.accept_op(","):
+                    row.append(self.expr())
+                self.expect_op(")")
+                rows.append(row)
+                if not self.accept_op(","):
+                    break
+            return ast.Insert(table, columns, rows=rows)
+        if self.at_kw("select"):
+            return ast.Insert(table, columns, select=self.select())
+        raise ParseError("INSERT requires VALUES or SELECT")
+
+    def delete(self) -> ast.Node:
+        self.expect_kw("delete")
+        self.expect_kw("from")
+        table = self.ident()
+        where = self.expr() if self.accept_kw("where") else None
+        return ast.Delete(table, where)
+
+    def update(self) -> ast.Node:
+        self.expect_kw("update")
+        table = self.ident()
+        self.expect_kw("set")
+        assigns = []
+        name = self.ident()
+        self.expect_op("=")
+        assigns.append((name, self.expr()))
+        while self.accept_op(","):
+            name = self.ident()
+            self.expect_op("=")
+            assigns.append((name, self.expr()))
+        where = self.expr() if self.accept_kw("where") else None
+        return ast.Update(table, assigns, where)
+
+    # ---- expressions (precedence climbing)
+    def expr(self) -> ast.Node:
+        return self.or_expr()
+
+    def or_expr(self) -> ast.Node:
+        left = self.and_expr()
+        while self.accept_kw("or"):
+            left = ast.BinaryOp("or", left, self.and_expr())
+        return left
+
+    def and_expr(self) -> ast.Node:
+        left = self.not_expr()
+        while self.accept_kw("and"):
+            left = ast.BinaryOp("and", left, self.not_expr())
+        return left
+
+    def not_expr(self) -> ast.Node:
+        if self.accept_kw("not"):
+            return ast.UnaryOp("not", self.not_expr())
+        return self.comparison()
+
+    def comparison(self) -> ast.Node:
+        left = self.additive()
+        while True:
+            if self.at_op("=", "<", ">", "<=", ">=", "!=", "<>"):
+                op = self.next().value
+                if op == "<>":
+                    op = "!="
+                left = ast.BinaryOp(op, left, self.additive())
+            elif self.at_kw("like"):
+                self.next()
+                left = ast.BinaryOp("like", left, self.additive())
+            elif self.at_kw("is"):
+                self.next()
+                negated = self.accept_kw("not")
+                self.expect_kw("null")
+                left = ast.IsNull(left, negated)
+            elif self.at_kw("in") or (self.at_kw("not") and
+                                      self.peek(1).value == "in"):
+                negated = self.accept_kw("not")
+                self.expect_kw("in")
+                self.expect_op("(")
+                if self.at_kw("select"):
+                    sub = self.select()
+                    self.expect_op(")")
+                    left = ast.InList(left, [ast.Subquery(sub)], negated)
+                else:
+                    items = [self.expr()]
+                    while self.accept_op(","):
+                        items.append(self.expr())
+                    self.expect_op(")")
+                    left = ast.InList(left, items, negated)
+            elif self.at_kw("between") or (self.at_kw("not") and
+                                           self.peek(1).value == "between"):
+                negated = self.accept_kw("not")
+                self.expect_kw("between")
+                low = self.additive()
+                self.expect_kw("and")
+                high = self.additive()
+                left = ast.Between(left, low, high, negated)
+            else:
+                return left
+
+    def additive(self) -> ast.Node:
+        left = self.multiplicative()
+        while self.at_op("+", "-"):
+            op = self.next().value
+            right = self.multiplicative()
+            if isinstance(right, ast.IntervalLiteral):
+                left = ast.BinaryOp("date" + op, left, right)
+            else:
+                left = ast.BinaryOp(op, left, right)
+        return left
+
+    def multiplicative(self) -> ast.Node:
+        left = self.unary()
+        while self.at_op("*", "/", "%"):
+            op = self.next().value
+            left = ast.BinaryOp(op, left, self.unary())
+        return left
+
+    def unary(self) -> ast.Node:
+        if self.accept_op("-"):
+            operand = self.unary()
+            if isinstance(operand, ast.Literal) and operand.kind in ("int", "float"):
+                return ast.Literal(-operand.value, operand.kind)
+            return ast.UnaryOp("-", operand)
+        if self.accept_op("+"):
+            return self.unary()
+        return self.primary()
+
+    def primary(self) -> ast.Node:
+        t = self.peek()
+        if t.kind == "int":
+            self.next()
+            return ast.Literal(int(t.value), "int")
+        if t.kind == "float":
+            self.next()
+            # keep the literal text: the binder types short decimal literals
+            # as exact DECIMAL64 (MySQL semantics), not float
+            return ast.Literal(t.value, "float")
+        if t.kind == "str":
+            self.next()
+            return ast.Literal(t.value, "str")
+        if self.accept_op("?"):
+            idx = sum(1 for tk in self.toks[:self.i - 1]
+                      if tk.kind == "op" and tk.value == "?")
+            return ast.Param(idx)
+        if t.kind == "kw":
+            if self.accept_kw("null"):
+                return ast.Literal(None, "null")
+            if self.accept_kw("true"):
+                return ast.Literal(True, "bool")
+            if self.accept_kw("false"):
+                return ast.Literal(False, "bool")
+            if self.accept_kw("date"):
+                s = self.next()
+                if s.kind != "str":
+                    raise ParseError("DATE literal requires a string")
+                d = datetime.date.fromisoformat(s.value)
+                return ast.DateLiteral((d - datetime.date(1970, 1, 1)).days)
+            if self.accept_kw("interval"):
+                v = self.next()
+                unit = self.ident()
+                unit = unit.rstrip("s")
+                return ast.IntervalLiteral(int(v.value), unit)
+            if self.accept_kw("case"):
+                whens = []
+                operand = None
+                if not self.at_kw("when"):
+                    operand = self.expr()
+                while self.accept_kw("when"):
+                    cond = self.expr()
+                    if operand is not None:
+                        cond = ast.BinaryOp("=", operand, cond)
+                    self.expect_kw("then")
+                    whens.append((cond, self.expr()))
+                else_ = self.expr() if self.accept_kw("else") else None
+                self.expect_kw("end")
+                return ast.Case(whens, else_)
+            if self.accept_kw("cast"):
+                self.expect_op("(")
+                e = self.expr()
+                self.expect_kw("as")
+                tname = self.ident()
+                args: tuple = ()
+                if self.accept_op("("):
+                    vals = [int(self.next().value)]
+                    while self.accept_op(","):
+                        vals.append(int(self.next().value))
+                    self.expect_op(")")
+                    args = tuple(vals)
+                self.expect_op(")")
+                return ast.Cast(e, tname.lower(), args)
+            if self.accept_kw("exists"):
+                self.expect_op("(")
+                sel = self.select()
+                self.expect_op(")")
+                return ast.Exists(sel)
+            if t.value in AGG_FUNCS:
+                return self.func_or_column()
+        if self.accept_op("("):
+            if self.at_kw("select"):
+                sel = self.select()
+                self.expect_op(")")
+                return ast.Subquery(sel)
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        if t.kind in ("ident", "kw"):
+            return self.func_or_column()
+        raise ParseError(f"unexpected token {t.value!r} (pos {t.pos})")
+
+    def func_or_column(self) -> ast.Node:
+        name = self.ident()
+        if self.accept_op("("):
+            if self.accept_op("*"):
+                self.expect_op(")")
+                return ast.FuncCall(name.lower(), [], star=True)
+            distinct = self.accept_kw("distinct")
+            args = []
+            if not self.at_op(")"):
+                args.append(self.expr())
+                while self.accept_op(","):
+                    args.append(self.expr())
+            self.expect_op(")")
+            return ast.FuncCall(name.lower(), args, distinct=distinct)
+        if self.accept_op("."):
+            col = self.ident()
+            return ast.ColumnRef(col, table=name)
+        return ast.ColumnRef(name)

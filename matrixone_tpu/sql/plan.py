@@ -1,0 +1,140 @@
+"""Logical plan nodes (reference: proto/plan.proto + pkg/sql/plan — redesigned).
+
+A plan is a tree of dataclass nodes, each with an output `schema`
+(list of (name, DType)). The planner applies a small pass list —
+filter pushdown into Scan (feeds zonemap pruning), ORDER BY+LIMIT -> TopK
+fusion, vector-index rewrite — the reference's pass list lives in
+`plan/query_builder.go:2714-2790`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from matrixone_tpu.container.dtypes import DType
+from matrixone_tpu.sql.expr import AggCall, BoundExpr
+
+Schema = List[Tuple[str, DType]]
+
+
+class PlanNode:
+    schema: Schema
+
+
+@dataclasses.dataclass
+class Scan(PlanNode):
+    table: str
+    columns: List[str]
+    schema: Schema
+    # conjunctive filters pushed into the scan (zonemap pruning + early mask)
+    filters: List[BoundExpr] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Filter(PlanNode):
+    child: PlanNode
+    pred: BoundExpr
+    schema: Schema
+
+
+@dataclasses.dataclass
+class Project(PlanNode):
+    child: PlanNode
+    exprs: List[BoundExpr]
+    schema: Schema
+
+
+@dataclasses.dataclass
+class Aggregate(PlanNode):
+    child: PlanNode
+    group_keys: List[BoundExpr]
+    aggs: List[AggCall]
+    schema: Schema          # group key cols then agg cols
+
+
+@dataclasses.dataclass
+class Sort(PlanNode):
+    child: PlanNode
+    keys: List[BoundExpr]
+    descendings: List[bool]
+    schema: Schema
+
+
+@dataclasses.dataclass
+class TopK(PlanNode):
+    child: PlanNode
+    keys: List[BoundExpr]
+    descendings: List[bool]
+    k: int
+    offset: int
+    schema: Schema
+
+
+@dataclasses.dataclass
+class Limit(PlanNode):
+    child: PlanNode
+    n: Optional[int]
+    offset: int
+    schema: Schema
+
+
+@dataclasses.dataclass
+class Join(PlanNode):
+    kind: str               # inner | left | cross  (right is flipped to left)
+    left: PlanNode
+    right: PlanNode
+    left_keys: List[BoundExpr]
+    right_keys: List[BoundExpr]
+    residual: Optional[BoundExpr]
+    schema: Schema
+
+
+@dataclasses.dataclass
+class Distinct(PlanNode):
+    child: PlanNode
+    schema: Schema
+
+
+@dataclasses.dataclass
+class Values(PlanNode):
+    rows: List[list]
+    schema: Schema
+
+
+@dataclasses.dataclass
+class VectorTopK(PlanNode):
+    """Index-accelerated `ORDER BY distance(col, const) LIMIT k` — the
+    reference's applyIndices rewrite (plan/apply_indices_ivfflat.go)."""
+    table: str
+    index_name: str
+    query_vector: list
+    k: int
+    metric: str
+    columns: List[str]
+    schema: Schema
+    nprobe: int = 8
+
+
+def explain(node: PlanNode, indent: int = 0) -> str:
+    pad = "  " * indent
+    name = type(node).__name__
+    extra = ""
+    if isinstance(node, Scan):
+        extra = f" table={node.table} cols={node.columns}" + (
+            f" filters={len(node.filters)}" if node.filters else "")
+    elif isinstance(node, Aggregate):
+        extra = f" keys={len(node.group_keys)} aggs={[a.func for a in node.aggs]}"
+    elif isinstance(node, (Sort, TopK)):
+        extra = f" desc={node.descendings}" + (
+            f" k={node.k}" if isinstance(node, TopK) else "")
+    elif isinstance(node, Join):
+        extra = f" kind={node.kind}"
+    elif isinstance(node, VectorTopK):
+        extra = f" index={node.index_name} k={node.k} metric={node.metric}"
+    lines = [f"{pad}{name}{extra}  -> {[n for n, _ in node.schema]}"]
+    for attr in ("child", "left", "right"):
+        c = getattr(node, attr, None)
+        if c is not None:
+            lines.append(explain(c, indent + 1))
+    return "\n".join(lines)

@@ -1,0 +1,3 @@
+from matrixone_tpu.storage.memtable import Catalog, IndexMeta, MemTable, TableMeta
+
+__all__ = ["Catalog", "IndexMeta", "MemTable", "TableMeta"]

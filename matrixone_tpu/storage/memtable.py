@@ -1,0 +1,233 @@
+"""In-memory columnar table + catalog (milestone storage; the objectio/TAE
+persistence layer replaces the backing store later, keeping this interface).
+
+Reference analogue: the Engine -> Database -> Relation -> Reader chain
+(`pkg/vm/engine/types.go:1210`) collapsed to the minimum: a Relation stores
+columns as numpy arrays with validity + table-global dictionaries for
+varchar (so dictionary codes are consistent across all scan batches), and
+serves chunked scans with zonemap pruning (`readutil` analogue: per-chunk
+min/max skip).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from matrixone_tpu.container import dtypes as dt
+from matrixone_tpu.container.batch import Batch
+from matrixone_tpu.container.dtypes import DType, TypeOid
+from matrixone_tpu.sql.expr import (BoundCol, BoundExpr, BoundFunc,
+                                    BoundLiteral)
+
+Schema = List[Tuple[str, DType]]
+
+
+@dataclasses.dataclass
+class TableMeta:
+    name: str
+    schema: Schema
+    primary_key: List[str]
+
+
+class MemTable:
+    def __init__(self, meta: TableMeta):
+        self.meta = meta
+        self.n_rows = 0
+        self.columns: Dict[str, List[np.ndarray]] = {c: [] for c, _ in meta.schema}
+        self.validity: Dict[str, List[np.ndarray]] = {c: [] for c, _ in meta.schema}
+        self.dicts: Dict[str, List[str]] = {
+            c: [] for c, d in meta.schema if d.is_varlen}
+        self._dict_idx: Dict[str, Dict[str, int]] = {
+            c: {} for c in self.dicts}
+
+    @property
+    def schema(self) -> Schema:
+        return self.meta.schema
+
+    # ------------------------------------------------------------- write
+    def insert_batch(self, batch: Batch) -> int:
+        n = len(batch)
+        if n == 0:
+            return 0
+        for col, dtype in self.meta.schema:
+            vec = batch.columns[col]
+            val = vec.valid_mask()
+            if dtype.is_varlen:
+                codes = self._encode_strings(col, vec)
+                self.columns[col].append(codes)
+            else:
+                self.columns[col].append(
+                    np.asarray(vec.data, dtype=dtype.np_dtype))
+            self.validity[col].append(val.copy())
+        self.n_rows += n
+        return n
+
+    def insert_numpy(self, arrays: Dict[str, np.ndarray],
+                     validity: Optional[Dict[str, np.ndarray]] = None,
+                     strings: Optional[Dict[str, tuple]] = None) -> int:
+        """Bulk load: numeric columns as arrays; varchar columns as
+        (codes, categories) pairs in `strings` (codes are remapped into the
+        table-global dictionary). The ETL fast path (reference:
+        colexec/external CSV load)."""
+        strings = strings or {}
+        n = None
+        for col, dtype in self.meta.schema:
+            if dtype.is_varlen:
+                codes, cats = strings[col]
+                lut, d = self._dict_idx[col], self.dicts[col]
+                remap = np.empty(len(cats), dtype=np.int32)
+                for i, s in enumerate(cats):
+                    code = lut.get(s)
+                    if code is None:
+                        code = len(d)
+                        lut[s] = code
+                        d.append(s)
+                    remap[i] = code
+                arr = remap[np.asarray(codes, dtype=np.int64)]
+            else:
+                arr = np.asarray(arrays[col], dtype=dtype.np_dtype)
+            if n is None:
+                n = len(arr)
+            self.columns[col].append(arr)
+            val = None if validity is None else validity.get(col)
+            self.validity[col].append(
+                val.copy() if val is not None else np.ones(n, np.bool_))
+        self.n_rows += n
+        return n
+
+    def _encode_strings(self, col: str, vec) -> np.ndarray:
+        lut = self._dict_idx[col]
+        d = self.dicts[col]
+        out = np.zeros(len(vec), dtype=np.int32)
+        values = vec.strings.to_pylist()
+        for i, s in enumerate(values):
+            if s is None:
+                continue
+            code = lut.get(s)
+            if code is None:
+                code = len(d)
+                lut[s] = code
+                d.append(s)
+            out[i] = code
+        return out
+
+    # -------------------------------------------------------------- read
+    def iter_chunks(self, columns: List[str], batch_rows: int,
+                    filters: Optional[List[BoundExpr]] = None,
+                    qualified_names: Optional[List[str]] = None
+                    ) -> Iterator[tuple]:
+        """Yield (arrays, validity, dicts, n_rows) chunks; chunks whose
+        zonemaps prove no row can pass a pushed filter are skipped."""
+        if self.n_rows == 0:
+            return
+        full = {c: (np.concatenate(self.columns[c]) if self.columns[c]
+                    else np.zeros(0)) for c in columns}
+        fval = {c: np.concatenate(self.validity[c]) for c in columns}
+        qmap = dict(zip(qualified_names or columns, columns))
+        for start in range(0, self.n_rows, batch_rows):
+            end = min(start + batch_rows, self.n_rows)
+            arrays = {c: full[c][start:end] for c in columns}
+            validity = {c: fval[c][start:end] for c in columns}
+            if filters and self._zonemap_excludes(filters, arrays, validity,
+                                                  qmap):
+                continue
+            yield arrays, validity, self.dicts, end - start
+
+    def _zonemap_excludes(self, filters, arrays, validity, qmap) -> bool:
+        """True if a pushed `col <op> literal` filter excludes the chunk
+        by min/max (objectio zonemap analogue, evaluated on the chunk)."""
+        for f in filters:
+            if not (isinstance(f, BoundFunc) and f.op in
+                    ("lt", "le", "gt", "ge", "eq") and len(f.args) == 2):
+                continue
+            a, b = f.args
+            if isinstance(a, BoundCol) and isinstance(b, BoundLiteral):
+                col, lit, op = a, b, f.op
+            elif isinstance(b, BoundCol) and isinstance(a, BoundLiteral):
+                col, lit = b, a
+                op = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
+                      "eq": "eq"}[f.op]
+            else:
+                continue
+            raw = qmap.get(col.name, col.name)
+            if raw not in arrays or col.dtype.is_varlen:
+                continue
+            vals = arrays[raw][validity[raw]] if not validity[raw].all() \
+                else arrays[raw]
+            if len(vals) == 0:
+                return True
+            lo, hi = vals.min(), vals.max()
+            lv = lit.value
+            if col.dtype.oid == TypeOid.DECIMAL64:
+                # normalize literal into the column's scaled-int domain
+                lit_scale = (lit.dtype.scale
+                             if lit.dtype.oid == TypeOid.DECIMAL64 else 0)
+                if lit.dtype.oid == TypeOid.DECIMAL64 or lit.dtype.is_integer:
+                    lv = lv * 10 ** (col.dtype.scale - lit_scale)
+                else:
+                    continue  # float vs decimal: skip pruning, kernel decides
+            if not isinstance(lv, (int, float)):
+                continue
+            if op == "lt" and not (lo < lv):
+                return True
+            if op == "le" and not (lo <= lv):
+                return True
+            if op == "gt" and not (hi > lv):
+                return True
+            if op == "ge" and not (hi >= lv):
+                return True
+            if op == "eq" and not (lo <= lv <= hi):
+                return True
+        return False
+
+    def read_column_f32(self, col: str) -> np.ndarray:
+        """Dense f32 matrix for a VECF32 column (vector index build)."""
+        return np.concatenate(self.columns[col]).astype(np.float32)
+
+
+@dataclasses.dataclass
+class IndexMeta:
+    name: str
+    table: str
+    columns: List[str]
+    algo: str              # 'ivfflat' | ...
+    options: dict
+    index_obj: object = None   # device-resident IvfFlatIndex
+
+
+class Catalog:
+    """reference: pkg/catalog system tables, collapsed to a host dict."""
+
+    def __init__(self):
+        self.tables: Dict[str, MemTable] = {}
+        self.indexes: Dict[str, IndexMeta] = {}
+
+    def create_table(self, meta: TableMeta, if_not_exists=False):
+        if meta.name in self.tables:
+            if if_not_exists:
+                return
+            raise ValueError(f"table {meta.name} already exists")
+        self.tables[meta.name] = MemTable(meta)
+
+    def drop_table(self, name: str, if_exists=False):
+        if name not in self.tables:
+            if if_exists:
+                return
+            raise ValueError(f"no such table {name}")
+        del self.tables[name]
+        self.indexes = {k: v for k, v in self.indexes.items()
+                        if v.table != name}
+
+    def get_table(self, name: str) -> MemTable:
+        if name not in self.tables:
+            raise ValueError(f"no such table {name}")
+        return self.tables[name]
+
+    def get_table_meta(self, name: str) -> TableMeta:
+        return self.get_table(name).meta
+
+    def indexes_on(self, table: str) -> List[IndexMeta]:
+        return [ix for ix in self.indexes.values() if ix.table == table]

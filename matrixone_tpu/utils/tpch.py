@@ -1,0 +1,155 @@
+"""TPC-H-shaped data generator (lineitem) for benchmarks and BVT tests.
+
+NOT the official dbgen (no C dbgen in this image): column domains,
+correlations, and cardinalities follow the TPC-H spec for the columns Q1/Q6
+touch — qty 1..50, discount 0.00..0.10, tax 0.00..0.08, extendedprice =
+qty * partprice, returnflag R/A for shipped-before-1995-06-17 else N,
+linestatus F/O by shipdate — so predicate selectivities and group
+cardinalities match the real benchmark's shape. The correctness oracle is
+pandas over the same arrays, so result checking is exact regardless.
+
+Reference test corpus analogue: test/distributed/cases/benchmark/tpch.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Dict
+
+import numpy as np
+
+from matrixone_tpu.container import dtypes as dt
+from matrixone_tpu.storage.memtable import Catalog, TableMeta
+
+LINEITEM_SCHEMA = [
+    ("l_orderkey", dt.INT64),
+    ("l_partkey", dt.INT64),
+    ("l_suppkey", dt.INT64),
+    ("l_linenumber", dt.INT32),
+    ("l_quantity", dt.decimal64(15, 2)),
+    ("l_extendedprice", dt.decimal64(15, 2)),
+    ("l_discount", dt.decimal64(15, 2)),
+    ("l_tax", dt.decimal64(15, 2)),
+    ("l_returnflag", dt.DType(dt.TypeOid.CHAR, width=1)),
+    ("l_linestatus", dt.DType(dt.TypeOid.CHAR, width=1)),
+    ("l_shipdate", dt.DATE),
+    ("l_commitdate", dt.DATE),
+    ("l_receiptdate", dt.DATE),
+]
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+def _days(y, m, d):
+    return (datetime.date(y, m, d) - _EPOCH).days
+
+
+def gen_lineitem(n_rows: int, seed: int = 0) -> Dict[str, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    qty = rng.integers(1, 51, n_rows).astype(np.int64)          # 1..50
+    partprice = rng.integers(90000, 10500001, n_rows)           # cents
+    extprice = (qty * partprice) // 100                         # cents
+    discount = rng.integers(0, 11, n_rows).astype(np.int64)     # 0.00..0.10
+    tax = rng.integers(0, 9, n_rows).astype(np.int64)           # 0.00..0.08
+    ship = rng.integers(_days(1992, 1, 2), _days(1998, 12, 2),
+                        n_rows).astype(np.int32)
+    commit = ship + rng.integers(-30, 61, n_rows).astype(np.int32)
+    receipt = ship + rng.integers(1, 31, n_rows).astype(np.int32)
+    cutoff = _days(1995, 6, 17)
+    # returnflag: shipped long ago -> R or A; recent -> N (spec 4.2.3 shape)
+    old = receipt <= cutoff
+    ra = rng.integers(0, 2, n_rows)
+    flag_codes = np.where(old, ra, 2).astype(np.int32)          # 0=A 1=R 2=N
+    status_codes = (ship > _days(1995, 6, 17)).astype(np.int32)  # 0=F 1=O
+    return {
+        "l_orderkey": rng.integers(1, n_rows, n_rows).astype(np.int64),
+        "l_partkey": rng.integers(1, 200001, n_rows).astype(np.int64),
+        "l_suppkey": rng.integers(1, 10001, n_rows).astype(np.int64),
+        "l_linenumber": rng.integers(1, 8, n_rows).astype(np.int32),
+        "l_quantity": qty * 100,          # decimal(15,2) scaled
+        "l_extendedprice": extprice,      # already cents
+        "l_discount": discount,           # cents scale (0.00-0.10)
+        "l_tax": tax,
+        "l_returnflag": flag_codes,
+        "l_linestatus": status_codes,
+        "l_shipdate": ship,
+        "l_commitdate": commit,
+        "l_receiptdate": receipt,
+    }
+
+
+FLAG_CATS = ["A", "R", "N"]
+STATUS_CATS = ["F", "O"]
+
+
+def load_lineitem(catalog: Catalog, n_rows: int, seed: int = 0,
+                  table: str = "lineitem") -> Dict[str, np.ndarray]:
+    """Create + bulk-load lineitem; returns raw arrays for oracle checks."""
+    catalog.create_table(TableMeta(table, LINEITEM_SCHEMA, ["l_orderkey"]),
+                         if_not_exists=True)
+    t = catalog.get_table(table)
+    arrays = gen_lineitem(n_rows, seed)
+    t.insert_numpy(
+        arrays,
+        strings={"l_returnflag": (arrays["l_returnflag"], FLAG_CATS),
+                 "l_linestatus": (arrays["l_linestatus"], STATUS_CATS)})
+    return arrays
+
+
+def q1_oracle(arrays: Dict[str, np.ndarray], delta_days: int = 90):
+    """Exact integer-domain Q1 oracle (pandas-free, pure numpy)."""
+    cutoff = _days(1998, 12, 1) - delta_days
+    sel = arrays["l_shipdate"] <= cutoff
+    flags = np.asarray(FLAG_CATS)[arrays["l_returnflag"][sel]]
+    stats = np.asarray(STATUS_CATS)[arrays["l_linestatus"][sel]]
+    qty = arrays["l_quantity"][sel]            # scale 2
+    price = arrays["l_extendedprice"][sel]     # scale 2
+    disc = arrays["l_discount"][sel]           # scale 2
+    tax = arrays["l_tax"][sel]                 # scale 2
+    out = {}
+    for f in np.unique(flags):
+        for s_ in np.unique(stats):
+            m = (flags == f) & (stats == s_)
+            if not m.any():
+                continue
+            q, p, d_, t_ = (x[m].astype(object) for x in (qty, price, disc, tax))
+            disc_price = p * (100 - d_)                  # scale 4
+            charge = disc_price * (100 + t_)             # scale 6
+            out[(f, s_)] = {
+                "sum_qty": int(q.sum()),                 # scale 2
+                "sum_base_price": int(p.sum()),          # scale 2
+                "sum_disc_price": int(disc_price.sum()),  # scale 4
+                "sum_charge": int(charge.sum()),         # scale 6
+                "avg_qty": q.sum() / len(q) / 100,
+                "avg_price": p.sum() / len(p) / 100,
+                "avg_disc": d_.sum() / len(d_) / 100,
+                "count_order": int(m.sum()),
+            }
+    return out
+
+
+Q1_SQL = """
+select
+    l_returnflag, l_linestatus,
+    sum(l_quantity) as sum_qty,
+    sum(l_extendedprice) as sum_base_price,
+    sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+    sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+    avg(l_quantity) as avg_qty,
+    avg(l_extendedprice) as avg_price,
+    avg(l_discount) as avg_disc,
+    count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-12-01' - interval '90' day
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+
+Q6_SQL = """
+select sum(l_extendedprice * l_discount) as revenue
+from lineitem
+where l_shipdate >= date '1994-01-01'
+  and l_shipdate < date '1994-01-01' + interval '1' year
+  and l_discount between 0.05 and 0.07
+  and l_quantity < 24
+"""

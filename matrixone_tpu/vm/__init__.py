@@ -1,0 +1,3 @@
+from matrixone_tpu.vm import compile, exprs, join, operators
+
+__all__ = ["compile", "exprs", "join", "operators"]

@@ -1,0 +1,35 @@
+"""Plan -> operator tree (reference: pkg/sql/compile/compile.go:670
+compileScope, collapsed: one process, one pipeline per plan for now;
+ParallelRun/RemoteRun equivalents live in matrixone_tpu.parallel)."""
+
+from __future__ import annotations
+
+from matrixone_tpu.sql import plan as P
+from matrixone_tpu.vm import operators as ops
+
+
+def compile_plan(node: P.PlanNode, catalog) -> ops.Operator:
+    if isinstance(node, P.Scan):
+        rel = catalog.get_table(node.table)
+        return ops.ScanOp(node, rel)
+    if isinstance(node, P.Values):
+        return ops.ValuesOp(node)
+    if isinstance(node, P.Filter):
+        return ops.FilterOp(node, compile_plan(node.child, catalog))
+    if isinstance(node, P.Project):
+        return ops.ProjectOp(node, compile_plan(node.child, catalog))
+    if isinstance(node, P.Aggregate):
+        return ops.AggOp(node, compile_plan(node.child, catalog))
+    if isinstance(node, P.Sort):
+        return ops.SortOp(node, compile_plan(node.child, catalog))
+    if isinstance(node, P.TopK):
+        return ops.TopKOp(node, compile_plan(node.child, catalog))
+    if isinstance(node, P.Limit):
+        return ops.LimitOp(node, compile_plan(node.child, catalog))
+    if isinstance(node, P.Distinct):
+        return ops.DistinctOp(node, compile_plan(node.child, catalog))
+    if isinstance(node, P.Join):
+        from matrixone_tpu.vm.join import JoinOp
+        return JoinOp(node, compile_plan(node.left, catalog),
+                      compile_plan(node.right, catalog))
+    raise NotImplementedError(f"compile: {type(node).__name__}")

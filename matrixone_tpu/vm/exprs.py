@@ -1,0 +1,272 @@
+"""Expression evaluation over device batches.
+
+Reference analogue: `colexec/evalExpression.go` + the function kernels it
+dispatches to (`plan/function`, `vectorize/`, cgo XCall). Here the whole
+bound-expression tree evaluates inside one traced JAX computation, so XLA
+fuses the entire WHERE clause (or projection list) into a single kernel
+over the batch.
+
+Varchar columns arrive as dictionary codes + a host-side dictionary
+(ExecBatch.dicts): string predicates are evaluated on the *dictionary*
+(host, tiny) and become code-space operations on device — `eq` is a code
+compare, LIKE is a host regex over distinct values turned into a boolean
+LUT gather.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import re
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from matrixone_tpu.container import dtypes as dt
+from matrixone_tpu.container.device import DeviceBatch, DeviceColumn
+from matrixone_tpu.container.dtypes import DType, TypeOid
+from matrixone_tpu.ops import distance as D, scalar as S
+from matrixone_tpu.sql.expr import (BoundCase, BoundCast, BoundCol,
+                                    BoundExpr, BoundFunc, BoundInList,
+                                    BoundIsNull, BoundLike, BoundLiteral)
+
+
+@dataclasses.dataclass
+class ExecBatch:
+    """A batch mid-pipeline: device columns + host dictionaries + row mask.
+
+    `mask` folds the batch row_mask with every filter applied so far —
+    operators consume masks instead of compacting (ops/filter.py rationale).
+    """
+    batch: DeviceBatch
+    dicts: Dict[str, List[str]]
+    mask: jnp.ndarray
+
+    @property
+    def padded_len(self) -> int:
+        return self.batch.padded_len
+
+
+class EvalError(ValueError):
+    pass
+
+
+def _is_varchar(dtype: DType) -> bool:
+    return dtype.is_varlen
+
+
+def _dict_of(e: BoundExpr, ex: ExecBatch) -> Optional[List[str]]:
+    if isinstance(e, BoundCol):
+        return ex.dicts.get(e.name)
+    return None
+
+
+def eval_expr(e: BoundExpr, ex: ExecBatch) -> DeviceColumn:
+    if isinstance(e, BoundCol):
+        return ex.batch.columns[e.name]
+    if isinstance(e, BoundLiteral):
+        if e.value is None:
+            return DeviceColumn.const_null(e.dtype)
+        if e.dtype.is_vector:
+            data = jnp.asarray([e.value], dtype=e.dtype.jnp_dtype)
+            return DeviceColumn(data, jnp.ones((1,), jnp.bool_), e.dtype)
+        if _is_varchar(e.dtype):
+            raise EvalError("bare string literal column not supported; "
+                            "strings appear only inside predicates")
+        return DeviceColumn.const(e.value, e.dtype)
+    if isinstance(e, BoundCast):
+        return S.cast(eval_expr(e.arg, ex), e.dtype)
+    if isinstance(e, BoundIsNull):
+        col = eval_expr(e.arg, ex)
+        out = S.isnotnull(col) if e.negated else S.isnull(col)
+        return out
+    if isinstance(e, BoundCase):
+        if _is_varchar(e.dtype):
+            return _eval_case_strings(e, ex)
+        else_col = (eval_expr(e.else_, ex) if e.else_ is not None
+                    else DeviceColumn.const_null(e.dtype))
+        out = else_col
+        for cond, val in reversed(e.whens):
+            out = S.case_when(eval_expr(cond, ex), eval_expr(val, ex), out)
+        return out
+    if isinstance(e, BoundInList):
+        arg = eval_expr(e.arg, ex)
+        d = _dict_of(e.arg, ex)
+        if d is not None:
+            code_of = {s: i for i, s in enumerate(d)}
+            codes = [code_of[v] for v in e.values if v in code_of]
+            if not codes:
+                base = DeviceColumn(jnp.zeros(arg.data.shape, jnp.bool_),
+                                    arg.validity, dt.BOOL)
+            else:
+                base = S.in_list(arg, codes)
+        else:
+            base = S.in_list(arg, list(e.values))
+        return S.logical_not(base) if e.negated else base
+    if isinstance(e, BoundLike):
+        arg = eval_expr(e.arg, ex)
+        d = _dict_of(e.arg, ex)
+        if d is None:
+            raise EvalError("LIKE requires a varchar column")
+        rx = _like_regex(e.pattern)
+        lut = np.array([bool(rx.match(s)) for s in d], dtype=np.bool_)
+        if e.negated:
+            lut = ~lut
+        hit = jnp.asarray(lut)[jnp.clip(arg.data, 0, len(d) - 1)]
+        return DeviceColumn(hit, arg.validity, dt.BOOL)
+    if isinstance(e, BoundFunc):
+        return _eval_func(e, ex)
+    raise EvalError(f"unsupported expression {type(e).__name__}")
+
+
+_SIMPLE = {
+    "add": S.add, "sub": S.sub, "mul": S.mul, "div": S.div, "mod": S.mod,
+    "and": S.logical_and, "or": S.logical_or,
+    "abs": S.abs_, "floor": S.floor, "ceil": S.ceil, "sqrt": S.sqrt,
+    "exp": S.exp, "ln": S.ln, "sin": S.sin, "cos": S.cos, "power": S.power,
+    "coalesce": S.coalesce,
+}
+
+_CMP = {"eq": S.eq, "ne": S.ne, "lt": S.lt, "le": S.le, "gt": S.gt,
+        "ge": S.ge}
+
+
+def case_string_dict(e: BoundCase) -> List[str]:
+    """Deterministic dictionary for a CASE with string-literal branches
+    (ProjectOp uses the same function to attach the output dictionary)."""
+    out: List[str] = []
+    branches = [v for _, v in e.whens] + ([e.else_] if e.else_ else [])
+    for v in branches:
+        if isinstance(v, BoundLiteral) and isinstance(v.value, str):
+            if v.value not in out:
+                out.append(v.value)
+        elif v is not None:
+            raise EvalError("string CASE branches must be literals for now")
+    return out or [""]
+
+
+def _eval_case_strings(e: BoundCase, ex: ExecBatch) -> DeviceColumn:
+    d = case_string_dict(e)
+    code_of = {s: i for i, s in enumerate(d)}
+
+    def code_col(v) -> DeviceColumn:
+        if v is None or (isinstance(v, BoundLiteral) and v.value is None):
+            return DeviceColumn.const_null(dt.INT32)
+        return DeviceColumn.const(code_of[v.value], dt.INT32)
+
+    out = code_col(e.else_)
+    for cond, val in reversed(e.whens):
+        out = S.case_when(eval_expr(cond, ex), code_col(val), out)
+    # tag with the SQL string type; dict attached by the projection
+    return DeviceColumn(out.data, out.validity, e.dtype)
+
+
+def _eval_func(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
+    op = e.op
+    if op in _CMP:
+        return _eval_compare(e, ex)
+    if op == "not":
+        return S.logical_not(eval_expr(e.args[0], ex))
+    if op == "neg":
+        return S.neg(eval_expr(e.args[0], ex))
+    if op == "round":
+        a = eval_expr(e.args[0], ex)
+        digits = e.args[1].value if len(e.args) > 1 else 0
+        return S.round_(a, int(digits))
+    if op == "date_add_days":
+        a = eval_expr(e.args[0], ex)
+        delta = eval_expr(e.args[1], ex)
+        da, db, valid = S._broadcast2(a, delta)
+        return DeviceColumn((da.astype(jnp.int32) + db.astype(jnp.int32)),
+                            valid, dt.DATE)
+    if op in ("year", "month", "day"):
+        a = eval_expr(e.args[0], ex)
+        y, m, d = _civil_from_days(a.data.astype(jnp.int64))
+        out = {"year": y, "month": m, "day": d}[op]
+        return DeviceColumn(out.astype(jnp.int32), a.validity, dt.INT32)
+    if op in ("l2_distance", "l2_distance_sq", "cosine_distance",
+              "inner_product", "cosine_similarity"):
+        return _eval_distance(e, ex)
+    if op in _SIMPLE:
+        args = [eval_expr(a, ex) for a in e.args]
+        return _SIMPLE[op](*args)
+    raise EvalError(f"unsupported function {op}")
+
+
+def _eval_compare(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
+    a_raw, b_raw = e.args
+    a_dict, b_dict = _dict_of(a_raw, ex), _dict_of(b_raw, ex)
+    a_is_str_lit = isinstance(a_raw, BoundLiteral) and _is_varchar(a_raw.dtype)
+    b_is_str_lit = isinstance(b_raw, BoundLiteral) and _is_varchar(b_raw.dtype)
+    if a_dict is not None or b_dict is not None or a_is_str_lit or b_is_str_lit:
+        # string comparison: evaluate on the dictionary, gather on codes
+        if a_dict is not None and (b_is_str_lit or b_dict is not None):
+            col_e, other = a_raw, b_raw
+            d = a_dict
+            flip = False
+        elif b_dict is not None and a_is_str_lit:
+            col_e, other = b_raw, a_raw
+            d = b_dict
+            flip = True
+        else:
+            raise EvalError("unsupported string comparison")
+        col = eval_expr(col_e, ex)
+        if isinstance(other, BoundLiteral):
+            lit = str(other.value)
+            op = e.op
+            if flip:
+                op = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}.get(op, op)
+            cmp_fn = {"eq": lambda s: s == lit, "ne": lambda s: s != lit,
+                      "lt": lambda s: s < lit, "le": lambda s: s <= lit,
+                      "gt": lambda s: s > lit, "ge": lambda s: s >= lit}[op]
+            lut = np.array([cmp_fn(s) for s in d], dtype=np.bool_)
+            hit = jnp.asarray(lut)[jnp.clip(col.data, 0, len(d) - 1)]
+            return DeviceColumn(hit, col.validity, dt.BOOL)
+        # column vs column over the SAME dictionary (same table column)
+        other_col = eval_expr(other, ex)
+        if _dict_of(other, ex) is d and e.op in ("eq", "ne"):
+            return _CMP[e.op](col, other_col)
+        raise EvalError("cross-dictionary string comparison not supported yet")
+    return _CMP[e.op](eval_expr(a_raw, ex), eval_expr(b_raw, ex))
+
+
+def _eval_distance(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
+    a = eval_expr(e.args[0], ex)
+    b = eval_expr(e.args[1], ex)
+    da, db, valid = S._broadcast2(a, b)
+    fn = {"l2_distance": D.l2_distance_rowwise,
+          "l2_distance_sq": lambda x, y: D.l2_distance_rowwise(x, y) ** 2,
+          "cosine_distance": D.cosine_distance_rowwise,
+          "inner_product": D.inner_product_rowwise,
+          "cosine_similarity": lambda x, y: 1.0 - D.cosine_distance_rowwise(x, y),
+          }[e.op]
+    return DeviceColumn(fn(da, db), valid, dt.FLOAT64)
+
+
+def _like_regex(pattern: str) -> "re.Pattern":
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return re.compile("^" + "".join(out) + "$", re.DOTALL)
+
+
+def _civil_from_days(z: jnp.ndarray):
+    """Epoch days -> (year, month, day); Howard Hinnant's civil algorithm
+    (public domain), integer-only so it runs on device."""
+    z = z + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d

@@ -1,0 +1,187 @@
+"""Joins as sort + searchsorted probes — no pointer-chasing hash tables.
+
+Reference analogue: `colexec/hashbuild` + `colexec/join` (and loopjoin for
+cross). TPU re-design:
+
+  build:  hash build-side keys -> argsort -> sorted hash array   (one sort)
+  probe:  hash probe keys -> searchsorted (log n vectorized binary search)
+          -> expand up to `max_matches` consecutive duplicates -> verify
+          real key equality (hashes only route; equality decides) -> gather
+
+Duplicate fan-out beyond max_matches is detected on host and the probe
+re-runs with a doubled budget — the shape-bucketing trick the rest of the
+engine uses, applied to join multiplicity.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import jax
+import jax.numpy as jnp
+
+from matrixone_tpu.container import dtypes as dt
+from matrixone_tpu.container.device import DeviceBatch, DeviceColumn
+from matrixone_tpu.ops import filter as F, hash as H
+from matrixone_tpu.sql import plan as P
+from matrixone_tpu.vm.exprs import ExecBatch, eval_expr
+from matrixone_tpu.vm.operators import Operator, _broadcast_full, _concat_batches
+
+
+class JoinOp(Operator):
+    def __init__(self, node: P.Join, left: Operator, right: Operator,
+                 max_matches: int = 4):
+        self.node = node
+        self.left = left
+        self.right = right
+        self.schema = node.schema
+        self.max_matches = max_matches
+
+    def execute(self) -> Iterator[ExecBatch]:
+        build_batches = list(self.right.execute())
+        if not build_batches and self.node.kind == "inner":
+            return
+        build = (_concat_batches(build_batches, self.node.right.schema)
+                 if build_batches else None)
+        if self.node.kind == "cross":
+            yield from self._cross(build)
+            return
+        if build is None:
+            # LEFT JOIN with empty right side: all left rows null-extended
+            for ex in self.left.execute():
+                yield self._null_extend_all(ex)
+            return
+        # build side: dense-compact masked rows, hash + sort keys
+        bkeys = [_broadcast_full(eval_expr(k, build), build.padded_len)
+                 for k in self.node.right_keys]
+        bhash = H.hash_columns([k.data for k in bkeys],
+                               [k.validity for k in bkeys])
+        # rows with NULL keys never match (SQL equi-join semantics)
+        bvalid = build.mask
+        for k in bkeys:
+            bvalid = bvalid & k.validity
+        bhash = jnp.where(bvalid, bhash, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+        order = jnp.argsort(bhash).astype(jnp.int32)
+        sorted_hash = bhash[order]
+
+        for ex in self.left.execute():
+            yield from self._probe(ex, build, sorted_hash, order, bkeys)
+
+    def _probe(self, ex: ExecBatch, build, sorted_hash, border, bkeys):
+        pkeys = [_broadcast_full(eval_expr(k, ex), ex.padded_len)
+                 for k in self.node.left_keys]
+        phash = H.hash_columns([k.data for k in pkeys],
+                               [k.validity for k in pkeys])
+        pvalid = ex.mask
+        for k in pkeys:
+            pvalid = pvalid & k.validity
+        mm = self.max_matches
+        while True:
+            out, overflow = self._expand(ex, build, sorted_hash, border,
+                                         phash, pvalid, pkeys, bkeys, mm)
+            if not overflow:
+                break
+            mm *= 2
+        yield out
+
+    def _expand(self, ex, build, sorted_hash, border, phash, pvalid,
+                pkeys, bkeys, mm):
+        np_ = ex.padded_len
+        start = jnp.searchsorted(sorted_hash, phash)          # [np]
+        lane = jnp.arange(mm, dtype=jnp.int32)
+        pos = start[:, None] + lane[None, :]                  # [np, mm]
+        pos_c = jnp.clip(pos, 0, sorted_hash.shape[0] - 1)
+        cand_hash = sorted_hash[pos_c]
+        hash_ok = (cand_hash == phash[:, None]) & \
+            (pos < sorted_hash.shape[0]) & pvalid[:, None]
+        cand_rows = border[pos_c]                             # build row ids
+        # verify true key equality (hash only routes)
+        key_ok = hash_ok
+        for pk, bk in zip(pkeys, bkeys):
+            pv = pk.data[:, None]
+            bv = bk.data[cand_rows]
+            if pk.data.dtype != bv.dtype:
+                ct = jnp.promote_types(pk.data.dtype, bv.dtype)
+                pv, bv = pv.astype(ct), bv.astype(ct)
+            key_ok = key_ok & (pv == bv)
+        # overflow: a (mm+1)-th duplicate would also match
+        extra = jnp.clip(start + mm, 0, sorted_hash.shape[0] - 1)
+        overflow = bool(jax.device_get(jnp.any(
+            (sorted_hash[extra] == phash) & (start + mm < sorted_hash.shape[0])
+            & pvalid)))
+
+        match = key_ok.reshape(-1)                            # [np*mm]
+        probe_idx = jnp.repeat(jnp.arange(np_, dtype=jnp.int32), mm)
+        build_idx = cand_rows.reshape(-1)
+
+        cols = {}
+        for name, _ in self.node.left.schema:
+            c = _broadcast_full(ex.batch.columns[name], np_)
+            cols[name] = DeviceColumn(c.data[probe_idx],
+                                      c.validity[probe_idx], c.dtype)
+        for name, _ in self.node.right.schema:
+            c = _broadcast_full(build.batch.columns[name], build.padded_len)
+            validity = c.validity[build_idx] & match
+            cols[name] = DeviceColumn(c.data[build_idx], validity, c.dtype)
+        db = DeviceBatch(columns=cols, n_rows=jnp.sum(match.astype(jnp.int32)))
+        out = ExecBatch(batch=db, dicts={**build.dicts, **ex.dicts},
+                        mask=match)
+        # residual ON predicate filters match lanes BEFORE left-join
+        # null-extension: a left row whose matches all fail the residual
+        # still emits one null-extended row (MySQL semantics)
+        if self.node.residual is not None:
+            pred = eval_expr(self.node.residual, out)
+            out.mask = out.mask & F.predicate_mask(pred, db)
+        if self.node.kind == "left":
+            matched_any = jnp.any(out.mask.reshape(np_, mm), axis=1)
+            lane0 = jnp.tile(lane == 0, (np_,))
+            null_emit = lane0 & ~jnp.repeat(matched_any, mm) & \
+                jnp.repeat(ex.mask, mm)
+            # null-extended lanes: right-side columns must read as NULL
+            for name, _ in self.node.right.schema:
+                c = out.batch.columns[name]
+                out.batch.columns[name] = DeviceColumn(
+                    c.data, c.validity & ~null_emit, c.dtype)
+            out.mask = out.mask | null_emit
+        out.batch.n_rows = jnp.sum(out.mask.astype(jnp.int32))
+        return out, overflow
+
+    def _null_extend_all(self, ex: ExecBatch) -> ExecBatch:
+        np_ = ex.padded_len
+        cols = {}
+        for name, _ in self.node.left.schema:
+            cols[name] = _broadcast_full(ex.batch.columns[name], np_)
+        for name, dtype in self.node.right.schema:
+            jt = jnp.int32 if dtype.is_varlen else dtype.jnp_dtype
+            shape = (np_, dtype.dim) if dtype.is_vector else (np_,)
+            cols[name] = DeviceColumn(jnp.zeros(shape, jt),
+                                      jnp.zeros((np_,), jnp.bool_), dtype)
+        db = DeviceBatch(columns=cols, n_rows=ex.batch.n_rows)
+        return ExecBatch(batch=db, dicts=dict(ex.dicts), mask=ex.mask)
+
+    def _cross(self, build):
+        if build is None:
+            return
+        nb = build.padded_len
+        for ex in self.left.execute():
+            np_ = ex.padded_len
+            probe_idx = jnp.repeat(jnp.arange(np_, dtype=jnp.int32), nb)
+            build_idx = jnp.tile(jnp.arange(nb, dtype=jnp.int32), (np_,))
+            emit = jnp.repeat(ex.mask, nb) & jnp.tile(build.mask, (np_,))
+            cols = {}
+            for name, _ in self.node.left.schema:
+                c = _broadcast_full(ex.batch.columns[name], np_)
+                cols[name] = DeviceColumn(c.data[probe_idx],
+                                          c.validity[probe_idx], c.dtype)
+            for name, _ in self.node.right.schema:
+                c = _broadcast_full(build.batch.columns[name], nb)
+                cols[name] = DeviceColumn(c.data[build_idx],
+                                          c.validity[build_idx], c.dtype)
+            db = DeviceBatch(columns=cols,
+                             n_rows=jnp.sum(emit.astype(jnp.int32)))
+            out = ExecBatch(batch=db, dicts={**build.dicts, **ex.dicts},
+                            mask=emit)
+            if self.node.residual is not None:
+                pred = eval_expr(self.node.residual, out)
+                out.mask = out.mask & F.predicate_mask(pred, db)
+            yield out

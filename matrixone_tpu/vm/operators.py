@@ -1,0 +1,560 @@
+"""Physical operators: host-driven loops over device batches.
+
+Reference analogue: `pkg/sql/colexec` operator packages + the `vm.Operator`
+pull loop (`vm/pipeline/pipeline.go:62`). Differences by design:
+
+  * operators yield ExecBatch (device arrays + mask) — filters produce
+    masks, not compacted rows, so filter+project+aggregate fuse into a
+    handful of XLA executables per batch instead of per-operator loops;
+  * group-by is the sort/segment kernel (ops.agg) with *streaming partial
+    merge*: each batch folds into a bounded device-resident group table
+    (the reference's agg hash table, re-expressed);
+  * sort/top-k materialize through concat + argsort/top_k — XLA-native.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from matrixone_tpu.container import dtypes as dt
+from matrixone_tpu.container.device import (DeviceBatch, DeviceColumn,
+                                            bucket_length)
+from matrixone_tpu.container.dtypes import DType, TypeOid
+from matrixone_tpu.ops import agg as A, filter as F, sort as msort
+from matrixone_tpu.sql import plan as P
+from matrixone_tpu.sql.expr import AggCall, BoundExpr
+from matrixone_tpu.vm.exprs import EvalError, ExecBatch, eval_expr
+
+
+class Operator:
+    def execute(self) -> Iterator[ExecBatch]:
+        raise NotImplementedError
+
+    schema: List
+
+
+# ------------------------------------------------------------------- scan
+
+class ScanOp(Operator):
+    """Table scan with filter pushdown + zonemap chunk pruning
+    (reference: colexec/table_scan + readutil block pruning)."""
+
+    def __init__(self, node: P.Scan, relation, batch_rows: int = 1 << 20):
+        self.node = node
+        self.rel = relation
+        self.batch_rows = batch_rows
+        self.schema = node.schema
+
+    def execute(self) -> Iterator[ExecBatch]:
+        qnames = [n for n, _ in self.node.schema]
+        for chunk in self.rel.iter_chunks(self.node.columns, self.batch_rows,
+                                          filters=self.node.filters,
+                                          qualified_names=qnames):
+            arrays, validity, dicts, n = chunk
+            from matrixone_tpu.container import device as dev
+            dtypes = {}
+            arr2, val2, dicts2 = {}, {}, {}
+            for qn, (col, dtype) in zip(qnames,
+                                        zip(self.node.columns,
+                                            [d for _, d in self.node.schema])):
+                arr2[qn] = arrays[col]
+                val2[qn] = validity[col]
+                dtypes[qn] = dt.INT32 if dtype.is_varlen else dtype
+                if col in dicts:
+                    dicts2[qn] = dicts[col]
+            db = dev.from_numpy(arr2, dtypes, val2, n_rows=n)
+            # tag varchar device columns with their SQL type
+            for qn, (_, dtype) in zip(qnames, self.node.schema):
+                if dtype.is_varlen:
+                    c = db.columns[qn]
+                    db.columns[qn] = DeviceColumn(c.data, c.validity, dtype)
+            ex = ExecBatch(batch=db, dicts=dicts2, mask=db.row_mask())
+            # evaluate pushed filters as an early mask (zonemap pruning
+            # already dropped fully-excluded chunks host-side)
+            for f in self.node.filters:
+                pred = eval_expr(f, ex)
+                ex.mask = ex.mask & F.predicate_mask(pred, db)
+            yield ex
+
+
+class ValuesOp(Operator):
+    def __init__(self, node: P.Values):
+        self.node = node
+        self.schema = node.schema
+
+    def execute(self) -> Iterator[ExecBatch]:
+        from matrixone_tpu.container import device as dev
+        arrays, dtypes = {}, {}
+        for i, (name, dtype) in enumerate(self.node.schema):
+            vals = [row[i] for row in self.node.rows]
+            arrays[name] = np.asarray(vals, dtype=dtype.np_dtype)
+            dtypes[name] = dtype
+        db = dev.from_numpy(arrays, dtypes, n_rows=len(self.node.rows))
+        yield ExecBatch(batch=db, dicts={}, mask=db.row_mask())
+
+
+# ----------------------------------------------------------------- filter
+
+class FilterOp(Operator):
+    def __init__(self, node: P.Filter, child: Operator):
+        self.node = node
+        self.child = child
+        self.schema = node.schema
+
+    def execute(self) -> Iterator[ExecBatch]:
+        for ex in self.child.execute():
+            pred = eval_expr(self.node.pred, ex)
+            ex.mask = ex.mask & F.predicate_mask(pred, ex.batch)
+            yield ex
+
+
+class ProjectOp(Operator):
+    def __init__(self, node: P.Project, child: Operator):
+        self.node = node
+        self.child = child
+        self.schema = node.schema
+
+    def execute(self) -> Iterator[ExecBatch]:
+        for ex in self.child.execute():
+            cols: Dict[str, DeviceColumn] = {}
+            dicts: Dict[str, List[str]] = {}
+            for (name, dtype), e in zip(self.node.schema, self.node.exprs):
+                col = eval_expr(e, ex)
+                cols[name] = col
+                src_dict = _expr_dict(e, ex)
+                if src_dict is not None:
+                    dicts[name] = src_dict
+            db = DeviceBatch(columns=cols, n_rows=ex.batch.n_rows)
+            yield ExecBatch(batch=db, dicts=dicts, mask=ex.mask)
+
+
+def _expr_dict(e: BoundExpr, ex: ExecBatch):
+    from matrixone_tpu.sql.expr import BoundCase, BoundCol
+    if isinstance(e, BoundCol):
+        return ex.dicts.get(e.name)
+    if isinstance(e, BoundCase) and e.dtype.is_varlen:
+        from matrixone_tpu.vm.exprs import case_string_dict
+        return case_string_dict(e)
+    return None
+
+
+# -------------------------------------------------------------- aggregate
+
+class AggOp(Operator):
+    """Streaming group-by: per-batch partial agg folded into a device-
+    resident group table (colexec/group + mergegroup, re-expressed)."""
+
+    def __init__(self, node: P.Aggregate, child: Operator,
+                 max_groups: int = 4096):
+        self.node = node
+        self.child = child
+        self.schema = node.schema
+        self.max_groups = max_groups
+
+    def execute(self) -> Iterator[ExecBatch]:
+        if not self.node.group_keys:
+            yield from self._scalar_agg()
+            return
+        yield from self._grouped_agg()
+
+    # ---- scalar (no GROUP BY)
+    def _scalar_agg(self):
+        states = [None] * len(self.node.aggs)
+        for ex in self.child.execute():
+            for i, a in enumerate(self.node.aggs):
+                states[i] = _scalar_step(a, ex, states[i])
+        cols, n1 = {}, jnp.asarray(1, jnp.int32)
+        for (name, dtype), a, st in zip(self.node.schema[len(self.node.group_keys):],
+                                        self.node.aggs, states):
+            cols[name] = _scalar_final(a, st, dtype)
+        db = DeviceBatch(columns=cols, n_rows=n1)
+        yield ExecBatch(batch=db, dicts={},
+                        mask=jnp.ones((1,), jnp.bool_))
+
+    # ---- grouped
+    def _grouped_agg(self):
+        nkeys = len(self.node.group_keys)
+        state = None   # dict: keys:[arrays], kvalid:[arrays], partials per agg
+        key_dicts: List[Optional[List[str]]] = [None] * nkeys
+        for ex in self.child.execute():
+            keys = [eval_expr(k, ex) for k in self.node.group_keys]
+            for i, (k_ast, k) in enumerate(zip(self.node.group_keys, keys)):
+                d = _expr_dict(k_ast, ex)
+                if d is not None:
+                    key_dicts[i] = d
+            part = self._partial(keys, ex)
+            state = part if state is None else self._merge(state, part)
+        if state is None:
+            state = self._empty_state()
+        yield self._finalize(state, key_dicts)
+
+    def _partial(self, keys: List[DeviceColumn], ex: ExecBatch):
+        mg = self.max_groups
+        kdata = [_broadcast_full(k, ex.padded_len).data for k in keys]
+        kvalid = [_broadcast_full(k, ex.padded_len).validity for k in keys]
+        gi = A.group_ids(kdata, kvalid, ex.mask, mg)
+        ng = int(jax.device_get(gi.num_groups))
+        if ng > mg:
+            raise EvalError(
+                f"group count {ng} exceeds max_groups={mg}; raise AggOp "
+                f"max_groups (adaptive re-bucketing lands with spill support)")
+        rep_k, rep_v = A.gather_keys(kdata, kvalid, gi.rep_rows)
+        present = jnp.arange(mg, dtype=jnp.int32) < gi.num_groups
+        partials = []
+        for a in self.node.aggs:
+            partials.append(_grouped_step(a, gi, ex, mg))
+        return {"keys": rep_k, "kvalid": rep_v, "present": present,
+                "partials": partials, "n": gi.num_groups}
+
+    def _merge(self, s1, s2):
+        """Merge two partial group tables by concatenating their rows and
+        re-grouping (mergegroup)."""
+        mg = self.max_groups
+        keys = [jnp.concatenate([a, b]) for a, b in zip(s1["keys"], s2["keys"])]
+        kvalid = [jnp.concatenate([a, b]) for a, b in zip(s1["kvalid"], s2["kvalid"])]
+        mask = jnp.concatenate([s1["present"], s2["present"]])
+        gi = A.group_ids(keys, kvalid, mask, mg)
+        ng = int(jax.device_get(gi.num_groups))
+        if ng > mg:
+            raise EvalError(f"group count {ng} exceeds max_groups={mg}")
+        rep_k, rep_v = A.gather_keys(keys, kvalid, gi.rep_rows)
+        present = jnp.arange(mg, dtype=jnp.int32) < gi.num_groups
+        partials = []
+        for a, p1, p2 in zip(self.node.aggs, s1["partials"], s2["partials"]):
+            partials.append(_grouped_merge(a, p1, p2, gi, mask, mg))
+        return {"keys": rep_k, "kvalid": rep_v, "present": present,
+                "partials": partials, "n": gi.num_groups}
+
+    def _empty_state(self):
+        mg = self.max_groups
+        keys, kvalid = [], []
+        for k in self.node.group_keys:
+            keys.append(jnp.zeros((mg,), k.dtype.jnp_dtype if not
+                                  k.dtype.is_varlen else jnp.int32))
+            kvalid.append(jnp.zeros((mg,), jnp.bool_))
+        partials = [_grouped_empty(a, mg) for a in self.node.aggs]
+        return {"keys": keys, "kvalid": kvalid,
+                "present": jnp.zeros((mg,), jnp.bool_),
+                "partials": partials, "n": jnp.asarray(0, jnp.int32)}
+
+    def _finalize(self, state, key_dicts) -> ExecBatch:
+        nkeys = len(self.node.group_keys)
+        cols: Dict[str, DeviceColumn] = {}
+        dicts: Dict[str, List[str]] = {}
+        for i, ((name, dtype), k) in enumerate(zip(self.node.schema[:nkeys],
+                                                   self.node.group_keys)):
+            cols[name] = DeviceColumn(state["keys"][i], state["kvalid"][i],
+                                      k.dtype)
+            if key_dicts[i] is not None:
+                dicts[name] = key_dicts[i]
+        for (name, dtype), a, part in zip(self.node.schema[nkeys:],
+                                          self.node.aggs, state["partials"]):
+            cols[name] = _grouped_final(a, part, dtype)
+        db = DeviceBatch(columns=cols, n_rows=state["n"])
+        return ExecBatch(batch=db, dicts=dicts, mask=state["present"])
+
+
+def _broadcast_full(col: DeviceColumn, n: int) -> DeviceColumn:
+    if col.data.shape[0] == n:
+        return col
+    return DeviceColumn(jnp.broadcast_to(col.data, (n,) + col.data.shape[1:]),
+                        jnp.broadcast_to(col.validity, (n,)), col.dtype)
+
+
+# agg kernels: per-batch partial, merge, finalize -------------------------
+
+def _agg_value(a: AggCall, ex: ExecBatch):
+    col = eval_expr(a.arg, ex)
+    col = _broadcast_full(col, ex.padded_len)
+    return col
+
+
+def _grouped_step(a: AggCall, gi, ex: ExecBatch, mg: int):
+    if a.func == "count" and a.arg is None:
+        return {"count": A.seg_count(gi.gids, ex.mask, mg)}
+    col = _agg_value(a, ex)
+    m = ex.mask & col.validity
+    if a.func == "count":
+        return {"count": A.seg_count(gi.gids, m, mg)}
+    if a.func == "sum":
+        return {"sum": A.seg_sum(col.data, gi.gids, m, mg),
+                "count": A.seg_count(gi.gids, m, mg)}
+    if a.func == "avg":
+        return {"sum": A.seg_sum(col.data.astype(jnp.float64)
+                                 if col.dtype.is_float else col.data,
+                                 gi.gids, m, mg),
+                "count": A.seg_count(gi.gids, m, mg)}
+    if a.func == "min":
+        return {"min": A.seg_min(col.data, gi.gids, m, mg),
+                "count": A.seg_count(gi.gids, m, mg)}
+    if a.func == "max":
+        return {"max": A.seg_max(col.data, gi.gids, m, mg),
+                "count": A.seg_count(gi.gids, m, mg)}
+    raise EvalError(f"unsupported aggregate {a.func}")
+
+
+def _grouped_merge(a: AggCall, p1, p2, gi, mask, mg: int):
+    out = {}
+    for field, vals in _concat_fields(p1, p2).items():
+        m = mask
+        if field in ("sum", "count"):
+            out[field] = A.seg_sum(vals, gi.gids, m, mg)
+        elif field == "min":
+            out[field] = A.seg_min(vals, gi.gids, m, mg)
+        elif field == "max":
+            out[field] = A.seg_max(vals, gi.gids, m, mg)
+    return out
+
+
+def _concat_fields(p1, p2):
+    return {k: jnp.concatenate([p1[k], p2[k]]) for k in p1}
+
+
+def _grouped_empty(a: AggCall, mg: int):
+    z64 = jnp.zeros((mg,), jnp.int64)
+    if a.func == "count" and a.arg is None:
+        return {"count": z64}
+    vt = a.arg.dtype.jnp_dtype
+    if a.func == "count":
+        return {"count": z64}
+    if a.func == "sum":
+        return {"sum": jnp.zeros((mg,), vt if a.arg.dtype.is_float else jnp.int64),
+                "count": z64}
+    if a.func == "avg":
+        return {"sum": jnp.zeros((mg,), jnp.float64 if a.arg.dtype.is_float
+                                 else jnp.int64), "count": z64}
+    if a.func in ("min", "max"):
+        return {a.func: jnp.zeros((mg,), vt), "count": z64}
+    raise EvalError(a.func)
+
+
+def _grouped_final(a: AggCall, part, dtype: DType) -> DeviceColumn:
+    valid = part["count"] > 0
+    if a.func == "count":
+        return DeviceColumn(part["count"], jnp.ones_like(valid), dt.INT64)
+    if a.func == "sum":
+        s = part["sum"]
+        if dtype.oid == TypeOid.DECIMAL64:
+            s = s.astype(jnp.int64)
+        return DeviceColumn(s.astype(dtype.jnp_dtype), valid, dtype)
+    if a.func == "avg":
+        s = part["sum"].astype(jnp.float64)
+        if a.arg.dtype.oid == TypeOid.DECIMAL64:
+            s = s / (10.0 ** a.arg.dtype.scale)
+        c = jnp.maximum(part["count"], 1).astype(jnp.float64)
+        return DeviceColumn(s / c, valid, dt.FLOAT64)
+    if a.func in ("min", "max"):
+        return DeviceColumn(part[a.func], valid, dtype)
+    raise EvalError(a.func)
+
+
+def _scalar_step(a: AggCall, ex: ExecBatch, state):
+    if a.func == "count" and a.arg is None:
+        v = A.scalar_count(ex.mask)
+        return v if state is None else state + v
+    col = _agg_value(a, ex)
+    m = ex.mask & col.validity
+    if a.func == "count":
+        v = A.scalar_count(m)
+        return v if state is None else state + v
+    if a.func in ("sum", "avg"):
+        s = A.scalar_sum(col.data.astype(jnp.float64)
+                         if (a.func == "avg" and col.dtype.is_float)
+                         else col.data, m)
+        c = A.scalar_count(m)
+        if state is None:
+            return (s, c)
+        return (state[0] + s, state[1] + c)
+    if a.func == "min":
+        v = A.scalar_min(col.data, m)
+        c = A.scalar_count(m)
+        return (v, c) if state is None else (jnp.minimum(state[0], v),
+                                             state[1] + c)
+    if a.func == "max":
+        v = A.scalar_max(col.data, m)
+        c = A.scalar_count(m)
+        return (v, c) if state is None else (jnp.maximum(state[0], v),
+                                             state[1] + c)
+    raise EvalError(a.func)
+
+
+def _scalar_final(a: AggCall, state, dtype: DType) -> DeviceColumn:
+    one = jnp.ones((1,), jnp.bool_)
+    if a.func == "count":
+        v = jnp.zeros((), jnp.int64) if state is None else state
+        return DeviceColumn(v[None].astype(jnp.int64), one, dt.INT64)
+    if state is None:
+        return DeviceColumn.const_null(dtype)
+    if a.func == "sum":
+        s, c = state
+        return DeviceColumn(s[None].astype(dtype.jnp_dtype), (c > 0)[None], dtype)
+    if a.func == "avg":
+        s, c = state
+        sf = s.astype(jnp.float64)
+        if a.arg.dtype.oid == TypeOid.DECIMAL64:
+            sf = sf / (10.0 ** a.arg.dtype.scale)
+        return DeviceColumn((sf / jnp.maximum(c, 1))[None], (c > 0)[None],
+                            dt.FLOAT64)
+    v, c = state
+    return DeviceColumn(v[None], (c > 0)[None], dtype)
+
+
+# ------------------------------------------------------------- sort / topk
+
+def _sort_key_col(expr: BoundExpr, ex: ExecBatch) -> DeviceColumn:
+    """Evaluate an ORDER BY key; dictionary-coded strings are translated
+    code -> collation rank so the sort follows string order, not insertion
+    order of the dictionary."""
+    col = _broadcast_full(eval_expr(expr, ex), ex.padded_len)
+    d = _expr_dict(expr, ex)
+    if d is not None and col.dtype.is_varlen:
+        ranks = np.empty(len(d), dtype=np.int32)
+        ranks[np.argsort(np.asarray(d, dtype=object))] = np.arange(len(d))
+        rank_data = jnp.asarray(ranks)[jnp.clip(col.data, 0, len(d) - 1)]
+        return DeviceColumn(rank_data, col.validity, dt.INT32)
+    return col
+
+
+def _concat_batches(batches: List[ExecBatch], schema) -> ExecBatch:
+    if len(batches) == 1:
+        return batches[0]
+    names = [n for n, _ in schema]
+    cols = {}
+    for n in names:
+        datas, valids = [], []
+        for ex in batches:
+            c = _broadcast_full(ex.batch.columns[n], ex.padded_len)
+            datas.append(c.data)
+            valids.append(c.validity)
+        first = batches[0].batch.columns[n]
+        cols[n] = DeviceColumn(jnp.concatenate(datas),
+                               jnp.concatenate(valids), first.dtype)
+    mask = jnp.concatenate([ex.mask for ex in batches])
+    n_rows = sum([ex.batch.n_rows for ex in batches])
+    dicts = {}
+    for ex in batches:
+        dicts.update(ex.dicts)
+    db = DeviceBatch(columns=cols, n_rows=n_rows.astype(jnp.int32))
+    return ExecBatch(batch=db, dicts=dicts, mask=mask)
+
+
+class SortOp(Operator):
+    def __init__(self, node: P.Sort, child: Operator):
+        self.node = node
+        self.child = child
+        self.schema = node.schema
+
+    def execute(self) -> Iterator[ExecBatch]:
+        batches = list(self.child.execute())
+        if not batches:
+            return
+        ex = _concat_batches(batches, self.schema)
+        cols = [_sort_key_col(k, ex) for k in self.node.keys]
+        order = msort.sort_indices([c.data for c in cols],
+                                   [c.validity for c in cols],
+                                   self.node.descendings, ex.mask)
+        n_out = jnp.sum(ex.mask.astype(jnp.int32))
+        out = F.gather(ex.batch, order, n_out)
+        yield ExecBatch(batch=out, dicts=ex.dicts, mask=out.row_mask())
+
+
+class TopKOp(Operator):
+    def __init__(self, node: P.TopK, child: Operator):
+        self.node = node
+        self.child = child
+        self.schema = node.schema
+
+    def execute(self) -> Iterator[ExecBatch]:
+        batches = list(self.child.execute())
+        if not batches:
+            return
+        ex = _concat_batches(batches, self.schema)
+        want = self.node.k + self.node.offset
+        if len(self.node.keys) == 1:
+            key = _sort_key_col(self.node.keys[0], ex)
+            k = min(want, ex.padded_len)
+            idx, count = msort.top_k_indices(key.data, key.validity,
+                                             self.node.descendings[0],
+                                             ex.mask, k)
+            out = F.gather(ex.batch, idx, jnp.minimum(count, k))
+            ex2 = ExecBatch(batch=out, dicts=ex.dicts, mask=out.row_mask())
+            # top_k gives the right SET; restore exact ORDER via sort of k rows
+            key2 = _sort_key_col(self.node.keys[0], ex2)
+            order = msort.sort_indices([key2.data], [key2.validity],
+                                       [self.node.descendings[0]], ex2.mask)
+            out2 = F.gather(ex2.batch, order, out.n_rows)
+        else:
+            cols = [_sort_key_col(kx, ex) for kx in self.node.keys]
+            order = msort.sort_indices([c.data for c in cols],
+                                       [c.validity for c in cols],
+                                       self.node.descendings, ex.mask)
+            n_out = jnp.minimum(jnp.sum(ex.mask.astype(jnp.int32)), want)
+            out2 = F.gather(ex.batch, order[:max(bucket_length(want), 1)],
+                            n_out)
+        if self.node.offset:
+            out2 = _apply_offset(out2, self.node.offset, self.node.k)
+        yield ExecBatch(batch=out2, dicts=ex.dicts, mask=out2.row_mask())
+
+
+def _apply_offset(db: DeviceBatch, offset: int, k: Optional[int]) -> DeviceBatch:
+    n = db.padded_len
+    idx = jnp.arange(n, dtype=jnp.int32) + offset
+    idx = jnp.clip(idx, 0, n - 1)
+    remaining = jnp.maximum(db.n_rows - offset, 0)
+    if k is not None:
+        remaining = jnp.minimum(remaining, k)
+    return F.gather(db, idx, remaining)
+
+
+class LimitOp(Operator):
+    def __init__(self, node: P.Limit, child: Operator):
+        self.node = node
+        self.child = child
+        self.schema = node.schema
+
+    def execute(self) -> Iterator[ExecBatch]:
+        seen = 0
+        off = self.node.offset
+        n = self.node.n
+        for ex in self.child.execute():
+            rank = jnp.cumsum(ex.mask.astype(jnp.int64)) + seen
+            keep = ex.mask
+            if off:
+                keep = keep & (rank > off)
+            if n is not None:
+                keep = keep & (rank <= off + n)
+            batch_rows = int(jax.device_get(jnp.sum(ex.mask.astype(jnp.int64))))
+            seen += batch_rows
+            ex.mask = keep
+            yield ex
+            if n is not None and seen >= off + n:
+                return
+
+
+class DistinctOp(Operator):
+    def __init__(self, node: P.Distinct, child: Operator,
+                 max_groups: int = 65536):
+        self.node = node
+        self.child = child
+        self.schema = node.schema
+        self.max_groups = max_groups
+
+    def execute(self) -> Iterator[ExecBatch]:
+        batches = list(self.child.execute())
+        if not batches:
+            return
+        ex = _concat_batches(batches, self.schema)
+        cols = [_broadcast_full(ex.batch.columns[n], ex.padded_len)
+                for n, _ in self.schema]
+        gi = A.group_ids([c.data for c in cols], [c.validity for c in cols],
+                         ex.mask, self.max_groups)
+        ng = int(jax.device_get(gi.num_groups))
+        if ng > self.max_groups:
+            raise EvalError("DISTINCT cardinality exceeds max_groups")
+        out = F.gather(ex.batch, gi.rep_rows, gi.num_groups)
+        yield ExecBatch(batch=out, dicts=ex.dicts, mask=out.row_mask())
