@@ -22,8 +22,12 @@ from matrixone_tpu.container.dtypes import DType, TypeOid
 from matrixone_tpu.sql import ast, plan as P
 from matrixone_tpu.sql.binder import Binder, BindError, type_from_name
 from matrixone_tpu.sql.parser import parse
-from matrixone_tpu.storage.memtable import Catalog, IndexMeta, MemTable, TableMeta
+from matrixone_tpu.storage.engine import (Catalog, Engine, IndexMeta,
+                                          TableMeta)
+from matrixone_tpu.storage.engine import ROWID
+from matrixone_tpu.txn.client import TxnClient, TxnState
 from matrixone_tpu.vm.compile import compile_plan
+from matrixone_tpu.vm.process import ExecContext
 
 
 @dataclasses.dataclass
@@ -48,9 +52,15 @@ class Session:
     """One client session (reference: frontend.Session); system variables
     and (later) transaction state hang off this object."""
 
-    def __init__(self, catalog: Optional[Catalog] = None):
-        self.catalog = catalog if catalog is not None else Catalog()
+    def __init__(self, catalog: Optional[Engine] = None, fs=None):
+        self.catalog = catalog if catalog is not None else Engine(fs)
+        self.txn_client = TxnClient(self.catalog)
+        self.txn = None                 # active explicit transaction
         self.variables = {"gpu_mode": 1, "batch_rows": 1 << 20}
+
+    def _ctx(self) -> ExecContext:
+        return ExecContext(catalog=self.catalog, txn=self.txn,
+                           variables=self.variables)
 
     # ------------------------------------------------------------ execute
     def execute(self, sql: str, params: Optional[list] = None) -> Result:
@@ -87,14 +97,33 @@ class Session:
             if isinstance(stmt.value, ast.Literal):
                 self.variables[stmt.name] = stmt.value.value
             return Result()
-        if isinstance(stmt, (ast.BeginTxn, ast.CommitTxn, ast.RollbackTxn)):
-            return Result()   # txn layer lands with the MVCC storage engine
+        if isinstance(stmt, ast.Delete):
+            return self._delete(stmt)
+        if isinstance(stmt, ast.Update):
+            return self._update(stmt)
+        if isinstance(stmt, ast.BeginTxn):
+            if self.txn is not None:
+                old, self.txn = self.txn, None
+                old.commit()            # MySQL: BEGIN commits the open txn
+            self.txn = self.txn_client.begin()
+            return Result()
+        if isinstance(stmt, ast.CommitTxn):
+            if self.txn is not None:
+                old, self.txn = self.txn, None   # clear even on conflict
+                affected = old.commit()
+                return Result(affected=affected)
+            return Result()
+        if isinstance(stmt, ast.RollbackTxn):
+            if self.txn is not None:
+                self.txn.rollback()
+                self.txn = None
+            return Result()
         raise BindError(f"unsupported statement {type(stmt).__name__}")
 
     # ------------------------------------------------------------- select
     def _select(self, sel: ast.Select) -> Result:
         node = Binder(self.catalog).bind_select(sel)
-        op = compile_plan(node, self.catalog)
+        op = compile_plan(node, self._ctx())
         out_batches = []
         for ex in op.execute():
             out_batches.append(self._to_host(ex, node.schema))
@@ -138,20 +167,100 @@ class Session:
             coltype = dict(table.meta.schema)[col]
             if not coltype.is_vector:
                 raise BindError(f"ivfflat index requires a vecf32 column")
-            data = table.read_column_f32(col)
+            data, row_gids = table.read_column_f32(col)
             nlist = int(stmt.options.get("lists", 64))
             op_type = stmt.options.get("op_type", "vector_l2_ops")
             metric = {"vector_l2_ops": "l2", "vector_cosine_ops": "cosine",
                       "vector_ip_ops": "ip"}.get(op_type, "l2")
             idx = ivf_flat.build(jnp.asarray(data), nlist=nlist,
                                  metric=metric)
-            self.catalog.indexes[stmt.name] = IndexMeta(
-                stmt.name, stmt.table, stmt.columns, "ivfflat",
-                dict(stmt.options), index_obj=idx)
+            meta = IndexMeta(stmt.name, stmt.table, stmt.columns, "ivfflat",
+                             dict(stmt.options), index_obj=idx)
+            meta.options["_row_gids"] = row_gids
+            meta.options["_metric"] = metric
+            self.catalog.indexes[stmt.name] = meta
             return Result()
         raise BindError(f"unsupported index algo {stmt.using!r}")
 
     # --------------------------------------------------------------- dml
+    def _dml_plan(self, table_name: str, where, extra_exprs=None,
+                  extra_names=None):
+        """Plan `SELECT __rowid [, extra...] FROM t WHERE ...` for DML."""
+        from matrixone_tpu.sql.binder import Scope
+        from matrixone_tpu.sql.expr import BoundCol
+        table = self.catalog.get_table(table_name)
+        scope = Scope()
+        for col, dtype in table.meta.schema:
+            scope.add(table_name, col, dtype)
+        binder = Binder(self.catalog)
+        scan_cols = [c for c, _ in table.meta.schema] + [ROWID]
+        scan_schema = [(f"{table_name}.{c}", d)
+                       for c, d in table.meta.schema] + [(ROWID, dt.INT64)]
+        node = P.Scan(table_name, scan_cols, scan_schema)
+        if where is not None:
+            pred = binder.bind_expr(where, scope)
+            node = P.Filter(node, pred, node.schema)
+        exprs = [BoundCol(ROWID, dt.INT64)]
+        names = [ROWID]
+        out_types = [dt.INT64]
+        for e, nm in zip(extra_exprs or [], extra_names or []):
+            b = binder.bind_expr(e, scope) if not hasattr(e, "dtype") else e
+            exprs.append(b)
+            names.append(nm)
+            out_types.append(b.dtype)
+        proj = P.Project(node, exprs, list(zip(names, out_types)))
+        return proj, binder, scope
+
+    def _delete(self, stmt: ast.Delete) -> Result:
+        txn = self.txn or self.txn_client.begin()
+        ctx = ExecContext(catalog=self.catalog, txn=txn,
+                          variables=self.variables)
+        proj, _, _ = self._dml_plan(stmt.table, stmt.where)
+        op = compile_plan(proj, ctx)
+        gids = []
+        for ex in op.execute():
+            b = self._to_host(ex, proj.schema)
+            gids.extend(b.columns[ROWID].data.tolist())
+        gids = np.asarray(gids, np.int64)
+        txn.delete_rows(stmt.table, gids)
+        if self.txn is None:
+            txn.commit()
+        return Result(affected=len(gids))
+
+    def _update(self, stmt: ast.Update) -> Result:
+        txn = self.txn or self.txn_client.begin()
+        ctx = ExecContext(catalog=self.catalog, txn=txn,
+                          variables=self.variables)
+        table = self.catalog.get_table(stmt.table)
+        schema = table.meta.schema
+        assigned = dict(stmt.assignments)
+        extra_exprs, extra_names = [], []
+        for col, dtype in schema:
+            e = assigned.get(col, ast.ColumnRef(col, stmt.table))
+            extra_exprs.append(e)
+            extra_names.append(col)
+        proj, _, _ = self._dml_plan(stmt.table, stmt.where,
+                                    extra_exprs, extra_names)
+        op = compile_plan(proj, ctx)
+        gids, new_cols = [], {c: [] for c, _ in schema}
+        for ex in op.execute():
+            b = self._to_host(ex, proj.schema)
+            gids.extend(b.columns[ROWID].data.tolist())
+            for c, _ in schema:
+                new_cols[c].extend(b.columns[c].to_pylist())
+        gids = np.asarray(gids, np.int64)
+        if len(gids) == 0:
+            return Result(affected=0)
+        # rows must round-trip through the table's SQL types (e.g. the
+        # assignment may produce float for a decimal column)
+        batch = Batch.from_pydict(new_cols, {c: d for c, d in schema})
+        arrays, validity = table.batch_to_arrays(batch)
+        txn.delete_rows(stmt.table, gids)
+        txn.write_batch(stmt.table, arrays, validity)
+        if self.txn is None:
+            txn.commit()
+        return Result(affected=len(gids))
+
     def _insert(self, stmt: ast.Insert) -> Result:
         table = self.catalog.get_table(stmt.table)
         schema = table.meta.schema
@@ -180,7 +289,11 @@ class Session:
                         if isinstance(v, str) else v for v in vals]
             full[c] = vals
         batch = Batch.from_pydict(full, {c: d for c, d in schema})
-        n = table.insert_batch(batch)
+        if self.txn is not None:
+            arrays, validity = table.batch_to_arrays(batch)
+            n = self.txn.write_batch(stmt.table, arrays, validity)
+        else:
+            n = table.insert_batch(batch)
         return Result(affected=n)
 
 

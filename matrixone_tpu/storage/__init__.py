@@ -1,3 +1,8 @@
-from matrixone_tpu.storage.memtable import Catalog, IndexMeta, MemTable, TableMeta
+from matrixone_tpu.storage import engine, fileservice, objectio, wal
+from matrixone_tpu.storage.engine import (Catalog, ConflictError, Engine,
+                                          IndexMeta, MVCCTable, TableMeta)
+from matrixone_tpu.storage.fileservice import LocalFS, MemoryFS
 
-__all__ = ["Catalog", "IndexMeta", "MemTable", "TableMeta"]
+__all__ = ["engine", "fileservice", "objectio", "wal", "Catalog",
+           "ConflictError", "Engine", "IndexMeta", "MVCCTable", "TableMeta",
+           "LocalFS", "MemoryFS"]

@@ -1,0 +1,59 @@
+"""Shared arrays <-> Arrow IPC serialization (used by WAL and objectio).
+
+Columns are numpy arrays (fixed-width, incl. [n,d] vecf32) or python lists
+of str/None (varchar travelling as strings, e.g. WAL insert frames).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+
+def arrays_to_ipc(arrays: Dict[str, object],
+                  validity: Dict[str, np.ndarray]) -> bytes:
+    fields, cols = [], []
+    for name, arr in arrays.items():
+        val = validity.get(name)
+        mask = None if val is None or val.all() else ~val
+        if isinstance(arr, list):
+            col = pa.array(arr, type=pa.string())
+        elif arr.ndim == 2:
+            flat = pa.array(arr.reshape(-1))
+            col = pa.FixedSizeListArray.from_arrays(flat, arr.shape[1])
+        else:
+            col = pa.array(arr, mask=mask)
+        fields.append(pa.field(name, col.type))
+        cols.append(col)
+    rb = pa.RecordBatch.from_arrays(cols, schema=pa.schema(fields))
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, rb.schema) as w:
+        w.write_batch(rb)
+    return sink.getvalue().to_pybytes()
+
+
+def ipc_to_arrays(blob: bytes) -> Tuple[Dict[str, object],
+                                        Dict[str, np.ndarray]]:
+    rb = pa.ipc.open_stream(pa.BufferReader(blob)).read_next_batch()
+    arrays, validity = {}, {}
+    for i, name in enumerate(rb.schema.names):
+        col = rb.column(i)
+        if pa.types.is_string(col.type) or pa.types.is_large_string(col.type):
+            arrays[name] = col.to_pylist()
+            validity[name] = ~np.asarray(col.is_null()) if col.null_count \
+                else np.ones(len(col), np.bool_)
+            continue
+        if pa.types.is_fixed_size_list(col.type):
+            d = col.type.list_size
+            arrays[name] = np.asarray(col.flatten()).reshape(-1, d)
+            validity[name] = np.ones(len(col), np.bool_)
+            continue
+        if col.null_count:
+            validity[name] = ~np.asarray(col.is_null())
+            col = col.fill_null(0)
+        else:
+            validity[name] = np.ones(len(col), np.bool_)
+        arrays[name] = np.asarray(col)
+    return arrays, validity

@@ -1,0 +1,114 @@
+"""File service: storage backend abstraction (reference: pkg/fileservice
+`file_service.go:31` — redesigned to the minimum the engine needs).
+
+Backends: memory (tests), local disk. The S3 backend slots in behind the
+same interface when object-store credentials exist; all engine code above
+(objectio, WAL, checkpoints) is backend-agnostic.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+
+class FileService:
+    def write(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def append(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> List[str]:
+        raise NotImplementedError
+
+
+class MemoryFS(FileService):
+    def __init__(self):
+        self._files: Dict[str, bytearray] = {}
+        self._lock = threading.Lock()
+
+    def write(self, path, data):
+        with self._lock:
+            self._files[path] = bytearray(data)
+
+    def append(self, path, data):
+        with self._lock:
+            self._files.setdefault(path, bytearray()).extend(data)
+
+    def read(self, path):
+        with self._lock:
+            if path not in self._files:
+                raise FileNotFoundError(path)
+            return bytes(self._files[path])
+
+    def exists(self, path):
+        with self._lock:
+            return path in self._files
+
+    def delete(self, path):
+        with self._lock:
+            self._files.pop(path, None)
+
+    def list(self, prefix):
+        with self._lock:
+            return sorted(p for p in self._files if p.startswith(prefix))
+
+
+class LocalFS(FileService):
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _p(self, path: str) -> str:
+        full = os.path.join(self.root, path)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        return full
+
+    def write(self, path, data):
+        full = self._p(path)
+        tmp = full + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, full)
+
+    def append(self, path, data):
+        with open(self._p(path), "ab") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def read(self, path):
+        with open(os.path.join(self.root, path), "rb") as f:
+            return f.read()
+
+    def exists(self, path):
+        return os.path.exists(os.path.join(self.root, path))
+
+    def delete(self, path):
+        try:
+            os.remove(os.path.join(self.root, path))
+        except FileNotFoundError:
+            pass
+
+    def list(self, prefix):
+        out = []
+        for dirpath, _, files in os.walk(self.root):
+            for fn in files:
+                rel = os.path.relpath(os.path.join(dirpath, fn), self.root)
+                rel = rel.replace(os.sep, "/")
+                if rel.startswith(prefix) and not rel.endswith(".tmp"):
+                    out.append(rel)
+        return sorted(out)

@@ -1,0 +1,120 @@
+"""Columnar object format (reference: pkg/objectio — redesigned on Arrow).
+
+An object = one immutable Arrow IPC stream (a committed segment's columns,
+dictionary codes for varchar) + a JSON meta header carrying per-column
+zonemaps (min/max/null_count) and the segment's commit metadata. Readers
+prune whole objects by zonemap before touching column bytes — the
+reference's block-level zonemap prune (`pkg/vm/engine/readutil`).
+
+Layout on the fileservice:
+    objects/<table>/<object_id>.obj   (meta_len | meta_json | arrow_ipc)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from matrixone_tpu.storage import arrowio
+from matrixone_tpu.storage.fileservice import FileService
+
+_MAGIC = b"MOTB"
+
+
+@dataclasses.dataclass
+class ZoneMap:
+    min: object
+    max: object
+    null_count: int
+
+
+@dataclasses.dataclass
+class ObjectMeta:
+    table: str
+    object_id: str
+    n_rows: int
+    commit_ts: int
+    zonemaps: Dict[str, ZoneMap]
+    kind: str = "data"          # 'data' | 'tombstone'
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "table": self.table, "object_id": self.object_id,
+            "n_rows": self.n_rows, "commit_ts": self.commit_ts,
+            "kind": self.kind,
+            "zonemaps": {c: [_enc(z.min), _enc(z.max), z.null_count]
+                         for c, z in self.zonemaps.items()}})
+
+    @classmethod
+    def from_json(cls, s: str) -> "ObjectMeta":
+        d = json.loads(s)
+        zm = {c: ZoneMap(v[0], v[1], v[2])
+              for c, v in d.get("zonemaps", {}).items()}
+        return cls(table=d["table"], object_id=d["object_id"],
+                   n_rows=d["n_rows"], commit_ts=d["commit_ts"],
+                   zonemaps=zm, kind=d.get("kind", "data"))
+
+
+def _enc(v):
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    return v
+
+
+def compute_zonemaps(arrays: Dict[str, np.ndarray],
+                     validity: Dict[str, np.ndarray]) -> Dict[str, ZoneMap]:
+    out = {}
+    for c, a in arrays.items():
+        val = validity.get(c)
+        nulls = 0 if val is None else int((~val).sum())
+        if a.ndim != 1 or a.dtype == np.bool_:
+            continue
+        vals = a if val is None else a[val]
+        if len(vals) == 0:
+            out[c] = ZoneMap(None, None, nulls)
+        else:
+            out[c] = ZoneMap(_enc(vals.min()), _enc(vals.max()), nulls)
+    return out
+
+
+def object_path(table: str, object_id: str) -> str:
+    return f"objects/{table}/{object_id}.obj"
+
+
+def write_object(fs: FileService, meta: ObjectMeta,
+                 arrays: Dict[str, np.ndarray],
+                 validity: Dict[str, np.ndarray]) -> str:
+    """Serialize a segment -> fileservice; returns the path."""
+    ipc = arrowio.arrays_to_ipc(arrays, validity)
+    mj = meta.to_json().encode()
+    blob = _MAGIC + struct.pack("<I", len(mj)) + mj + ipc
+    path = object_path(meta.table, meta.object_id)
+    fs.write(path, blob)
+    return path
+
+
+def read_meta(fs: FileService, path: str) -> ObjectMeta:
+    blob = fs.read(path)
+    return _parse(blob)[0]
+
+
+def _parse(blob: bytes) -> Tuple[ObjectMeta, bytes]:
+    assert blob[:4] == _MAGIC, "bad object magic"
+    (mlen,) = struct.unpack("<I", blob[4:8])
+    meta = ObjectMeta.from_json(blob[8:8 + mlen].decode())
+    return meta, blob[8 + mlen:]
+
+
+def read_object(fs: FileService, path: str
+                ) -> Tuple[ObjectMeta, Dict[str, np.ndarray],
+                           Dict[str, np.ndarray]]:
+    meta, ipc = _parse(fs.read(path))
+    arrays, validity = arrowio.ipc_to_arrays(ipc)
+    return meta, arrays, validity

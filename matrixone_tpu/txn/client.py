@@ -1,0 +1,109 @@
+"""Transaction client: snapshot handle + write workspace.
+
+Reference analogue: `pkg/txn/client` TxnOperator (operator.go:1098 Commit)
++ the CN-side workspace (`disttae/txn.go:89 WriteBatch`). A transaction
+buffers inserts as uncommitted segments and deletes as row-id sets; reads
+merge the workspace into the snapshot; commit hands everything to the
+engine's single-writer pipeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from matrixone_tpu.storage.engine import Engine, MVCCTable, Segment
+
+
+class TxnState(enum.Enum):
+    ACTIVE = 1
+    COMMITTED = 2
+    ABORTED = 3
+
+
+@dataclasses.dataclass
+class TableWorkspace:
+    segments: List[Segment] = dataclasses.field(default_factory=list)
+    delete_gids: List[np.ndarray] = dataclasses.field(default_factory=list)
+    _next_local_gid: int = -2   # workspace rows get negative gids
+
+    def all_deletes(self) -> np.ndarray:
+        if not self.delete_gids:
+            return np.zeros(0, np.int64)
+        return np.concatenate(self.delete_gids)
+
+
+class TxnHandle:
+    def __init__(self, engine: Engine, snapshot_ts: int):
+        self.engine = engine
+        self.snapshot_ts = snapshot_ts
+        self.state = TxnState.ACTIVE
+        self.workspace: Dict[str, TableWorkspace] = {}
+
+    def ws(self, table: str) -> TableWorkspace:
+        return self.workspace.setdefault(table, TableWorkspace())
+
+    # ------------------------------------------------------------ writes
+    def write_batch(self, table: str, arrays, validity) -> int:
+        t = self.engine.get_table(table)
+        w = self.ws(table)
+        n = len(next(iter(arrays.values())))
+        seg = Segment(seg_id=-1, commit_ts=0, arrays=arrays,
+                      validity=validity, n_rows=n,
+                      base_gid=w._next_local_gid - n)
+        w._next_local_gid -= n + 1
+        w.segments.append(seg)
+        return n
+
+    def delete_rows(self, table: str, gids: np.ndarray) -> int:
+        w = self.ws(table)
+        committed = np.asarray(gids[gids >= 0], np.int64)
+        if len(committed):
+            w.delete_gids.append(committed)
+        # deletes of rows inserted by this txn: drop from workspace segments
+        local = gids[gids < 0]
+        if len(local):
+            for seg in w.segments:
+                seg_gids = np.arange(seg.base_gid,
+                                     seg.base_gid + seg.n_rows)
+                keep = ~np.isin(seg_gids, local)
+                if not keep.all():
+                    seg.arrays = {c: a[keep] for c, a in seg.arrays.items()}
+                    seg.validity = {c: v[keep]
+                                    for c, v in seg.validity.items()}
+                    seg.n_rows = int(keep.sum())
+        return len(gids)
+
+    # ------------------------------------------------------------ finish
+    def commit(self) -> int:
+        assert self.state == TxnState.ACTIVE, "txn not active"
+        inserts = {t: [(s.arrays, s.validity) for s in w.segments
+                       if s.n_rows > 0]
+                   for t, w in self.workspace.items() if w.segments}
+        deletes = {t: w.all_deletes() for t, w in self.workspace.items()
+                   if w.delete_gids}
+        try:
+            affected = self.engine.commit_txn(self.snapshot_ts, inserts,
+                                              deletes)
+        except Exception:
+            self.state = TxnState.ABORTED
+            raise
+        self.state = TxnState.COMMITTED
+        return affected
+
+    def rollback(self) -> None:
+        self.workspace.clear()
+        self.state = TxnState.ABORTED
+
+
+class TxnClient:
+    """reference: txn/client — hands out snapshot-stamped handles."""
+
+    def __init__(self, engine: Engine):
+        self.engine = engine
+
+    def begin(self) -> TxnHandle:
+        return TxnHandle(self.engine, self.engine.hlc.now())

@@ -19,7 +19,7 @@ from typing import Dict
 import numpy as np
 
 from matrixone_tpu.container import dtypes as dt
-from matrixone_tpu.storage.memtable import Catalog, TableMeta
+from matrixone_tpu.storage.engine import Catalog, TableMeta
 
 LINEITEM_SCHEMA = [
     ("l_orderkey", dt.INT64),

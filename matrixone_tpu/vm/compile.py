@@ -6,30 +6,34 @@ from __future__ import annotations
 
 from matrixone_tpu.sql import plan as P
 from matrixone_tpu.vm import operators as ops
+from matrixone_tpu.vm.process import ExecContext
 
 
-def compile_plan(node: P.PlanNode, catalog) -> ops.Operator:
+def compile_plan(node: P.PlanNode, ctx) -> ops.Operator:
+    if not isinstance(ctx, ExecContext):
+        ctx = ExecContext(catalog=ctx)
+    catalog = ctx.catalog
     if isinstance(node, P.Scan):
         rel = catalog.get_table(node.table)
-        return ops.ScanOp(node, rel)
+        return ops.ScanOp(node, rel, ctx=ctx)
     if isinstance(node, P.Values):
         return ops.ValuesOp(node)
     if isinstance(node, P.Filter):
-        return ops.FilterOp(node, compile_plan(node.child, catalog))
+        return ops.FilterOp(node, compile_plan(node.child, ctx))
     if isinstance(node, P.Project):
-        return ops.ProjectOp(node, compile_plan(node.child, catalog))
+        return ops.ProjectOp(node, compile_plan(node.child, ctx))
     if isinstance(node, P.Aggregate):
-        return ops.AggOp(node, compile_plan(node.child, catalog))
+        return ops.AggOp(node, compile_plan(node.child, ctx))
     if isinstance(node, P.Sort):
-        return ops.SortOp(node, compile_plan(node.child, catalog))
+        return ops.SortOp(node, compile_plan(node.child, ctx))
     if isinstance(node, P.TopK):
-        return ops.TopKOp(node, compile_plan(node.child, catalog))
+        return ops.TopKOp(node, compile_plan(node.child, ctx))
     if isinstance(node, P.Limit):
-        return ops.LimitOp(node, compile_plan(node.child, catalog))
+        return ops.LimitOp(node, compile_plan(node.child, ctx))
     if isinstance(node, P.Distinct):
-        return ops.DistinctOp(node, compile_plan(node.child, catalog))
+        return ops.DistinctOp(node, compile_plan(node.child, ctx))
     if isinstance(node, P.Join):
         from matrixone_tpu.vm.join import JoinOp
-        return JoinOp(node, compile_plan(node.left, catalog),
-                      compile_plan(node.right, catalog))
+        return JoinOp(node, compile_plan(node.left, ctx),
+                      compile_plan(node.right, ctx))
     raise NotImplementedError(f"compile: {type(node).__name__}")

@@ -44,17 +44,22 @@ class ScanOp(Operator):
     """Table scan with filter pushdown + zonemap chunk pruning
     (reference: colexec/table_scan + readutil block pruning)."""
 
-    def __init__(self, node: P.Scan, relation, batch_rows: int = 1 << 20):
+    def __init__(self, node: P.Scan, relation, batch_rows: int = 1 << 20,
+                 ctx=None):
         self.node = node
         self.rel = relation
         self.batch_rows = batch_rows
         self.schema = node.schema
+        self.ctx = ctx
 
     def execute(self) -> Iterator[ExecBatch]:
         qnames = [n for n, _ in self.node.schema]
+        read_args = (self.ctx.table_read_args(self.node.table)
+                     if self.ctx is not None else {})
         for chunk in self.rel.iter_chunks(self.node.columns, self.batch_rows,
                                           filters=self.node.filters,
-                                          qualified_names=qnames):
+                                          qualified_names=qnames,
+                                          **read_args):
             arrays, validity, dicts, n = chunk
             from matrixone_tpu.container import device as dev
             dtypes = {}

@@ -1,0 +1,30 @@
+"""Per-query execution context (reference: pkg/vm/process/types.go:386
+`Process` — the per-query bag of engine handle + txn + session state that
+every operator receives)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ExecContext:
+    catalog: object                     # storage.engine.Engine
+    txn: Optional[object] = None        # txn.client.TxnHandle
+    variables: Optional[dict] = None
+
+    @property
+    def snapshot_ts(self) -> Optional[int]:
+        return self.txn.snapshot_ts if self.txn is not None else None
+
+    def table_read_args(self, table: str) -> dict:
+        """kwargs for MVCCTable.iter_chunks realizing this context's view."""
+        if self.txn is None:
+            return {}
+        w = self.txn.workspace.get(table)
+        return {
+            "snapshot_ts": self.txn.snapshot_ts,
+            "extra_segments": list(w.segments) if w else None,
+            "extra_deletes": w.all_deletes() if w else None,
+        }
