@@ -64,10 +64,32 @@ class Session:
 
     # ------------------------------------------------------------ execute
     def execute(self, sql: str, params: Optional[list] = None) -> Result:
+        import time as _time
+        from matrixone_tpu.utils import metrics as M
+        from matrixone_tpu.utils.trace import STMT_TABLE, StatementRecorder
+        if not hasattr(self.catalog, "stmt_recorder"):
+            self.catalog.stmt_recorder = StatementRecorder(self.catalog)
+        if STMT_TABLE in sql:
+            self.catalog.stmt_recorder.flush()
         stmts = parse(sql)
         if params is not None:
             stmts = [_substitute_params(st, params) for st in stmts]
-        results = [self._execute_stmt(s) for s in stmts]
+        results = []
+        for st in stmts:
+            t0 = _time.perf_counter()
+            try:
+                r = self._execute_stmt(st)
+            except Exception as e:
+                dt_ = _time.perf_counter() - t0
+                M.query_seconds.observe(dt_)
+                self.catalog.stmt_recorder.record(
+                    sql, "error", dt_, 0, error=str(e)[:1024])
+                raise
+            dt_ = _time.perf_counter() - t0
+            M.query_seconds.observe(dt_)
+            rows_out = len(r.batch) if r.batch is not None else r.affected
+            self.catalog.stmt_recorder.record(sql, "ok", dt_, rows_out)
+            results.append(r)
         return results[-1] if results else Result()
 
     def _execute_stmt(self, stmt: ast.Node) -> Result:
@@ -95,7 +117,23 @@ class Session:
             return Result(batch=b)
         if isinstance(stmt, ast.SetVariable):
             if isinstance(stmt.value, ast.Literal):
-                self.variables[stmt.name] = stmt.value.value
+                value = stmt.value.value
+                # fault injection control (reference: mo_ctl addfaultpoint)
+                from matrixone_tpu.utils.fault import INJECTOR
+                if stmt.name == "fault_point" and isinstance(value, str):
+                    parts = value.split(":")
+                    if len(parts) < 2:
+                        raise BindError(
+                            "fault_point format: 'name:action[:arg]'")
+                    try:
+                        INJECTOR.add(parts[0], parts[1],
+                                     parts[2] if len(parts) > 2 else None)
+                    except ValueError as e:
+                        raise BindError(str(e))
+                elif stmt.name == "fault_point_clear":
+                    INJECTOR.remove(str(value))
+                else:
+                    self.variables[stmt.name] = value
             return Result()
         if isinstance(stmt, ast.Delete):
             return self._delete(stmt)
@@ -122,7 +160,12 @@ class Session:
 
     # ------------------------------------------------------------- select
     def _select(self, sel: ast.Select) -> Result:
+        from matrixone_tpu.sql.optimize import apply_indices
         node = Binder(self.catalog).bind_select(sel)
+        skip = frozenset(self.txn.workspace.keys()) if self.txn else frozenset()
+        node = apply_indices(node, self.catalog,
+                             nprobe=int(self.variables.get("ivf_nprobe", 8)),
+                             skip_tables=skip)
         op = compile_plan(node, self._ctx())
         out_batches = []
         for ex in op.execute():

@@ -214,6 +214,52 @@ class MVCCTable:
                     continue
                 yield arrays, validity, self.dicts, n
 
+    def visible_gids(self, gids: np.ndarray,
+                     snapshot_ts: Optional[int] = None,
+                     extra_deletes: Optional[np.ndarray] = None) -> np.ndarray:
+        """Filter gids to rows visible at the snapshot: owning segment
+        committed <= ts and not tombstoned (incl. txn-local deletes)."""
+        gids = np.asarray(gids, np.int64)
+        if len(gids) == 0:
+            return gids
+        bases = np.array([s.base_gid for s in self.segments], np.int64)
+        seg_ts = np.array([s.commit_ts for s in self.segments], np.int64)
+        si = np.searchsorted(bases, gids, side="right") - 1
+        ok = si >= 0
+        if snapshot_ts is not None:
+            ok = ok & (seg_ts[np.clip(si, 0, None)] <= snapshot_ts)
+        dead = self._dead_gids(snapshot_ts, extra_deletes)
+        if len(dead):
+            ok = ok & ~np.isin(gids, dead)
+        return gids[ok]
+
+    def fetch_rows(self, gids: np.ndarray, columns: List[str]):
+        """Host gather of rows by global id (vector-index result fetch).
+        Returns (arrays, validity) in gid order."""
+        gids = np.asarray(gids, np.int64)
+        bases = np.array([s.base_gid for s in self.segments], np.int64)
+        arrays = {c: [] for c in columns}
+        validity = {c: [] for c in columns}
+        seg_idx = np.searchsorted(bases, gids, side="right") - 1
+        for c in columns:
+            dtype = dict(self.meta.schema)[c]
+            parts_a, parts_v = [], []
+            for gi, si in zip(gids, seg_idx):
+                seg = self.segments[si]
+                off = int(gi - seg.base_gid)
+                parts_a.append(seg.arrays[c][off])
+                parts_v.append(seg.validity[c][off])
+            if parts_a:
+                arrays[c] = np.stack(parts_a) if np.ndim(parts_a[0]) \
+                    else np.asarray(parts_a)
+                validity[c] = np.asarray(parts_v, np.bool_)
+            else:
+                shape = (0, dtype.dim) if dtype.is_vector else (0,)
+                np_t = np.int32 if dtype.is_varlen else dtype.np_dtype
+                arrays[c] = np.zeros(shape, np_t)
+                validity[c] = np.zeros(0, np.bool_)
+        return arrays, validity
+
     def read_column_f32(self, col: str):
         """Dense f32 matrix of VISIBLE rows (tombstones excluded) plus the
         gid of each matrix row — index builds must not index deleted rows,
@@ -380,17 +426,24 @@ class Engine:
         """The TN commit pipeline (tae/rpc/handle.go:547 HandleCommit):
         conflict check -> commit ts -> WAL -> apply -> logtail notify.
         Returns rows affected."""
+        from matrixone_tpu.utils import metrics as M
+        from matrixone_tpu.utils.fault import INJECTOR
+        if INJECTOR.trigger("commit.before") == "fail":
+            M.txn_commits.inc(outcome="fault")
+            raise RuntimeError("injected commit failure")
         with self._commit_lock:
             # write-write conflict: someone deleted my victim after my
             # snapshot (first-committer-wins)
             if snapshot_ts is not None:
                 for tname, gids in deletes.items():
                     t = self.get_table(tname)
-                    mine = set(np.asarray(gids, np.int64).tolist())
-                    for ts, g in t.tombstones:
-                        if ts > snapshot_ts and mine & set(g.tolist()):
-                            raise ConflictError(
-                                f"write-write conflict on {tname}")
+                    mine = np.asarray(gids, np.int64)
+                    newer = [g for ts, g in t.tombstones if ts > snapshot_ts]
+                    if newer and len(np.intersect1d(
+                            mine, np.concatenate(newer))):
+                        M.txn_commits.inc(outcome="conflict")
+                        raise ConflictError(
+                            f"write-write conflict on {tname}")
             commit_ts = self.hlc.now()
             affected = 0
             # WAL first; varchar columns are logged as decoded strings so
@@ -433,6 +486,7 @@ class Engine:
                 affected += len(gids)
                 for fn in self._subscribers:
                     fn(commit_ts, tname, "delete", gids)
+            M.txn_commits.inc(outcome="ok")
             return affected
 
     # ------------------------------------------------- checkpoint / open

@@ -1,0 +1,3 @@
+from matrixone_tpu.utils import fault, metrics, tpch, trace
+
+__all__ = ["fault", "metrics", "tpch", "trace"]

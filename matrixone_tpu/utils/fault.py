@@ -1,0 +1,76 @@
+"""Runtime fault injection (reference: pkg/util/fault fault.go:44-53 —
+RETURN/SLEEP/PANIC/WAIT actions at named trigger sites, settable at
+runtime; the reference wires them through `select mo_ctl(...)`, here
+through `Session.execute("set fault_...")` or the Python API).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+_ACTIONS = ("return", "sleep", "panic", "wait")
+
+
+class FaultPoint:
+    def __init__(self, name: str, action: str, arg=None):
+        if action not in _ACTIONS:
+            raise ValueError(
+                f"unknown fault action {action!r}; use one of {_ACTIONS}")
+        self.name = name
+        self.action = action
+        self.arg = arg
+        self.hits = 0
+        self.event = threading.Event()
+
+
+class FaultInjector:
+    def __init__(self):
+        self._points: Dict[str, FaultPoint] = {}
+        self._lock = threading.Lock()
+
+    def add(self, name: str, action: str, arg=None):
+        with self._lock:
+            self._points[name] = FaultPoint(name, action, arg)
+
+    def remove(self, name: str):
+        with self._lock:
+            fp = self._points.pop(name, None)
+            if fp is not None:
+                fp.event.set()   # release waiters
+
+    def notify(self, name: str):
+        with self._lock:
+            fp = self._points.get(name)
+        if fp is not None:
+            fp.event.set()
+
+    def trigger(self, name: str) -> Optional[object]:
+        """Call at an injection site. Returns the RETURN arg (site decides
+        how to interpret it), or None when no fault is armed."""
+        with self._lock:
+            fp = self._points.get(name)
+        if fp is None:
+            return None
+        fp.hits += 1
+        if fp.action == "return":
+            return fp.arg
+        if fp.action == "sleep":
+            time.sleep(float(fp.arg or 0))
+            return None
+        if fp.action == "panic":
+            raise RuntimeError(f"fault point {name!r} panic")
+        if fp.action == "wait":
+            fp.event.wait(timeout=float(fp.arg) if fp.arg else None)
+            return None
+        return None
+
+    def status(self):
+        with self._lock:
+            return {n: (p.action, p.arg, p.hits)
+                    for n, p in self._points.items()}
+
+
+#: process-global injector (reference: fault package singleton)
+INJECTOR = FaultInjector()

@@ -1,0 +1,114 @@
+"""Prometheus-style metrics registry (reference: pkg/util/metric/v2 +
+mometric — redesigned to a minimal host-side registry with text
+exposition; the collector writing system_metrics tables rides the same
+trace pipeline as statement_info).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._values: Dict[Tuple, float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    def inc(self, value: float = 1.0, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] += value
+
+    def get(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+
+class Histogram:
+    _BUCKETS = [1e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60]
+
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self.counts = [0] * (len(self._BUCKETS) + 1)
+        self.sum = 0.0
+        self.total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float):
+        with self._lock:
+            self.sum += v
+            self.total += 1
+            for i, b in enumerate(self._BUCKETS):
+                if v <= b:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+    def time(self):
+        h = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *a):
+                h.observe(time.perf_counter() - self.t0)
+        return _Timer()
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = Counter(name, help_)
+            return self._metrics[name]
+
+    def histogram(self, name: str, help_: str = "") -> Histogram:
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = Histogram(name, help_)
+            return self._metrics[name]
+
+    def expose(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+        for name, m in sorted(self._metrics.items()):
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {name} counter")
+                with m._lock:
+                    snapshot = dict(m._values)
+                for key, v in snapshot.items():
+                    lbl = ",".join(f'{k}="{val}"' for k, val in key)
+                    lines.append(f"{name}{{{lbl}}} {v}" if lbl
+                                 else f"{name} {v}")
+            elif isinstance(m, Histogram):
+                lines.append(f"# TYPE {name} histogram")
+                acc = 0
+                for b, c in zip(m._BUCKETS, m.counts):
+                    acc += c
+                    lines.append(f'{name}_bucket{{le="{b}"}} {acc}')
+                lines.append(f'{name}_bucket{{le="+Inf"}} {m.total}')
+                lines.append(f"{name}_sum {m.sum}")
+                lines.append(f"{name}_count {m.total}")
+        return "\n".join(lines) + "\n"
+
+
+#: process-global registry (reference: metric/v2 package-level vars)
+REGISTRY = Registry()
+
+query_seconds = REGISTRY.histogram(
+    "mo_query_duration_seconds", "SQL statement execution latency")
+rows_scanned = REGISTRY.counter(
+    "mo_scan_rows_total", "rows scanned by table scans")
+txn_commits = REGISTRY.counter(
+    "mo_txn_commit_total", "transaction commits by outcome")

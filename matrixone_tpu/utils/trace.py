@@ -1,0 +1,89 @@
+"""Statement tracing, dogfooded into queryable system tables.
+
+Reference: pkg/util/trace + motrace — statement records buffered through
+util/batchpipe and bulk-written into `system.statement_info`, queryable by
+SQL (`motrace/schema.go:38`). Same shape here: a StatementRecorder buffers
+(stmt, duration, status, rows) tuples and flushes them into the
+`system_statement_info` table of the same engine, so
+
+    SELECT ... FROM system_statement_info ORDER BY duration_us DESC
+
+works out of the box.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from matrixone_tpu.container import dtypes as dt
+
+STMT_TABLE = "system_statement_info"
+
+_SCHEMA = [
+    ("stmt_id", dt.INT64),
+    ("statement", dt.TEXT),
+    ("status", dt.varchar(16)),
+    ("duration_us", dt.INT64),
+    ("rows_out", dt.INT64),
+    ("error", dt.TEXT),
+    ("ts", dt.INT64),
+]
+
+
+class StatementRecorder:
+    def __init__(self, engine, flush_every: int = 64):
+        self.engine = engine
+        self.flush_every = flush_every
+        self._buf: List[tuple] = []
+        self._next_id = 1
+        self._lock = threading.Lock()
+        self._ensure_table()
+
+    def _ensure_table(self):
+        from matrixone_tpu.storage.engine import TableMeta
+        if STMT_TABLE not in self.engine.tables:
+            self.engine.create_table(
+                TableMeta(STMT_TABLE, list(_SCHEMA), ["stmt_id"]),
+                if_not_exists=True, log=False)
+
+    def record(self, statement: str, status: str, duration_s: float,
+               rows_out: int, error: Optional[str] = None):
+        with self._lock:
+            rec = (self._next_id, statement[:4096], status,
+                   int(duration_s * 1e6), rows_out, error or "",
+                   time.time_ns() // 1000)
+            self._next_id += 1
+            self._buf.append(rec)
+            need_flush = len(self._buf) >= self.flush_every
+        if need_flush:
+            self.flush()
+
+    def flush(self):
+        with self._lock:
+            buf, self._buf = self._buf, []
+        if not buf:
+            return
+        import numpy as np
+        t = self.engine.get_table(STMT_TABLE)
+        cols = list(zip(*buf))
+        arrays = {
+            "stmt_id": np.asarray(cols[0], np.int64),
+            "duration_us": np.asarray(cols[3], np.int64),
+            "rows_out": np.asarray(cols[4], np.int64),
+            "ts": np.asarray(cols[6], np.int64),
+        }
+        strings = {
+            "statement": t.encode_strings_list("statement", list(cols[1])),
+            "status": t.encode_strings_list("status", list(cols[2])),
+            "error": t.encode_strings_list("error", list(cols[5])),
+        }
+        arrays.update(strings)
+        validity = {c: np.ones(len(buf), np.bool_) for c in arrays}
+        # bypass the WAL for observability data (reference uses the ETL
+        # fileservice, not the txn path) — but segment allocation must still
+        # respect the single-writer invariant
+        with self.engine._commit_lock:
+            seg = t.make_segment(arrays, validity, self.engine.hlc.now())
+            t.apply_segment(seg)

@@ -32,6 +32,9 @@ def compile_plan(node: P.PlanNode, ctx) -> ops.Operator:
         return ops.LimitOp(node, compile_plan(node.child, ctx))
     if isinstance(node, P.Distinct):
         return ops.DistinctOp(node, compile_plan(node.child, ctx))
+    if isinstance(node, P.VectorTopK):
+        from matrixone_tpu.vm.vector_scan import VectorTopKOp
+        return VectorTopKOp(node, ctx)
     if isinstance(node, P.Join):
         from matrixone_tpu.vm.join import JoinOp
         return JoinOp(node, compile_plan(node.left, ctx),

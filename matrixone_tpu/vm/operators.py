@@ -40,6 +40,28 @@ class Operator:
 
 # ------------------------------------------------------------------- scan
 
+def chunk_to_execbatch(arrays, validity, table_dicts, n, columns, schema
+                       ) -> ExecBatch:
+    """Host chunk -> padded device ExecBatch, renaming raw table columns to
+    the plan's qualified names and tagging varlen columns (used by ScanOp
+    and the vector-index scan)."""
+    from matrixone_tpu.container import device as dev
+    qnames = [nm for nm, _ in schema]
+    arr2, val2, dicts2, dtypes = {}, {}, {}, {}
+    for qn, col, dtype in zip(qnames, columns, [d for _, d in schema]):
+        arr2[qn] = arrays[col]
+        val2[qn] = validity[col]
+        dtypes[qn] = dt.INT32 if dtype.is_varlen else dtype
+        if col in table_dicts:
+            dicts2[qn] = table_dicts[col]
+    db = dev.from_numpy(arr2, dtypes, val2, n_rows=n)
+    for qn, (_, dtype) in zip(qnames, schema):
+        if dtype.is_varlen:
+            c = db.columns[qn]
+            db.columns[qn] = DeviceColumn(c.data, c.validity, dtype)
+    return ExecBatch(batch=db, dicts=dicts2, mask=db.row_mask())
+
+
 class ScanOp(Operator):
     """Table scan with filter pushdown + zonemap chunk pruning
     (reference: colexec/table_scan + readutil block pruning)."""
@@ -53,6 +75,9 @@ class ScanOp(Operator):
         self.ctx = ctx
 
     def execute(self) -> Iterator[ExecBatch]:
+        from matrixone_tpu.utils import metrics as M
+        from matrixone_tpu.utils.fault import INJECTOR
+        INJECTOR.trigger("scan.before")
         qnames = [n for n, _ in self.node.schema]
         read_args = (self.ctx.table_read_args(self.node.table)
                      if self.ctx is not None else {})
@@ -61,29 +86,14 @@ class ScanOp(Operator):
                                           qualified_names=qnames,
                                           **read_args):
             arrays, validity, dicts, n = chunk
-            from matrixone_tpu.container import device as dev
-            dtypes = {}
-            arr2, val2, dicts2 = {}, {}, {}
-            for qn, (col, dtype) in zip(qnames,
-                                        zip(self.node.columns,
-                                            [d for _, d in self.node.schema])):
-                arr2[qn] = arrays[col]
-                val2[qn] = validity[col]
-                dtypes[qn] = dt.INT32 if dtype.is_varlen else dtype
-                if col in dicts:
-                    dicts2[qn] = dicts[col]
-            db = dev.from_numpy(arr2, dtypes, val2, n_rows=n)
-            # tag varchar device columns with their SQL type
-            for qn, (_, dtype) in zip(qnames, self.node.schema):
-                if dtype.is_varlen:
-                    c = db.columns[qn]
-                    db.columns[qn] = DeviceColumn(c.data, c.validity, dtype)
-            ex = ExecBatch(batch=db, dicts=dicts2, mask=db.row_mask())
+            M.rows_scanned.inc(n, table=self.node.table)
+            ex = chunk_to_execbatch(arrays, validity, dicts, n,
+                                    self.node.columns, self.node.schema)
             # evaluate pushed filters as an early mask (zonemap pruning
             # already dropped fully-excluded chunks host-side)
             for f in self.node.filters:
                 pred = eval_expr(f, ex)
-                ex.mask = ex.mask & F.predicate_mask(pred, db)
+                ex.mask = ex.mask & F.predicate_mask(pred, ex.batch)
             yield ex
 
 

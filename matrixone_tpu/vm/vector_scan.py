@@ -1,0 +1,52 @@
+"""Vector-index scan operator (reference: colexec/table_function/
+ivf_search.go + vectorindex/ivfflat/search.go — redesigned: the index is a
+device-resident pytree and search is one jitted batched kernel; candidate
+rows are fetched by row id and re-enter the normal pipeline).
+
+Txn-workspace caveat: the planner only applies the index rewrite outside
+transactions that have written to the table (sql/optimize.apply_indices
+skip_tables) — in-txn queries take the exact scan path, which merges the
+workspace. Committed-but-post-snapshot rows and deletes ARE handled here
+via MVCCTable.visible_gids.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax.numpy as jnp
+import numpy as np
+
+from matrixone_tpu.sql import plan as P
+from matrixone_tpu.vm.exprs import ExecBatch
+from matrixone_tpu.vm.operators import Operator, chunk_to_execbatch
+
+
+class VectorTopKOp(Operator):
+    def __init__(self, node: P.VectorTopK, ctx):
+        self.node = node
+        self.ctx = ctx
+        self.schema = node.schema
+
+    def execute(self) -> Iterator[ExecBatch]:
+        from matrixone_tpu.vectorindex import ivf_flat
+        catalog = self.ctx.catalog
+        ix = catalog.indexes[self.node.index_name]
+        index = ix.index_obj
+        row_gids = np.asarray(ix.options["_row_gids"])
+        table = catalog.get_table(self.node.table)
+
+        q = np.asarray([self.node.query_vector], dtype=np.float32)
+        k = min(self.node.k, index.n) or 1
+        nprobe = min(self.node.nprobe, index.nlist)
+        dists, pos = ivf_flat.search(index, jnp.asarray(q), k=k,
+                                     nprobe=nprobe, query_chunk=1)
+        pos = np.asarray(pos)[0]
+        gids = row_gids[pos[pos >= 0]]
+        read_args = self.ctx.table_read_args(self.node.table)
+        gids = table.visible_gids(
+            gids, snapshot_ts=self.ctx.snapshot_ts,
+            extra_deletes=read_args.get("extra_deletes"))
+        arrays, validity = table.fetch_rows(gids, self.node.columns)
+        yield chunk_to_execbatch(arrays, validity, table.dicts, len(gids),
+                                 self.node.columns, self.node.schema)

@@ -1,0 +1,49 @@
+"""Aux subsystems: metrics, statement tracing (dogfooded), fault injection."""
+
+import pytest
+
+from matrixone_tpu.frontend import Session
+from matrixone_tpu.utils.fault import INJECTOR
+from matrixone_tpu.utils.metrics import REGISTRY
+
+
+def test_statement_info_dogfooded():
+    s = Session()
+    s.execute("create table t (a bigint)")
+    s.execute("insert into t values (1), (2)")
+    s.execute("select * from t")
+    rows = s.execute("""select statement, status, rows_out
+                        from system_statement_info order by stmt_id""").rows()
+    assert len(rows) >= 3
+    assert any("insert into t" in r[0] for r in rows)
+    assert all(r[1] == "ok" for r in rows)
+
+
+def test_statement_info_records_errors():
+    s = Session()
+    with pytest.raises(Exception):
+        s.execute("select * from missing_table")
+    rows = s.execute("select status, error from system_statement_info").rows()
+    assert any(r[0] == "error" and "missing_table" in r[1] for r in rows)
+
+
+def test_metrics_exposition():
+    s = Session()
+    s.execute("create table t (a bigint)")
+    s.execute("insert into t values (1)")
+    s.execute("select * from t")
+    text = REGISTRY.expose()
+    assert "mo_query_duration_seconds" in text
+    assert "mo_scan_rows_total" in text
+
+
+def test_fault_injection_via_sql():
+    s = Session()
+    s.execute("create table t (a bigint)")
+    s.execute("set fault_point = 'commit.before:return:fail'")
+    with pytest.raises(RuntimeError, match="injected commit failure"):
+        s.execute("insert into t values (1)")
+    s.execute("set fault_point_clear = 'commit.before'")
+    s.execute("insert into t values (1)")
+    assert len(s.execute("select * from t").rows()) == 1
+    assert INJECTOR.status() == {}

@@ -1,0 +1,111 @@
+"""Vector search through SQL: CREATE INDEX + ORDER BY distance LIMIT k
+(reference analogue: test/distributed/cases/vector BVT cases)."""
+
+import numpy as np
+import pytest
+
+from matrixone_tpu.frontend import Session
+
+
+@pytest.fixture(scope="module")
+def vsess():
+    s = Session()
+    s.execute("create table items (id bigint primary key, emb vecf32(16))")
+    rng = np.random.default_rng(11)
+    centers = rng.standard_normal((8, 16)) * 4
+    rows = []
+    for i in range(2000):
+        c = centers[i % 8]
+        v = c + rng.standard_normal(16) * 0.3
+        vec = "[" + ",".join(f"{x:.4f}" for x in v) + "]"
+        rows.append(f"({i}, '{vec}')")
+    for j in range(0, 2000, 500):
+        s.execute("insert into items values " + ", ".join(rows[j:j + 500]))
+    s.execute("create index iv using ivfflat on items (emb) "
+              "lists = 16 op_type = 'vector_l2_ops'")
+    return s, centers
+
+
+def _knn_sql(center):
+    vec = "[" + ",".join(f"{x:.4f}" for x in center) + "]"
+    return (f"select id, l2_distance(emb, '{vec}') d from items "
+            f"order by d limit 10")
+
+
+def test_index_rewrite_in_plan(vsess):
+    s, centers = vsess
+    txt = s.execute("explain " + _knn_sql(centers[0])).text
+    # EXPLAIN shows the pre-rewrite plan (rewrite applies at execution);
+    # check the rewrite directly
+    from matrixone_tpu.sql.binder import Binder
+    from matrixone_tpu.sql.optimize import apply_indices
+    from matrixone_tpu.sql.parser import parse_one
+    from matrixone_tpu.sql import plan as P
+    node = Binder(s.catalog).bind_select(parse_one(_knn_sql(centers[0])))
+    node = apply_indices(node, s.catalog)
+    found = []
+
+    def walk(n):
+        found.append(type(n).__name__)
+        for a in ("child", "left", "right"):
+            c = getattr(n, a, None)
+            if c is not None:
+                walk(c)
+    walk(node)
+    assert "VectorTopK" in found and "Scan" not in found
+
+
+def test_knn_results_match_exact(vsess):
+    s, centers = vsess
+    for ci in range(4):
+        rows = s.execute(_knn_sql(centers[ci])).rows()
+        assert len(rows) == 10
+        # distances ascending
+        ds = [r[1] for r in rows]
+        assert ds == sorted(ds)
+        # oracle: brute force over raw vectors via SQL w/o index
+        # (drop index path by using a fresh session w/o indexes)
+        import copy
+        from matrixone_tpu.sql.binder import Binder
+        from matrixone_tpu.sql.parser import parse_one
+        from matrixone_tpu.vm.compile import compile_plan
+        node = Binder(s.catalog).bind_select(parse_one(_knn_sql(centers[ci])))
+        op = compile_plan(node, s.catalog)  # no apply_indices -> full scan
+        exact_rows = []
+        for ex in op.execute():
+            b = s._to_host(ex, node.schema)
+            ids = b.columns["id"].to_pylist()
+            dd = b.columns["d"].to_pylist()
+            exact_rows = list(zip(ids, dd))
+        exact_ids = {r[0] for r in exact_rows}
+        got_ids = {r[0] for r in rows}
+        # IVF recall at nprobe=8/16 lists on well-separated clusters
+        assert len(got_ids & exact_ids) >= 8
+
+
+def test_knn_excludes_deleted(vsess):
+    s, centers = vsess
+    rows = s.execute(_knn_sql(centers[1])).rows()
+    victim = rows[0][0]
+    s.execute(f"delete from items where id = {victim}")
+    rows2 = s.execute(_knn_sql(centers[1])).rows()
+    assert victim not in {r[0] for r in rows2}
+    # restore-ish: further queries still work
+    assert len(rows2) == 10
+
+
+def test_cosine_index():
+    s = Session()
+    s.execute("create table docs (id bigint, emb vecf32(8))")
+    rng = np.random.default_rng(3)
+    vals = rng.standard_normal((200, 8))
+    for i in range(200):
+        vec = "[" + ",".join(f"{x:.4f}" for x in vals[i]) + "]"
+        s.execute(f"insert into docs values ({i}, '{vec}')")
+    s.execute("create index cv using ivfflat on docs (emb) "
+              "lists = 4 op_type = 'vector_cosine_ops'")
+    q = vals[7]
+    vec = "[" + ",".join(f"{x:.4f}" for x in q) + "]"
+    rows = s.execute(f"select id, cosine_distance(emb, '{vec}') d from docs "
+                     f"order by d limit 3").rows()
+    assert rows[0][0] == 7 and rows[0][1] < 1e-6
