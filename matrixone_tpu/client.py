@@ -1,0 +1,168 @@
+"""Python client SDK speaking the MySQL wire protocol
+(reference: clients/python SDK + any stock MySQL connector).
+
+    conn = matrixone_tpu.client.connect(port=6001)
+    cols, rows = conn.query("select 1 + 1")
+    conn.execute("insert into t values (1)")
+    conn.close()
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import List, Optional, Tuple
+
+
+class MySQLError(RuntimeError):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"({code}) {message}")
+        self.code = code
+
+
+class Connection:
+    def __init__(self, host: str = "127.0.0.1", port: int = 6001,
+                 user: str = "root", password: str = "",
+                 database: str = ""):
+        self.sock = socket.create_connection((host, port), timeout=30)
+        self.seq = 0
+        self._handshake(user, database)
+
+    # ---- framing
+    def _send(self, payload: bytes):
+        header = struct.pack("<I", len(payload))[:3] + bytes([self.seq & 0xFF])
+        self.sock.sendall(header + payload)
+        self.seq += 1
+
+    def _recv(self) -> bytes:
+        header = self._recv_n(4)
+        length = int.from_bytes(header[:3], "little")
+        self.seq = header[3] + 1
+        return self._recv_n(length)
+
+    def _recv_n(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            part = self.sock.recv(n - len(buf))
+            if not part:
+                raise ConnectionError("server closed connection")
+            buf += part
+        return buf
+
+    # ---- lenenc decoding
+    @staticmethod
+    def _lenenc(data: bytes, pos: int) -> Tuple[Optional[int], int]:
+        b0 = data[pos]
+        if b0 < 0xFB:
+            return b0, pos + 1
+        if b0 == 0xFB:
+            return None, pos + 1          # NULL
+        if b0 == 0xFC:
+            return int.from_bytes(data[pos + 1:pos + 3], "little"), pos + 3
+        if b0 == 0xFD:
+            return int.from_bytes(data[pos + 1:pos + 4], "little"), pos + 4
+        return int.from_bytes(data[pos + 1:pos + 9], "little"), pos + 9
+
+    # ---- handshake
+    def _handshake(self, user: str, database: str):
+        greeting = self._recv()
+        assert greeting[0] == 10, "unsupported protocol"
+        caps = 0x0200 | 0x8000 | 0x00200000   # 41 + secure conn + plugin auth
+        if database:
+            caps |= 0x8                        # CLIENT_CONNECT_WITH_DB
+        payload = (struct.pack("<I", caps) + struct.pack("<I", 1 << 24)
+                   + bytes([0x21]) + b"\x00" * 23
+                   + user.encode() + b"\x00"
+                   + bytes([0])                      # empty auth response
+                   + (database.encode() + b"\x00" if database else b""))
+        self._send(payload)
+        resp = self._recv()
+        if resp[0] == 0xFF:
+            raise self._err(resp)
+
+    @staticmethod
+    def _err(payload: bytes) -> MySQLError:
+        code = int.from_bytes(payload[1:3], "little")
+        msg = payload[3:].decode("utf-8", "replace")
+        if msg.startswith("#"):
+            msg = msg[6:]
+        return MySQLError(code, msg)
+
+    # ---- commands
+    def query(self, sql: str) -> Tuple[List[str], List[tuple]]:
+        self.seq = 0
+        self._send(b"\x03" + sql.encode())
+        first = self._recv()
+        if first[0] == 0xFF:
+            raise self._err(first)
+        if first[0] == 0x00:          # OK packet (no resultset)
+            return [], []
+        ncols, _ = self._lenenc(first, 0)
+        names = []
+        for _ in range(ncols):
+            col = self._recv()
+            pos = 0
+            parts = []
+            for _f in range(6):       # catalog schema table org_table name org_name
+                ln, pos = self._lenenc(col, pos)
+                parts.append(col[pos:pos + (ln or 0)])
+                pos += ln or 0
+            names.append(parts[4].decode())
+        eof = self._recv()            # EOF after columns
+        rows = []
+        while True:
+            pkt = self._recv()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            if pkt[0] == 0xFF:
+                raise self._err(pkt)
+            pos = 0
+            row = []
+            for _ in range(ncols):
+                ln, pos = self._lenenc(pkt, pos)
+                if ln is None:
+                    row.append(None)
+                else:
+                    row.append(pkt[pos:pos + ln].decode())
+                    pos += ln
+            rows.append(tuple(row))
+        return names, rows
+
+    def execute(self, sql: str) -> int:
+        """Run a statement; returns affected rows (0 for resultsets)."""
+        self.seq = 0
+        self._send(b"\x03" + sql.encode())
+        first = self._recv()
+        if first[0] == 0xFF:
+            raise self._err(first)
+        if first[0] == 0x00:
+            affected, _ = self._lenenc(first, 1)
+            return affected or 0
+        # drain the resultset
+        ncols, _ = self._lenenc(first, 0)
+        for _ in range(ncols):
+            self._recv()
+        self._recv()
+        while True:
+            pkt = self._recv()
+            if pkt[0] == 0xFF:
+                raise self._err(pkt)
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                return 0
+
+    def ping(self) -> bool:
+        self.seq = 0
+        self._send(b"\x0e")
+        return self._recv()[0] == 0x00
+
+    def close(self):
+        try:
+            self.seq = 0
+            self._send(b"\x01")
+        except OSError:
+            pass
+        self.sock.close()
+
+
+def connect(**kwargs) -> Connection:
+    return Connection(**kwargs)

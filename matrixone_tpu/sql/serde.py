@@ -1,0 +1,96 @@
+"""JSON serialization of bound expressions / stage descriptors.
+
+Reference analogue: `compile/remoterun.go:86 encodeScope` — the reference
+serializes operator subtrees as protobuf and ships them to peer CNs; here
+bound-expression trees and stage descriptors serialize to JSON and ship to
+the TPU worker (worker/) or a peer host.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from matrixone_tpu.container.dtypes import DType, TypeOid
+from matrixone_tpu.sql.expr import (AggCall, BoundCase, BoundCast, BoundCol,
+                                    BoundExpr, BoundFunc, BoundInList,
+                                    BoundIsNull, BoundLike, BoundLiteral)
+
+
+def dtype_to_json(d: DType) -> list:
+    return [d.oid.value, d.width, d.scale, d.dim]
+
+
+def dtype_from_json(v: list) -> DType:
+    return DType(TypeOid(v[0]), width=v[1], scale=v[2], dim=v[3])
+
+
+def expr_to_json(e: BoundExpr) -> dict:
+    if isinstance(e, BoundCol):
+        return {"t": "col", "name": e.name, "dtype": dtype_to_json(e.dtype)}
+    if isinstance(e, BoundLiteral):
+        return {"t": "lit", "value": e.value, "dtype": dtype_to_json(e.dtype)}
+    if isinstance(e, BoundFunc):
+        return {"t": "func", "op": e.op,
+                "args": [expr_to_json(a) for a in e.args],
+                "dtype": dtype_to_json(e.dtype)}
+    if isinstance(e, BoundCast):
+        return {"t": "cast", "arg": expr_to_json(e.arg),
+                "dtype": dtype_to_json(e.dtype)}
+    if isinstance(e, BoundCase):
+        return {"t": "case",
+                "whens": [[expr_to_json(c), expr_to_json(v)]
+                          for c, v in e.whens],
+                "else": expr_to_json(e.else_) if e.else_ is not None else None,
+                "dtype": dtype_to_json(e.dtype)}
+    if isinstance(e, BoundInList):
+        return {"t": "in", "arg": expr_to_json(e.arg), "values": e.values,
+                "negated": e.negated, "dtype": dtype_to_json(e.dtype)}
+    if isinstance(e, BoundIsNull):
+        return {"t": "isnull", "arg": expr_to_json(e.arg),
+                "negated": e.negated, "dtype": dtype_to_json(e.dtype)}
+    if isinstance(e, BoundLike):
+        return {"t": "like", "arg": expr_to_json(e.arg),
+                "pattern": e.pattern, "negated": e.negated,
+                "dtype": dtype_to_json(e.dtype)}
+    raise TypeError(f"cannot serialize {type(e).__name__}")
+
+
+def expr_from_json(d: dict) -> BoundExpr:
+    t = d["t"]
+    dt_ = dtype_from_json(d["dtype"])
+    if t == "col":
+        return BoundCol(d["name"], dt_)
+    if t == "lit":
+        return BoundLiteral(d["value"], dt_)
+    if t == "func":
+        return BoundFunc(d["op"], [expr_from_json(a) for a in d["args"]], dt_)
+    if t == "cast":
+        return BoundCast(expr_from_json(d["arg"]), dt_)
+    if t == "case":
+        return BoundCase([(expr_from_json(c), expr_from_json(v))
+                          for c, v in d["whens"]],
+                         expr_from_json(d["else"]) if d["else"] else None,
+                         dt_)
+    if t == "in":
+        return BoundInList(expr_from_json(d["arg"]), d["values"],
+                           d["negated"], dt_)
+    if t == "isnull":
+        return BoundIsNull(expr_from_json(d["arg"]), d["negated"], dt_)
+    if t == "like":
+        return BoundLike(expr_from_json(d["arg"]), d["pattern"],
+                         d["negated"], dt_)
+    raise TypeError(f"cannot deserialize expr kind {t}")
+
+
+def agg_to_json(a: AggCall) -> dict:
+    return {"func": a.func,
+            "arg": expr_to_json(a.arg) if a.arg is not None else None,
+            "distinct": a.distinct, "dtype": dtype_to_json(a.dtype),
+            "out_name": a.out_name}
+
+
+def agg_from_json(d: dict) -> AggCall:
+    return AggCall(d["func"],
+                   expr_from_json(d["arg"]) if d["arg"] else None,
+                   d["distinct"], dtype_from_json(d["dtype"]),
+                   d["out_name"])

@@ -37,8 +37,9 @@ class VectorTopKOp(Operator):
         table = catalog.get_table(self.node.table)
 
         q = np.asarray([self.node.query_vector], dtype=np.float32)
-        k = min(self.node.k, index.n) or 1
         nprobe = min(self.node.nprobe, index.nlist)
+        pool = nprobe * index.max_cluster_size
+        k = min(self.node.k, index.n, pool) or 1
         dists, pos = ivf_flat.search(index, jnp.asarray(q), k=k,
                                      nprobe=nprobe, query_chunk=1)
         pos = np.asarray(pos)[0]

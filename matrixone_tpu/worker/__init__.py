@@ -1,0 +1,4 @@
+from matrixone_tpu.worker.client import WorkerClient
+from matrixone_tpu.worker.server import TpuWorkerServer, WorkerCore
+
+__all__ = ["WorkerClient", "TpuWorkerServer", "WorkerCore"]

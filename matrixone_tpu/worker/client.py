@@ -1,0 +1,67 @@
+"""Client for the TPU compute worker (reference: pkg/udf/pythonservice/
+client.go — the CN side of the offload seam)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from matrixone_tpu.worker.server import pack, unpack
+
+
+class WorkerClient:
+    def __init__(self, address: str):
+        import grpc
+        self.channel = grpc.insecure_channel(address)
+        self._run = self.channel.unary_unary(
+            "/mo.tpu.Worker/Run",
+            request_serializer=None, response_deserializer=None)
+        self._health = self.channel.unary_unary(
+            "/mo.tpu.Worker/Health",
+            request_serializer=None, response_deserializer=None)
+
+    def run(self, header: dict, blob: bytes = b"") -> Tuple[dict, bytes]:
+        resp = self._run(pack(header, blob))
+        h, b = unpack(resp)
+        if "error" in h:
+            raise RuntimeError(f"worker: {h['error']}")
+        return h, b
+
+    def health(self) -> dict:
+        return unpack(self._health(pack({})))[0]
+
+    # ---- convenience wrappers
+    def filter_project(self, arrays: Dict[str, np.ndarray], validity,
+                       schema_json: dict, filters_json: list,
+                       projections_json: dict,
+                       dicts: Optional[dict] = None):
+        from matrixone_tpu.storage import arrowio
+        h, b = self.run({"op": "filter_project", "schema": schema_json,
+                         "filters": filters_json,
+                         "projections": projections_json,
+                         "dicts": dicts or {}},
+                        arrowio.arrays_to_ipc(arrays, validity))
+        out_arrays, out_val = arrowio.ipc_to_arrays(b)
+        return h, out_arrays, out_val
+
+    def load_index(self, name: str, data: np.ndarray, nlist: int = 64,
+                   metric: str = "l2"):
+        from matrixone_tpu.storage import arrowio
+        val = {"data": np.ones(len(data), np.bool_)}
+        return self.run({"op": "load_index", "name": name, "nlist": nlist,
+                         "metric": metric},
+                        arrowio.arrays_to_ipc({"data": data}, val))[0]
+
+    def search_index(self, name: str, queries: np.ndarray, k: int = 10,
+                     nprobe: int = 8):
+        from matrixone_tpu.storage import arrowio
+        val = {"queries": np.ones(len(queries), np.bool_)}
+        h, b = self.run({"op": "search_index", "name": name, "k": k,
+                         "nprobe": nprobe},
+                        arrowio.arrays_to_ipc({"queries": queries}, val))
+        arrays, _ = arrowio.ipc_to_arrays(b)
+        return arrays["distances"], arrays["ids"]
+
+    def close(self):
+        self.channel.close()

@@ -1,0 +1,130 @@
+// Host-side native kernels (reference analogue: cgo/*.c — xcall ABI,
+// bloom.c vectorized bloom probe, cbitmap.c bitsets, xxHash in
+// thirdparties/). Redesigned, not ported: a minimal C ABI over dense
+// arrays, called from Python via ctypes; the TPU compute path never sees
+// this code — it serves the host planner/runtime (runtime filters, doc-id
+// pushdown, PK dedup).
+//
+// Build: g++ -O3 -march=native -shared -fPIC mo_native.cpp -o libmo_native.so
+
+#include <cstdint>
+#include <cstddef>
+#include <cstring>
+
+extern "C" {
+
+// ----------------------------------------------------------------- hashing
+// splitmix64 finalizer (public domain; same mixer as the device-side
+// ops/hash.py so host and device agree on hash values).
+static inline uint64_t mix64(uint64_t x) {
+    x += 0x9E3779B97F4A7C15ULL;
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+    return x ^ (x >> 31);
+}
+
+void mo_hash64_i64(const int64_t* in, size_t n, uint64_t* out) {
+    for (size_t i = 0; i < n; i++) out[i] = mix64((uint64_t)in[i]);
+}
+
+// bytes hashing (varlena): simple 8-byte-block splitmix chain — NOT xxhash,
+// deliberately: host/device parity matters more than raw speed here.
+uint64_t mo_hash_bytes(const uint8_t* data, size_t len, uint64_t seed) {
+    uint64_t h = mix64(seed ^ (uint64_t)len);
+    size_t i = 0;
+    for (; i + 8 <= len; i += 8) {
+        uint64_t w;
+        memcpy(&w, data + i, 8);
+        h = mix64(h ^ w);
+    }
+    if (i < len) {
+        uint64_t w = 0;
+        memcpy(&w, data + i, len - i);
+        h = mix64(h ^ w);
+    }
+    return h;
+}
+
+// ------------------------------------------------------------ bloom filter
+// Blocked bloom: k derived probes from one 64-bit hash (double hashing),
+// reference: cgo/bloom.c + common/bloomfilter.
+void mo_bloom_add(const uint64_t* hashes, size_t n, uint8_t* bits,
+                  uint64_t nbits, int k) {
+    for (size_t i = 0; i < n; i++) {
+        uint64_t h1 = hashes[i];
+        uint64_t h2 = mix64(h1);
+        for (int j = 0; j < k; j++) {
+            uint64_t bit = (h1 + (uint64_t)j * h2) % nbits;
+            bits[bit >> 3] |= (uint8_t)(1u << (bit & 7));
+        }
+    }
+}
+
+void mo_bloom_probe(const uint64_t* hashes, size_t n, const uint8_t* bits,
+                    uint64_t nbits, int k, uint8_t* out) {
+    for (size_t i = 0; i < n; i++) {
+        uint64_t h1 = hashes[i];
+        uint64_t h2 = mix64(h1);
+        uint8_t hit = 1;
+        for (int j = 0; j < k && hit; j++) {
+            uint64_t bit = (h1 + (uint64_t)j * h2) % nbits;
+            hit = (bits[bit >> 3] >> (bit & 7)) & 1;
+        }
+        out[i] = hit;
+    }
+}
+
+// ---------------------------------------------------------------- bitsets
+// dense bitsets over row ids (reference: cgo/cbitmap.c; the compressed
+// roaring variant slots behind the same API when row domains get sparse).
+void mo_bitset_set(uint8_t* bits, uint64_t nbits, const int64_t* ids,
+                   size_t n) {
+    for (size_t i = 0; i < n; i++) {
+        int64_t id = ids[i];
+        if (id >= 0 && (uint64_t)id < nbits)
+            bits[id >> 3] |= (uint8_t)(1u << (id & 7));
+    }
+}
+
+void mo_bitset_test(const uint8_t* bits, uint64_t nbits, const int64_t* ids,
+                    size_t n, uint8_t* out) {
+    for (size_t i = 0; i < n; i++) {
+        int64_t id = ids[i];
+        out[i] = (id >= 0 && (uint64_t)id < nbits)
+                     ? ((bits[id >> 3] >> (id & 7)) & 1)
+                     : 0;
+    }
+}
+
+void mo_bitset_and(uint8_t* a, const uint8_t* b, size_t nbytes) {
+    for (size_t i = 0; i < nbytes; i++) a[i] &= b[i];
+}
+
+void mo_bitset_or(uint8_t* a, const uint8_t* b, size_t nbytes) {
+    for (size_t i = 0; i < nbytes; i++) a[i] |= b[i];
+}
+
+int64_t mo_bitset_count(const uint8_t* bits, size_t nbytes) {
+    int64_t total = 0;
+    for (size_t i = 0; i < nbytes; i++)
+        total += __builtin_popcount(bits[i]);
+    return total;
+}
+
+// ----------------------------------------------------- sorted-set helpers
+// membership of ids in a SORTED haystack (tombstone filtering hot path —
+// the C version of np.isin for the scan loop).
+void mo_sorted_contains(const int64_t* haystack, size_t hn,
+                        const int64_t* ids, size_t n, uint8_t* out) {
+    for (size_t i = 0; i < n; i++) {
+        int64_t x = ids[i];
+        size_t lo = 0, hi = hn;
+        while (lo < hi) {
+            size_t mid = (lo + hi) / 2;
+            if (haystack[mid] < x) lo = mid + 1; else hi = mid;
+        }
+        out[i] = (lo < hn && haystack[lo] == x);
+    }
+}
+
+}  // extern "C"

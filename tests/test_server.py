@@ -1,0 +1,64 @@
+"""MySQL wire protocol: in-repo client against the MOServer
+(reference analogue: frontend protocol tests + clients/python)."""
+
+import pytest
+
+from matrixone_tpu import client
+from matrixone_tpu.frontend.server import MOServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = MOServer(port=0).start()   # ephemeral port
+    yield srv
+    srv.stop()
+
+
+def test_connect_ping_query(server):
+    c = client.connect(port=server.port)
+    assert c.ping()
+    cols, rows = c.query("select 1 + 1 as s")
+    assert cols == ["s"] and rows == [("2",)]
+    c.close()
+
+
+def test_ddl_dml_roundtrip(server):
+    c = client.connect(port=server.port)
+    c.execute("create table wt (id bigint, name varchar(20), p decimal(8,2))")
+    n = c.execute("insert into wt values (1, 'ann', 1.50), (2, null, 2.25)")
+    assert n == 2
+    cols, rows = c.query("select id, name, p from wt order by id")
+    assert cols == ["id", "name", "p"]
+    assert rows == [("1", "ann", "1.5"), ("2", None, "2.25")]
+    assert c.execute("update wt set p = 9.99 where id = 1") == 1
+    _, rows = c.query("select p from wt where id = 1")
+    assert rows == [("9.99",)]
+    c.close()
+
+
+def test_error_packet(server):
+    c = client.connect(port=server.port)
+    with pytest.raises(client.MySQLError, match="no such table"):
+        c.query("select * from does_not_exist")
+    # connection still usable after an error
+    assert c.ping()
+    c.close()
+
+
+def test_concurrent_connections_share_engine(server):
+    c1 = client.connect(port=server.port)
+    c2 = client.connect(port=server.port)
+    c1.execute("create table shared (x bigint)")
+    c1.execute("insert into shared values (42)")
+    _, rows = c2.query("select x from shared")
+    assert rows == [("42",)]
+    # txn isolation across connections
+    c1.execute("begin")
+    c1.execute("insert into shared values (43)")
+    _, rows = c2.query("select count(*) from shared")
+    assert rows == [("1",)]
+    c1.execute("commit")
+    _, rows = c2.query("select count(*) from shared")
+    assert rows == [("2",)]
+    c1.close()
+    c2.close()

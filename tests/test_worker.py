@@ -1,0 +1,64 @@
+"""TPU compute worker over gRPC (reference analogue: udf pyserver tests +
+cgo/cuvs worker lifecycle)."""
+
+import numpy as np
+import pytest
+
+from matrixone_tpu.container import dtypes as dt
+from matrixone_tpu.sql.serde import dtype_to_json, expr_to_json
+from matrixone_tpu.sql.expr import BoundCol, BoundFunc, BoundLiteral
+from matrixone_tpu.worker import TpuWorkerServer, WorkerClient
+
+
+@pytest.fixture(scope="module")
+def worker():
+    srv = TpuWorkerServer(port=0).start()
+    client = WorkerClient(f"127.0.0.1:{srv.port}")
+    yield client
+    client.close()
+    srv.stop()
+
+
+def test_health(worker):
+    h = worker.health()
+    assert h["backend"] in ("cpu", "tpu")
+    assert h["stages_run"] == 0 or h["stages_run"] >= 0
+
+
+def test_filter_project_stage(worker):
+    n = 1000
+    arrays = {"a": np.arange(n, dtype=np.int64),
+              "b": np.linspace(0, 1, n)}
+    validity = {c: np.ones(n, np.bool_) for c in arrays}
+    schema = {"a": dtype_to_json(dt.INT64), "b": dtype_to_json(dt.FLOAT64)}
+    col_a = BoundCol("a", dt.INT64)
+    col_b = BoundCol("b", dt.FLOAT64)
+    filt = BoundFunc("lt", [col_a, BoundLiteral(100, dt.INT64)], dt.BOOL)
+    proj = {"a2": expr_to_json(BoundFunc("mul", [col_a,
+                                                 BoundLiteral(2, dt.INT64)],
+                                         dt.INT64)),
+            "b": expr_to_json(col_b)}
+    h, out, val = worker.filter_project(arrays, validity, schema,
+                                        [expr_to_json(filt)], proj)
+    assert len(out["a2"]) == 100
+    np.testing.assert_array_equal(out["a2"], np.arange(100) * 2)
+
+
+def test_index_lifecycle(worker):
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((3000, 24)).astype(np.float32)
+    r = worker.load_index("ix1", data, nlist=12)
+    assert r["ok"] and r["n"] == 3000
+    q = data[:5] + 0.001
+    dists, ids = worker.search_index("ix1", q, k=3, nprobe=12)
+    assert ids.shape == (5, 3)
+    # self-hit first
+    np.testing.assert_array_equal(ids[:, 0], np.arange(5))
+    assert worker.health()["indexes"] == ["ix1"]
+
+
+def test_worker_error_surface(worker):
+    with pytest.raises(RuntimeError, match="worker:"):
+        worker.run({"op": "nope"})
+    with pytest.raises(RuntimeError, match="worker:"):
+        worker.search_index("missing_index", np.zeros((1, 4), np.float32))
