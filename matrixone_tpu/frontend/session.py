@@ -93,7 +93,7 @@ class Session:
         return results[-1] if results else Result()
 
     def _execute_stmt(self, stmt: ast.Node) -> Result:
-        if isinstance(stmt, ast.Select):
+        if isinstance(stmt, (ast.Select, ast.Union)):
             return self._select(stmt)
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt)
@@ -135,6 +135,25 @@ class Session:
                 else:
                     self.variables[stmt.name] = value
             return Result()
+        if isinstance(stmt, ast.CreateSnapshot):
+            self.catalog.create_snapshot(stmt.name)
+            return Result()
+        if isinstance(stmt, ast.DropSnapshot):
+            self.catalog.drop_snapshot(stmt.name)
+            return Result()
+        if isinstance(stmt, ast.ShowSnapshots):
+            names = sorted(self.catalog.snapshots)
+            b = Batch.from_pydict(
+                {"Snapshot": names,
+                 "Timestamp": [self.catalog.snapshots[n] for n in names]},
+                {"Snapshot": dt.VARCHAR, "Timestamp": dt.INT64})
+            return Result(batch=b)
+        if isinstance(stmt, ast.RestoreTable):
+            snaps = self.catalog.snapshots
+            if stmt.snapshot not in snaps:
+                raise BindError(f"no such snapshot {stmt.snapshot!r}")
+            n = self.catalog.restore_table(stmt.table, snaps[stmt.snapshot])
+            return Result(affected=n)
         if isinstance(stmt, ast.Delete):
             return self._delete(stmt)
         if isinstance(stmt, ast.Update):
@@ -161,7 +180,7 @@ class Session:
     # ------------------------------------------------------------- select
     def _select(self, sel: ast.Select) -> Result:
         from matrixone_tpu.sql.optimize import apply_indices
-        node = Binder(self.catalog).bind_select(sel)
+        node = Binder(self.catalog).bind_statement(sel)
         skip = frozenset(self.txn.workspace.keys()) if self.txn else frozenset()
         node = apply_indices(node, self.catalog,
                              nprobe=int(self.variables.get("ivf_nprobe", 8)),

@@ -129,6 +129,8 @@ class SelectItem(Node):
 class TableRef(Node):
     name: str
     alias: Optional[str] = None
+    snapshot: Optional[str] = None       # AS OF SNAPSHOT 'name'
+    as_of_ts: Optional[int] = None       # AS OF TIMESTAMP <hlc>
 
 
 @dataclasses.dataclass
@@ -162,6 +164,15 @@ class Select(Node):
     limit: Optional[int] = None
     offset: Optional[int] = None
     distinct: bool = False
+
+
+@dataclasses.dataclass
+class Union(Node):
+    selects: List["Select"]
+    alls: List[bool]         # alls[i]: UNION ALL between selects[i], [i+1]
+    order_by: List["OrderItem"] = dataclasses.field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -227,6 +238,27 @@ class Explain(Node):
 @dataclasses.dataclass
 class ShowTables(Node):
     pass
+
+
+@dataclasses.dataclass
+class CreateSnapshot(Node):
+    name: str
+
+
+@dataclasses.dataclass
+class DropSnapshot(Node):
+    name: str
+
+
+@dataclasses.dataclass
+class ShowSnapshots(Node):
+    pass
+
+
+@dataclasses.dataclass
+class RestoreTable(Node):
+    table: str
+    snapshot: str
 
 
 @dataclasses.dataclass

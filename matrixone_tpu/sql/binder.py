@@ -86,6 +86,73 @@ class Binder:
     def __init__(self, catalog):
         self.catalog = catalog
 
+    def bind_statement(self, stmt) -> plan.PlanNode:
+        if isinstance(stmt, ast.Union):
+            return self.bind_union(stmt)
+        return self.bind_select(stmt)
+
+    def bind_union(self, u: ast.Union) -> plan.PlanNode:
+        children = [self.bind_select(s) for s in u.selects]
+        base = children[0].schema
+        for c in children[1:]:
+            if len(c.schema) != len(base):
+                raise BindError("UNION arms have different column counts")
+        # output types: promote numerics column-wise
+        out_schema = []
+        for i, (name, d0) in enumerate(base):
+            out_t = d0
+            for c in children[1:]:
+                d1 = c.schema[i][1]
+                if d1.oid != out_t.oid:
+                    if d1.is_numeric and out_t.is_numeric:
+                        out_t = dt.promote(out_t, d1)
+                    elif d1.is_varlen and out_t.is_varlen:
+                        pass
+                    else:
+                        raise BindError(
+                            f"UNION column {name}: incompatible types "
+                            f"{out_t} vs {d1}")
+            out_schema.append((name, out_t))
+        # MySQL semantics: a plain UNION dedups everything up to and
+        # including its position; UNION ALL arms AFTER the last plain
+        # UNION append duplicates
+        last_distinct = -1
+        for i, is_all in enumerate(u.alls):
+            if not is_all:
+                last_distinct = i
+        if last_distinct >= 0:
+            head = children[:last_distinct + 2]
+            node = plan.Distinct(plan.Union(head, out_schema), out_schema)
+            tail = children[last_distinct + 2:]
+            if tail:
+                node = plan.Union([node] + tail, out_schema)
+        else:
+            node = plan.Union(children, out_schema)
+        if u.order_by:
+            keys, descs = [], []
+            names = [n for n, _ in out_schema]
+            for o in u.order_by:
+                descs.append(o.descending)
+                if isinstance(o.expr, ast.Literal) and o.expr.kind == "int":
+                    idx = int(o.expr.value) - 1
+                    if not 0 <= idx < len(names):
+                        raise BindError("ORDER BY ordinal out of range")
+                elif isinstance(o.expr, ast.ColumnRef) and \
+                        o.expr.name in names:
+                    idx = names.index(o.expr.name)
+                else:
+                    raise BindError(
+                        "UNION ORDER BY supports output names/ordinals")
+                keys.append(BoundCol(names[idx], out_schema[idx][1]))
+            if u.limit is not None:
+                node = plan.TopK(node, keys, descs, u.limit, u.offset or 0,
+                                 out_schema)
+            else:
+                node = plan.Sort(node, keys, descs, out_schema)
+        elif u.limit is not None or u.offset:
+            node = plan.Limit(node, u.limit, u.offset or 0, out_schema)
+        return node
+
     # ------------------------------------------------------------- select
     def bind_select(self, sel: ast.Select) -> plan.PlanNode:
         node, scope = self._bind_from(sel.from_)
@@ -128,6 +195,19 @@ class Binder:
                 else self.bind_expr(it.expr, scope)
             exprs.append(e)
             names.append(it.alias or _expr_name(it.expr, idx))
+        # batches are dict-keyed: disambiguate duplicate output labels
+        seen: Dict[str, int] = {}
+        taken = set(names)
+        for i, n in enumerate(names):
+            if n in seen:
+                k = seen[n] + 1
+                while f"{n}_{k}" in taken:
+                    k += 1
+                seen[n] = k
+                names[i] = f"{n}_{k}"
+                taken.add(names[i])
+            else:
+                seen[n] = 0
         out_schema = list(zip(names, [e.dtype for e in exprs]))
         node = plan.Project(node, exprs, out_schema)
 
@@ -169,9 +249,16 @@ class Binder:
             sc = Scope()
             for col, dtype in meta.schema:
                 sc.add(alias, col, dtype)
+            as_of = from_.as_of_ts
+            if from_.snapshot is not None:
+                snaps = getattr(self.catalog, "snapshots", {})
+                if from_.snapshot not in snaps:
+                    raise BindError(f"no such snapshot {from_.snapshot!r}")
+                as_of = snaps[from_.snapshot]
             scan = plan.Scan(from_.name,
                              [c for c, _ in meta.schema],
-                             [(f"{alias}.{c}", d) for c, d in meta.schema])
+                             [(f"{alias}.{c}", d) for c, d in meta.schema],
+                             as_of_ts=as_of)
             return scan, sc
         if isinstance(from_, ast.SubqueryRef):
             child = self.bind_select(from_.select)
@@ -625,6 +712,22 @@ _SCALAR_FUNCS = {
     "year": ("year", lambda ts: dt.INT32),
     "month": ("month", lambda ts: dt.INT32),
     "day": ("day", lambda ts: dt.INT32),
+    "upper": ("upper", lambda ts: ts[0]),
+    "ucase": ("upper", lambda ts: ts[0]),
+    "lower": ("lower", lambda ts: ts[0]),
+    "lcase": ("lower", lambda ts: ts[0]),
+    "length": ("length", lambda ts: dt.INT64),
+    "char_length": ("length", lambda ts: dt.INT64),
+    "reverse": ("reverse", lambda ts: ts[0]),
+    "trim": ("trim", lambda ts: ts[0]),
+    "ltrim": ("ltrim", lambda ts: ts[0]),
+    "rtrim": ("rtrim", lambda ts: ts[0]),
+    "concat": ("concat", lambda ts: dt.VARCHAR),
+    "substring": ("substring", lambda ts: dt.VARCHAR),
+    "substr": ("substring", lambda ts: dt.VARCHAR),
+    "replace": ("replace", lambda ts: dt.VARCHAR),
+    "starts_with": ("starts_with", lambda ts: dt.BOOL),
+    "ends_with": ("ends_with", lambda ts: dt.BOOL),
     "l2_distance": ("l2_distance", lambda ts: dt.FLOAT64),
     "l2_distance_sq": ("l2_distance_sq", lambda ts: dt.FLOAT64),
     "cosine_distance": ("cosine_distance", lambda ts: dt.FLOAT64),

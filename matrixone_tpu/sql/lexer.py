@@ -14,7 +14,8 @@ KEYWORDS = {
     "values", "delete", "update", "set", "show", "tables", "explain",
     "analyze", "date", "interval", "day", "month", "year", "primary",
     "key", "if", "exists", "using", "begin", "commit", "rollback", "with",
-    "union", "all", "default", "lists", "op_type", "count", "sum", "avg",
+    "union", "all", "default", "lists", "op_type", "count", "sum",
+    "snapshot", "snapshots", "restore", "of", "timestamp", "avg",
     "min", "max",
 }
 

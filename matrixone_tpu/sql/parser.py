@@ -94,7 +94,7 @@ class Parser:
 
     def statement(self) -> ast.Node:
         if self.at_kw("select"):
-            return self.select()
+            return self.select_or_union()
         if self.at_kw("create"):
             return self.create()
         if self.at_kw("drop"):
@@ -111,6 +111,13 @@ class Parser:
             return ast.Explain(self.statement(), analyze=analyze)
         if self.at_kw("show"):
             return self.show()
+        if self.at_kw("restore"):
+            self.next()
+            self.expect_kw("table")
+            table = self.ident()
+            self.expect_kw("from")
+            self.expect_kw("snapshot")
+            return ast.RestoreTable(table, self.ident())
         if self.at_kw("set"):
             self.next()
             name = self.ident()
@@ -128,12 +135,30 @@ class Parser:
         self.expect_kw("show")
         if self.accept_kw("tables"):
             return ast.ShowTables()
+        if self.accept_kw("snapshots"):
+            return ast.ShowSnapshots()
         if self.accept_kw("create"):
             self.expect_kw("table")
             return ast.ShowCreateTable(self.ident())
         raise ParseError("unsupported SHOW")
 
     # ---- SELECT
+    def select_or_union(self) -> ast.Node:
+        first = self.select()
+        if not self.at_kw("union"):
+            return first
+        selects, alls = [first], []
+        while self.accept_kw("union"):
+            alls.append(self.accept_kw("all"))
+            selects.append(self.select())
+        # a trailing ORDER BY / LIMIT binds to the whole UNION (MySQL);
+        # the select() of the last arm grabbed it — move it up
+        last = selects[-1]
+        u = ast.Union(selects, alls, order_by=last.order_by,
+                      limit=last.limit, offset=last.offset)
+        last.order_by, last.limit, last.offset = [], None, None
+        return u
+
     def select(self) -> ast.Select:
         self.expect_kw("select")
         distinct = self.accept_kw("distinct")
@@ -231,12 +256,26 @@ class Parser:
             alias = self.ident()
             return ast.SubqueryRef(sel, alias)
         name = self.ident()
+        snapshot = None
+        as_of_ts = None
+        # time travel: t AS OF SNAPSHOT 'name' | t AS OF TIMESTAMP 12345
+        if self.at_kw("as") and self.peek(1).kind == "kw" \
+                and self.peek(1).value == "of":
+            self.next()
+            self.next()
+            if self.accept_kw("snapshot"):
+                t = self.next()
+                snapshot = t.value
+            elif self.accept_kw("timestamp"):
+                as_of_ts = int(self.next().value)
+            else:
+                raise ParseError("AS OF requires SNAPSHOT or TIMESTAMP")
         alias = None
         if self.accept_kw("as"):
             alias = self.ident()
         elif self.peek().kind == "ident":
             alias = self.ident()
-        return ast.TableRef(name, alias)
+        return ast.TableRef(name, alias, snapshot=snapshot, as_of_ts=as_of_ts)
 
     # ---- DDL / DML
     def create(self) -> ast.Node:
@@ -268,6 +307,8 @@ class Parser:
                 if c.primary_key and c.name not in pk:
                     pk.append(c.name)
             return ast.CreateTable(name, cols, pk, if_not)
+        if self.accept_kw("snapshot"):
+            return ast.CreateSnapshot(self.ident())
         if self.accept_kw("index"):
             name = self.ident()
             using = None
@@ -322,6 +363,8 @@ class Parser:
 
     def drop(self) -> ast.Node:
         self.expect_kw("drop")
+        if self.accept_kw("snapshot"):
+            return ast.DropSnapshot(self.ident())
         self.expect_kw("table")
         if_exists = False
         if self.accept_kw("if"):

@@ -29,6 +29,8 @@ class Scan(PlanNode):
     schema: Schema
     # conjunctive filters pushed into the scan (zonemap pruning + early mask)
     filters: List[BoundExpr] = dataclasses.field(default_factory=list)
+    # time-travel read (AS OF SNAPSHOT/TIMESTAMP): overrides the txn snapshot
+    as_of_ts: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -97,6 +99,12 @@ class Distinct(PlanNode):
 
 
 @dataclasses.dataclass
+class Union(PlanNode):
+    children: List[PlanNode]
+    schema: Schema
+
+
+@dataclasses.dataclass
 class Values(PlanNode):
     rows: List[list]
     schema: Schema
@@ -137,4 +145,6 @@ def explain(node: PlanNode, indent: int = 0) -> str:
         c = getattr(node, attr, None)
         if c is not None:
             lines.append(explain(c, indent + 1))
+    for c in getattr(node, "children", []) or []:
+        lines.append(explain(c, indent + 1))
     return "\n".join(lines)

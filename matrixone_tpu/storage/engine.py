@@ -367,6 +367,7 @@ class Engine:
         self._commit_lock = threading.Lock()
         self._subscribers: List[Callable] = []   # logtail analogue
         self._ckpt_ts = 0
+        self.snapshots: Dict[str, int] = {}      # Git-for-data named points
 
     # ----------------------------------------------------------- catalog
     def create_table(self, meta: TableMeta, if_not_exists=False,
@@ -408,10 +409,56 @@ class Engine:
     def indexes_on(self, table: str) -> List[IndexMeta]:
         return [ix for ix in self.indexes.values() if ix.table == table]
 
+    # --------------------------------------------------- snapshots / PITR
+    def create_snapshot(self, name: str) -> int:
+        """Named point-in-time (reference: frontend CREATE SNAPSHOT +
+        TAE snapshot reads, docs arXiv 2604.03927)."""
+        ts = self.hlc.now()
+        self.snapshots[name] = ts
+        self.wal.append({"op": "create_snapshot", "name": name, "ts": ts})
+        return ts
+
+    def drop_snapshot(self, name: str) -> None:
+        self.snapshots.pop(name, None)
+        self.wal.append({"op": "drop_snapshot", "name": name,
+                         "ts": self.hlc.now()})
+
+    def restore_table(self, table: str, ts: int) -> int:
+        """RESTORE ... FROM SNAPSHOT: one commit replaces the current
+        visible rows with the rows visible at ts (reference:
+        frontend/data_branch + clone.go restore path)."""
+        t = self.get_table(table)
+        # materialize the historical view
+        parts_a, parts_v = [], []
+        cols = [c for c, _ in t.meta.schema]
+        for arrays, validity, _dicts, n in t.iter_chunks(
+                cols, 1 << 20, snapshot_ts=ts):
+            parts_a.append(arrays)
+            parts_v.append(validity)
+        # all currently-visible rows go away
+        current = []
+        for arrays, validity, _d, n in t.iter_chunks(
+                [ROWID], 1 << 20):
+            current.append(arrays[ROWID])
+        cur_gids = (np.concatenate(current) if current
+                    else np.zeros(0, np.int64))
+        if parts_a:
+            merged = {c: np.concatenate([p[c] for p in parts_a])
+                      for c in cols}
+            merged_v = {c: np.concatenate([p[c] for p in parts_v])
+                        for c in cols}
+            inserts = {table: [(merged, merged_v)]}
+        else:
+            inserts = {}
+        return self.commit_txn(None, inserts, {table: cur_gids})
+
     def subscribe(self, fn: Callable) -> None:
         """Register a logtail subscriber: fn(commit_ts, table, kind, payload)
         — kind in ('insert','delete'); feeds CDC/index maintenance."""
         self._subscribers.append(fn)
+
+    def unsubscribe(self, fn: Callable) -> None:
+        self._subscribers = [f for f in self._subscribers if f is not fn]
 
     # ------------------------------------------------------------ commit
     def commit_write(self, table: str, arrays, validity) -> int:
@@ -499,7 +546,8 @@ class Engine:
             self._checkpoint_locked()
 
     def _checkpoint_locked(self) -> None:
-        manifest = {"ckpt_ts": self.hlc.now(), "tables": {}}
+        manifest = {"ckpt_ts": self.hlc.now(), "tables": {},
+                    "snapshots": dict(self.snapshots)}
         for name, t in self.tables.items():
             objs = []
             for seg in t.segments:
@@ -535,6 +583,7 @@ class Engine:
         if fs.exists("meta/manifest.json"):
             manifest = json.loads(fs.read("meta/manifest.json").decode())
             eng._ckpt_ts = manifest.get("ckpt_ts", 0)
+            eng.snapshots = dict(manifest.get("snapshots", {}))
             eng.hlc.update(eng._ckpt_ts)
             for name, tm in manifest["tables"].items():
                 schema = [(c, DType(TypeOid(o), width=w, scale=s, dim=dm))
@@ -579,6 +628,10 @@ class Engine:
                                   if_not_exists=True)
             elif op == "drop_table":
                 self.drop_table(header["name"], if_exists=True, log=False)
+            elif op == "create_snapshot":
+                self.snapshots[header["name"]] = header["ts"]
+            elif op == "drop_snapshot":
+                self.snapshots.pop(header["name"], None)
             elif op == "insert":
                 pending.append(("insert", header, blob))
             elif op == "delete":

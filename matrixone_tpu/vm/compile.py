@@ -32,6 +32,9 @@ def compile_plan(node: P.PlanNode, ctx) -> ops.Operator:
         return ops.LimitOp(node, compile_plan(node.child, ctx))
     if isinstance(node, P.Distinct):
         return ops.DistinctOp(node, compile_plan(node.child, ctx))
+    if isinstance(node, P.Union):
+        return ops.UnionOp(node, [compile_plan(c, ctx)
+                                  for c in node.children])
     if isinstance(node, P.VectorTopK):
         from matrixone_tpu.vm.vector_scan import VectorTopKOp
         return VectorTopKOp(node, ctx)

@@ -45,7 +45,10 @@ class ExecBatch:
 
     @property
     def padded_len(self) -> int:
-        return self.batch.padded_len
+        # the mask always has the true padded length — batch.padded_len
+        # degenerates to 1 when every column is a const (literal-only
+        # projections)
+        return self.mask.shape[0]
 
 
 class EvalError(ValueError):
@@ -57,8 +60,15 @@ def _is_varchar(dtype: DType) -> bool:
 
 
 def _dict_of(e: BoundExpr, ex: ExecBatch) -> Optional[List[str]]:
+    """Dictionary of a varchar-valued expression (recursive: columns,
+    string-function results, CASE over string literals)."""
     if isinstance(e, BoundCol):
         return ex.dicts.get(e.name)
+    if isinstance(e, BoundCase) and e.dtype.is_varlen:
+        return case_string_dict(e)
+    if isinstance(e, BoundFunc) and e.dtype.is_varlen \
+            and e.op in _STRING_FUNCS:
+        return string_func_final_dict(e, ex)
     return None
 
 
@@ -72,8 +82,10 @@ def eval_expr(e: BoundExpr, ex: ExecBatch) -> DeviceColumn:
             data = jnp.asarray([e.value], dtype=e.dtype.jnp_dtype)
             return DeviceColumn(data, jnp.ones((1,), jnp.bool_), e.dtype)
         if _is_varchar(e.dtype):
-            raise EvalError("bare string literal column not supported; "
-                            "strings appear only inside predicates")
+            # const string column: code 0 into a single-entry dictionary
+            # (the projection attaches the dict via expr_output_dict)
+            col = DeviceColumn.const(0, dt.INT32)
+            return DeviceColumn(col.data, col.validity, e.dtype)
         return DeviceColumn.const(e.value, e.dtype)
     if isinstance(e, BoundCast):
         return S.cast(eval_expr(e.arg, ex), e.dtype)
@@ -118,6 +130,128 @@ def eval_expr(e: BoundExpr, ex: ExecBatch) -> DeviceColumn:
     if isinstance(e, BoundFunc):
         return _eval_func(e, ex)
     raise EvalError(f"unsupported expression {type(e).__name__}")
+
+
+_STRING_FUNCS = {"upper", "lower", "length", "reverse", "trim", "ltrim",
+                 "rtrim", "concat", "substring", "replace", "starts_with",
+                 "ends_with"}
+
+
+def _string_arg_info(e, ex, want_col: bool = True):
+    """-> (col DeviceColumn|None, dict, literals list) for a string
+    function call: at most one dict-coded column operand; an all-literal
+    call treats the first literal as the subject. want_col=False skips the
+    device evaluation (dictionary derivation only)."""
+    col = None
+    col_ast = None
+    d = None
+    lits = []
+    for a in e.args:
+        if isinstance(a, BoundLiteral):
+            lits.append(a.value)
+            continue
+        src = _dict_of(a, ex)
+        if src is None:
+            raise EvalError(
+                f"string function {e.op} needs a varchar column or literal "
+                f"arguments")
+        if col_ast is not None:
+            raise EvalError(
+                f"string function {e.op} over two columns not supported yet")
+        col_ast = a
+        d = src
+        lits.append(None)          # placeholder for the column position
+    if col_ast is None:
+        # all-literal call: first literal is the subject string
+        if not lits:
+            raise EvalError(f"string function {e.op} needs arguments")
+        d = [str(lits[0])]
+        lits[0] = None
+    elif want_col:
+        col = eval_expr(col_ast, ex)
+    return col, d, lits
+
+
+def _apply_string_func(op, s, lits):
+    """Python-level semantics per dictionary entry (MySQL behavior)."""
+    if op == "upper":
+        return s.upper()
+    if op == "lower":
+        return s.lower()
+    if op == "length":
+        return len(s.encode())
+    if op == "reverse":
+        return s[::-1]
+    if op == "trim":
+        return s.strip()
+    if op == "ltrim":
+        return s.lstrip()
+    if op == "rtrim":
+        return s.rstrip()
+    if op == "concat":
+        return "".join(s if x is None else str(x) for x in lits)
+    if op == "substring":
+        args = [x for x in lits if x is not None]
+        start = int(args[0])
+        start = start - 1 if start > 0 else len(s) + start
+        if len(args) > 1:
+            return s[start:start + int(args[1])]
+        return s[start:]
+    if op == "replace":
+        args = [x for x in lits if x is not None]
+        return s.replace(str(args[0]), str(args[1]))
+    if op == "starts_with":
+        args = [x for x in lits if x is not None]
+        return s.startswith(str(args[0]))
+    if op == "ends_with":
+        args = [x for x in lits if x is not None]
+        return s.endswith(str(args[0]))
+    raise EvalError(op)
+
+
+def string_func_output_dict(e: BoundFunc, ex: ExecBatch):
+    """Transformed dictionary for a varchar-result string function
+    (no device work: dictionaries + literals only)."""
+    _, d, lits = _string_arg_info(e, ex, want_col=False)
+    return [str(_apply_string_func(e.op, s, lits)) for s in d]
+
+
+def _eval_string_func(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
+    col, d, lits = _string_arg_info(e, ex)
+    if col is None:
+        # all-literal subject: a const code-0 column over the 1-entry dict
+        col = DeviceColumn(jnp.zeros((1,), jnp.int32),
+                           jnp.ones((1,), jnp.bool_), dt.VARCHAR)
+    if e.op in ("length",):
+        lut = np.asarray([_apply_string_func(e.op, s, lits) for s in d],
+                         dtype=np.int64)
+        out = jnp.asarray(lut)[jnp.clip(col.data, 0, len(d) - 1)]
+        return DeviceColumn(out, col.validity, dt.INT64)
+    if e.op in ("starts_with", "ends_with"):
+        lut = np.asarray([_apply_string_func(e.op, s, lits) for s in d],
+                         dtype=np.bool_)
+        out = jnp.asarray(lut)[jnp.clip(col.data, 0, len(d) - 1)]
+        return DeviceColumn(out, col.validity, dt.BOOL)
+    # varchar result: codes pass through (the dict is transformed); the
+    # transformed dict may contain duplicates — harmless for output, and
+    # group-by keys on it group by ORIGINAL code... so re-encode to the
+    # transformed value space to keep GROUP BY upper(x) correct:
+    out_dict = string_func_output_dict(e, ex)
+    uniq = {}
+    remap = np.empty(len(out_dict), np.int32)
+    for i, v in enumerate(out_dict):
+        remap[i] = uniq.setdefault(v, len(uniq))
+    codes = jnp.asarray(remap)[jnp.clip(col.data, 0, len(out_dict) - 1)]
+    return DeviceColumn(codes, col.validity, e.dtype)
+
+
+def string_func_final_dict(e: BoundFunc, ex: ExecBatch):
+    """Dict matching _eval_string_func's re-encoded code space."""
+    out_dict = string_func_output_dict(e, ex)
+    uniq = {}
+    for v in out_dict:
+        uniq.setdefault(v, len(uniq))
+    return list(uniq)
 
 
 _SIMPLE = {
@@ -188,6 +322,8 @@ def _eval_func(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
     if op in ("l2_distance", "l2_distance_sq", "cosine_distance",
               "inner_product", "cosine_similarity"):
         return _eval_distance(e, ex)
+    if op in _STRING_FUNCS:
+        return _eval_string_func(e, ex)
     if op in _SIMPLE:
         args = [eval_expr(a, ex) for a in e.args]
         return _SIMPLE[op](*args)

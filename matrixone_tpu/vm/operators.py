@@ -81,6 +81,9 @@ class ScanOp(Operator):
         qnames = [n for n, _ in self.node.schema]
         read_args = (self.ctx.table_read_args(self.node.table)
                      if self.ctx is not None else {})
+        if self.node.as_of_ts is not None:
+            # time travel: a historical read, independent of the txn view
+            read_args = {"snapshot_ts": self.node.as_of_ts}
         for chunk in self.rel.iter_chunks(self.node.columns, self.batch_rows,
                                           filters=self.node.filters,
                                           qualified_names=qnames,
@@ -149,13 +152,11 @@ class ProjectOp(Operator):
 
 
 def _expr_dict(e: BoundExpr, ex: ExecBatch):
-    from matrixone_tpu.sql.expr import BoundCase, BoundCol
-    if isinstance(e, BoundCol):
-        return ex.dicts.get(e.name)
-    if isinstance(e, BoundCase) and e.dtype.is_varlen:
-        from matrixone_tpu.vm.exprs import case_string_dict
-        return case_string_dict(e)
-    return None
+    from matrixone_tpu.sql.expr import BoundLiteral
+    from matrixone_tpu.vm.exprs import _dict_of
+    if isinstance(e, BoundLiteral) and e.dtype.is_varlen:
+        return [str(e.value)]
+    return _dict_of(e, ex)
 
 
 # -------------------------------------------------------------- aggregate
@@ -417,6 +418,56 @@ def _scalar_final(a: AggCall, state, dtype: DType) -> DeviceColumn:
                             dt.FLOAT64)
     v, c = state
     return DeviceColumn(v[None], (c > 0)[None], dtype)
+
+
+class UnionOp(Operator):
+    """UNION ALL: stream children, renaming to the union schema and
+    re-encoding string columns into a union-wide dictionary (children's
+    dictionaries are per-table and must not collide)."""
+
+    def __init__(self, node, children: List[Operator]):
+        self.node = node
+        self.children = children
+        self.schema = node.schema
+        self._union_dicts: Dict[str, List[str]] = {}
+        self._union_lut: Dict[str, Dict[str, int]] = {}
+
+    def _remap_strings(self, name: str, col: DeviceColumn, src_dict):
+        d = self._union_dicts.setdefault(name, [])
+        lut = self._union_lut.setdefault(name, {})
+        remap = np.empty(max(len(src_dict), 1), np.int32)
+        for i, s_ in enumerate(src_dict):
+            if s_ not in lut:
+                lut[s_] = len(d)
+                d.append(s_)
+            remap[i] = lut[s_]
+        data = jnp.asarray(remap)[jnp.clip(col.data, 0, len(remap) - 1)]
+        return DeviceColumn(data, col.validity, col.dtype)
+
+    def execute(self) -> Iterator[ExecBatch]:
+        names = [n for n, _ in self.schema]
+        for child in self.children:
+            child_names = [n for n, _ in child.schema]
+            for ex in child.execute():
+                cols = {}
+                for out_name, (cn, (on, out_t)) in zip(
+                        names, zip(child_names, self.schema)):
+                    col = ex.batch.columns[cn]
+                    if out_t.is_varlen:
+                        src = ex.dicts.get(cn, [])
+                        col = self._remap_strings(out_name, col, src)
+                        col = DeviceColumn(col.data, col.validity, out_t)
+                    elif col.dtype.jnp_dtype != out_t.jnp_dtype \
+                            and out_t.is_numeric:
+                        from matrixone_tpu.ops import scalar as S
+                        col = S.cast(col, out_t)
+                    cols[out_name] = col
+                db = DeviceBatch(columns=cols, n_rows=ex.batch.n_rows)
+                yield ExecBatch(
+                    batch=db,
+                    dicts={n: self._union_dicts[n]
+                           for n in self._union_dicts},
+                    mask=ex.mask)
 
 
 # ------------------------------------------------------------- sort / topk

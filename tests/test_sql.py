@@ -237,3 +237,29 @@ def test_distinct_order_by_hidden_col_rejected(sess):
     import pytest as _pt
     with _pt.raises(BindError, match="DISTINCT"):
         sess.execute("select distinct grp from t order by val")
+
+
+def test_string_functions(sess):
+    rows = sess.execute("""select id, upper(grp), length(grp),
+        concat(grp, '-x') from t where grp is not null order by id limit 2""").rows()
+    assert rows == [(1, "A", 1, "a-x"), (2, "A", 1, "a-x")]
+    rows = sess.execute(
+        "select grp from t where starts_with(grp, 'a') order by id").rows()
+    assert [r[0] for r in rows] == ["a", "a"]
+    rows = sess.execute(
+        "select upper(grp) u, count(*) c from t where grp is not null "
+        "group by u order by u").rows()
+    assert rows == [("A", 2), ("B", 2), ("C", 1)]
+
+
+def test_union(sess):
+    sess.execute("create table t3 (id bigint, grp varchar(10))")
+    sess.execute("insert into t3 values (1, 'a'), (99, 'zz')")
+    rows = sess.execute("""select id, grp from t where id <= 2
+        union all select id, grp from t3 order by id""").rows()
+    assert [r[0] for r in rows] == [1, 1, 2, 99]
+    rows = sess.execute("""select id, grp from t where id <= 2
+        union select id, grp from t3 order by id""").rows()
+    assert [r[0] for r in rows] == [1, 2, 99]       # distinct merges (1,'a')
+    # string dict unification across arms
+    assert ("zz" in [r[1] for r in rows])
