@@ -105,10 +105,14 @@ class Session:
         if isinstance(stmt, ast.Insert):
             return self._insert(stmt)
         if isinstance(stmt, ast.Explain):
+            from matrixone_tpu.sql.optimize import apply_indices
             binder = Binder(self.catalog)
-            if not isinstance(stmt.stmt, ast.Select):
+            if not isinstance(stmt.stmt, (ast.Select, ast.Union)):
                 raise BindError("EXPLAIN supports SELECT only for now")
-            node = binder.bind_select(stmt.stmt)
+            node = binder.bind_statement(stmt.stmt)
+            node = apply_indices(
+                node, self.catalog,
+                nprobe=int(self.variables.get("ivf_nprobe", 8)))
             return Result(text=P.explain(node))
         if isinstance(stmt, ast.ShowTables):
             names = sorted(self.catalog.tables)
@@ -229,17 +233,25 @@ class Session:
             coltype = dict(table.meta.schema)[col]
             if not coltype.is_vector:
                 raise BindError(f"ivfflat index requires a vecf32 column")
-            data, row_gids = table.read_column_f32(col)
-            nlist = int(stmt.options.get("lists", 64))
+            from matrixone_tpu import indexing
             op_type = stmt.options.get("op_type", "vector_l2_ops")
             metric = {"vector_l2_ops": "l2", "vector_cosine_ops": "cosine",
                       "vector_ip_ops": "ip"}.get(op_type, "l2")
-            idx = ivf_flat.build(jnp.asarray(data), nlist=nlist,
-                                 metric=metric)
             meta = IndexMeta(stmt.name, stmt.table, stmt.columns, "ivfflat",
-                             dict(stmt.options), index_obj=idx)
-            meta.options["_row_gids"] = row_gids
+                             dict(stmt.options), dirty=True)
             meta.options["_metric"] = metric
+            indexing.build_ivfflat(self.catalog, meta)
+            self.catalog.indexes[stmt.name] = meta
+            return Result()
+        if algo == "fulltext":
+            from matrixone_tpu import indexing
+            for col in stmt.columns:
+                if not dict(table.meta.schema)[col].is_varlen:
+                    raise BindError(
+                        f"fulltext index requires text columns ({col})")
+            meta = IndexMeta(stmt.name, stmt.table, stmt.columns,
+                             "fulltext", dict(stmt.options), dirty=True)
+            indexing.build_fulltext(self.catalog, meta)
             self.catalog.indexes[stmt.name] = meta
             return Result()
         raise BindError(f"unsupported index algo {stmt.using!r}")

@@ -1,0 +1,57 @@
+"""Index build/refresh shared by DDL and the scan operators.
+
+Reference analogue: the iscp IndexSync consumer + idxcron re-clustering
+(`pkg/iscp`, `pkg/vectorindex/idxcron`): the reference maintains indexes
+asynchronously off the logtail; here commits mark dependent indexes dirty
+(engine.commit_txn) and the next index-accelerated query rebuilds lazily —
+same freshness contract (eventually consistent), simpler machinery.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from matrixone_tpu.storage.engine import Engine, IndexMeta
+
+
+def build_ivfflat(engine: Engine, ix: IndexMeta) -> None:
+    from matrixone_tpu.vectorindex import ivf_flat
+    table = engine.get_table(ix.table)
+    data, gids = table.read_column_f32(ix.columns[0])
+    nlist = int(ix.options.get("lists", 64))
+    metric = ix.options.get("_metric", "l2")
+    nlist = max(1, min(nlist, max(1, len(data))))
+    ix.index_obj = ivf_flat.build(jnp.asarray(data), nlist=nlist,
+                                  metric=metric)
+    ix.options["_row_gids"] = gids
+    ix.dirty = False
+
+
+def build_fulltext(engine: Engine, ix: IndexMeta) -> None:
+    from matrixone_tpu import fulltext as FT
+    table = engine.get_table(ix.table)
+    texts = None
+    gids = None
+    for col in ix.columns:
+        col_texts, col_gids = table.read_texts(col)
+        if texts is None:
+            texts, gids = col_texts, col_gids
+        else:
+            # multi-column index: concatenated document text (reference:
+            # fulltext multi-column MATCH)
+            texts = [" ".join(t for t in (a, b) if t) or None
+                     for a, b in zip(texts, col_texts)]
+    ix.index_obj = FT.build(texts or [])
+    ix.options["_row_gids"] = gids if gids is not None \
+        else np.zeros(0, np.int64)
+    ix.dirty = False
+
+
+def refresh_if_dirty(engine: Engine, ix: IndexMeta) -> None:
+    if not ix.dirty:
+        return
+    if ix.algo == "ivfflat":
+        build_ivfflat(engine, ix)
+    elif ix.algo == "fulltext":
+        build_fulltext(engine, ix)

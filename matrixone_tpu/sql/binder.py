@@ -728,6 +728,7 @@ _SCALAR_FUNCS = {
     "replace": ("replace", lambda ts: dt.VARCHAR),
     "starts_with": ("starts_with", lambda ts: dt.BOOL),
     "ends_with": ("ends_with", lambda ts: dt.BOOL),
+    "match_against": ("match_against", lambda ts: dt.FLOAT64),
     "l2_distance": ("l2_distance", lambda ts: dt.FLOAT64),
     "l2_distance_sq": ("l2_distance_sq", lambda ts: dt.FLOAT64),
     "cosine_distance": ("cosine_distance", lambda ts: dt.FLOAT64),

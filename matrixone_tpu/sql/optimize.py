@@ -34,6 +34,9 @@ def apply_indices(node: P.PlanNode, catalog, nprobe: int = 8,
                                               skip_tables))
     if not isinstance(node, P.TopK):
         return node
+    ft = _try_fulltext(node, catalog, skip_tables)
+    if ft is not None:
+        return ft
     if len(node.keys) != 1 or node.descendings[0]:
         return node
     key = node.keys[0]
@@ -75,3 +78,49 @@ def apply_indices(node: P.PlanNode, catalog, nprobe: int = 8,
                 columns=scan.columns, schema=scan.schema, nprobe=nprobe)
             return node
     return node
+
+
+def _try_fulltext(node: P.TopK, catalog, skip_tables) -> "P.PlanNode | None":
+    """TopK(desc, key = match_against(col, 'q')) over Project over Scan ->
+    FulltextTopK replacing the whole subtree."""
+    if len(node.keys) != 1 or not node.descendings[0]:
+        return None
+    key = node.keys[0]
+    proj = node.child
+    if not (isinstance(key, BoundCol) and isinstance(proj, P.Project)):
+        return None
+    try:
+        kidx = [n for n, _ in proj.schema].index(key.name)
+    except ValueError:
+        return None
+    mexpr = proj.exprs[kidx]
+    if not (isinstance(mexpr, BoundFunc) and mexpr.op == "match_against"
+            and len(mexpr.args) >= 2):
+        return None
+    col_exprs, q_e = mexpr.args[:-1], mexpr.args[-1]
+    if not (all(isinstance(c, BoundCol) for c in col_exprs)
+            and isinstance(q_e, BoundLiteral)
+            and isinstance(q_e.value, str)):
+        return None
+    scan = proj.child
+    if not (isinstance(scan, P.Scan) and not scan.filters
+            and scan.table not in skip_tables):
+        return None
+    raw_cols_wanted = [c.name.split(".")[-1] for c in col_exprs]
+    for ix in catalog.indexes_on(scan.table):
+        if ix.algo != "fulltext" or ix.columns != raw_cols_wanted:
+            continue
+        # every projected output must be a plain column or the match expr
+        out_exprs = []
+        for e in proj.exprs:
+            if e == mexpr:
+                out_exprs.append(("score",))
+            elif isinstance(e, BoundCol):
+                out_exprs.append(("col", e.name.split(".")[-1]))
+            else:
+                return None
+        return P.FulltextTopK(
+            table=scan.table, index_name=ix.name, query=q_e.value,
+            k=node.k, offset=node.offset, columns=scan.columns,
+            out_exprs=out_exprs, schema=proj.schema)
+    return None

@@ -595,6 +595,21 @@ class Parser:
 
     def func_or_column(self) -> ast.Node:
         name = self.ident()
+        if name.lower() == "match" and self.at_op("("):
+            # MySQL fulltext: MATCH (col [, col...]) AGAINST ('query')
+            self.expect_op("(")
+            cols = [self.expr()]
+            while self.accept_op(","):
+                cols.append(self.expr())
+            self.expect_op(")")
+            nxt = self.peek()
+            if nxt.kind == "ident" and nxt.value.lower() == "against":
+                self.next()
+                self.expect_op("(")
+                q = self.expr()
+                self.expect_op(")")
+                return ast.FuncCall("match_against", cols + [q])
+            return ast.FuncCall("match", cols)
         if self.accept_op("("):
             if self.accept_op("*"):
                 self.expect_op(")")

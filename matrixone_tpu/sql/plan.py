@@ -124,6 +124,22 @@ class VectorTopK(PlanNode):
     nprobe: int = 8
 
 
+@dataclasses.dataclass
+class FulltextTopK(PlanNode):
+    """Index-accelerated `ORDER BY match(col) against('q') DESC LIMIT k` —
+    replaces the whole Project+TopK subtree (the score is produced by the
+    index search, not re-evaluated per row). Reference:
+    plan/apply_indices_fulltext.go + table_function/fulltext."""
+    table: str
+    index_name: str
+    query: str
+    k: int
+    offset: int
+    columns: List[str]                  # table columns needed
+    out_exprs: List[object]             # per output: ('col', raw) | ('score',)
+    schema: Schema
+
+
 def explain(node: PlanNode, indent: int = 0) -> str:
     pad = "  " * indent
     name = type(node).__name__
@@ -140,6 +156,8 @@ def explain(node: PlanNode, indent: int = 0) -> str:
         extra = f" kind={node.kind}"
     elif isinstance(node, VectorTopK):
         extra = f" index={node.index_name} k={node.k} metric={node.metric}"
+    elif isinstance(node, FulltextTopK):
+        extra = f" index={node.index_name} k={node.k} query={node.query!r}"
     lines = [f"{pad}{name}{extra}  -> {[n for n, _ in node.schema]}"]
     for attr in ("child", "left", "right"):
         c = getattr(node, attr, None)

@@ -58,6 +58,7 @@ class IndexMeta:
     algo: str
     options: dict
     index_obj: object = None
+    dirty: bool = False        # table changed since build -> lazy rebuild
 
 
 @dataclasses.dataclass
@@ -259,6 +260,24 @@ class MVCCTable:
                 arrays[c] = np.zeros(shape, np_t)
                 validity[c] = np.zeros(0, np.bool_)
         return arrays, validity
+
+    def read_texts(self, col: str):
+        """Decoded visible strings (+ gids) for a varchar column
+        (fulltext index build)."""
+        dead = self._dead_gids(None, None)
+        texts, gids = [], []
+        d = self.dicts[col]
+        for seg in self.segments:
+            g = np.arange(seg.base_gid, seg.base_gid + seg.n_rows,
+                          dtype=np.int64)
+            keep = ~np.isin(g, dead) if len(dead) else np.ones(
+                seg.n_rows, np.bool_)
+            codes = seg.arrays[col]
+            val = seg.validity[col]
+            for i in np.nonzero(keep)[0]:
+                texts.append(d[int(codes[i])] if val[i] else None)
+                gids.append(int(g[i]))
+        return texts, np.asarray(gids, np.int64)
 
     def read_column_f32(self, col: str):
         """Dense f32 matrix of VISIBLE rows (tombstones excluded) plus the
@@ -533,6 +552,9 @@ class Engine:
                 affected += len(gids)
                 for fn in self._subscribers:
                     fn(commit_ts, tname, "delete", gids)
+            for tname in set(list(inserts) + list(deletes)):
+                for ix in self.indexes_on(tname):
+                    ix.dirty = True
             M.txn_commits.inc(outcome="ok")
             return affected
 

@@ -35,6 +35,9 @@ def compile_plan(node: P.PlanNode, ctx) -> ops.Operator:
     if isinstance(node, P.Union):
         return ops.UnionOp(node, [compile_plan(c, ctx)
                                   for c in node.children])
+    if isinstance(node, P.FulltextTopK):
+        from matrixone_tpu.vm.fulltext_scan import FulltextTopKOp
+        return FulltextTopKOp(node, ctx)
     if isinstance(node, P.VectorTopK):
         from matrixone_tpu.vm.vector_scan import VectorTopKOp
         return VectorTopKOp(node, ctx)

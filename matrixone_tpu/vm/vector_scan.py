@@ -30,8 +30,10 @@ class VectorTopKOp(Operator):
 
     def execute(self) -> Iterator[ExecBatch]:
         from matrixone_tpu.vectorindex import ivf_flat
+        from matrixone_tpu import indexing
         catalog = self.ctx.catalog
         ix = catalog.indexes[self.node.index_name]
+        indexing.refresh_if_dirty(catalog, ix)
         index = ix.index_obj
         row_gids = np.asarray(ix.options["_row_gids"])
         table = catalog.get_table(self.node.table)

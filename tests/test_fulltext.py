@@ -1,0 +1,132 @@
+"""Fulltext BM25 (reference analogue: pkg/fulltext tests + fulltext BVT)."""
+
+import numpy as np
+import pytest
+
+from matrixone_tpu import fulltext as FT
+from matrixone_tpu.frontend import Session
+
+
+def test_tokenizer():
+    assert FT.tokenize("Hello, World_2!") == ["hello", "world_2"]
+    assert FT.tokenize("") == []
+    toks = FT.tokenize("数据库系统")
+    assert "数据" in toks and "据库" in toks   # CJK bigrams
+
+
+def test_bm25_ranking_vs_reference_formula():
+    texts = ["apple banana apple", "banana cherry", "apple", "dog"]
+    ix = FT.build(texts)
+    scores = FT.score_all(ix, "apple")
+    # manual BM25 (same formula)
+    n, k1, b = 4, 1.2, 0.75
+    df = 2
+    idf = np.log(1 + (n - df + 0.5) / (df + 0.5))
+    lens = np.array([3, 2, 1, 1], float)
+    avgdl = lens.mean()
+    for i, tf in enumerate([2, 0, 1, 0]):
+        expect = idf * tf * (k1 + 1) / (tf + k1 * (1 - b + b * lens[i] / avgdl)) \
+            if tf else 0.0
+        assert abs(scores[i] - expect) < 1e-5
+    # doc 0 (tf=2) must outrank doc 2 (tf=1, but shorter): check top-1
+    s, i = FT.search(ix, "apple", k=2)
+    assert set(i.tolist()) == {0, 2}
+
+
+def test_multi_term_and_missing_terms():
+    ix = FT.build(["red green", "green blue", "blue red"])
+    s, i = FT.search(ix, "red zebra", k=3)   # zebra not in vocab
+    assert (s > 0).sum() == 2
+    s2, _ = FT.search(ix, "zebra", k=3)
+    assert (s2 > 0).sum() == 0
+
+
+def test_fulltext_sql_end_to_end():
+    s = Session()
+    s.execute("create table docs (id bigint, body text)")
+    s.execute("""insert into docs values
+      (1, 'the quick brown fox'), (2, 'engine tour'),
+      (3, 'lazy dog sleeps'), (4, 'quick fox and dog'), (5, null)""")
+    s.execute("create index ft using fulltext on docs (body)")
+    rows = s.execute("""select id, match(body) against ('quick fox') sc
+                        from docs order by sc desc limit 2""").rows()
+    assert {r[0] for r in rows} == {1, 4}
+    assert rows[0][1] >= rows[1][1] > 0
+    # deleted docs disappear from results
+    s.execute(f"delete from docs where id = {rows[0][0]}")
+    rows2 = s.execute("""select id from docs
+                         order by match(body) against ('quick fox') desc
+                         limit 2""").rows()
+    assert rows[0][0] not in {r[0] for r in rows2}
+
+
+def test_fulltext_index_required_error():
+    s = Session()
+    s.execute("create table d2 (id bigint, body text)")
+    s.execute("insert into d2 values (1, 'x')")
+    with pytest.raises(Exception):
+        # no fulltext index and no rewrite -> eval has no kernel for it
+        s.execute("select match(body) against ('x') from d2")
+
+
+def test_fulltext_offset_and_zero_score_fill():
+    s = Session()
+    s.execute("create table d3 (id bigint, body text)")
+    s.execute("""insert into d3 values (1, 'alpha beta'), (2, 'alpha'),
+                 (3, 'gamma')""")
+    s.execute("create index f3 using fulltext on d3 (body)")
+    all_rows = s.execute("""select id, match(body) against ('alpha') sc
+                            from d3 order by sc desc limit 3""").rows()
+    # MySQL semantics: non-matching row included with score 0
+    assert len(all_rows) == 3 and all_rows[-1][1] == 0.0
+    off = s.execute("""select id from d3
+                       order by match(body) against ('alpha') desc
+                       limit 1 offset 1""").rows()
+    assert off == [(all_rows[1][0],)]
+
+
+def test_fulltext_lazy_refresh_after_insert():
+    s = Session()
+    s.execute("create table d4 (id bigint, body text)")
+    s.execute("insert into d4 values (1, 'old news')")
+    s.execute("create index f4 using fulltext on d4 (body)")
+    s.execute("insert into d4 values (2, 'fresh fresh fresh news')")
+    rows = s.execute("""select id from d4
+                        order by match(body) against ('fresh') desc
+                        limit 1""").rows()
+    assert rows == [(2,)]      # index refreshed lazily after the insert
+
+
+def test_fulltext_multi_column():
+    s = Session()
+    s.execute("create table d5 (id bigint, title varchar(20), body text)")
+    s.execute("insert into d5 values (1, 'cats', 'about dogs'), (2, 'dogs', 'about cats')")
+    s.execute("create index f5 using fulltext on d5 (title, body)")
+    rows = s.execute("""select id, match(title, body) against ('cats') sc
+                        from d5 order by sc desc limit 2""").rows()
+    assert len(rows) == 2 and all(r[1] > 0 for r in rows)
+
+
+def test_fulltext_aliased_varchar_output():
+    s = Session()
+    s.execute("create table d6 (id bigint, body text)")
+    s.execute("insert into d6 values (1, 'hello world')")
+    s.execute("create index f6 using fulltext on d6 (body)")
+    rows = s.execute("""select body b, match(body) against ('hello') sc
+                        from d6 order by sc desc limit 1""").rows()
+    assert rows[0][0] == "hello world"
+
+
+def test_fulltext_empty_table_index():
+    s = Session()
+    s.execute("create table d7 (id bigint, body text)")
+    s.execute("create index f7 using fulltext on d7 (body)")
+    rows = s.execute("""select id from d7
+                        order by match(body) against ('x') desc limit 2""").rows()
+    assert rows == []
+
+
+def test_cjk_bigrams_not_across_runs():
+    from matrixone_tpu import fulltext as FT
+    assert "中国" not in FT.tokenize("中A国")
+    assert "中国" in FT.tokenize("中国")
