@@ -45,7 +45,7 @@ class Result:
 
     @property
     def column_names(self) -> List[str]:
-        return list(self.batch.columns) if self.batch else []
+        return list(self.batch.columns) if self.batch is not None else []
 
 
 class Session:
@@ -60,7 +60,17 @@ class Session:
 
     def _ctx(self) -> ExecContext:
         return ExecContext(catalog=self.catalog, txn=self.txn,
-                           variables=self.variables)
+                           variables=self.variables,
+                           frozen_ts=(None if self.txn is not None
+                                      else self.catalog.committed_ts))
+
+    def _index_skip_tables(self) -> frozenset:
+        """Index rewrites serve only frontier (autocommit) reads: an open
+        txn reads an older snapshot + workspace that a frontier-built
+        index cannot realize."""
+        if self.txn is not None:
+            return frozenset(self.catalog.tables)
+        return frozenset()
 
     # ------------------------------------------------------------ execute
     def execute(self, sql: str, params: Optional[list] = None) -> Result:
@@ -109,10 +119,14 @@ class Session:
             binder = Binder(self.catalog)
             if not isinstance(stmt.stmt, (ast.Select, ast.Union)):
                 raise BindError("EXPLAIN supports SELECT only for now")
+            self._prepare_select(stmt.stmt)
             node = binder.bind_statement(stmt.stmt)
             node = apply_indices(
                 node, self.catalog,
-                nprobe=int(self.variables.get("ivf_nprobe", 8)))
+                nprobe=int(self.variables.get("ivf_nprobe", 8)),
+                skip_tables=self._index_skip_tables())
+            if stmt.analyze:
+                return Result(text=self._explain_analyze(node))
             return Result(text=P.explain(node))
         if isinstance(stmt, ast.ShowTables):
             names = sorted(self.catalog.tables)
@@ -181,14 +195,142 @@ class Session:
             return Result()
         raise BindError(f"unsupported statement {type(stmt).__name__}")
 
+    def _explain_analyze(self, node) -> str:
+        """Run the plan, recording per-operator batches/rows/time
+        (reference: EXPLAIN ANALYZE via process.Analyzer/OpAnalyzer,
+        vm/types.go:256 + compile/analyze_module.go)."""
+        import time as _time
+        import jax as _jax
+        import jax.numpy as _jnp
+        op = compile_plan(node, self._ctx())
+        stats = {}
+
+        def wrap(o):
+            orig = o.execute
+            st = stats.setdefault(id(o), {"op": type(o).__name__,
+                                          "batches": 0, "rows": 0,
+                                          "seconds": 0.0})
+
+            def timed():
+                it = orig()
+                while True:
+                    t0 = _time.perf_counter()
+                    try:
+                        ex = next(it)
+                    except StopIteration:
+                        st["seconds"] += _time.perf_counter() - t0
+                        return
+                    st["seconds"] += _time.perf_counter() - t0
+                    st["batches"] += 1
+                    st["rows"] += int(_jax.device_get(
+                        _jnp.sum(ex.mask.astype(_jnp.int32))))
+                    yield ex
+            o.execute = timed
+            for attr in ("child", "left", "right"):
+                c = getattr(o, attr, None)
+                if c is not None:
+                    wrap(c)
+            for c in getattr(o, "children", []) or []:
+                wrap(c)
+        wrap(op)
+        for _ in op.execute():
+            pass
+
+        def render(o, indent=0):
+            st = stats[id(o)]
+            line = ("  " * indent + f"{st['op']}  rows={st['rows']} "
+                    f"batches={st['batches']} time={st['seconds']*1000:.1f}ms")
+            out = [line]
+            for attr in ("child", "left", "right"):
+                c = getattr(o, attr, None)
+                if c is not None:
+                    out.extend(render(c, indent + 1))
+            for c in getattr(o, "children", []) or []:
+                out.extend(render(c, indent + 1))
+            return out
+        return "\n".join(render(op))
+
+    # ---------------------------------------------------- subquery inlining
+    def _inline_subqueries(self, node, depth=0):
+        """Execute uncorrelated subqueries once and inline the results
+        (reference: the planner turns these into joins; execute-once has
+        identical semantics for the uncorrelated case). Correlated
+        subqueries surface as 'unknown column' from the inner bind."""
+        import dataclasses as dc
+        if depth > 8:
+            raise BindError("subquery nesting too deep")
+        if isinstance(node, ast.Subquery):
+            r = self._select(node.select)
+            rows = r.rows()
+            if len(r.column_names) != 1:
+                raise BindError("scalar subquery must return one column")
+            if len(rows) > 1:
+                raise BindError("scalar subquery returned more than one row")
+            v = rows[0][0] if rows else None
+            return _param_literal(v)
+        if isinstance(node, ast.Exists):
+            inner_limit = (1 if node.select.limit is None
+                           else min(1, node.select.limit))
+            sub = dc.replace(node.select, limit=inner_limit)
+            r = self._select(sub)
+            has = len(r.rows()) > 0
+            return ast.Literal(has != node.negated, "bool")
+        if isinstance(node, ast.InList) and len(node.items) == 1 \
+                and isinstance(node.items[0], ast.Subquery):
+            r = self._select(node.items[0].select)
+            if len(r.column_names) != 1:
+                raise BindError("IN subquery must return one column")
+            vals = [row[0] for row in r.rows()]
+            if node.negated and any(v is None for v in vals):
+                # NOT IN with NULLs is never TRUE (SQL ternary logic)
+                return ast.Literal(False, "bool")
+            vals = [v for v in vals if v is not None]
+            if not vals:
+                return ast.Literal(bool(node.negated), "bool")
+            return ast.InList(node.expr,
+                              [_param_literal(v) for v in vals],
+                              node.negated)
+        if dc.is_dataclass(node) and isinstance(node, ast.Node) \
+                and not isinstance(node, (ast.SubqueryRef,)):
+            for f in dc.fields(node):
+                v = getattr(node, f.name)
+                if isinstance(v, ast.Node):
+                    setattr(node, f.name,
+                            self._inline_subqueries(v, depth + 1))
+                elif isinstance(v, list):
+                    setattr(node, f.name, [
+                        self._inline_subqueries(x, depth + 1)
+                        if isinstance(x, ast.Node) else
+                        tuple(self._inline_subqueries(y, depth + 1)
+                              if isinstance(y, ast.Node) else y
+                              for y in x) if isinstance(x, tuple) else x
+                        for x in v])
+        return node
+
+    def _prepare_select(self, sel) -> None:
+        """Inline uncorrelated subqueries in WHERE/HAVING/select items
+        (not derived tables — those bind as plans)."""
+        if isinstance(sel, ast.Union):
+            for arm in sel.selects:
+                self._prepare_select(arm)
+            return
+        if not isinstance(sel, ast.Select):
+            return
+        for it in sel.items:
+            it.expr = self._inline_subqueries(it.expr)
+        if sel.where is not None:
+            sel.where = self._inline_subqueries(sel.where)
+        if sel.having is not None:
+            sel.having = self._inline_subqueries(sel.having)
+
     # ------------------------------------------------------------- select
     def _select(self, sel: ast.Select) -> Result:
         from matrixone_tpu.sql.optimize import apply_indices
+        self._prepare_select(sel)
         node = Binder(self.catalog).bind_statement(sel)
-        skip = frozenset(self.txn.workspace.keys()) if self.txn else frozenset()
         node = apply_indices(node, self.catalog,
                              nprobe=int(self.variables.get("ivf_nprobe", 8)),
-                             skip_tables=skip)
+                             skip_tables=self._index_skip_tables())
         op = compile_plan(node, self._ctx())
         out_batches = []
         for ex in op.execute():
@@ -219,8 +361,12 @@ class Session:
     def _create_table(self, stmt: ast.CreateTable) -> Result:
         schema = [(c.name, type_from_name(c.type_name, c.type_args))
                   for c in stmt.columns]
+        auto = [c.name for c in stmt.columns if c.auto_increment]
+        if len(auto) > 1:
+            raise BindError("only one AUTO_INCREMENT column allowed")
         self.catalog.create_table(
-            TableMeta(stmt.name, schema, stmt.primary_key),
+            TableMeta(stmt.name, schema, stmt.primary_key,
+                      auto_increment=auto[0] if auto else None),
             if_not_exists=stmt.if_not_exists)
         return Result()
 
@@ -352,8 +498,18 @@ class Session:
                     data[c].append(_literal_value(v))
         full = {}
         n = len(next(iter(data.values()))) if data else 0
+        auto_col = table.meta.auto_increment
         for c, d in schema:
             vals = data.get(c, [None] * n)
+            if c == auto_col:
+                # row order matters: an explicit value advances the counter
+                # for subsequent NULLs in the same statement (MySQL behavior)
+                vals = list(vals)
+                for i, v in enumerate(vals):
+                    if v is None:
+                        vals[i] = int(table.allocate_auto(1)[0])
+                    else:
+                        table.observe_auto(np.asarray([v], np.int64))
             if d.oid == TypeOid.DATE:
                 vals = [(datetime.date.fromisoformat(v)
                          - datetime.date(1970, 1, 1)).days

@@ -51,7 +51,12 @@ def build_fulltext(engine: Engine, ix: IndexMeta) -> None:
 def refresh_if_dirty(engine: Engine, ix: IndexMeta) -> None:
     if not ix.dirty:
         return
-    if ix.algo == "ivfflat":
-        build_ivfflat(engine, ix)
-    elif ix.algo == "fulltext":
-        build_fulltext(engine, ix)
+    # under the commit lock: a concurrent commit must not set dirty=True
+    # between our table read and the trailing dirty=False (lost update)
+    with engine._commit_lock:
+        if not ix.dirty:
+            return
+        if ix.algo == "ivfflat":
+            build_ivfflat(engine, ix)
+        elif ix.algo == "fulltext":
+            build_fulltext(engine, ix)

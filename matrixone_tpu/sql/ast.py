@@ -183,6 +183,7 @@ class ColumnDef(Node):
     not_null: bool = False
     primary_key: bool = False
     default: Optional[Node] = None
+    auto_increment: bool = False
 
 
 @dataclasses.dataclass

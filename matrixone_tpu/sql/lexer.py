@@ -16,6 +16,7 @@ KEYWORDS = {
     "key", "if", "exists", "using", "begin", "commit", "rollback", "with",
     "union", "all", "default", "lists", "op_type", "count", "sum",
     "snapshot", "snapshots", "restore", "of", "timestamp", "avg",
+    "auto_increment",
     "min", "max",
 }
 

@@ -59,11 +59,12 @@ def apply_indices(node: P.PlanNode, catalog, nprobe: int = 8,
             and isinstance(vec_e.value, list)):
         return node
     scan = proj.child
-    if not (isinstance(scan, P.Scan) and not scan.filters):
+    if not (isinstance(scan, P.Scan) and not scan.filters
+            and scan.as_of_ts is None):
         return node
     if scan.table in skip_tables:
-        # txn has a workspace on this table: exact scan merges it, the
-        # index cannot — decline the rewrite
+        # txn snapshot / workspace reads: exact scan realizes the txn
+        # view, the (frontier-built) index cannot — decline the rewrite
         return node
     # find a matching index on (table, column)
     raw_col = col_e.name.split(".")[-1]
@@ -104,6 +105,7 @@ def _try_fulltext(node: P.TopK, catalog, skip_tables) -> "P.PlanNode | None":
         return None
     scan = proj.child
     if not (isinstance(scan, P.Scan) and not scan.filters
+            and scan.as_of_ts is None
             and scan.table not in skip_tables):
         return None
     raw_cols_wanted = [c.name.split(".")[-1] for c in col_exprs]

@@ -345,6 +345,7 @@ class Parser:
         not_null = False
         primary = False
         default = None
+        auto_inc = False
         while True:
             if self.accept_kw("not"):
                 self.expect_kw("null")
@@ -356,10 +357,12 @@ class Parser:
                 primary = True
             elif self.accept_kw("default"):
                 default = self.expr()
+            elif self.accept_kw("auto_increment"):
+                auto_inc = True
             else:
                 break
         return ast.ColumnDef(name, type_name.lower(), args, not_null, primary,
-                             default)
+                             default, auto_inc)
 
     def drop(self) -> ast.Node:
         self.expect_kw("drop")

@@ -48,6 +48,7 @@ class TableMeta:
     name: str
     schema: Schema
     primary_key: List[str]
+    auto_increment: Optional[str] = None   # column name (incrservice)
 
 
 @dataclasses.dataclass
@@ -88,6 +89,22 @@ class MVCCTable:
         self.dicts: Dict[str, List[str]] = {
             c: [] for c, d in meta.schema if d.is_varlen}
         self._dict_idx: Dict[str, Dict[str, int]] = {c: {} for c in self.dicts}
+        self.next_auto = 1
+
+    def allocate_auto(self, n: int) -> np.ndarray:
+        """Allocate n auto_increment values (reference: pkg/incrservice
+        cached range allocator — single-process form). Serialized by the
+        engine's commit lock so concurrent inserts never collide."""
+        with self.engine._commit_lock:
+            base = self.next_auto
+            self.next_auto += n
+        return np.arange(base, base + n, dtype=np.int64)
+
+    def observe_auto(self, values: np.ndarray) -> None:
+        if len(values):
+            with self.engine._commit_lock:
+                self.next_auto = max(self.next_auto,
+                                     int(values.max()) + 1)
 
     @property
     def schema(self) -> Schema:
@@ -358,7 +375,10 @@ def _zonemap_excludes(filters, arrays, validity, qmap, schema) -> bool:
             if lit.dtype.oid == TypeOid.DECIMAL64 or lit.dtype.is_integer:
                 lv = lv * 10 ** (col.dtype.scale - lit_scale)
             else:
-                continue
+                continue   # float vs decimal column: kernel decides
+        elif lit.dtype.oid == TypeOid.DECIMAL64:
+            # decimal literal vs non-decimal column: compare in real units
+            lv = lv / 10 ** lit.dtype.scale
         if not isinstance(lv, (int, float)):
             continue
         if op == "lt" and not (lo < lv):
@@ -387,6 +407,9 @@ class Engine:
         self._subscribers: List[Callable] = []   # logtail analogue
         self._ckpt_ts = 0
         self.snapshots: Dict[str, int] = {}      # Git-for-data named points
+        #: last FULLY applied commit: readers snapshot here so a commit
+        #: mid-apply (segments in, tombstones not yet) can never tear a read
+        self.committed_ts = self.hlc.now()
 
     # ----------------------------------------------------------- catalog
     def create_table(self, meta: TableMeta, if_not_exists=False,
@@ -555,6 +578,7 @@ class Engine:
             for tname in set(list(inserts) + list(deletes)):
                 for ix in self.indexes_on(tname):
                     ix.dirty = True
+            self.committed_ts = commit_ts
             M.txn_commits.inc(outcome="ok")
             return affected
 
@@ -629,6 +653,7 @@ class Engine:
                 t.next_gid = tm["next_gid"]
                 t.next_seg = tm["next_seg"]
         eng._replay_wal()
+        eng.committed_ts = eng.hlc.now()
         return eng
 
     def _replay_wal(self) -> None:

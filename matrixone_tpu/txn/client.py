@@ -106,4 +106,6 @@ class TxnClient:
         self.engine = engine
 
     def begin(self) -> TxnHandle:
-        return TxnHandle(self.engine, self.engine.hlc.now())
+        # snapshot at the last fully-applied commit, not the raw clock: a
+        # commit mid-apply must be entirely invisible (no torn reads)
+        return TxnHandle(self.engine, self.engine.committed_ts)

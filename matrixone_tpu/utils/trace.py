@@ -85,5 +85,8 @@ class StatementRecorder:
         # fileservice, not the txn path) — but segment allocation must still
         # respect the single-writer invariant
         with self.engine._commit_lock:
-            seg = t.make_segment(arrays, validity, self.engine.hlc.now())
+            ts = self.engine.hlc.now()
+            seg = t.make_segment(arrays, validity, ts)
             t.apply_segment(seg)
+            # advance the read frontier so snapshot reads see trace rows
+            self.engine.committed_ts = max(self.engine.committed_ts, ts)

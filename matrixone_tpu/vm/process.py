@@ -13,15 +13,25 @@ class ExecContext:
     catalog: object                     # storage.engine.Engine
     txn: Optional[object] = None        # txn.client.TxnHandle
     variables: Optional[dict] = None
+    #: committed_ts captured ONCE at statement start: every table in the
+    #: statement reads the same frontier (no cross-table tearing)
+    frozen_ts: Optional[int] = None
+
+    def __post_init__(self):
+        if self.txn is None and self.frozen_ts is None:
+            self.frozen_ts = getattr(self.catalog, "committed_ts", None)
 
     @property
     def snapshot_ts(self) -> Optional[int]:
-        return self.txn.snapshot_ts if self.txn is not None else None
+        if self.txn is not None:
+            return self.txn.snapshot_ts
+        return self.frozen_ts
 
     def table_read_args(self, table: str) -> dict:
         """kwargs for MVCCTable.iter_chunks realizing this context's view."""
         if self.txn is None:
-            return {}
+            return ({"snapshot_ts": self.frozen_ts}
+                    if self.frozen_ts is not None else {})
         w = self.txn.workspace.get(table)
         return {
             "snapshot_ts": self.txn.snapshot_ts,

@@ -1,0 +1,134 @@
+"""Subqueries, EXPLAIN ANALYZE, AUTO_INCREMENT, HTAP concurrency."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from matrixone_tpu.frontend import Session
+
+
+@pytest.fixture()
+def subq():
+    s = Session()
+    s.execute("create table a (id bigint, g varchar(3))")
+    s.execute("create table b (id bigint)")
+    s.execute("insert into a values (1,'x'), (2,'y'), (3,'x'), (4, null)")
+    s.execute("insert into b values (1), (3), (99)")
+    return s
+
+
+def test_in_subquery(subq):
+    assert subq.execute(
+        "select id from a where id in (select id from b) order by id"
+    ).rows() == [(1,), (3,)]
+    assert subq.execute(
+        "select id from a where id not in (select id from b) order by id"
+    ).rows() == [(2,), (4,)]
+
+
+def test_not_in_subquery_with_null(subq):
+    subq.execute("insert into b values (null)")
+    assert subq.execute(
+        "select id from a where id not in (select id from b)").rows() == []
+    # positive IN ignores the NULL
+    assert subq.execute(
+        "select id from a where id in (select id from b) order by id"
+    ).rows() == [(1,), (3,)]
+
+
+def test_scalar_and_exists_subqueries(subq):
+    assert subq.execute(
+        "select (select max(id) from b) from a limit 1").rows() == [(99,)]
+    assert len(subq.execute(
+        "select id from a where exists (select id from b where id > 50)"
+    ).rows()) == 4
+    assert subq.execute(
+        "select id from a where not exists (select id from b where id > 50)"
+    ).rows() == []
+    with pytest.raises(Exception, match="more than one row"):
+        subq.execute("select id from a where id = (select id from b)")
+
+
+def test_explain_analyze(subq):
+    txt = subq.execute(
+        "explain analyze select g, count(*) from a group by g").text
+    assert "AggOp" in txt and "rows=" in txt and "time=" in txt
+
+
+def test_auto_increment():
+    s = Session()
+    s.execute("create table t (id bigint auto_increment primary key, v varchar(5))")
+    s.execute("insert into t (v) values ('a'), ('b')")
+    s.execute("insert into t values (10, 'x'), (null, 'y')")
+    rows = s.execute("select id, v from t order by id").rows()
+    assert rows == [(1, "a"), (2, "b"), (10, "x"), (11, "y")]
+
+
+def test_htap_concurrent_oltp_and_snapshot_reads():
+    """BASELINE config #5 shape: concurrent writers + snapshot readers
+    (reference: pessimistic_transaction BVT + HTAP mixed runs)."""
+    s = Session()
+    s.execute("create table acct (id bigint, bal bigint)")
+    s.execute("insert into acct values " +
+              ",".join(f"({i}, 100)" for i in range(20)))
+    errors = []
+
+    def writer(k):
+        try:
+            w = Session(catalog=s.catalog)
+            for i in range(10):
+                # transfers preserve the invariant sum(bal) == 2000
+                src, dst = (k * 7 + i) % 20, (k * 11 + i + 1) % 20
+                if src == dst:
+                    continue
+                w.execute("begin")
+                w.execute(f"update acct set bal = bal - 1 where id = {src}")
+                w.execute(f"update acct set bal = bal + 1 where id = {dst}")
+                try:
+                    w.execute("commit")
+                except Exception:
+                    pass          # conflict aborts are expected
+        except Exception as e:    # pragma: no cover
+            errors.append(e)
+
+    def reader():
+        try:
+            r = Session(catalog=s.catalog)
+            for _ in range(8):
+                total = r.execute("select sum(bal) from acct").rows()[0][0]
+                # snapshot reads always see a consistent total
+                assert total == 2000, total
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(k,)) for k in range(3)] \
+        + [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert s.execute("select sum(bal) from acct").rows()[0][0] == 2000
+
+
+def test_zonemap_decimal_literal_vs_int_column():
+    # regression: scaled decimal literal must not prune int chunks raw
+    s = Session()
+    s.execute("create table z (q bigint)")
+    s.execute("insert into z values (5), (9)")
+    assert s.execute("select q from z where q > 7.0").rows() == [(9,)]
+    assert s.execute("select q from z where q > (select avg(q) from z)"
+                     ).rows() == [(9,)]
+
+
+def test_empty_result_column_names():
+    s = Session()
+    s.execute("create table e (a bigint, b varchar(3))")
+    r = s.execute("select a, b from e")
+    assert r.column_names == ["a", "b"] and r.rows() == []
+    # IN over an empty subquery result
+    s.execute("create table f (x bigint)")
+    s.execute("insert into f values (1)")
+    assert s.execute("select x from f where x in (select a from e)").rows() == []
+    assert s.execute("select x from f where x not in (select a from e)").rows() == [(1,)]
