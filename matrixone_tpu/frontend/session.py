@@ -403,6 +403,63 @@ class Session:
         raise BindError(f"unsupported index algo {stmt.using!r}")
 
     # --------------------------------------------------------------- dml
+    def _pessimistic(self, txn) -> bool:
+        return (self.txn is not None
+                and self.variables.get("txn_mode") == "pessimistic")
+
+    def _maybe_lock(self, txn, table: str, gids) -> None:
+        """Pessimistic mode (reference: colexec/lockop + lockservice.Lock):
+        DML takes exclusive row locks before buffering the write; released
+        at commit/rollback. `set txn_mode = 'pessimistic'` arms it. A
+        deadlock victim is auto-rolled-back (InnoDB/reference behavior) so
+        its locks release immediately and the survivor proceeds."""
+        if not self._pessimistic(txn):
+            return     # autocommit DML serializes through the commit lock
+        from matrixone_tpu.lockservice import DeadlockError
+        committed = np.asarray(gids)[np.asarray(gids) >= 0]
+        if len(committed):
+            timeout = float(self.variables.get("lock_timeout", 10.0))
+            try:
+                self.catalog.locks.lock(txn.txn_id, table, committed,
+                                        timeout=timeout)
+            except DeadlockError:
+                if self.txn is txn:
+                    txn.rollback()
+                    self.txn = None
+                raise
+
+    def _dml_read_ctx(self, txn) -> ExecContext:
+        """Row-planning context for DML. Pessimistic txns plan against the
+        CURRENT frontier (MySQL 'current read'): after the lock wait, the
+        statement must see the rows the lock winner left behind, not its
+        own stale snapshot — otherwise the wait ends in a write-write
+        conflict anyway."""
+        import types
+        if self._pessimistic(txn):
+            cur = types.SimpleNamespace(
+                snapshot_ts=self.catalog.committed_ts,
+                workspace=txn.workspace)
+            return ExecContext(catalog=self.catalog, txn=cur,
+                               variables=self.variables)
+        return ExecContext(catalog=self.catalog, txn=txn,
+                           variables=self.variables)
+
+    def _plan_and_lock_rows(self, txn, table: str, run_plan):
+        """run_plan(ctx) -> (gids, payload). In pessimistic mode: plan at
+        the frontier, lock, re-plan (the frontier may have advanced while
+        we waited) until the row set stabilizes."""
+        result = run_plan(self._dml_read_ctx(txn))
+        if not self._pessimistic(txn):
+            return result
+        for _ in range(5):
+            self._maybe_lock(txn, table, result[0])
+            again = run_plan(self._dml_read_ctx(txn))
+            if set(np.asarray(again[0]).tolist()) == \
+                    set(np.asarray(result[0]).tolist()):
+                return again
+            result = again
+        return result
+
     def _dml_plan(self, table_name: str, where, extra_exprs=None,
                   extra_names=None):
         """Plan `SELECT __rowid [, extra...] FROM t WHERE ...` for DML."""
@@ -433,15 +490,17 @@ class Session:
 
     def _delete(self, stmt: ast.Delete) -> Result:
         txn = self.txn or self.txn_client.begin()
-        ctx = ExecContext(catalog=self.catalog, txn=txn,
-                          variables=self.variables)
         proj, _, _ = self._dml_plan(stmt.table, stmt.where)
-        op = compile_plan(proj, ctx)
-        gids = []
-        for ex in op.execute():
-            b = self._to_host(ex, proj.schema)
-            gids.extend(b.columns[ROWID].data.tolist())
-        gids = np.asarray(gids, np.int64)
+
+        def run_plan(ctx):
+            op = compile_plan(proj, ctx)
+            gids = []
+            for ex in op.execute():
+                b = self._to_host(ex, proj.schema)
+                gids.extend(b.columns[ROWID].data.tolist())
+            return np.asarray(gids, np.int64), None
+
+        gids, _ = self._plan_and_lock_rows(txn, stmt.table, run_plan)
         txn.delete_rows(stmt.table, gids)
         if self.txn is None:
             txn.commit()
@@ -449,8 +508,6 @@ class Session:
 
     def _update(self, stmt: ast.Update) -> Result:
         txn = self.txn or self.txn_client.begin()
-        ctx = ExecContext(catalog=self.catalog, txn=txn,
-                          variables=self.variables)
         table = self.catalog.get_table(stmt.table)
         schema = table.meta.schema
         assigned = dict(stmt.assignments)
@@ -461,14 +518,18 @@ class Session:
             extra_names.append(col)
         proj, _, _ = self._dml_plan(stmt.table, stmt.where,
                                     extra_exprs, extra_names)
-        op = compile_plan(proj, ctx)
-        gids, new_cols = [], {c: [] for c, _ in schema}
-        for ex in op.execute():
-            b = self._to_host(ex, proj.schema)
-            gids.extend(b.columns[ROWID].data.tolist())
-            for c, _ in schema:
-                new_cols[c].extend(b.columns[c].to_pylist())
-        gids = np.asarray(gids, np.int64)
+
+        def run_plan(ctx):
+            op = compile_plan(proj, ctx)
+            gids, new_cols = [], {c: [] for c, _ in schema}
+            for ex in op.execute():
+                b = self._to_host(ex, proj.schema)
+                gids.extend(b.columns[ROWID].data.tolist())
+                for c, _ in schema:
+                    new_cols[c].extend(b.columns[c].to_pylist())
+            return np.asarray(gids, np.int64), new_cols
+
+        gids, new_cols = self._plan_and_lock_rows(txn, stmt.table, run_plan)
         if len(gids) == 0:
             return Result(affected=0)
         # rows must round-trip through the table's SQL types (e.g. the

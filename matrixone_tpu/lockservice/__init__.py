@@ -1,0 +1,121 @@
+"""Pessimistic lock service with deadlock detection.
+
+Reference analogue: `pkg/lockservice` (34k LoC — lock tables allocated per
+table, row/range locks, distributed deadlock detection `deadlock.go`,
+orphan GC), collapsed to the single-service form: an in-process lock table
+keyed by (table, row), shared/exclusive modes, and a wait-for graph
+checked for cycles before every block — the waiter whose edge completes a
+cycle aborts (`DeadlockError`), matching the reference's kill-the-latecomer
+policy. Wakeups race on a shared condition (no fairness queue yet): an
+exclusive waiter can starve under sustained shared traffic — the
+reference's per-lock FIFO queue is the planned refinement.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Set, Tuple
+
+SHARED = "shared"
+EXCLUSIVE = "exclusive"
+
+
+class DeadlockError(RuntimeError):
+    pass
+
+
+class LockTimeoutError(RuntimeError):
+    pass
+
+
+class _RowLock:
+    __slots__ = ("owners", "mode")
+
+    def __init__(self):
+        self.owners: Set[int] = set()
+        self.mode: Optional[str] = None
+
+
+class LockService:
+    def __init__(self):
+        self._locks: Dict[Tuple[str, int], _RowLock] = {}
+        self._held: Dict[int, Set[Tuple[str, int]]] = defaultdict(set)
+        #: waiter txn -> owner txns it is blocked on (wait-for graph)
+        self._waits: Dict[int, Set[int]] = {}
+        self._cond = threading.Condition()
+
+    # ------------------------------------------------------------- locking
+    def lock(self, txn_id: int, table: str, rows, mode: str = EXCLUSIVE,
+             timeout: float = 10.0) -> None:
+        """Acquire locks on every row (all-or-block, row at a time in
+        sorted order — ordered acquisition limits livelock)."""
+        for row in sorted(int(r) for r in rows):
+            self._lock_one(txn_id, (table, row), mode, timeout)
+
+    def _compatible(self, lk: _RowLock, txn_id: int, mode: str) -> bool:
+        if not lk.owners or lk.owners == {txn_id}:
+            return True
+        if mode == SHARED and lk.mode == SHARED:
+            return True
+        return False
+
+    def _lock_one(self, txn_id: int, key, mode: str, timeout: float):
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            lk = self._locks.setdefault(key, _RowLock())
+            while not self._compatible(lk, txn_id, mode):
+                blockers = lk.owners - {txn_id}
+                self._waits[txn_id] = set(blockers)
+                if self._creates_cycle(txn_id):
+                    self._waits.pop(txn_id, None)
+                    self._cond.notify_all()
+                    raise DeadlockError(
+                        f"txn {txn_id} would deadlock on {key}")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(timeout=remaining):
+                    self._waits.pop(txn_id, None)
+                    raise LockTimeoutError(f"txn {txn_id} timed out on {key}")
+                lk = self._locks.setdefault(key, _RowLock())
+            self._waits.pop(txn_id, None)
+            lk.owners.add(txn_id)
+            if mode == EXCLUSIVE or lk.mode is None:
+                lk.mode = mode      # never downgrades an EXCLUSIVE hold
+            self._held[txn_id].add(key)
+
+    def _creates_cycle(self, start: int) -> bool:
+        """DFS over the wait-for graph from start's blockers back to start."""
+        seen = set()
+        stack = list(self._waits.get(start, ()))
+        while stack:
+            t = stack.pop()
+            if t == start:
+                return True
+            if t in seen:
+                continue
+            seen.add(t)
+            stack.extend(self._waits.get(t, ()))
+        return False
+
+    # ------------------------------------------------------------ release
+    def unlock_all(self, txn_id: int) -> None:
+        with self._cond:
+            for key in self._held.pop(txn_id, set()):
+                lk = self._locks.get(key)
+                if lk is None:
+                    continue
+                lk.owners.discard(txn_id)
+                if not lk.owners:
+                    del self._locks[key]
+            self._waits.pop(txn_id, None)
+            self._cond.notify_all()
+
+    # ------------------------------------------------------------- status
+    def held_by(self, txn_id: int) -> Set[Tuple[str, int]]:
+        with self._cond:
+            return set(self._held.get(txn_id, ()))
+
+    def n_locks(self) -> int:
+        with self._cond:
+            return len(self._locks)

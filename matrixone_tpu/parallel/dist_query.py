@@ -154,3 +154,52 @@ def hash_shuffle(mesh: Mesh, keys: jnp.ndarray, values: jnp.ndarray,
     fn = jax.shard_map(step, mesh=mesh, in_specs=(P(axis), P(axis)),
                        out_specs=(P(axis), P(axis)))
     return fn(keys, values)
+
+
+# ----------------------------------------------------------- full Q1 step
+
+def distributed_q1(mesh: Mesh, cols: dict, n_flags: int = 4,
+                   n_status: int = 2, axis: str = "shard"):
+    """TPC-H Q1 as ONE shard_map program over the mesh: per-shard masked
+    segment aggregation into the dense (returnflag x linestatus) group
+    table, merged with psum — the distributed form of the Session's Q1
+    pipeline (scan rows are sharded across devices like ParallelRun DOP
+    pipelines, mergegroup is a psum over ICI).
+
+    cols: row-sharded device arrays {shipdate i32, flag i32 codes,
+    status i32 codes, qty/price/disc/tax int64 scaled}, plus 'mask' bool.
+    Returns replicated dense arrays keyed by group slot
+    g = flag * n_status + status: sum_qty, sum_base, sum_disc, sum_charge,
+    count, present.
+    """
+    n_groups = n_flags * n_status
+
+    def step(flag, status, qty, price, disc, tax, mask):
+        gid = (flag * n_status + status).astype(jnp.int32)
+        m = mask
+        disc_price = price * (100 - disc)              # scale 4
+        charge = disc_price * (100 + tax)              # scale 6
+
+        def seg(v):
+            return jax.lax.psum(
+                jax.ops.segment_sum(jnp.where(m, v, 0), gid,
+                                    num_segments=n_groups), axis)
+        out = {
+            "sum_qty": seg(qty),
+            "sum_base": seg(price),
+            "sum_disc": seg(disc_price),
+            "sum_charge": seg(charge),
+            "count": jax.lax.psum(
+                jax.ops.segment_sum(m.astype(jnp.int64), gid,
+                                    num_segments=n_groups), axis),
+        }
+        out["present"] = out["count"] > 0
+        return (out["sum_qty"], out["sum_base"], out["sum_disc"],
+                out["sum_charge"], out["count"], out["present"])
+
+    fn = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=tuple([P(axis)] * 7),
+        out_specs=tuple([P()] * 6))
+    return fn(cols["flag"], cols["status"], cols["qty"], cols["price"],
+              cols["disc"], cols["tax"], cols["mask"])

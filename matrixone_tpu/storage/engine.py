@@ -410,6 +410,8 @@ class Engine:
         #: last FULLY applied commit: readers snapshot here so a commit
         #: mid-apply (segments in, tombstones not yet) can never tear a read
         self.committed_ts = self.hlc.now()
+        from matrixone_tpu.lockservice import LockService
+        self.locks = LockService()     # pessimistic mode (pkg/lockservice)
 
     # ----------------------------------------------------------- catalog
     def create_table(self, meta: TableMeta, if_not_exists=False,

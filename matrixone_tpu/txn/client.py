@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import itertools
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -36,12 +37,25 @@ class TableWorkspace:
         return np.concatenate(self.delete_gids)
 
 
+_txn_counter = itertools.count(1)
+
+
 class TxnHandle:
     def __init__(self, engine: Engine, snapshot_ts: int):
         self.engine = engine
         self.snapshot_ts = snapshot_ts
         self.state = TxnState.ACTIVE
         self.workspace: Dict[str, TableWorkspace] = {}
+        self._txn_id = next(_txn_counter)   # never reused (id(self) can be)
+
+    def __del__(self):
+        # orphan GC (reference: lockservice orphan-txn cleanup): an
+        # abandoned ACTIVE handle must not pin its row locks forever
+        try:
+            if self.state == TxnState.ACTIVE:
+                self.engine.locks.unlock_all(self._txn_id)
+        except Exception:
+            pass
 
     def ws(self, table: str) -> TableWorkspace:
         return self.workspace.setdefault(table, TableWorkspace())
@@ -78,6 +92,10 @@ class TxnHandle:
         return len(gids)
 
     # ------------------------------------------------------------ finish
+    @property
+    def txn_id(self) -> int:
+        return self._txn_id
+
     def commit(self) -> int:
         assert self.state == TxnState.ACTIVE, "txn not active"
         inserts = {t: [(s.arrays, s.validity) for s in w.segments
@@ -90,13 +108,16 @@ class TxnHandle:
                                               deletes)
         except Exception:
             self.state = TxnState.ABORTED
+            self.engine.locks.unlock_all(self.txn_id)
             raise
         self.state = TxnState.COMMITTED
+        self.engine.locks.unlock_all(self.txn_id)
         return affected
 
     def rollback(self) -> None:
         self.workspace.clear()
         self.state = TxnState.ABORTED
+        self.engine.locks.unlock_all(self.txn_id)
 
 
 class TxnClient:

@@ -1,0 +1,190 @@
+"""Lock service: modes, blocking, deadlock detection
+(reference analogue: pkg/lockservice tests + pessimistic_transaction BVT)."""
+
+import threading
+import time
+
+import pytest
+
+from matrixone_tpu.lockservice import (DeadlockError, EXCLUSIVE,
+                                       LockService, LockTimeoutError, SHARED)
+
+
+def test_shared_locks_coexist_exclusive_blocks():
+    ls = LockService()
+    ls.lock(1, "t", [5], SHARED)
+    ls.lock(2, "t", [5], SHARED)            # shared+shared OK
+    with pytest.raises(LockTimeoutError):
+        ls.lock(3, "t", [5], EXCLUSIVE, timeout=0.1)
+    ls.unlock_all(1)
+    ls.unlock_all(2)
+    ls.lock(3, "t", [5], EXCLUSIVE)         # now acquires
+    assert ls.held_by(3) == {("t", 5)}
+    ls.unlock_all(3)
+    assert ls.n_locks() == 0
+
+
+def test_reentrant_same_txn():
+    ls = LockService()
+    ls.lock(1, "t", [7], EXCLUSIVE)
+    ls.lock(1, "t", [7], EXCLUSIVE)         # same txn re-locks freely
+    ls.unlock_all(1)
+
+
+def test_blocking_handoff():
+    ls = LockService()
+    ls.lock(1, "t", [9], EXCLUSIVE)
+    got = []
+
+    def waiter():
+        ls.lock(2, "t", [9], EXCLUSIVE, timeout=5)
+        got.append(True)
+        ls.unlock_all(2)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.1)
+    assert not got            # still blocked
+    ls.unlock_all(1)
+    th.join(timeout=5)
+    assert got == [True]
+
+
+def test_deadlock_detected():
+    ls = LockService()
+    ls.lock(1, "t", [1], EXCLUSIVE)
+    ls.lock(2, "t", [2], EXCLUSIVE)
+    errors = []
+
+    def t1():
+        try:
+            ls.lock(1, "t", [2], EXCLUSIVE, timeout=5)   # waits on txn 2
+        except (DeadlockError, LockTimeoutError) as e:
+            errors.append(("t1", type(e).__name__))
+            ls.unlock_all(1)
+
+    def t2():
+        time.sleep(0.2)
+        try:
+            ls.lock(2, "t", [1], EXCLUSIVE, timeout=5)   # closes the cycle
+        except (DeadlockError, LockTimeoutError) as e:
+            errors.append(("t2", type(e).__name__))
+            ls.unlock_all(2)
+
+    a, b = threading.Thread(target=t1), threading.Thread(target=t2)
+    a.start(); b.start()
+    a.join(timeout=10); b.join(timeout=10)
+    # exactly one of the two must have been killed by deadlock detection
+    assert ("t2", "DeadlockError") in errors or ("t1", "DeadlockError") in errors
+    ls.unlock_all(1)
+    ls.unlock_all(2)
+    assert ls.n_locks() == 0
+
+
+def test_ordered_multi_row_acquisition_no_deadlock():
+    # sorted acquisition means two txns locking {1,2} in any given order
+    # serialize instead of deadlocking
+    ls = LockService()
+    done = []
+
+    def worker(txn):
+        for _ in range(5):
+            ls.lock(txn, "t", [2, 1], EXCLUSIVE, timeout=10)
+            time.sleep(0.01)
+            ls.unlock_all(txn)
+        done.append(txn)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in (1, 2, 3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert sorted(done) == [1, 2, 3]
+
+
+def test_pessimistic_sql_blocks_and_deadlocks():
+    from matrixone_tpu.frontend import Session
+    from matrixone_tpu.lockservice import DeadlockError, LockTimeoutError
+    s1 = Session()
+    s1.execute("create table t (id bigint, v bigint)")
+    s1.execute("insert into t values (1, 0), (2, 0)")
+    s2 = Session(catalog=s1.catalog)
+    for s in (s1, s2):
+        s.execute("set txn_mode = 'pessimistic'")
+        s.execute("set lock_timeout = 2")
+    s1.execute("begin"); s2.execute("begin")
+    s1.execute("update t set v = 1 where id = 1")
+    s2.execute("update t set v = 2 where id = 2")
+    results = []
+
+    def cross(sess, target, tag):
+        try:
+            sess.execute(f"update t set v = 9 where id = {target}")
+            results.append((tag, "ok"))
+        except (DeadlockError, LockTimeoutError) as e:
+            results.append((tag, type(e).__name__))
+            sess.execute("rollback")
+
+    t1 = threading.Thread(target=cross, args=(s1, 2, "s1"))
+    t2 = threading.Thread(target=cross, args=(s2, 1, "s2"))
+    t1.start(); time.sleep(0.2); t2.start()
+    t1.join(timeout=15); t2.join(timeout=15)
+    kinds = dict(results)
+    assert "DeadlockError" in kinds.values()
+    # whichever survived can commit
+    for sess, tag in ((s1, "s1"), (s2, "s2")):
+        if kinds.get(tag) == "ok" and sess.txn is not None:
+            sess.execute("commit")
+    assert s1.catalog.locks.n_locks() == 0
+
+
+def test_pessimistic_blocked_writer_succeeds_after_wait():
+    """The whole point of pessimistic mode: the waiter proceeds against the
+    winner's committed state instead of aborting (current-read)."""
+    from matrixone_tpu.frontend import Session
+    s1 = Session()
+    s1.execute("create table t (id bigint, v bigint)")
+    s1.execute("insert into t values (1, 100)")
+    s2 = Session(catalog=s1.catalog)
+    for s in (s1, s2):
+        s.execute("set txn_mode = 'pessimistic'")
+        s.execute("set lock_timeout = 10")
+    s1.execute("begin")
+    s1.execute("update t set v = v + 1 where id = 1")
+    outcome = []
+
+    def waiter():
+        s2.execute("begin")
+        s2.execute("update t set v = v + 10 where id = 1")   # blocks on s1
+        s2.execute("commit")
+        outcome.append("committed")
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.3)
+    s1.execute("commit")
+    th.join(timeout=15)
+    assert outcome == ["committed"]
+    # both increments applied: 100 + 1 + 10 (lost-update-free)
+    assert s1.execute("select v from t where id = 1").rows() == [(111,)]
+
+
+def test_orphaned_txn_releases_locks():
+    from matrixone_tpu.frontend import Session
+    import gc
+    s1 = Session()
+    s1.execute("create table t (id bigint, v bigint)")
+    s1.execute("insert into t values (1, 0)")
+    s2 = Session(catalog=s1.catalog)
+    for s in (s1, s2):
+        s.execute("set txn_mode = 'pessimistic'")
+        s.execute("set lock_timeout = 3")
+    s1.execute("begin")
+    s1.execute("update t set v = 1 where id = 1")
+    assert s1.catalog.locks.n_locks() == 1
+    s1.txn = None            # abandon the handle without rollback
+    gc.collect()             # __del__ orphan GC releases the locks
+    assert s1.catalog.locks.n_locks() == 0
+    s2.execute("begin")
+    s2.execute("update t set v = 2 where id = 1")   # acquires immediately
+    s2.execute("commit")

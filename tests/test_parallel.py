@@ -68,3 +68,34 @@ def test_hash_shuffle_colocates_keys(mesh, rng):
     # key -> value mapping preserved
     for pos in np.nonzero(real)[0]:
         assert keys[v2[pos]] == k2[pos]
+
+
+def test_distributed_q1_matches_oracle(mesh, rng):
+    import jax.numpy as jnp
+    from matrixone_tpu.utils import tpch as T
+    n = 8 * 1024
+    arrays = T.gen_lineitem(n, seed=9)
+    cutoff = 10471   # 1998-12-01 minus 90 days
+    sel = arrays["l_shipdate"] <= cutoff
+    cols = {
+        "flag": jnp.asarray(arrays["l_returnflag"].astype(np.int32)),
+        "status": jnp.asarray(arrays["l_linestatus"].astype(np.int32)),
+        "qty": jnp.asarray(arrays["l_quantity"]),
+        "price": jnp.asarray(arrays["l_extendedprice"]),
+        "disc": jnp.asarray(arrays["l_discount"]),
+        "tax": jnp.asarray(arrays["l_tax"]),
+        "mask": jnp.asarray(sel),
+    }
+    from matrixone_tpu.parallel import shard_rows
+    cols = {k: shard_rows(mesh, v) for k, v in cols.items()}
+    sq, sb, sd, sc, cnt, present = dist_query.distributed_q1(
+        mesh, cols, n_flags=3, n_status=2)
+    oracle = T.q1_oracle(arrays)
+    for (f, st), o in oracle.items():
+        g = T.FLAG_CATS.index(f) * 2 + T.STATUS_CATS.index(st)
+        assert int(sq[g]) == o["sum_qty"]
+        assert int(sb[g]) == o["sum_base_price"]
+        assert int(sd[g]) == o["sum_disc_price"]
+        assert int(sc[g]) == o["sum_charge"]
+        assert int(cnt[g]) == o["count_order"]
+        assert bool(present[g])
