@@ -26,6 +26,7 @@ from matrixone_tpu.vectorindex import brute_force, ivf_flat
 from matrixone_tpu.vectorindex.recall import recall_at_k
 
 SMOKE = os.environ.get("MO_BENCH_SMOKE") == "1"
+INDEX_KIND = os.environ.get("MO_BENCH_INDEX", "ivfflat")   # ivfflat | ivfpq
 N = int(os.environ.get("MO_BENCH_N", 20_000 if SMOKE else 1_000_000))
 D = int(os.environ.get("MO_BENCH_D", 64 if SMOKE else 768))
 NQ = int(os.environ.get("MO_BENCH_Q", 256 if SMOKE else 1024))
@@ -68,14 +69,25 @@ def main():
     t_data = time.time() - t0
 
     # ---- build
+    from matrixone_tpu.vectorindex import ivf_pq
     t0 = time.time()
-    index = ivf_flat.build(data, nlist=NLIST, n_iter=10,
-                           storage_dtype=jnp.bfloat16,
-                           balance_weight=0.3,
-                           kmeans_sample=min(N, 262144),
-                           compute_dtype=jnp.bfloat16)
-    jax.block_until_ready(index.vectors)
+    if INDEX_KIND == "ivfpq":
+        from matrixone_tpu.indexing import _pick_subspaces
+        index = ivf_pq.build(data, nlist=NLIST,
+                             n_subspaces=_pick_subspaces(D),
+                             n_iter=10, balance_weight=0.3,
+                             kmeans_sample=min(N, 262144),
+                             compute_dtype=jnp.bfloat16)
+        jax.block_until_ready(index.codes)
+    else:
+        index = ivf_flat.build(data, nlist=NLIST, n_iter=10,
+                               storage_dtype=jnp.bfloat16,
+                               balance_weight=0.3,
+                               kmeans_sample=min(N, 262144),
+                               compute_dtype=jnp.bfloat16)
+        jax.block_until_ready(index.vectors)
     t_build = time.time() - t0
+    search_fn = ivf_pq.search if INDEX_KIND == "ivfpq" else ivf_flat.search
 
     # ---- ground truth: exact f32 at HIGHEST matmul precision (bf16 truth
     # would bias the recall measurement)
@@ -93,9 +105,9 @@ def main():
     def run_all():
         outs = []
         for i in range(0, NQ, BATCH):
-            _, ids = ivf_flat.search(index, queries[i:i + BATCH], k=K,
-                                     nprobe=NPROBE, query_chunk=32,
-                                     compute_dtype=jnp.bfloat16)
+            _, ids = search_fn(index, queries[i:i + BATCH], k=K,
+                               nprobe=NPROBE, query_chunk=32,
+                               compute_dtype=jnp.bfloat16)
             outs.append(ids)
         jax.block_until_ready(outs[-1])
         return outs
@@ -112,7 +124,7 @@ def main():
         best_qps = max(best_qps, NQ / dt)
 
     result = {
-        "metric": f"ivf_flat_search_qps_{N}x{D}_top{K}_nprobe{NPROBE}",
+        "metric": f"{INDEX_KIND}_search_qps_{N}x{D}_top{K}_nprobe{NPROBE}",
         "value": round(best_qps, 1),
         "unit": "qps",
         "vs_baseline": round(best_qps / BASELINE_QPS, 2),

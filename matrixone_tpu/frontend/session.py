@@ -373,20 +373,26 @@ class Session:
     def _create_index(self, stmt: ast.CreateIndex) -> Result:
         table = self.catalog.get_table(stmt.table)
         algo = (stmt.using or "").lower()
-        if algo in ("ivfflat", "ivf_flat"):
-            from matrixone_tpu.vectorindex import ivf_flat
+        if algo in ("ivfflat", "ivf_flat", "ivfpq", "ivf_pq"):
             col = stmt.columns[0]
             coltype = dict(table.meta.schema)[col]
             if not coltype.is_vector:
-                raise BindError(f"ivfflat index requires a vecf32 column")
+                raise BindError(f"{algo} index requires a vecf32 column")
             from matrixone_tpu import indexing
             op_type = stmt.options.get("op_type", "vector_l2_ops")
             metric = {"vector_l2_ops": "l2", "vector_cosine_ops": "cosine",
                       "vector_ip_ops": "ip"}.get(op_type, "l2")
-            meta = IndexMeta(stmt.name, stmt.table, stmt.columns, "ivfflat",
+            algo_name = "ivfpq" if "pq" in algo else "ivfflat"
+            if algo_name == "ivfpq" and metric == "ip":
+                raise BindError(
+                    "ivfpq does not support vector_ip_ops; use ivfflat")
+            meta = IndexMeta(stmt.name, stmt.table, stmt.columns, algo_name,
                              dict(stmt.options), dirty=True)
             meta.options["_metric"] = metric
-            indexing.build_ivfflat(self.catalog, meta)
+            try:
+                indexing.build_ivfflat(self.catalog, meta)
+            except ValueError as e:
+                raise BindError(str(e))
             self.catalog.indexes[stmt.name] = meta
             return Result()
         if algo == "fulltext":

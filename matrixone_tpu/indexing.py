@@ -16,16 +16,32 @@ from matrixone_tpu.storage.engine import Engine, IndexMeta
 
 
 def build_ivfflat(engine: Engine, ix: IndexMeta) -> None:
-    from matrixone_tpu.vectorindex import ivf_flat
+    from matrixone_tpu.vectorindex import ivf_flat, ivf_pq
     table = engine.get_table(ix.table)
     data, gids = table.read_column_f32(ix.columns[0])
     nlist = int(ix.options.get("lists", 64))
     metric = ix.options.get("_metric", "l2")
     nlist = max(1, min(nlist, max(1, len(data))))
-    ix.index_obj = ivf_flat.build(jnp.asarray(data), nlist=nlist,
-                                  metric=metric)
+    if ix.algo == "ivfpq":
+        d = data.shape[1] if data.ndim == 2 else 1
+        m = int(ix.options.get("subspaces", 0)) or _pick_subspaces(d)
+        if d % m != 0:
+            raise ValueError(f"dim {d} must divide into n_subspaces={m}")
+        ix.index_obj = ivf_pq.build(jnp.asarray(data), nlist=nlist,
+                                    n_subspaces=m, metric=metric)
+    else:
+        ix.index_obj = ivf_flat.build(jnp.asarray(data), nlist=nlist,
+                                      metric=metric)
     ix.options["_row_gids"] = gids
     ix.dirty = False
+
+
+def _pick_subspaces(d: int) -> int:
+    """Largest divisor of d with subspace width >= 4, capped at d//4."""
+    for m in (96, 64, 48, 32, 24, 16, 12, 8, 6, 4, 2, 1):
+        if m <= max(d // 4, 1) and d % m == 0:
+            return m
+    return 1
 
 
 def build_fulltext(engine: Engine, ix: IndexMeta) -> None:
@@ -56,7 +72,7 @@ def refresh_if_dirty(engine: Engine, ix: IndexMeta) -> None:
     with engine._commit_lock:
         if not ix.dirty:
             return
-        if ix.algo == "ivfflat":
+        if ix.algo in ("ivfflat", "ivfpq"):
             build_ivfflat(engine, ix)
         elif ix.algo == "fulltext":
             build_fulltext(engine, ix)

@@ -70,9 +70,12 @@ def apply_indices(node: P.PlanNode, catalog, nprobe: int = 8,
     raw_col = col_e.name.split(".")[-1]
     metric = _DIST_METRIC[dist.op]
     for ix in catalog.indexes_on(scan.table):
-        if ix.algo == "ivfflat" and ix.columns[0] == raw_col \
+        if ix.algo in ("ivfflat", "ivfpq") and ix.columns[0] == raw_col \
                 and ix.options.get("_metric", "l2") == metric:
-            k = (node.k + node.offset) * overfetch
+            # PQ candidates need a deeper pool: the exact re-rank above
+            # (Project recompute + TopK) recovers ADC quantization loss
+            factor = overfetch * (3 if ix.algo == "ivfpq" else 1)
+            k = (node.k + node.offset) * factor
             proj.child = P.VectorTopK(
                 table=scan.table, index_name=ix.name,
                 query_vector=list(vec_e.value), k=k, metric=metric,

@@ -1,5 +1,7 @@
-from matrixone_tpu.vectorindex import brute_force, ivf_flat, kmeans, recall
+from matrixone_tpu.vectorindex import (brute_force, ivf_flat, ivf_pq,
+                                       kmeans, recall)
 from matrixone_tpu.vectorindex.ivf_flat import IvfFlatIndex, build, search
+from matrixone_tpu.vectorindex.ivf_pq import IvfPqIndex
 
-__all__ = ["brute_force", "ivf_flat", "kmeans", "recall",
-           "IvfFlatIndex", "build", "search"]
+__all__ = ["brute_force", "ivf_flat", "ivf_pq", "kmeans", "recall",
+           "IvfFlatIndex", "IvfPqIndex", "build", "search"]

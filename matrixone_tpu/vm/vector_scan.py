@@ -29,7 +29,7 @@ class VectorTopKOp(Operator):
         self.schema = node.schema
 
     def execute(self) -> Iterator[ExecBatch]:
-        from matrixone_tpu.vectorindex import ivf_flat
+        from matrixone_tpu.vectorindex import ivf_flat, ivf_pq
         from matrixone_tpu import indexing
         catalog = self.ctx.catalog
         ix = catalog.indexes[self.node.index_name]
@@ -42,8 +42,10 @@ class VectorTopKOp(Operator):
         nprobe = min(self.node.nprobe, index.nlist)
         pool = nprobe * index.max_cluster_size
         k = min(self.node.k, index.n, pool) or 1
-        dists, pos = ivf_flat.search(index, jnp.asarray(q), k=k,
-                                     nprobe=nprobe, query_chunk=1)
+        search_fn = (ivf_pq.search if ix.algo == "ivfpq"
+                     else ivf_flat.search)
+        dists, pos = search_fn(index, jnp.asarray(q), k=k,
+                               nprobe=nprobe, query_chunk=1)
         pos = np.asarray(pos)[0]
         gids = row_gids[pos[pos >= 0]]
         read_args = self.ctx.table_read_args(self.node.table)

@@ -103,3 +103,31 @@ def test_rerank_exact_orders_bit_identically(rng):
         order = np.argsort(exp)
         np.testing.assert_array_equal(np.asarray(ids2)[i], np.asarray(ids)[i][order])
         np.testing.assert_array_equal(np.asarray(dist)[i], exp[order])
+
+
+def test_ivf_pq_recall_and_memory(rng):
+    from matrixone_tpu.vectorindex import ivf_pq
+    x = _clustered_data(rng, n=20000, d=32)
+    q = (x[rng.integers(0, len(x), 32)]
+         + 0.01 * rng.standard_normal((32, 32))).astype(np.float32)
+    index = ivf_pq.build(jnp.asarray(x), nlist=32, n_subspaces=8,
+                         n_iter=8, pq_iter=6, kmeans_sample=None,
+                         compute_dtype=None)
+    # 8 bytes/vector instead of 128 (f32 flat)
+    assert index.codes.dtype == jnp.uint8
+    assert index.codes.shape == (len(x), 8)
+    dist, ids = ivf_pq.search(index, jnp.asarray(q), k=10, nprobe=8,
+                              query_chunk=16)
+    padded, n = brute_force.pad_dataset(jnp.asarray(x), chunk_size=4096)
+    _, truth = brute_force.search(padded, jnp.asarray(q), k=10, n_valid=n,
+                                  chunk_size=4096)
+    r = recall_at_k(np.asarray(ids), np.asarray(truth))
+    assert r >= 0.4, r        # raw ADC: PQ trades recall for 16x memory
+    # exact re-rank over a deeper candidate pool recovers recall (this is
+    # what the SQL path's overfetch+Project-recompute does)
+    _, ids50 = ivf_pq.search(index, jnp.asarray(q), k=50, nprobe=8,
+                             query_chunk=16)
+    _, rr = ivf_flat.rerank_exact(jnp.asarray(x), jnp.asarray(q),
+                                  ids50)
+    r2 = recall_at_k(np.asarray(rr)[:, :10], np.asarray(truth))
+    assert r2 >= 0.8, (r, r2)
