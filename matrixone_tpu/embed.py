@@ -1,0 +1,87 @@
+"""In-process cluster for tests and embedding (reference: pkg/embed
+cluster.go:73 NewCluster — log + TN + N CNs in one process).
+
+Here the "cluster" is: one Engine (storage+txn, the TN/Log role), a wire
+server (the CN frontend), a TaskService (background checkpoint runner),
+and optionally a TPU compute worker — all with one lifecycle:
+
+    with Cluster(n_sessions=2) as c:
+        c.sessions[0].execute("create table t (a bigint)")
+        conn = c.connect()        # MySQL-wire client into the same engine
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+from typing import List, Optional
+
+from matrixone_tpu.frontend.server import MOServer
+from matrixone_tpu.frontend.session import Session
+from matrixone_tpu.storage.engine import Engine
+from matrixone_tpu.storage.fileservice import LocalFS, MemoryFS
+from matrixone_tpu.taskservice import TaskService
+
+
+class Cluster:
+    def __init__(self, n_sessions: int = 1, data_dir: Optional[str] = None,
+                 wire: bool = True, checkpoint_interval_s: float = 0.0,
+                 with_worker: bool = False):
+        self._tmp = None
+        if data_dir == ":tmp:":
+            self._tmp = tempfile.mkdtemp(prefix="mo_tpu_")
+            fs = LocalFS(self._tmp)
+        elif data_dir is not None:
+            fs = LocalFS(data_dir)
+        else:
+            fs = MemoryFS()
+        self.engine = (Engine.open(fs) if fs.exists("meta/manifest.json")
+                       or fs.exists("wal/wal.log") else Engine(fs))
+        self.sessions: List[Session] = [Session(catalog=self.engine)
+                                        for _ in range(n_sessions)]
+        self.tasks = TaskService(self.engine).start()
+        if checkpoint_interval_s > 0:
+            resumed = any(t["name"] == "auto-checkpoint"
+                          for t in self.tasks._tasks.values())
+            if not resumed:
+                self.tasks.submit("auto-checkpoint", "checkpoint",
+                                  interval_s=checkpoint_interval_s)
+        self.server = MOServer(engine=self.engine, port=0).start() \
+            if wire else None
+        self.worker = None
+        self.worker_client = None
+        if with_worker:
+            from matrixone_tpu.worker import TpuWorkerServer, WorkerClient
+            self.worker = TpuWorkerServer(port=0).start()
+            self.worker_client = WorkerClient(f"127.0.0.1:{self.worker.port}")
+
+    # ------------------------------------------------------------- access
+    def session(self, i: int = 0) -> Session:
+        return self.sessions[i]
+
+    def connect(self):
+        """New wire-protocol connection (matrixone_tpu.client)."""
+        from matrixone_tpu import client
+        assert self.server is not None, "cluster started with wire=False"
+        return client.connect(port=self.server.port)
+
+    def checkpoint(self):
+        self.engine.checkpoint()
+
+    # ---------------------------------------------------------- lifecycle
+    def close(self, cleanup: bool = False):
+        self.tasks.stop()
+        if self.server is not None:
+            self.server.stop()
+        if self.worker_client is not None:
+            self.worker_client.close()
+        if self.worker is not None:
+            self.worker.stop()
+        if self._tmp is not None and cleanup:
+            shutil.rmtree(self._tmp, ignore_errors=True)
+
+    def __enter__(self) -> "Cluster":
+        return self
+
+    def __exit__(self, *exc):
+        self.close(cleanup=True)

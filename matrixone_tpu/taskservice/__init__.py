@@ -1,0 +1,208 @@
+"""Async / cron task framework (reference: pkg/taskservice, 14k LoC —
+tasks persisted in sys tables, runners claim and execute them).
+
+Collapsed to the single-process form with the same contract:
+  * tasks are durable rows in the `system_async_task` table of the engine
+    (dogfooded storage, like statement_info) — they survive restart;
+  * a TaskRunner thread claims due tasks (one-shot or fixed-interval
+    cron), executes the registered executor by name, and records
+    status/last_run/error back to the table;
+  * executors register by name (the reference's task codes), so replayed
+    tasks reconnect to code after restart.
+
+Ships one built-in executor: `checkpoint` — the TAE background checkpoint
+runner (tae/db/checkpoint/runner.go) as a cron task.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from matrixone_tpu.container import dtypes as dt
+
+TASK_TABLE = "system_async_task"
+
+_SCHEMA = [
+    ("task_id", dt.INT64),
+    ("name", dt.varchar(64)),
+    ("executor", dt.varchar(64)),
+    ("arg", dt.TEXT),
+    ("interval_s", dt.FLOAT64),     # 0 = one-shot
+    ("next_run", dt.FLOAT64),       # unix seconds
+    ("status", dt.varchar(16)),     # pending | running | done | failed
+    ("last_error", dt.TEXT),
+    ("runs", dt.INT64),
+]
+
+
+class TaskService:
+    def __init__(self, engine):
+        self.engine = engine
+        self.executors: Dict[str, Callable] = {
+            "checkpoint": lambda eng, arg: eng.checkpoint(),
+        }
+        self._tasks: Dict[int, dict] = {}
+        self._next_id = 1
+        self._lock = threading.Lock()
+        self._persist_lock = threading.Lock()   # serializes table writes
+        self._last_gid: Dict[int, int] = {}     # task_id -> latest row gid
+        self._runner: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._ensure_table()
+        self._load()
+
+    # ------------------------------------------------------------ storage
+    def _ensure_table(self):
+        from matrixone_tpu.storage.engine import TableMeta
+        if TASK_TABLE not in self.engine.tables:
+            # WAL-logged (unlike trace): tasks must survive restart
+            self.engine.create_table(
+                TableMeta(TASK_TABLE, list(_SCHEMA), ["task_id"]),
+                if_not_exists=True)
+
+    def _load(self):
+        """Rehydrate pending/cron tasks after restart (replay catch-up)."""
+        t = self.engine.tables.get(TASK_TABLE)
+        if t is None:
+            return
+        latest: Dict[int, dict] = {}
+        dead = set(t._dead_gids(None, None).tolist())
+        for seg in t.segments:
+            for i in range(seg.n_rows):
+                gid = seg.base_gid + i
+                if gid in dead:
+                    continue
+                row = {c: seg.arrays[c][i] for c, _ in _SCHEMA}
+                tid = int(row["task_id"])
+                self._last_gid[tid] = gid
+                d = t.dicts
+                latest[tid] = {
+                    "task_id": tid,
+                    "name": d["name"][int(row["name"])],
+                    "executor": d["executor"][int(row["executor"])],
+                    "arg": d["arg"][int(row["arg"])],
+                    "interval_s": float(row["interval_s"]),
+                    "next_run": float(row["next_run"]),
+                    "status": d["status"][int(row["status"])],
+                    "last_error": d["last_error"][int(row["last_error"])],
+                    "runs": int(row["runs"]),
+                }
+        with self._lock:
+            for tid, task in latest.items():
+                if task["status"] in ("pending", "running"):
+                    task["status"] = "pending"   # running at crash -> retry
+                    self._tasks[tid] = task
+                self._next_id = max(self._next_id, tid + 1)
+
+    def _persist(self, task: dict):
+        t = self.engine.get_table(TASK_TABLE)
+        arrays = {
+            "task_id": np.asarray([task["task_id"]], np.int64),
+            "interval_s": np.asarray([task["interval_s"]], np.float64),
+            "next_run": np.asarray([task["next_run"]], np.float64),
+            "runs": np.asarray([task["runs"]], np.int64),
+        }
+        for c in ("name", "executor", "arg", "status", "last_error"):
+            arrays[c] = t.encode_strings_list(c, [task[c] or ""])
+        validity = {c: np.ones(1, np.bool_) for c in arrays}
+        # through the commit pipeline: durable via WAL (tasks are
+        # low-frequency; the per-update commit cost is fine). The previous
+        # version row is tombstoned in the same commit so the table stays
+        # one-row-per-task (no unbounded growth); only this service writes
+        # TASK_TABLE, serialized by _persist_lock, so next_gid-1 after the
+        # commit is exactly our new row.
+        with self._persist_lock:
+            tid = task["task_id"]
+            prev = self._last_gid.get(tid)
+            deletes = {TASK_TABLE: np.asarray([prev], np.int64)} \
+                if prev is not None else {}
+            self.engine.commit_txn(None, {TASK_TABLE: [(arrays, validity)]},
+                                   deletes)
+            self._last_gid[tid] = t.next_gid - 1
+
+    # --------------------------------------------------------------- api
+    def register(self, executor_name: str, fn: Callable) -> None:
+        self.executors[executor_name] = fn
+
+    def submit(self, name: str, executor: str, arg: str = "",
+               interval_s: float = 0.0, delay_s: float = 0.0) -> int:
+        if executor not in self.executors:
+            raise ValueError(f"unknown executor {executor!r}")
+        with self._lock:
+            tid = self._next_id
+            self._next_id += 1
+        task = {"task_id": tid, "name": name, "executor": executor,
+                "arg": arg, "interval_s": float(interval_s),
+                "next_run": time.time() + delay_s, "status": "pending",
+                "last_error": "", "runs": 0}
+        self._persist(task)          # durable BEFORE the runner can claim
+        with self._lock:
+            self._tasks[tid] = task
+        return tid
+
+    def cancel(self, task_id: int) -> None:
+        with self._lock:
+            task = self._tasks.pop(task_id, None)
+        if task is not None:
+            task["status"] = "done"
+            self._persist(task)
+
+    def status(self, task_id: int) -> Optional[dict]:
+        with self._lock:
+            t = self._tasks.get(task_id)
+            return dict(t) if t else None
+
+    # ------------------------------------------------------------- runner
+    def start(self, poll_s: float = 0.05) -> "TaskService":
+        if self._runner is not None:
+            return self
+        self._stop.clear()
+        self._runner = threading.Thread(
+            target=self._run_loop, args=(poll_s,), daemon=True)
+        self._runner.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._runner is not None:
+            self._runner.join(timeout=5)
+            self._runner = None
+
+    def _run_loop(self, poll_s: float):
+        while not self._stop.is_set():
+            now = time.time()
+            due = []
+            with self._lock:
+                for t in self._tasks.values():
+                    if t["status"] == "pending" and t["next_run"] <= now \
+                            and t["executor"] in self.executors:
+                        # unknown executor: stay pending until register()
+                        # reconnects it (replay contract)
+                        t["status"] = "running"
+                        due.append(t)
+            for t in due:
+                fn = self.executors.get(t["executor"])
+                try:
+                    fn(self.engine, t["arg"])
+                    t["last_error"] = ""
+                    ok = True
+                except Exception as e:     # noqa: BLE001 — task isolation
+                    t["last_error"] = f"{type(e).__name__}: {e}"[:512]
+                    ok = False
+                t["runs"] += 1
+                with self._lock:
+                    cancelled = t["task_id"] not in self._tasks
+                    if cancelled:
+                        t["status"] = "done"      # cancel() won the race
+                    elif t["interval_s"] > 0:
+                        t["status"] = "pending"
+                        t["next_run"] = time.time() + t["interval_s"]
+                    else:
+                        t["status"] = "done" if ok else "failed"
+                        self._tasks.pop(t["task_id"], None)
+                self._persist(t)
+            self._stop.wait(poll_s)

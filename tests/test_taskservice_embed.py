@@ -1,0 +1,146 @@
+"""Task service + embedded cluster (reference analogues: pkg/taskservice
+tests, pkg/embed cluster tests)."""
+
+import time
+
+import pytest
+
+from matrixone_tpu.embed import Cluster
+from matrixone_tpu.frontend import Session
+from matrixone_tpu.storage.engine import Engine
+from matrixone_tpu.storage.fileservice import MemoryFS
+from matrixone_tpu.taskservice import TaskService
+
+
+def test_one_shot_and_cron_tasks():
+    eng = Engine()
+    ts = TaskService(eng)
+    hits = []
+    ts.register("probe", lambda e, arg: hits.append(arg))
+    ts.start(poll_s=0.02)
+    try:
+        tid = ts.submit("once", "probe", arg="x")
+        t0 = time.time()
+        while ts.status(tid) is not None and time.time() - t0 < 5:
+            time.sleep(0.02)
+        assert hits == ["x"]
+        tid2 = ts.submit("cron", "probe", arg="c", interval_s=0.05)
+        time.sleep(0.3)
+        assert hits.count("c") >= 3            # repeated
+        ts.cancel(tid2)
+        n = hits.count("c")
+        time.sleep(0.15)
+        assert hits.count("c") <= n + 1        # stopped (one may be in flight)
+    finally:
+        ts.stop()
+
+
+def test_failed_task_records_error():
+    eng = Engine()
+    ts = TaskService(eng)
+    ts.register("boom", lambda e, arg: 1 / 0)
+    ts.start(poll_s=0.02)
+    try:
+        ts.submit("bad", "boom")
+        time.sleep(0.3)
+    finally:
+        ts.stop()
+    s = Session(catalog=eng)
+    rows = s.execute("""select status, last_error from system_async_task
+                        order by runs desc""").rows()
+    assert any(r[0] == "failed" and "ZeroDivisionError" in r[1]
+               for r in rows)
+
+
+def test_tasks_survive_restart():
+    fs = MemoryFS()
+    eng = Engine(fs)
+    ts = TaskService(eng)
+    ts.register("noop", lambda e, a: None)
+    ts.submit("later", "noop", delay_s=3600)   # pending, not yet due
+    # "crash" and reopen
+    eng2 = Engine.open(fs)
+    ts2 = TaskService(eng2)
+    pending = [t for t in ts2._tasks.values() if t["status"] == "pending"]
+    assert any(t["name"] == "later" for t in pending)
+
+
+def test_embedded_cluster_end_to_end():
+    with Cluster(n_sessions=2, checkpoint_interval_s=0.2) as c:
+        c.session(0).execute("create table t (a bigint)")
+        c.session(0).execute("insert into t values (1), (2)")
+        assert c.session(1).execute("select count(*) from t").rows() == [(2,)]
+        conn = c.connect()
+        _, rows = conn.query("select sum(a) from t")
+        assert rows == [("3",)]
+        conn.close()
+        # auto-checkpoint task fires
+        time.sleep(0.5)
+        assert c.engine.fs.exists("meta/manifest.json")
+
+
+def test_embedded_cluster_restart_from_disk(tmp_path):
+    d = str(tmp_path / "clu")
+    c1 = Cluster(n_sessions=1, data_dir=d, wire=False)
+    c1.session().execute("create table t (a bigint)")
+    c1.session().execute("insert into t values (7)")
+    c1.checkpoint()
+    c1.close()
+    c2 = Cluster(n_sessions=1, data_dir=d, wire=False)
+    assert c2.session().execute("select a from t").rows() == [(7,)]
+    c2.close()
+
+
+def test_task_table_stays_bounded_and_cancel_wins():
+    eng = Engine()
+    ts = TaskService(eng)
+    ts.register("noop", lambda e, a: None)
+    ts.start(poll_s=0.01)
+    try:
+        tid = ts.submit("cron", "noop", interval_s=0.02)
+        time.sleep(0.3)
+        ts.cancel(tid)
+        time.sleep(0.1)
+    finally:
+        ts.stop()
+    # one live row per task despite many status transitions
+    t = eng.get_table("system_async_task")
+    assert t.n_rows <= 2, t.n_rows
+    # restart: the cancelled cron must NOT resurrect
+    ts2 = TaskService(eng)
+    assert not any(x["name"] == "cron" for x in ts2._tasks.values())
+
+
+def test_unknown_executor_waits_for_registration():
+    fs = MemoryFS()
+    eng = Engine(fs)
+    TaskService(eng).submit("later", "custom_exec",
+                            delay_s=0) if False else None
+    ts0 = TaskService(eng)
+    ts0.register("custom_exec", lambda e, a: None)
+    ts0.submit("later", "custom_exec")
+    eng2 = Engine.open(fs)
+    hits = []
+    ts2 = TaskService(eng2)          # executor not registered yet
+    ts2.start(poll_s=0.01)
+    try:
+        time.sleep(0.1)
+        st = [t["status"] for t in ts2._tasks.values()]
+        assert st == ["pending"]     # waiting, not failed
+        ts2.register("custom_exec", lambda e, a: hits.append(1))
+        t0 = time.time()
+        while not hits and time.time() - t0 < 5:
+            time.sleep(0.02)
+        assert hits == [1]
+    finally:
+        ts2.stop()
+
+
+def test_cluster_restart_no_duplicate_checkpoint_task(tmp_path):
+    d = str(tmp_path / "c")
+    c1 = Cluster(data_dir=d, wire=False, checkpoint_interval_s=100)
+    c1.close()
+    c2 = Cluster(data_dir=d, wire=False, checkpoint_interval_s=100)
+    names = [t["name"] for t in c2.tasks._tasks.values()]
+    assert names.count("auto-checkpoint") == 1
+    c2.close()
