@@ -373,7 +373,7 @@ class Session:
     def _create_index(self, stmt: ast.CreateIndex) -> Result:
         table = self.catalog.get_table(stmt.table)
         algo = (stmt.using or "").lower()
-        if algo in ("ivfflat", "ivf_flat", "ivfpq", "ivf_pq"):
+        if algo in ("ivfflat", "ivf_flat", "ivfpq", "ivf_pq", "hnsw"):
             col = stmt.columns[0]
             coltype = dict(table.meta.schema)[col]
             if not coltype.is_vector:
@@ -382,15 +382,18 @@ class Session:
             op_type = stmt.options.get("op_type", "vector_l2_ops")
             metric = {"vector_l2_ops": "l2", "vector_cosine_ops": "cosine",
                       "vector_ip_ops": "ip"}.get(op_type, "l2")
-            algo_name = "ivfpq" if "pq" in algo else "ivfflat"
+            algo_name = ("hnsw" if algo == "hnsw"
+                         else "ivfpq" if "pq" in algo else "ivfflat")
             if algo_name == "ivfpq" and metric == "ip":
                 raise BindError(
                     "ivfpq does not support vector_ip_ops; use ivfflat")
+            build_fn = (indexing.build_hnsw if algo_name == "hnsw"
+                        else indexing.build_ivfflat)
             meta = IndexMeta(stmt.name, stmt.table, stmt.columns, algo_name,
                              dict(stmt.options), dirty=True)
             meta.options["_metric"] = metric
             try:
-                indexing.build_ivfflat(self.catalog, meta)
+                build_fn(self.catalog, meta)
             except ValueError as e:
                 raise BindError(str(e))
             self.catalog.indexes[stmt.name] = meta

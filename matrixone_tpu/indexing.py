@@ -36,6 +36,19 @@ def build_ivfflat(engine: Engine, ix: IndexMeta) -> None:
     ix.dirty = False
 
 
+def build_hnsw(engine: Engine, ix: IndexMeta) -> None:
+    from matrixone_tpu.vectorindex import hnsw
+    table = engine.get_table(ix.table)
+    data, gids = table.read_column_f32(ix.columns[0])
+    m = int(ix.options.get("m", 16))
+    ef_c = int(ix.options.get("ef_construction", 64))
+    metric = ix.options.get("_metric", "l2")
+    ix.index_obj = hnsw.build(np.asarray(data), M=m, ef_construction=ef_c,
+                              metric=metric)
+    ix.options["_row_gids"] = gids
+    ix.dirty = False
+
+
 def _pick_subspaces(d: int) -> int:
     """Largest divisor of d with subspace width >= 4, capped at d//4."""
     for m in (96, 64, 48, 32, 24, 16, 12, 8, 6, 4, 2, 1):
@@ -74,5 +87,7 @@ def refresh_if_dirty(engine: Engine, ix: IndexMeta) -> None:
             return
         if ix.algo in ("ivfflat", "ivfpq"):
             build_ivfflat(engine, ix)
+        elif ix.algo == "hnsw":
+            build_hnsw(engine, ix)
         elif ix.algo == "fulltext":
             build_fulltext(engine, ix)

@@ -70,7 +70,8 @@ def apply_indices(node: P.PlanNode, catalog, nprobe: int = 8,
     raw_col = col_e.name.split(".")[-1]
     metric = _DIST_METRIC[dist.op]
     for ix in catalog.indexes_on(scan.table):
-        if ix.algo in ("ivfflat", "ivfpq") and ix.columns[0] == raw_col \
+        if ix.algo in ("ivfflat", "ivfpq", "hnsw") \
+                and ix.columns[0] == raw_col \
                 and ix.options.get("_metric", "l2") == metric:
             # PQ candidates need a deeper pool: the exact re-rank above
             # (Project recompute + TopK) recovers ADC quantization loss

@@ -412,6 +412,7 @@ class Engine:
         self.committed_ts = self.hlc.now()
         from matrixone_tpu.lockservice import LockService
         self.locks = LockService()     # pessimistic mode (pkg/lockservice)
+        self.active_txns = 0           # open explicit txns (merge guard)
 
     # ----------------------------------------------------------- catalog
     def create_table(self, meta: TableMeta, if_not_exists=False,
@@ -583,6 +584,58 @@ class Engine:
             self.committed_ts = commit_ts
             M.txn_commits.inc(outcome="ok")
             return affected
+
+    # ---------------------------------------------------------- compaction
+    def merge_table(self, name: str, min_segments: int = 2,
+                    checkpoint: bool = True) -> int:
+        """Background merge (reference: tae/db/merge scheduler): rewrite a
+        table's visible rows into ONE segment and tombstone nothing —
+        dead rows are physically dropped, history before the merge is
+        compacted away (like the reference's merged objects; time travel
+        to pre-merge snapshots of THIS table is truncated, same as TAE
+        after merge+GC). Returns the number of live rows kept."""
+        with self._commit_lock:
+            if self.active_txns > 0:
+                # open snapshots would see pre-merge gids/timestamps that
+                # the merge destroys — defer (the background task retries)
+                return -2
+            t = self.get_table(name)
+            if len(t.segments) < min_segments:
+                return -1
+            cols = [c for c, _ in t.meta.schema]
+            parts_a = {c: [] for c in cols}
+            parts_v = {c: [] for c in cols}
+            dead = t._dead_gids(None, None)
+            kept = 0
+            for seg in t.segments:
+                g = np.arange(seg.base_gid, seg.base_gid + seg.n_rows,
+                              dtype=np.int64)
+                keep = ~np.isin(g, dead) if len(dead) else np.ones(
+                    seg.n_rows, np.bool_)
+                if not keep.any():
+                    continue
+                for c in cols:
+                    parts_a[c].append(seg.arrays[c][keep])
+                    parts_v[c].append(seg.validity[c][keep])
+                kept += int(keep.sum())
+            merge_ts = self.hlc.now()
+            if kept:
+                arrays = {c: np.concatenate(parts_a[c]) for c in cols}
+                validity = {c: np.concatenate(parts_v[c]) for c in cols}
+                seg = t.make_segment(arrays, validity, merge_ts)
+                t.segments = [seg]
+            else:
+                t.segments = []
+            t.tombstones = []
+            self.committed_ts = max(self.committed_ts, merge_ts)
+            for ix in self.indexes_on(name):
+                ix.dirty = True       # gids changed: indexes must rebuild
+            # durability: the merged state IS the new truth — checkpoint
+            # so replay never resurrects pre-merge rows (callers merging
+            # many tables batch this: checkpoint=False + one checkpoint)
+            if checkpoint:
+                self._checkpoint_locked()
+            return kept
 
     # ------------------------------------------------- checkpoint / open
     def checkpoint(self) -> None:

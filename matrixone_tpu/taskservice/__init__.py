@@ -39,11 +39,26 @@ _SCHEMA = [
 ]
 
 
+def _merge_executor(engine, arg: str):
+    tables = [arg] if arg else [n for n in list(engine.tables)
+                                if not n.startswith("system_")]
+    merged_any = False
+    for name in tables:
+        if engine.merge_table(name, min_segments=4 if not arg else 2,
+                              checkpoint=False) > 0:
+            merged_any = True
+    if merged_any:
+        engine.checkpoint()
+
+
 class TaskService:
     def __init__(self, engine):
         self.engine = engine
         self.executors: Dict[str, Callable] = {
             "checkpoint": lambda eng, arg: eng.checkpoint(),
+            # background LSM merge (tae/db/merge): arg = table name, or
+            # empty = every user table with enough segments
+            "merge": _merge_executor,
         }
         self._tasks: Dict[int, dict] = {}
         self._next_id = 1

@@ -47,6 +47,15 @@ class TxnHandle:
         self.state = TxnState.ACTIVE
         self.workspace: Dict[str, TableWorkspace] = {}
         self._txn_id = next(_txn_counter)   # never reused (id(self) can be)
+        with engine._commit_lock:
+            engine.active_txns += 1
+        self._closed = False
+
+    def _close(self):
+        if not self._closed:
+            self._closed = True
+            with self.engine._commit_lock:
+                self.engine.active_txns -= 1
 
     def __del__(self):
         # orphan GC (reference: lockservice orphan-txn cleanup): an
@@ -54,6 +63,7 @@ class TxnHandle:
         try:
             if self.state == TxnState.ACTIVE:
                 self.engine.locks.unlock_all(self._txn_id)
+                self._close()
         except Exception:
             pass
 
@@ -109,15 +119,18 @@ class TxnHandle:
         except Exception:
             self.state = TxnState.ABORTED
             self.engine.locks.unlock_all(self.txn_id)
+            self._close()
             raise
         self.state = TxnState.COMMITTED
         self.engine.locks.unlock_all(self.txn_id)
+        self._close()
         return affected
 
     def rollback(self) -> None:
         self.workspace.clear()
         self.state = TxnState.ABORTED
         self.engine.locks.unlock_all(self.txn_id)
+        self._close()
 
 
 class TxnClient:

@@ -1,7 +1,9 @@
-from matrixone_tpu.vectorindex import (brute_force, ivf_flat, ivf_pq,
-                                       kmeans, recall)
+from matrixone_tpu.vectorindex import (brute_force, hnsw, ivf_flat,
+                                       ivf_pq, kmeans, recall)
+from matrixone_tpu.vectorindex.hnsw import HnswIndex
 from matrixone_tpu.vectorindex.ivf_flat import IvfFlatIndex, build, search
 from matrixone_tpu.vectorindex.ivf_pq import IvfPqIndex
 
-__all__ = ["brute_force", "ivf_flat", "ivf_pq", "kmeans", "recall",
-           "IvfFlatIndex", "IvfPqIndex", "build", "search"]
+__all__ = ["brute_force", "hnsw", "ivf_flat", "ivf_pq", "kmeans",
+           "recall", "HnswIndex", "IvfFlatIndex", "IvfPqIndex", "build",
+           "search"]

@@ -39,14 +39,21 @@ class VectorTopKOp(Operator):
         table = catalog.get_table(self.node.table)
 
         q = np.asarray([self.node.query_vector], dtype=np.float32)
-        nprobe = min(self.node.nprobe, index.nlist)
-        pool = nprobe * index.max_cluster_size
-        k = min(self.node.k, index.n, pool) or 1
-        search_fn = (ivf_pq.search if ix.algo == "ivfpq"
-                     else ivf_flat.search)
-        dists, pos = search_fn(index, jnp.asarray(q), k=k,
-                               nprobe=nprobe, query_chunk=1)
-        pos = np.asarray(pos)[0]
+        if ix.algo == "hnsw":
+            from matrixone_tpu.vectorindex import hnsw
+            k = min(self.node.k, index.n) or 1
+            ef = max(64, 2 * k)
+            _, pos2 = hnsw.search(index, q, k=k, ef=ef)
+            pos = pos2[0][pos2[0] >= 0]
+        else:
+            nprobe = min(self.node.nprobe, index.nlist)
+            pool = nprobe * index.max_cluster_size
+            k = min(self.node.k, index.n, pool) or 1
+            search_fn = (ivf_pq.search if ix.algo == "ivfpq"
+                         else ivf_flat.search)
+            dists, pos = search_fn(index, jnp.asarray(q), k=k,
+                                   nprobe=nprobe, query_chunk=1)
+            pos = np.asarray(pos)[0]
         gids = row_gids[pos[pos >= 0]]
         read_args = self.ctx.table_read_args(self.node.table)
         gids = table.visible_gids(

@@ -109,3 +109,27 @@ def test_cosine_index():
     rows = s.execute(f"select id, cosine_distance(emb, '{vec}') d from docs "
                      f"order by d limit 3").rows()
     assert rows[0][0] == 7 and rows[0][1] < 1e-6
+
+
+def test_hnsw_sql_index():
+    s = Session()
+    s.execute("create table hx (id bigint, e vecf32(16))")
+    rng = np.random.default_rng(8)
+    vals = rng.standard_normal((500, 16)).astype(np.float32)
+    buf = []
+    for i in range(500):
+        buf.append(f"({i}, '[{','.join(f'{x:.4f}' for x in vals[i])}]')")
+    s.execute("insert into hx values " + ",".join(buf))
+    s.execute("create index hn using hnsw on hx (e) m = 12 ef_construction = 48")
+    q = vals[42]
+    vec = "[" + ",".join(f"{x:.4f}" for x in q) + "]"
+    rows = s.execute(f"select id from hx order by l2_distance(e, '{vec}') limit 3").rows()
+    assert rows[0][0] == 42
+    # rewrite actually used
+    from matrixone_tpu.sql import plan as P
+    txt = s.execute(f"explain select id from hx order by l2_distance(e, '{vec}') limit 3").text
+    assert "VectorTopK" in txt and "hn" in txt
+    # stays correct after dml (lazy rebuild)
+    s.execute("delete from hx where id = 42")
+    rows = s.execute(f"select id from hx order by l2_distance(e, '{vec}') limit 3").rows()
+    assert 42 not in [r[0] for r in rows]

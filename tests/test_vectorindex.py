@@ -131,3 +131,30 @@ def test_ivf_pq_recall_and_memory(rng):
                                   ids50)
     r2 = recall_at_k(np.asarray(rr)[:, :10], np.asarray(truth))
     assert r2 >= 0.8, (r, r2)
+
+
+def test_hnsw_recall(rng):
+    from matrixone_tpu.vectorindex import hnsw
+    x = _clustered_data(rng, n=3000, d=24)
+    q = (x[rng.integers(0, len(x), 16)]
+         + 0.01 * rng.standard_normal((16, 24))).astype(np.float32)
+    index = hnsw.build(x, M=12, ef_construction=48)
+    d, ids = hnsw.search(index, q, k=10, ef=64)
+    padded, n = brute_force.pad_dataset(jnp.asarray(x), chunk_size=1024)
+    _, truth = brute_force.search(padded, jnp.asarray(q), k=10, n_valid=n,
+                                  chunk_size=1024)
+    r = recall_at_k(ids, np.asarray(truth))
+    assert r >= 0.9, r
+    # distances ascending, self-hit first
+    assert (np.diff(d, axis=1) >= -1e-5).all()
+    np.testing.assert_array_equal(
+        ids[:, 0], np.asarray(truth)[:, 0])
+
+
+def test_hnsw_cosine(rng):
+    from matrixone_tpu.vectorindex import hnsw
+    x = rng.standard_normal((1500, 16)).astype(np.float32)
+    q = x[:4] * 2.5           # scaled copies: cosine-nearest = themselves
+    index = hnsw.build(x, M=12, metric="cosine")
+    _, ids = hnsw.search(index, q, k=3, ef=48)
+    np.testing.assert_array_equal(ids[:, 0], np.arange(4))
