@@ -55,11 +55,18 @@ class UnaryOp(Node):
 
 
 @dataclasses.dataclass
+class WindowSpec(Node):
+    partition_by: List[Node] = dataclasses.field(default_factory=list)
+    order_by: List["OrderItem"] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
 class FuncCall(Node):
     name: str
     args: List[Node]
     distinct: bool = False
     star: bool = False       # count(*)
+    window: Optional[WindowSpec] = None   # fn(...) OVER (...)
 
 
 @dataclasses.dataclass

@@ -27,6 +27,7 @@ from matrixone_tpu.sql.expr import (AggCall, BoundCase, BoundCast, BoundCol,
                                     BoundIsNull, BoundLike, BoundLiteral)
 
 AGG_FUNCS = {"count", "sum", "avg", "min", "max"}
+WINDOW_ONLY_FUNCS = {"row_number", "rank", "dense_rank"}
 
 _TYPE_NAMES = {
     "bool": lambda a: dt.BOOL, "boolean": lambda a: dt.BOOL,
@@ -188,11 +189,14 @@ class Binder:
             if sel.having is not None:
                 raise BindError("HAVING without aggregation")
 
+        # window functions: compute as hidden columns below the projection
+        node, scope, win_map = self._bind_windows(node, scope, items,
+                                                  agg_sub)
+
         # projection
         exprs, names = [], []
         for idx, it in enumerate(items):
-            e = self._bind_post_agg(it.expr, scope, agg_sub) if agg_sub \
-                else self.bind_expr(it.expr, scope)
+            e = self._bind_item(it.expr, scope, agg_sub, win_map)
             exprs.append(e)
             names.append(it.alias or _expr_name(it.expr, idx))
         # batches are dict-keyed: disambiguate duplicate output labels
@@ -325,7 +329,8 @@ class Binder:
 
     # --------------------------------------------------------- aggregates
     def _contains_agg(self, e: ast.Node) -> bool:
-        if isinstance(e, ast.FuncCall) and e.name in AGG_FUNCS:
+        if isinstance(e, ast.FuncCall) and e.name in AGG_FUNCS \
+                and e.window is None:
             return True
         for f in dataclasses_fields_values(e):
             if isinstance(f, ast.Node) and self._contains_agg(f):
@@ -361,8 +366,19 @@ class Binder:
         agg_calls: List[ast.FuncCall] = []
 
         def collect(e):
-            if isinstance(e, ast.FuncCall) and e.name in AGG_FUNCS:
+            if isinstance(e, ast.FuncCall) and e.name in AGG_FUNCS \
+                    and e.window is None:
                 agg_calls.append(e)
+                return
+            if isinstance(e, ast.FuncCall) and e.window is not None:
+                # a windowed call is NOT a regular aggregate, but its args
+                # and OVER clause may contain ones (share-of-total queries)
+                for a in e.args:
+                    collect(a)
+                for p in e.window.partition_by:
+                    collect(p)
+                for o in e.window.order_by:
+                    collect(o.expr)
                 return
             for f in dataclasses_fields_values(e):
                 if isinstance(f, ast.Node):
@@ -394,6 +410,11 @@ class Binder:
             if a.distinct:
                 raise BindError(
                     f"{a.name}(DISTINCT ...) is not supported yet")
+            if a.name in ("min", "max") and a.args:
+                probe = self.bind_expr(a.args[0], scope)
+                if probe.dtype.is_varlen:
+                    raise BindError(
+                        f"{a.name}() over strings is not supported yet")
             if a.star or (not a.args):
                 if a.name != "count":
                     raise BindError(f"{a.name}(*) is not valid")
@@ -444,6 +465,83 @@ class Binder:
                                   lambda x: self._bind_post_agg(x, scope, agg_sub))
 
     # ------------------------------------------------------------ order by
+    def _bind_item(self, e, scope, agg_sub, win_map):
+        """Bind a select item, substituting window calls with their hidden
+        columns (win_map: id(ast node) -> BoundCol)."""
+        if isinstance(e, ast.FuncCall) and e.window is not None:
+            if win_map:
+                return win_map[id(e)]
+        import dataclasses as dc
+
+        def has_window(x):
+            if isinstance(x, ast.FuncCall) and x.window is not None:
+                return True
+            if dc.is_dataclass(x) and isinstance(x, ast.Node):
+                for f in dc.fields(x):
+                    v = getattr(x, f.name)
+                    vs = v if isinstance(v, list) else [v]
+                    for y in vs:
+                        if isinstance(y, ast.Node) and has_window(y):
+                            return True
+            return False
+        if has_window(e):
+            raise BindError(
+                "window functions may only appear as top-level select "
+                "items for now")
+        if agg_sub:
+            return self._bind_post_agg(e, scope, agg_sub)
+        return self.bind_expr(e, scope)
+
+    def _bind_windows(self, node, scope, items, agg_sub):
+        """Collect fn(...) OVER (...) calls from select items into a
+        plan.Window node; returns (node, scope, {id(ast): BoundCol})."""
+        calls = [it.expr for it in items
+                 if isinstance(it.expr, ast.FuncCall)
+                 and it.expr.window is not None]
+        if not calls:
+            return node, scope, {}
+        entries = []
+        win_map = {}
+        bind = (lambda x: self._bind_post_agg(x, scope, agg_sub)) \
+            if agg_sub else (lambda x: self.bind_expr(x, scope))
+        schema = list(node.schema)
+        for i, fc in enumerate(calls):
+            fn = fc.name
+            if fn not in AGG_FUNCS and fn not in WINDOW_ONLY_FUNCS:
+                raise BindError(f"{fn}() is not a window function")
+            if fc.distinct:
+                raise BindError(
+                    f"{fn}(DISTINCT ...) OVER (...) is not supported yet")
+            if fc.star and fn != "count":
+                raise BindError(f"{fn}(*) is not valid")
+            arg = None
+            if fn in AGG_FUNCS and not fc.star:
+                if not fc.args:
+                    raise BindError(f"{fn}() needs an argument")
+                arg = bind(fc.args[0])
+                if arg.dtype.is_varlen and fn != "count":
+                    raise BindError(
+                        f"{fn}() over strings in windows is not "
+                        f"supported yet")
+            part = [bind(p) for p in fc.window.partition_by]
+            okeys = [bind(o.expr) for o in fc.window.order_by]
+            odescs = [o.descending for o in fc.window.order_by]
+            if fn in AGG_FUNCS:
+                out_t = _agg_result_type(fn, arg.dtype) if arg is not None \
+                    else dt.INT64
+            else:
+                out_t = dt.INT64
+            out_name = f"_w{i}"
+            entries.append((fn, arg, part, okeys, odescs, out_name))
+            win_map[id(fc)] = BoundCol(out_name, out_t)
+            schema.append((out_name, out_t))
+        wnode = plan.Window(node, entries, schema)
+        new_scope = Scope()
+        new_scope.entries = list(scope.entries)
+        for name, d in schema[len(node.schema):]:
+            new_scope.add(None, name, d)
+        return wnode, new_scope, win_map
+
     def _bind_order_key(self, e, node, names, exprs, scope, agg_sub,
                         alias_map):
         if isinstance(e, ast.Literal) and e.kind == "int":

@@ -16,7 +16,7 @@ KEYWORDS = {
     "key", "if", "exists", "using", "begin", "commit", "rollback", "with",
     "union", "all", "default", "lists", "op_type", "count", "sum",
     "snapshot", "snapshots", "restore", "of", "timestamp", "avg",
-    "auto_increment",
+    "auto_increment", "over", "partition",
     "min", "max",
 }
 

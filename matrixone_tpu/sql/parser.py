@@ -596,6 +596,25 @@ class Parser:
             return self.func_or_column()
         raise ParseError(f"unexpected token {t.value!r} (pos {t.pos})")
 
+    def _maybe_over(self, fc: "ast.FuncCall") -> ast.Node:
+        if not self.accept_kw("over"):
+            return fc
+        self.expect_op("(")
+        spec = ast.WindowSpec()
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            spec.partition_by.append(self.expr())
+            while self.accept_op(","):
+                spec.partition_by.append(self.expr())
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            spec.order_by.append(self.order_item())
+            while self.accept_op(","):
+                spec.order_by.append(self.order_item())
+        self.expect_op(")")
+        fc.window = spec
+        return fc
+
     def func_or_column(self) -> ast.Node:
         name = self.ident()
         if name.lower() == "match" and self.at_op("("):
@@ -616,7 +635,8 @@ class Parser:
         if self.accept_op("("):
             if self.accept_op("*"):
                 self.expect_op(")")
-                return ast.FuncCall(name.lower(), [], star=True)
+                return self._maybe_over(
+                    ast.FuncCall(name.lower(), [], star=True))
             distinct = self.accept_kw("distinct")
             args = []
             if not self.at_op(")"):
@@ -624,7 +644,8 @@ class Parser:
                 while self.accept_op(","):
                     args.append(self.expr())
             self.expect_op(")")
-            return ast.FuncCall(name.lower(), args, distinct=distinct)
+            fc = ast.FuncCall(name.lower(), args, distinct=distinct)
+            return self._maybe_over(fc)
         if self.accept_op("."):
             col = self.ident()
             return ast.ColumnRef(col, table=name)

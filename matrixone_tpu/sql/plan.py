@@ -93,6 +93,16 @@ class Join(PlanNode):
 
 
 @dataclasses.dataclass
+class Window(PlanNode):
+    """Window functions (reference: colexec/window): each entry computes
+    one fn over (partition, order) into a new hidden column."""
+    child: PlanNode
+    # (func, arg BoundExpr|None, part_keys, ord_keys, ord_descs, out_name)
+    entries: List[tuple]
+    schema: Schema
+
+
+@dataclasses.dataclass
 class Distinct(PlanNode):
     child: PlanNode
     schema: Schema

@@ -15,6 +15,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import struct
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -90,10 +91,22 @@ def object_path(table: str, object_id: str) -> str:
 
 def write_object(fs: FileService, meta: ObjectMeta,
                  arrays: Dict[str, np.ndarray],
-                 validity: Dict[str, np.ndarray]) -> str:
-    """Serialize a segment -> fileservice; returns the path."""
+                 validity: Dict[str, np.ndarray],
+                 compress: bool = True) -> str:
+    """Serialize a segment -> fileservice; returns the path.
+
+    Block compression (reference: pkg/compress lz4): zlib level 1 over the
+    Arrow IPC body — cheap, typically 2-4x on columnar data. The header
+    records the codec so readers stay compatible with raw objects."""
     ipc = arrowio.arrays_to_ipc(arrays, validity)
-    mj = meta.to_json().encode()
+    codec = "none"
+    if compress:
+        packed = zlib.compress(ipc, level=1)
+        if len(packed) < len(ipc):
+            ipc, codec = packed, "zlib"
+    meta_json = json.loads(meta.to_json())
+    meta_json["codec"] = codec
+    mj = json.dumps(meta_json).encode()
     blob = _MAGIC + struct.pack("<I", len(mj)) + mj + ipc
     path = object_path(meta.table, meta.object_id)
     fs.write(path, blob)
@@ -108,8 +121,12 @@ def read_meta(fs: FileService, path: str) -> ObjectMeta:
 def _parse(blob: bytes) -> Tuple[ObjectMeta, bytes]:
     assert blob[:4] == _MAGIC, "bad object magic"
     (mlen,) = struct.unpack("<I", blob[4:8])
+    raw = json.loads(blob[8:8 + mlen].decode())
     meta = ObjectMeta.from_json(blob[8:8 + mlen].decode())
-    return meta, blob[8 + mlen:]
+    body = blob[8 + mlen:]
+    if raw.get("codec") == "zlib":
+        body = zlib.decompress(body)
+    return meta, body
 
 
 def read_object(fs: FileService, path: str

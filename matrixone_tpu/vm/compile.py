@@ -30,6 +30,9 @@ def compile_plan(node: P.PlanNode, ctx) -> ops.Operator:
         return ops.TopKOp(node, compile_plan(node.child, ctx))
     if isinstance(node, P.Limit):
         return ops.LimitOp(node, compile_plan(node.child, ctx))
+    if isinstance(node, P.Window):
+        from matrixone_tpu.vm.window import WindowOp
+        return WindowOp(node, compile_plan(node.child, ctx))
     if isinstance(node, P.Distinct):
         return ops.DistinctOp(node, compile_plan(node.child, ctx))
     if isinstance(node, P.Union):

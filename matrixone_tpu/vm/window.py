@@ -1,0 +1,211 @@
+"""Window function operator (reference: pkg/sql/colexec/window).
+
+TPU formulation: materialize, assign partition ids (ops.agg.group_ids),
+sort rows by (partition, order keys), then every window function is a
+segmented scan over the sorted order:
+
+  row_number  = position since partition start
+  rank        = position of the first peer + 1
+  dense_rank  = per-partition count of peer-group starts
+  agg + ORDER = cumulative aggregate up to the LAST PEER of the row
+                (SQL default frame RANGE UNBOUNDED PRECEDING..CURRENT ROW)
+  agg alone   = whole-partition aggregate broadcast
+
+Everything is argsort + (value, segment) associative scans + gathers —
+native XLA; the reference walks per-partition accumulators in Go.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from matrixone_tpu.container import dtypes as dt
+from matrixone_tpu.container.device import DeviceBatch, DeviceColumn
+from matrixone_tpu.ops import agg as A, hash as H, sort as msort
+from matrixone_tpu.sql import plan as P
+from matrixone_tpu.vm.exprs import EvalError, ExecBatch, eval_expr
+from matrixone_tpu.vm.operators import (Operator, _broadcast_full,
+                                        _concat_batches, _sort_key_col)
+
+_BIG = jnp.int64(1) << 62
+
+
+def _seg_scan(vals: jnp.ndarray, seg: jnp.ndarray, combine):
+    """Inclusive scan of `combine` over vals, restarting at each new value
+    of the (nondecreasing) segment id."""
+
+    def fn(a, b):
+        va, sa = a
+        vb, sb = b
+        take_b = sb > sa
+        return jnp.where(take_b, vb, combine(va, vb)), jnp.maximum(sa, sb)
+
+    out, _ = jax.lax.associative_scan(fn, (vals, seg))
+    return out
+
+
+def _suffix_min(vals: jnp.ndarray) -> jnp.ndarray:
+    """suffix_min[i] = min(vals[i:])."""
+    return jnp.flip(jax.lax.associative_scan(jnp.minimum, jnp.flip(vals)))
+
+
+class WindowOp(Operator):
+    def __init__(self, node: P.Window, child: Operator,
+                 max_partitions: int = 65536):
+        self.node = node
+        self.child = child
+        self.schema = node.schema
+        self.max_partitions = max_partitions
+
+    def execute(self) -> Iterator[ExecBatch]:
+        batches = list(self.child.execute())
+        if not batches:
+            return
+        ex = _concat_batches(batches, self.node.child.schema)
+        out_cols = dict(ex.batch.columns)
+        # entries sharing one OVER spec share the sort/segment machinery
+        spec_cache = {}
+        for (fn, arg, part, okeys, odescs, out_name) in self.node.entries:
+            from matrixone_tpu.sql.serde import expr_to_json
+            key = (tuple(repr(expr_to_json(p)) for p in part),
+                   tuple(repr(expr_to_json(k)) for k in okeys),
+                   tuple(odescs))
+            if key not in spec_cache:
+                spec_cache[key] = self._spec(part, okeys, odescs, ex)
+            out_cols[out_name] = self._compute(fn, arg, spec_cache[key], ex)
+        db = DeviceBatch(columns=out_cols, n_rows=ex.batch.n_rows)
+        yield ExecBatch(batch=db, dicts=ex.dicts, mask=ex.mask)
+
+    # ------------------------------------------------------------ kernels
+    def _spec(self, part, okeys, odescs, ex):
+        """Sort + segment machinery shared by every fn over one OVER spec."""
+        n = ex.padded_len
+        mask = ex.mask
+        if part:
+            cols = [_broadcast_full(eval_expr(p, ex), n) for p in part]
+            gi = A.group_ids([c.data for c in cols],
+                             [c.validity for c in cols], mask,
+                             self.max_partitions)
+            pid = gi.gids
+        else:
+            pid = jnp.zeros((n,), jnp.int32)
+
+        ocols = [_sort_key_col(k, ex) for k in okeys]
+        order = msort.sort_indices(
+            [pid] + [c.data for c in ocols],
+            [None] + [c.validity for c in ocols],
+            [False] + list(odescs), mask)
+        pid_s = pid[order]
+        mask_s = mask[order]
+        idx = jnp.arange(n, dtype=jnp.int64)
+        first = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                                 (pid_s[1:] != pid_s[:-1])
+                                 | (mask_s[1:] != mask_s[:-1])])
+        seg = jnp.cumsum(first.astype(jnp.int64))          # partition seq no
+
+        # position within partition (0-based): idx - partition start
+        start_idx = _seg_scan(jnp.where(first, idx, 0), seg, jnp.maximum)
+        pos = idx - start_idx
+
+        if ocols:
+            okey_hash = H.hash_columns(
+                [c.data[order] for c in ocols],
+                [None if c.validity is None else c.validity[order]
+                 for c in ocols])
+            new_peer = jnp.concatenate(
+                [jnp.ones((1,), jnp.bool_),
+                 (okey_hash[1:] != okey_hash[:-1])]) | first
+        else:
+            new_peer = first
+
+        # last row index of each peer group: next peer start - 1 (or the
+        # partition/array end)
+        nb = jnp.concatenate([jnp.where(new_peer, idx, _BIG)[1:],
+                              jnp.asarray([_BIG])])
+        next_peer_start = _suffix_min(nb)
+        part_nb = jnp.concatenate([jnp.where(first, idx, _BIG)[1:],
+                                   jnp.asarray([_BIG])])
+        next_part_start = _suffix_min(part_nb)
+        peer_end = jnp.minimum(jnp.where(next_peer_start == _BIG,
+                                         n - 1, next_peer_start - 1),
+                               jnp.where(next_part_start == _BIG,
+                                         n - 1, next_part_start - 1))
+        part_end = jnp.where(next_part_start == _BIG, n - 1,
+                             next_part_start - 1)
+        return {"order": order, "seg": seg, "first": first, "pos": pos,
+                "new_peer": new_peer, "peer_end": peer_end,
+                "part_end": part_end, "mask_s": mask_s,
+                "has_order": bool(ocols)}
+
+    def _compute(self, fn, arg, spec, ex) -> DeviceColumn:
+        n = ex.padded_len
+        order = spec["order"]
+        seg = spec["seg"]
+        pos = spec["pos"]
+        new_peer = spec["new_peer"]
+        mask_s = spec["mask_s"]
+
+        if fn == "row_number":
+            vals_s, out_t = pos + 1, dt.INT64
+        elif fn == "rank":
+            vals_s = _seg_scan(jnp.where(new_peer, pos + 1, 0), seg,
+                               jnp.maximum)
+            out_t = dt.INT64
+        elif fn == "dense_rank":
+            vals_s = _seg_scan(new_peer.astype(jnp.int64), seg, jnp.add)
+            out_t = dt.INT64
+        else:
+            take_at = spec["peer_end"] if spec["has_order"] \
+                else spec["part_end"]
+            vals_s, frame_valid, out_t = self._agg_window(
+                fn, arg, ex, order, seg, mask_s, take_at)
+            out = jnp.zeros((n,), vals_s.dtype).at[order].set(vals_s)
+            valid = jnp.zeros((n,), jnp.bool_).at[order].set(
+                mask_s & frame_valid)
+            return DeviceColumn(out, valid, out_t)
+
+        out = jnp.zeros((n,), vals_s.dtype).at[order].set(vals_s)
+        valid = jnp.zeros((n,), jnp.bool_).at[order].set(mask_s)
+        return DeviceColumn(out, valid, out_t)
+
+    def _agg_window(self, fn, arg, ex, order, seg, mask_s, take_at):
+        n = ex.padded_len
+        if arg is not None:
+            col = _broadcast_full(eval_expr(arg, ex), n)
+            v_s = col.data[order]
+            valid_s = col.validity[order] & mask_s
+        else:                         # count(*)
+            v_s = jnp.ones((n,), jnp.int64)
+            valid_s = mask_s
+
+        if fn in ("sum", "avg", "count"):
+            x = valid_s.astype(jnp.int64) if fn == "count" \
+                else jnp.where(valid_s, v_s, 0)
+            csum = _seg_scan(x, seg, jnp.add)[take_at]
+            cnt = _seg_scan(valid_s.astype(jnp.int64), seg, jnp.add)[take_at]
+            if fn == "count":
+                return cnt, jnp.ones_like(cnt, jnp.bool_), dt.INT64
+            # an all-NULL frame yields SQL NULL, not the identity element
+            frame_valid = cnt > 0
+            if fn == "avg":
+                cs = csum.astype(jnp.float64)
+                if arg is not None and arg.dtype.oid == dt.TypeOid.DECIMAL64:
+                    cs = cs / (10.0 ** arg.dtype.scale)
+                return cs / jnp.maximum(cnt, 1), frame_valid, dt.FLOAT64
+            out_t = (arg.dtype if arg.dtype.oid == dt.TypeOid.DECIMAL64
+                     else dt.INT64 if arg.dtype.is_integer else dt.FLOAT64)
+            return csum.astype(out_t.jnp_dtype), frame_valid, out_t
+        if fn in ("min", "max"):
+            fill = jnp.asarray(A._reduce_fill(v_s.dtype, fn == "min"),
+                               v_s.dtype)
+            x = jnp.where(valid_s, v_s, fill)
+            comb = jnp.minimum if fn == "min" else jnp.maximum
+            vals = _seg_scan(x, seg, comb)[take_at]
+            cnt = _seg_scan(valid_s.astype(jnp.int64), seg, jnp.add)[take_at]
+            return vals, cnt > 0, (arg.dtype if arg is not None
+                                   else dt.INT64)
+        raise EvalError(f"unsupported window function {fn}")

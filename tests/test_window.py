@@ -1,0 +1,138 @@
+"""Window functions vs pandas oracle (reference: colexec/window BVT)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from matrixone_tpu.frontend import Session
+
+
+@pytest.fixture(scope="module")
+def wsess(rng=np.random.default_rng(13)):
+    s = Session()
+    s.execute("create table t (g varchar(2), v bigint, p decimal(8,2))")
+    g = rng.choice(list("abcd"), 200)
+    v = rng.integers(0, 40, 200)   # plenty of ties
+    p = np.round(rng.uniform(0, 100, 200), 2)
+    rows = ", ".join(f"('{g[i]}', {v[i]}, {p[i]})" for i in range(200))
+    s.execute("insert into t values " + rows)
+    df = pd.DataFrame({"g": g, "v": v, "p": p})
+    return s, df
+
+
+def _sorted_rows(rows):
+    return sorted(rows)
+
+
+def test_ranking_functions(wsess):
+    s, df = wsess
+    got = s.execute("""select g, v,
+        row_number() over (partition by g order by v) rn,
+        rank() over (partition by g order by v) rk,
+        dense_rank() over (partition by g order by v) dr
+        from t order by g, v, rn""").rows()
+    d = df.sort_values(["g", "v"]).copy()
+    d["rn"] = d.groupby("g").cumcount() + 1
+    d["rk"] = d.groupby("g")["v"].rank(method="min").astype(int)
+    d["dr"] = d.groupby("g")["v"].rank(method="dense").astype(int)
+    exp = list(d[["g", "v", "rn", "rk", "dr"]].itertuples(index=False,
+                                                          name=None))
+    assert got == exp
+
+
+def test_cumulative_sum_range_peers(wsess):
+    s, df = wsess
+    got = s.execute("""select g, v,
+        sum(v) over (partition by g order by v) cs
+        from t order by g, v""").rows()
+    d = df.sort_values(["g", "v"]).copy()
+    # RANGE frame: peers share the cumulative value of the last peer
+    d["cs"] = d.groupby("g")["v"].cumsum()
+    d["cs"] = d.groupby(["g", "v"])["cs"].transform("max")
+    exp = list(d[["g", "v", "cs"]].itertuples(index=False, name=None))
+    assert sorted(got) == sorted(exp)
+
+
+def test_partition_totals_and_counts(wsess):
+    s, df = wsess
+    got = s.execute("""select g, sum(v) over (partition by g) t,
+        count(*) over (partition by g) c from t order by g limit 4""").rows()
+    sums = df.groupby("g")["v"].sum()
+    counts = df.groupby("g")["v"].count()
+    for g_, t_, c_ in got:
+        assert t_ == sums[g_] and c_ == counts[g_]
+
+
+def test_running_min_max_and_avg(wsess):
+    s, df = wsess
+    got = s.execute("""select g, v,
+        min(v) over (partition by g order by v) mn,
+        max(v) over (partition by g order by v) mx,
+        avg(v) over (partition by g order by v) av
+        from t order by g, v""").rows()
+    d = df.sort_values(["g", "v"]).copy()
+    d["mn"] = d.groupby("g")["v"].cummin()
+    d["mx"] = d.groupby("g")["v"].cummax()
+    d["cs"] = d.groupby("g")["v"].cumsum()
+    d["cn"] = d.groupby("g").cumcount() + 1
+    d["av"] = d["cs"] / d["cn"]
+    for c in ("mn", "mx", "av"):
+        d[c] = d.groupby(["g", "v"])[c].transform(
+            "max" if c != "mn" else "min")
+    # avg peers share last-peer value
+    d["av"] = d.groupby(["g", "v"])["cs"].transform("max") / \
+        d.groupby(["g", "v"])["cn"].transform("max")
+    exp = {(r[0], r[1]): (r[2], r[3], round(r[4], 9))
+           for r in d[["g", "v", "mn", "mx", "av"]].itertuples(
+               index=False, name=None)}
+    for g_, v_, mn, mx, av in got:
+        emn, emx, eav = exp[(g_, v_)]
+        assert mn == emn and mx == emx and abs(av - eav) < 1e-9
+
+
+def test_window_without_partition(wsess):
+    s, df = wsess
+    got = s.execute("select v, row_number() over (order by v) rn "
+                    "from t order by v, rn limit 3").rows()
+    assert [r[1] for r in got] == [1, 2, 3]
+
+
+def test_window_error_paths(wsess):
+    s, _ = wsess
+    with pytest.raises(Exception, match="not a window function"):
+        s.execute("select upper(g) over (partition by g) from t")
+    with pytest.raises(Exception, match="top-level"):
+        s.execute("select 1 + row_number() over (order by v) from t")
+
+
+def test_window_all_null_frame_yields_null():
+    s = Session()
+    s.execute("create table n (g varchar(2), v bigint)")
+    s.execute("insert into n values ('a', null), ('a', null), ('b', 1)")
+    rows = s.execute("""select g, sum(v) over (partition by g) sv,
+        min(v) over (partition by g) mv from n order by g""").rows()
+    assert rows[0] == ("a", None, None)
+    assert rows[2] == ("b", 1, 1)
+
+
+def test_window_over_group_by():
+    s = Session()
+    s.execute("create table t (g varchar(2), v bigint)")
+    s.execute("insert into t values ('a',1),('a',2),('b',10),('b',20),('c',3)")
+    rows = s.execute("""select g, sum(v) s,
+        rank() over (order by sum(v) desc) rk
+        from t group by g order by rk""").rows()
+    assert rows == [("b", 30, 1), ("c", 3, 2), ("a", 3, 2)] or \
+           rows == [("b", 30, 1), ("a", 3, 2), ("c", 3, 2)]
+
+
+def test_window_invalid_forms():
+    s = Session()
+    s.execute("create table t (g varchar(2), v bigint)")
+    s.execute("insert into t values ('a', 1)")
+    with pytest.raises(Exception, match=r"sum\(\*\)"):
+        s.execute("select sum(*) over (partition by g) from t")
+    with pytest.raises(Exception, match="DISTINCT"):
+        s.execute("select count(distinct v) over (partition by g) from t")
+    with pytest.raises(Exception, match="strings"):
+        s.execute("select min(g) over (partition by v) from t")
