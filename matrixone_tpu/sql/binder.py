@@ -514,6 +514,8 @@ class Binder:
                     f"{fn}(DISTINCT ...) OVER (...) is not supported yet")
             if fc.star and fn != "count":
                 raise BindError(f"{fn}(*) is not valid")
+            if fn in WINDOW_ONLY_FUNCS and (fc.args or fc.star):
+                raise BindError(f"{fn}() takes no arguments")
             arg = None
             if fn in AGG_FUNCS and not fc.star:
                 if not fc.args:

@@ -114,16 +114,27 @@ def write_object(fs: FileService, meta: ObjectMeta,
 
 
 def read_meta(fs: FileService, path: str) -> ObjectMeta:
+    """Header-only read: never touches (or decompresses) the column body —
+    this is the zonemap-prune fast path."""
     blob = fs.read(path)
-    return _parse(blob)[0]
+    meta, _raw, _body = _parse_header(blob)
+    return meta
 
 
-def _parse(blob: bytes) -> Tuple[ObjectMeta, bytes]:
+def _parse_header(blob: bytes):
     assert blob[:4] == _MAGIC, "bad object magic"
     (mlen,) = struct.unpack("<I", blob[4:8])
     raw = json.loads(blob[8:8 + mlen].decode())
-    meta = ObjectMeta.from_json(blob[8:8 + mlen].decode())
-    body = blob[8 + mlen:]
+    zm = {c: ZoneMap(v[0], v[1], v[2])
+          for c, v in raw.get("zonemaps", {}).items()}
+    meta = ObjectMeta(table=raw["table"], object_id=raw["object_id"],
+                      n_rows=raw["n_rows"], commit_ts=raw["commit_ts"],
+                      zonemaps=zm, kind=raw.get("kind", "data"))
+    return meta, raw, blob[8 + mlen:]
+
+
+def _parse(blob: bytes) -> Tuple[ObjectMeta, bytes]:
+    meta, raw, body = _parse_header(blob)
     if raw.get("codec") == "zlib":
         body = zlib.decompress(body)
     return meta, body

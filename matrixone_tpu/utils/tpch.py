@@ -258,3 +258,88 @@ def ssb_q1_oracle(lo, dates, q: str) -> int:
              & (lo["lo_quantity"] >= 26) & (lo["lo_quantity"] <= 35))
     return int((lo["lo_extendedprice"][m].astype(object)
                 * lo["lo_discount"][m]).sum())
+
+
+# ------------------------------------------------------------- TPC-H Q3
+
+CUSTOMER_SCHEMA = [
+    ("c_custkey", dt.INT64),
+    ("c_mktsegment", dt.varchar(10)),
+]
+
+ORDERS_SCHEMA = [
+    ("o_orderkey", dt.INT64),
+    ("o_custkey", dt.INT64),
+    ("o_orderdate", dt.DATE),
+    ("o_shippriority", dt.INT32),
+]
+
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
+
+
+def load_tpch_q3(catalog: Catalog, n_orders: int, seed: int = 0):
+    """customer + orders shaped for Q3 (lineitem reuses load_lineitem)."""
+    rng = np.random.default_rng(seed)
+    n_cust = max(n_orders // 10, 5)
+    seg_codes = rng.integers(0, len(SEGMENTS), n_cust).astype(np.int32)
+    catalog.create_table(TableMeta("customer", CUSTOMER_SCHEMA,
+                                   ["c_custkey"]), if_not_exists=True)
+    catalog.get_table("customer").insert_numpy(
+        {"c_custkey": np.arange(1, n_cust + 1, dtype=np.int64)},
+        strings={"c_mktsegment": (seg_codes, SEGMENTS)})
+    odate = rng.integers(_days(1992, 1, 1), _days(1998, 8, 3),
+                         n_orders).astype(np.int32)
+    orders = {
+        "o_orderkey": np.arange(1, n_orders + 1, dtype=np.int64),
+        "o_custkey": rng.integers(1, n_cust + 1, n_orders).astype(np.int64),
+        "o_orderdate": odate,
+        "o_shippriority": np.zeros(n_orders, np.int32),
+    }
+    catalog.create_table(TableMeta("orders", ORDERS_SCHEMA, ["o_orderkey"]),
+                         if_not_exists=True)
+    catalog.get_table("orders").insert_numpy(orders)
+    return {"seg_codes": seg_codes, "orders": orders}
+
+
+Q3_SQL = """
+select l_orderkey,
+       sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer
+join orders on c_custkey = o_custkey
+join lineitem on l_orderkey = o_orderkey
+where c_mktsegment = 'BUILDING'
+  and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate
+limit 10
+"""
+
+
+def q3_oracle(lineitem, q3data):
+    """Exact integer-domain Q3 oracle."""
+    import numpy as _np
+    seg = q3data["seg_codes"]
+    orders = q3data["orders"]
+    building = set((_np.nonzero(seg == SEGMENTS.index("BUILDING"))[0] + 1)
+                   .tolist())
+    cutoff = _days(1995, 3, 15)
+    omask = (_np.isin(orders["o_custkey"],
+                      _np.asarray(sorted(building), _np.int64))
+             & (orders["o_orderdate"] < cutoff))
+    okeys = set(orders["o_orderkey"][omask].tolist())
+    odate = dict(zip(orders["o_orderkey"].tolist(),
+                     orders["o_orderdate"].tolist()))
+    lmask = (_np.isin(lineitem["l_orderkey"],
+                      _np.asarray(sorted(okeys), _np.int64))
+             & (lineitem["l_shipdate"] > cutoff))
+    rev = {}
+    lk = lineitem["l_orderkey"][lmask]
+    price = lineitem["l_extendedprice"][lmask].astype(object)
+    disc = lineitem["l_discount"][lmask]
+    for k, p, d_ in zip(lk.tolist(), price, disc.tolist()):
+        rev[k] = rev.get(k, 0) + p * (100 - d_)
+    rows = sorted(((v, -odate[k], k) for k, v in rev.items()),
+                  key=lambda t: (-t[0], -t[1]))[:10]
+    return [(k, v, -dneg) for v, dneg, k in rows]

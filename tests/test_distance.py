@@ -66,3 +66,17 @@ def test_hash_determinism_and_spread(rng):
     low = h1 % 16
     counts = np.bincount(low.astype(np.int64), minlength=16)
     assert counts.min() > 400
+
+
+def test_pallas_l2_matches_xla(rng):
+    from matrixone_tpu.ops import pallas_kernels as PK
+    x = rng.standard_normal((2048, 128)).astype(np.float32)
+    q = rng.standard_normal((16, 128)).astype(np.float32)
+    got = np.asarray(PK.l2_distance_sq_pallas(jnp.asarray(x), jnp.asarray(q),
+                                              tile_m=512))
+    ref = np.asarray(D.l2_distance_sq(jnp.asarray(x), jnp.asarray(q)))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    # clamped non-negative even for self-pairs
+    got2 = np.asarray(PK.l2_distance_sq_pallas(jnp.asarray(x), jnp.asarray(x[:16]),
+                                               tile_m=512))
+    assert (got2 >= 0).all()

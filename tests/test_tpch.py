@@ -91,3 +91,18 @@ def test_ssb_q1x_exact():
             assert got is None or got == 0, (q, got)
         else:
             assert got == expect, (q, got, expect)
+
+
+def test_q3_exact():
+    import datetime
+    s = Session()
+    arrays = tpch.load_lineitem(s.catalog, 20_000, seed=2)
+    q3data = tpch.load_tpch_q3(s.catalog, 4_000, seed=2)
+    got = s.execute(tpch.Q3_SQL).rows()
+    exp = tpch.q3_oracle(arrays, q3data)
+    assert len(got) == len(exp)
+    epoch = datetime.date(1970, 1, 1)
+    for g, e in zip(got, exp):
+        assert g[0] == e[0]                       # l_orderkey
+        assert round(g[1] * 10000) == e[1]        # revenue scale-4 exact
+        assert (g[2] - epoch).days == e[2]        # o_orderdate
