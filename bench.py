@@ -27,6 +27,7 @@ from matrixone_tpu.vectorindex.recall import recall_at_k
 
 SMOKE = os.environ.get("MO_BENCH_SMOKE") == "1"
 INDEX_KIND = os.environ.get("MO_BENCH_INDEX", "ivfflat")   # ivfflat | ivfpq
+METRIC = os.environ.get("MO_BENCH_METRIC", "ivf")          # ivf | q1
 N = int(os.environ.get("MO_BENCH_N", 20_000 if SMOKE else 1_000_000))
 D = int(os.environ.get("MO_BENCH_D", 64 if SMOKE else 768))
 NQ = int(os.environ.get("MO_BENCH_Q", 256 if SMOKE else 1024))
@@ -61,7 +62,41 @@ def make_data(key, n, d, n_centers=2048):
     return data, queries
 
 
+def bench_q1():
+    """TPC-H Q1 rows/sec through the full SQL engine (BASELINE config #1).
+
+    The reference publishes no first-party Q1 throughput (BASELINE.md), so
+    vs_baseline is null; the number itself is the tracked metric."""
+    from matrixone_tpu.frontend import Session
+    from matrixone_tpu.utils import tpch
+    n = int(os.environ.get("MO_BENCH_N", 100_000 if SMOKE else 6_001_215))
+    s = Session()
+    t0 = time.time()
+    arrays = tpch.load_lineitem(s.catalog, n)
+    t_load = time.time() - t0
+    oracle = tpch.q1_oracle(arrays)
+    rows = s.execute(tpch.Q1_SQL).rows()      # warm: compiles the pipeline
+    exact = tpch.q1_check(rows, oracle)
+    best = 0.0
+    for _ in range(3):
+        t0 = time.time()
+        s.execute(tpch.Q1_SQL)
+        best = max(best, n / (time.time() - t0))
+    print(json.dumps({
+        "metric": f"tpch_q1_rows_per_sec_{n}",
+        "value": round(best, 1),
+        "unit": "rows/s",
+        "vs_baseline": None,
+        "exact_vs_oracle": exact,
+        "load_seconds": round(t_load, 2),
+        "backend": jax.default_backend(),
+    }))
+
+
 def main():
+    if METRIC == "q1":
+        bench_q1()
+        return
     key = jax.random.PRNGKey(1234)
     t0 = time.time()
     data, queries = make_data(key, N, D)

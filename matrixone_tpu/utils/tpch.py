@@ -128,6 +128,36 @@ def q1_oracle(arrays: Dict[str, np.ndarray], delta_days: int = 90):
     return out
 
 
+def q1_check(rows, oracle) -> bool:
+    """Full exactness check of Q1_SQL output against q1_oracle: group count
+    and all 8 aggregate columns (exact integer domain for the sums, 1e-9
+    for the float averages). Shared by tests and bench so the column/scale
+    mapping lives in exactly one place."""
+    if len(rows) != len(oracle):
+        return False
+    for r in rows:
+        o = oracle.get((r[0], r[1]))
+        if o is None:
+            return False
+        if round(r[2] * 100) != o["sum_qty"]:
+            return False
+        if round(r[3] * 100) != o["sum_base_price"]:
+            return False
+        if round(r[4] * 10000) != o["sum_disc_price"]:
+            return False
+        if round(r[5] * 1000000) != o["sum_charge"]:
+            return False
+        if r[9] != o["count_order"]:
+            return False
+        if abs(r[6] - o["avg_qty"]) > 1e-9:
+            return False
+        if abs(r[7] - o["avg_price"]) > 1e-6:
+            return False
+        if abs(r[8] - o["avg_disc"]) > 1e-12:
+            return False
+    return True
+
+
 Q1_SQL = """
 select
     l_returnflag, l_linestatus,

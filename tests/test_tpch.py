@@ -19,20 +19,15 @@ def test_q1_exact(sess_arrays):
     s, arrays = sess_arrays
     rows = s.execute(tpch.Q1_SQL).rows()
     oracle = tpch.q1_oracle(arrays)
-    assert len(rows) == len(oracle)
     # group ordering: flag asc, status asc
     keys = [(r[0], r[1]) for r in rows]
     assert keys == sorted(keys)
-    for r in rows:
-        o = oracle[(r[0], r[1])]
-        assert round(r[2] * 100) == o["sum_qty"]
-        assert round(r[3] * 100) == o["sum_base_price"]
-        assert round(r[4] * 10000) == o["sum_disc_price"]
-        assert round(r[5] * 1000000) == o["sum_charge"]
-        assert r[9] == o["count_order"]
-        assert abs(r[6] - o["avg_qty"]) < 1e-9
-        assert abs(r[7] - o["avg_price"]) < 1e-6
-        assert abs(r[8] - o["avg_disc"]) < 1e-12
+    assert tpch.q1_check(rows, oracle)
+    # the checker itself must catch corruption
+    bad = [tuple([rows[0][0], rows[0][1], rows[0][2] + 1] + list(rows[0][3:]))] \
+        + rows[1:]
+    assert not tpch.q1_check(bad, oracle)
+    assert not tpch.q1_check(rows[:-1], oracle)
 
 
 def test_q6_exact(sess_arrays):

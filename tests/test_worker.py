@@ -62,3 +62,30 @@ def test_worker_error_surface(worker):
         worker.run({"op": "nope"})
     with pytest.raises(RuntimeError, match="worker:"):
         worker.search_index("missing_index", np.zeros((1, 4), np.float32))
+
+
+def test_group_aggregate_stage(worker):
+    from matrixone_tpu.sql.serde import agg_to_json
+    from matrixone_tpu.sql.expr import AggCall
+    from matrixone_tpu.storage import arrowio
+    n = 500
+    keys = np.arange(n) % 7
+    vals = np.arange(n, dtype=np.int64)
+    arrays = {"k": keys.astype(np.int64), "v": vals}
+    validity = {c: np.ones(n, np.bool_) for c in arrays}
+    kcol = BoundCol("k", dt.INT64)
+    vcol = BoundCol("v", dt.INT64)
+    h, b = worker.run(
+        {"op": "group_aggregate",
+         "schema": {"k": dtype_to_json(dt.INT64),
+                    "v": dtype_to_json(dt.INT64)},
+         "group_keys": [expr_to_json(kcol)],
+         "aggs": [agg_to_json(AggCall("sum", vcol, False, dt.INT64,
+                                      out_name="_agg0"))],
+         "max_groups": 64},
+        arrowio.arrays_to_ipc(arrays, validity))
+    assert h["n_groups"] == 7
+    out, _ = arrowio.ipc_to_arrays(b)
+    got = dict(zip(out["_g0"][:7].tolist(), out["_a0_sum"][:7].tolist()))
+    for g in range(7):
+        assert got[g] == int(vals[keys == g].sum())
