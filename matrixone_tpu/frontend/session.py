@@ -251,7 +251,7 @@ class Session:
         return "\n".join(render(op))
 
     # ---------------------------------------------------- subquery inlining
-    def _inline_subqueries(self, node, depth=0):
+    def _inline_subqueries(self, node, depth=0, ctes=None):
         """Execute uncorrelated subqueries once and inline the results
         (reference: the planner turns these into joins; execute-once has
         identical semantics for the uncorrelated case). Correlated
@@ -260,6 +260,8 @@ class Session:
         if depth > 8:
             raise BindError("subquery nesting too deep")
         if isinstance(node, ast.Subquery):
+            if ctes:
+                node.select.ctes = list(ctes) + list(node.select.ctes)
             r = self._select(node.select)
             rows = r.rows()
             if len(r.column_names) != 1:
@@ -272,11 +274,16 @@ class Session:
             inner_limit = (1 if node.select.limit is None
                            else min(1, node.select.limit))
             sub = dc.replace(node.select, limit=inner_limit)
+            if ctes:
+                sub.ctes = list(ctes) + list(sub.ctes)
             r = self._select(sub)
             has = len(r.rows()) > 0
             return ast.Literal(has != node.negated, "bool")
         if isinstance(node, ast.InList) and len(node.items) == 1 \
                 and isinstance(node.items[0], ast.Subquery):
+            if ctes:
+                node.items[0].select.ctes = \
+                    list(ctes) + list(node.items[0].select.ctes)
             r = self._select(node.items[0].select)
             if len(r.column_names) != 1:
                 raise BindError("IN subquery must return one column")
@@ -296,12 +303,12 @@ class Session:
                 v = getattr(node, f.name)
                 if isinstance(v, ast.Node):
                     setattr(node, f.name,
-                            self._inline_subqueries(v, depth + 1))
+                            self._inline_subqueries(v, depth + 1, ctes))
                 elif isinstance(v, list):
                     setattr(node, f.name, [
-                        self._inline_subqueries(x, depth + 1)
+                        self._inline_subqueries(x, depth + 1, ctes)
                         if isinstance(x, ast.Node) else
-                        tuple(self._inline_subqueries(y, depth + 1)
+                        tuple(self._inline_subqueries(y, depth + 1, ctes)
                               if isinstance(y, ast.Node) else y
                               for y in x) if isinstance(x, tuple) else x
                         for x in v])
@@ -316,12 +323,18 @@ class Session:
             return
         if not isinstance(sel, ast.Select):
             return
+        ctes = sel.ctes   # WITH scope is visible inside subqueries
+        for i, (_name, sub) in enumerate(ctes):
+            # a CTE body sees only EARLIER ctes
+            if isinstance(sub, ast.Select) and not sub.ctes:
+                sub.ctes = list(ctes[:i])
+            self._prepare_select(sub)
         for it in sel.items:
-            it.expr = self._inline_subqueries(it.expr)
+            it.expr = self._inline_subqueries(it.expr, ctes=ctes)
         if sel.where is not None:
-            sel.where = self._inline_subqueries(sel.where)
+            sel.where = self._inline_subqueries(sel.where, ctes=ctes)
         if sel.having is not None:
-            sel.having = self._inline_subqueries(sel.having)
+            sel.having = self._inline_subqueries(sel.having, ctes=ctes)
 
     # ------------------------------------------------------------- select
     def _select(self, sel: ast.Select) -> Result:

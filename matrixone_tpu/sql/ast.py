@@ -171,6 +171,8 @@ class Select(Node):
     limit: Optional[int] = None
     offset: Optional[int] = None
     distinct: bool = False
+    ctes: List[Tuple[str, "Select"]] = dataclasses.field(
+        default_factory=list)          # WITH name AS (select ...)
 
 
 @dataclasses.dataclass

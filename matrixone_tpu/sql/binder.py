@@ -86,6 +86,9 @@ class Scope:
 class Binder:
     def __init__(self, catalog):
         self.catalog = catalog
+        #: name -> (definition_index, body) — index enforces that a CTE
+        #: body only sees EARLIER ctes (no forward refs, standard WITH)
+        self._ctes: Dict[str, tuple] = {}
 
     def bind_statement(self, stmt) -> plan.PlanNode:
         if isinstance(stmt, ast.Union):
@@ -156,6 +159,18 @@ class Binder:
 
     # ------------------------------------------------------------- select
     def bind_select(self, sel: ast.Select) -> plan.PlanNode:
+        outer_ctes = dict(self._ctes)
+        base = len(outer_ctes)
+        for i, (name, sub) in enumerate(sel.ctes):
+            if name in self._ctes and self._ctes[name][0] >= base:
+                raise BindError(f"duplicate CTE name {name!r}")
+            self._ctes[name] = (base + i, sub)
+        try:
+            return self._bind_select_inner(sel)
+        finally:
+            self._ctes = outer_ctes
+
+    def _bind_select_inner(self, sel: ast.Select) -> plan.PlanNode:
         node, scope = self._bind_from(sel.from_)
 
         if sel.where is not None:
@@ -247,6 +262,21 @@ class Binder:
             # SELECT without FROM: single-row dual table
             sc = Scope()
             return plan.Values([[1]], [("__dual", dt.INT64)]), sc
+        if isinstance(from_, ast.TableRef) and from_.name in self._ctes:
+            if from_.snapshot is not None or from_.as_of_ts is not None:
+                raise BindError(
+                    f"cannot time-travel a CTE ({from_.name!r}); AS OF "
+                    f"applies to stored tables")
+            # CTE reference: bind the body as a derived table, visible
+            # scope = strictly earlier CTEs (non-recursive, no forward refs)
+            my_idx, sub = self._ctes[from_.name]
+            alias = from_.alias or from_.name
+            saved = self._ctes
+            self._ctes = {k: v for k, v in saved.items() if v[0] < my_idx}
+            try:
+                return self._bind_from(ast.SubqueryRef(sub, alias))
+            finally:
+                self._ctes = saved
         if isinstance(from_, ast.TableRef):
             meta = self.catalog.get_table(from_.name)
             alias = from_.alias or from_.name
@@ -265,7 +295,7 @@ class Binder:
                              as_of_ts=as_of)
             return scan, sc
         if isinstance(from_, ast.SubqueryRef):
-            child = self.bind_select(from_.select)
+            child = self.bind_statement(from_.select)
             sc = Scope()
             for col, dtype in child.schema:
                 sc.add(from_.alias, col, dtype)

@@ -93,6 +93,8 @@ class Parser:
         return out
 
     def statement(self) -> ast.Node:
+        if self.at_kw("with"):
+            return self.with_select()
         if self.at_kw("select"):
             return self.select_or_union()
         if self.at_kw("create"):
@@ -143,6 +145,27 @@ class Parser:
         raise ParseError("unsupported SHOW")
 
     # ---- SELECT
+    def with_select(self) -> ast.Node:
+        """WITH name AS (select ...) [, ...] select ... (non-recursive)."""
+        self.expect_kw("with")
+        ctes = []
+        while True:
+            name = self.ident()
+            self.expect_kw("as")
+            self.expect_op("(")
+            sub = self.select_or_union()
+            self.expect_op(")")
+            ctes.append((name, sub))
+            if not self.accept_op(","):
+                break
+        stmt = self.select_or_union()
+        if isinstance(stmt, ast.Union):
+            for arm in stmt.selects:
+                arm.ctes = list(ctes) + list(arm.ctes)
+        else:
+            stmt.ctes = list(ctes) + list(stmt.ctes)
+        return stmt
+
     def select_or_union(self) -> ast.Node:
         first = self.select()
         if not self.at_kw("union"):

@@ -132,3 +132,60 @@ def test_empty_result_column_names():
     s.execute("insert into f values (1)")
     assert s.execute("select x from f where x in (select a from e)").rows() == []
     assert s.execute("select x from f where x not in (select a from e)").rows() == [(1,)]
+
+
+def test_ctes():
+    s = Session()
+    s.execute("create table t (g varchar(2), v bigint)")
+    s.execute("insert into t values ('a',1),('a',2),('b',10),('b',20),('c',3)")
+    assert s.execute("""with totals as (select g, sum(v) s from t group by g)
+        select g, s from totals where s > 3 order by s desc""").rows() == \
+        [("b", 30)]
+    # chained CTEs (later referencing earlier)
+    assert s.execute("""with x as (select v from t where g = 'a'),
+        y as (select v * 10 v10 from x) select sum(v10) from y""").rows() == \
+        [(30,)]
+    # CTE joined with a base table
+    assert s.execute("""with big as (select * from t where v >= 10)
+        select t.g, count(*) c from t join big on t.g = big.g
+        group by t.g""").rows() == [("b", 4)]
+    # CTE across UNION arms
+    rows = s.execute("""with a1 as (select v from t where g = 'a')
+        select v from a1 union all select v + 100 from a1 order by v""").rows()
+    assert [r[0] for r in rows] == [1, 2, 101, 102]
+    # recursion is rejected (non-recursive CTEs)
+    import pytest as _pt
+    with _pt.raises(Exception, match="no such table"):
+        s.execute("with r as (select * from r) select * from r")
+
+
+def test_cte_visible_in_subqueries_and_shadows():
+    s = Session()
+    s.execute("create table sales (region varchar(6), amt bigint)")
+    s.execute("insert into sales values ('e',10),('e',30),('w',5),('w',45),('n',100)")
+    assert s.execute("""with s2 as (select amt from sales)
+        select count(*) from s2
+        where amt > (select avg(amt) from s2)""").rows() == [(2,)]
+    # a CTE shadows the base table of the same name
+    assert s.execute("with sales as (select 1 x) select * from sales"
+                     ).rows() == [(1,)]
+
+
+def test_cte_strict_semantics():
+    s = Session()
+    s.execute("create table t (v bigint)")
+    s.execute("insert into t values (1), (2), (3)")
+    # UNION bodies and subqueries inside bodies
+    assert s.execute("""with x as (select v from t where v = 1
+        union all select v + 10 from t)
+        select count(*) from x""").rows() == [(4,)]
+    assert s.execute("""with x as (select v from t
+        where v > (select avg(v) from t)) select * from x""").rows() == [(3,)]
+    import pytest as _pt
+    with _pt.raises(Exception, match="no such table b"):
+        s.execute("with a as (select * from b), b as (select 1 x) select * from a")
+    with _pt.raises(Exception, match="duplicate CTE"):
+        s.execute("with a as (select 1 x), a as (select 2 y) select * from a")
+    s.execute("create snapshot s1")
+    with _pt.raises(Exception, match="time-travel a CTE"):
+        s.execute("with t2 as (select 1 x) select * from t2 as of snapshot 's1'")
