@@ -114,7 +114,10 @@ class DType:
         return jnp.dtype(self.np_dtype)
 
     def __str__(self) -> str:
-        n = self.oid.name.lower()
+        sql_names = {TypeOid.INT8: "tinyint", TypeOid.INT16: "smallint",
+                     TypeOid.INT32: "int", TypeOid.INT64: "bigint",
+                     TypeOid.FLOAT32: "float", TypeOid.FLOAT64: "double"}
+        n = sql_names.get(self.oid, self.oid.name.lower())
         if self.oid == TypeOid.DECIMAL64:
             return f"decimal({self.width or 18},{self.scale})"
         if self.oid == TypeOid.VARCHAR and self.width:

@@ -103,6 +103,9 @@ class Vector:
             epoch = datetime.datetime(1970, 1, 1)
             return [epoch + datetime.timedelta(microseconds=int(v)) if m
                     else None for v, m in zip(self.data, mask)]
+        if self.dtype.is_vector:
+            return [[float(x) for x in self.data[i]] if mask[i] else None
+                    for i in range(len(self))]
         return [self.data[i].item() if mask[i] else None
                 for i in range(len(self))]
 
@@ -132,6 +135,25 @@ class Vector:
                 val = ~np.asarray(arr.is_null())
             return cls(dtype=dtype, strings=arr.cast(pa.string()), validity=val)
         if dtype.is_vector:
+            if pa.types.is_string(arr.type) or pa.types.is_large_string(arr.type):
+                # '[1,2,3]' literals (CSV / client wire format); empty or
+                # NULL cells stay NULL (zero-filled + invalid), never a
+                # spurious all-zeros embedding
+                rows, valid = [], []
+                for s in arr.to_pylist():
+                    txt = (s or "").strip()
+                    parts = [x for x in txt[1:-1].split(",") if x.strip()] \
+                        if txt.startswith("[") else []
+                    if parts:
+                        rows.append([float(x) for x in parts])
+                        valid.append(True)
+                    else:
+                        rows.append([0.0] * dtype.dim)
+                        valid.append(False)
+                data = np.asarray(rows, dtype=dtype.np_dtype)
+                v = np.asarray(valid, np.bool_)
+                return cls(dtype=dtype, data=data,
+                           validity=None if v.all() else v)
             d = arr.type.list_size
             data = np.asarray(arr.flatten(), dtype=dtype.np_dtype).reshape(-1, d)
             return cls(dtype=dtype, data=data)

@@ -133,6 +133,41 @@ class Session:
             b = Batch.from_pydict({"Tables": names},
                                   {"Tables": dt.VARCHAR})
             return Result(batch=b)
+        if isinstance(stmt, ast.ShowCreateTable):
+            t = self.catalog.get_table(stmt.name)
+            cols = []
+            for c, d in t.meta.schema:
+                extra = " auto_increment" if c == t.meta.auto_increment else ""
+                cols.append(f"  `{c}` {d}{extra}")
+            if t.meta.primary_key:
+                cols.append("  primary key ("
+                            + ", ".join(t.meta.primary_key) + ")")
+            ddl = f"create table `{stmt.name}` (\n" + ",\n".join(cols) + "\n)"
+            b = Batch.from_pydict({"Table": [stmt.name],
+                                   "Create Table": [ddl]},
+                                  {"Table": dt.VARCHAR,
+                                   "Create Table": dt.TEXT})
+            return Result(batch=b)
+        if isinstance(stmt, ast.ShowColumns):
+            t = self.catalog.get_table(stmt.name)
+            b = Batch.from_pydict(
+                {"Field": [c for c, _ in t.meta.schema],
+                 "Type": [str(d) for _, d in t.meta.schema],
+                 "Key": ["PRI" if c in t.meta.primary_key else ""
+                         for c, _ in t.meta.schema]},
+                {"Field": dt.VARCHAR, "Type": dt.VARCHAR,
+                 "Key": dt.VARCHAR})
+            return Result(batch=b)
+        if isinstance(stmt, ast.ShowIndexes):
+            ixs = self.catalog.indexes_on(stmt.name)
+            b = Batch.from_pydict(
+                {"Key_name": [ix.name for ix in ixs],
+                 "Algo": [ix.algo for ix in ixs],
+                 "Columns": [",".join(ix.columns) for ix in ixs],
+                 "Dirty": [int(ix.dirty) for ix in ixs]},
+                {"Key_name": dt.VARCHAR, "Algo": dt.VARCHAR,
+                 "Columns": dt.VARCHAR, "Dirty": dt.INT64})
+            return Result(batch=b)
         if isinstance(stmt, ast.SetVariable):
             if isinstance(stmt.value, ast.Literal):
                 value = stmt.value.value
@@ -336,9 +371,57 @@ class Session:
         if sel.having is not None:
             sel.having = self._inline_subqueries(sel.having, ctes=ctes)
 
+    def _try_mo_ctl(self, sel) -> Optional[Result]:
+        """`select mo_ctl('cmd'[, 'arg'])` — ops control functions
+        (reference: plan/function/ctl mo_ctl): checkpoint | merge | flush."""
+        if not (isinstance(sel, ast.Select) and sel.from_ is None
+                and len(sel.items) == 1):
+            return None
+        e = sel.items[0].expr
+        if not (isinstance(e, ast.FuncCall) and e.name == "mo_ctl"):
+            return None
+        args = [a.value for a in e.args if isinstance(a, ast.Literal)]
+        cmd = str(args[0]).lower() if args else ""
+        arg = str(args[1]) if len(args) > 1 else ""
+        if cmd == "checkpoint":
+            self.catalog.checkpoint()
+            out = "checkpoint done"
+        elif cmd == "merge":
+            def describe(code):
+                if code == -1:
+                    return "skipped (too few segments)"
+                if code == -2:
+                    return "deferred (open transactions)"
+                return f"kept {code} rows"
+            if not arg:
+                results = []
+                for name in list(self.catalog.tables):
+                    if not name.startswith("system_"):
+                        r = self.catalog.merge_table(name,
+                                                     checkpoint=False)
+                        if r >= 0:
+                            results.append(f"{name}: {describe(r)}")
+                if results:
+                    self.catalog.checkpoint()
+                out = "; ".join(results) or "nothing to merge"
+            else:
+                out = f"merge {arg}: " + describe(
+                    self.catalog.merge_table(arg))
+        elif cmd == "flush":
+            if hasattr(self.catalog, "stmt_recorder"):
+                self.catalog.stmt_recorder.flush()
+            out = "flushed"
+        else:
+            raise BindError(f"unknown mo_ctl command {cmd!r}")
+        b = Batch.from_pydict({"mo_ctl": [out]}, {"mo_ctl": dt.VARCHAR})
+        return Result(batch=b)
+
     # ------------------------------------------------------------- select
     def _select(self, sel: ast.Select) -> Result:
         from matrixone_tpu.sql.optimize import apply_indices
+        ctl = self._try_mo_ctl(sel)
+        if ctl is not None:
+            return ctl
         self._prepare_select(sel)
         node = Binder(self.catalog).bind_statement(sel)
         node = apply_indices(node, self.catalog,
@@ -423,6 +506,41 @@ class Session:
             self.catalog.indexes[stmt.name] = meta
             return Result()
         raise BindError(f"unsupported index algo {stmt.using!r}")
+
+    # --------------------------------------------------------------- etl
+    def load_csv(self, table: str, path: str, **read_kwargs) -> int:
+        """Bulk CSV load (reference: colexec/external CSV reader) via
+        pyarrow.csv into the table's schema."""
+        import pyarrow.csv as pacsv
+        t = self.catalog.get_table(table)
+        tbl = pacsv.read_csv(path, **read_kwargs)
+        auto_col = t.meta.auto_increment
+        required = [c for c, _ in t.meta.schema if c != auto_col]
+        missing = [c for c in required if c not in tbl.schema.names]
+        if missing:
+            raise BindError(
+                f"CSV {path!r} is missing columns {missing}; "
+                f"file has {tbl.schema.names}")
+        # extra CSV columns are ignored; the auto_increment column may be
+        # absent (values are allocated) or present (counter advances past)
+        want = [c for c, _ in t.meta.schema if c in tbl.schema.names]
+        from matrixone_tpu.container.batch import Batch as _B
+        total = 0
+        schema_map = dict(t.meta.schema)
+        for rb in tbl.select(want).to_batches(max_chunksize=1 << 20):
+            batch = _B.from_arrow(rb, schema=schema_map)
+            if auto_col is not None:
+                if auto_col in batch.columns:
+                    t.observe_auto(np.asarray(
+                        batch.columns[auto_col].data, np.int64))
+                else:
+                    n = len(batch)
+                    from matrixone_tpu.container.vector import Vector
+                    batch.columns[auto_col] = Vector.from_values(
+                        [int(v) for v in t.allocate_auto(n)],
+                        schema_map[auto_col])
+            total += t.insert_batch(batch)
+        return total
 
     # --------------------------------------------------------------- dml
     def _pessimistic(self, txn) -> bool:

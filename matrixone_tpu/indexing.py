@@ -19,6 +19,11 @@ def build_ivfflat(engine: Engine, ix: IndexMeta) -> None:
     from matrixone_tpu.vectorindex import ivf_flat, ivf_pq
     table = engine.get_table(ix.table)
     data, gids = table.read_column_f32(ix.columns[0])
+    if len(data) == 0:
+        ix.index_obj = None            # empty table: nothing to index yet
+        ix.options["_row_gids"] = gids
+        ix.dirty = False
+        return
     nlist = int(ix.options.get("lists", 64))
     metric = ix.options.get("_metric", "l2")
     nlist = max(1, min(nlist, max(1, len(data))))
@@ -40,6 +45,11 @@ def build_hnsw(engine: Engine, ix: IndexMeta) -> None:
     from matrixone_tpu.vectorindex import hnsw
     table = engine.get_table(ix.table)
     data, gids = table.read_column_f32(ix.columns[0])
+    if len(data) == 0:
+        ix.index_obj = None
+        ix.options["_row_gids"] = gids
+        ix.dirty = False
+        return
     m = int(ix.options.get("m", 16))
     ef_c = int(ix.options.get("ef_construction", 64))
     metric = ix.options.get("_metric", "l2")

@@ -277,6 +277,16 @@ class ShowCreateTable(Node):
 
 
 @dataclasses.dataclass
+class ShowColumns(Node):
+    name: str
+
+
+@dataclasses.dataclass
+class ShowIndexes(Node):
+    name: str
+
+
+@dataclasses.dataclass
 class SetVariable(Node):
     name: str
     value: Node

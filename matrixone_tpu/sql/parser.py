@@ -113,6 +113,10 @@ class Parser:
             return ast.Explain(self.statement(), analyze=analyze)
         if self.at_kw("show"):
             return self.show()
+        t0 = self.peek()
+        if t0.kind == "ident" and t0.value.lower() in ("describe", "desc_table"):
+            self.next()
+            return ast.ShowColumns(self.ident())
         if self.at_kw("restore"):
             self.next()
             self.expect_kw("table")
@@ -139,6 +143,15 @@ class Parser:
             return ast.ShowTables()
         if self.accept_kw("snapshots"):
             return ast.ShowSnapshots()
+        nxt = self.peek()
+        if nxt.kind == "ident" and nxt.value.lower() == "columns":
+            self.next()
+            self.expect_kw("from")
+            return ast.ShowColumns(self.ident())
+        if nxt.kind == "ident" and nxt.value.lower() == "indexes":
+            self.next()
+            self.expect_kw("from")
+            return ast.ShowIndexes(self.ident())
         if self.accept_kw("create"):
             self.expect_kw("table")
             return ast.ShowCreateTable(self.ident())

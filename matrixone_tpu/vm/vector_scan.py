@@ -38,6 +38,13 @@ class VectorTopKOp(Operator):
         row_gids = np.asarray(ix.options["_row_gids"])
         table = catalog.get_table(self.node.table)
 
+        if index is None:        # index over an empty table
+            arrays, validity = table.fetch_rows(
+                np.zeros(0, np.int64), self.node.columns)
+            yield chunk_to_execbatch(arrays, validity, table.dicts, 0,
+                                     self.node.columns, self.node.schema)
+            return
+
         q = np.asarray([self.node.query_vector], dtype=np.float32)
         if ix.algo == "hnsw":
             from matrixone_tpu.vectorindex import hnsw

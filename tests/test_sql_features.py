@@ -189,3 +189,28 @@ def test_cte_strict_semantics():
     s.execute("create snapshot s1")
     with _pt.raises(Exception, match="time-travel a CTE"):
         s.execute("with t2 as (select 1 x) select * from t2 as of snapshot 's1'")
+
+
+def test_show_surfaces_and_mo_ctl(tmp_path):
+    s = Session()
+    s.execute("create table t (id bigint auto_increment primary key, "
+              "name varchar(10), e vecf32(4))")
+    ddl = s.execute("show create table t").rows()[0][1]
+    assert "auto_increment" in ddl and "primary key (id)" in ddl
+    cols = s.execute("show columns from t").rows()
+    assert cols[0] == ("id", "bigint", "PRI")
+    s.execute("create index iv using ivfflat on t (e) lists = 1")
+    s.execute("insert into t (name, e) values ('x', '[1,2,3,4]')")
+    ix = s.execute("show indexes from t").rows()
+    assert ix[0][0] == "iv" and ix[0][1] == "ivfflat"
+    assert s.execute("select mo_ctl('checkpoint')").rows() == \
+        [("checkpoint done",)]
+    assert "merge" in s.execute("select mo_ctl('merge')").rows()[0][0]
+    import pytest as _pt
+    with _pt.raises(Exception, match="unknown mo_ctl"):
+        s.execute("select mo_ctl('nope')")
+    # CSV bulk load incl. vector literals
+    p = tmp_path / "x.csv"
+    p.write_text('id,name,e\n10,aa,"[1,1,1,1]"\n11,bb,"[2,2,2,2]"\n')
+    assert s.load_csv("t", str(p)) == 2
+    assert len(s.execute("select * from t").rows()) == 3
