@@ -1,0 +1,160 @@
+"""MySQL-aware proxy: connection routing across backend servers.
+
+Reference analogue: `pkg/proxy` (24k LoC — tenant/label routing,
+connection migration, scale-driven rebalance), collapsed to the core:
+accept MySQL clients, pick a backend by least-connections (with optional
+draining for scale-in), and relay bytes both ways. Because the protocol
+is stateful per connection, "migration" is implemented as drain-and-
+reconnect: a draining backend stops receiving new connections and the
+proxy reports when it has fully quiesced.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class Backend:
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self.active = 0
+        self.draining = False
+        self.down_until = 0.0      # health cooldown after connect failure
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+
+class MOProxy:
+    def __init__(self, backends: List[Tuple[str, int]],
+                 host: str = "127.0.0.1", port: int = 0):
+        self.backends = [Backend(h, p) for h, p in backends]
+        self.host = host
+        self.port = port
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self._stopping = threading.Event()
+
+    # ----------------------------------------------------------- routing
+    def _pick(self, exclude=()) -> Optional[Backend]:
+        now = time.monotonic()
+        with self._lock:
+            live = [b for b in self.backends
+                    if not b.draining and b.down_until <= now
+                    and b not in exclude]
+            if not live:
+                return None
+            b = min(live, key=lambda x: x.active)
+            b.active += 1
+            return b
+
+    def add_backend(self, host: str, port: int) -> None:
+        with self._lock:
+            self.backends.append(Backend(host, port))
+
+    def drain(self, host: str, port: int) -> None:
+        """Scale-in: stop routing new connections to this backend."""
+        with self._lock:
+            for b in self.backends:
+                if b.address == (host, port):
+                    b.draining = True
+                    return
+        raise KeyError(f"no such backend {host}:{port}")
+
+    def drained(self, host: str, port: int) -> bool:
+        with self._lock:
+            for b in self.backends:
+                if b.address == (host, port):
+                    return b.draining and b.active == 0
+        raise KeyError(f"no such backend {host}:{port}")
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {f"{b.host}:{b.port}": b.active for b in self.backends}
+
+    # ------------------------------------------------------------ server
+    def start(self) -> "MOProxy":
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self.port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(64)
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        return self
+
+    def stop(self):
+        self._stopping.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self):
+        while not self._stopping.is_set():
+            try:
+                client, _ = self._sock.accept()
+            except OSError:
+                if self._stopping.is_set():
+                    return
+                continue   # transient (e.g. ECONNABORTED): keep serving
+            threading.Thread(target=self._serve_conn, args=(client,),
+                             daemon=True).start()
+
+    def _serve_conn(self, client: socket.socket):
+        """Pick a backend, retrying others when one refuses (dead backends
+        go on a health cooldown so they stop winning least-connections)."""
+        tried = []
+        while True:
+            backend = self._pick(exclude=tried)
+            if backend is None:
+                client.close()
+                return
+            try:
+                upstream = socket.create_connection(backend.address,
+                                                    timeout=5)
+                upstream.settimeout(None)   # the 5s budget was for CONNECT
+                break                        # only; sessions may idle
+            except OSError:
+                with self._lock:
+                    backend.active -= 1
+                    backend.down_until = time.monotonic() + 5.0
+                tried.append(backend)
+        self._relay(client, backend, upstream)
+
+    def _relay(self, client: socket.socket, backend: Backend,
+               upstream: socket.socket):
+        def pump(src, dst):
+            """One direction; on EOF half-close the peer's write side only
+            so in-flight data in the other direction still drains."""
+            try:
+                while True:
+                    data = src.recv(1 << 16)
+                    if not data:
+                        break
+                    dst.sendall(data)
+            except OSError:
+                pass
+            finally:
+                try:
+                    dst.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+
+        t = threading.Thread(target=pump, args=(upstream, client),
+                             daemon=True)
+        t.start()
+        pump(client, upstream)      # client->upstream runs in this thread
+        t.join()
+        for s in (client, upstream):
+            try:
+                s.close()
+            except OSError:
+                pass
+        with self._lock:
+            backend.active -= 1

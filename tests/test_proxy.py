@@ -1,0 +1,80 @@
+"""Proxy: routing, balancing, draining (reference analogue: pkg/proxy)."""
+
+import time
+
+import pytest
+
+from matrixone_tpu import client
+from matrixone_tpu.frontend.proxy import MOProxy
+from matrixone_tpu.frontend.server import MOServer
+from matrixone_tpu.storage.engine import Engine
+
+
+@pytest.fixture()
+def cluster():
+    engine = Engine()                      # shared storage: any CN serves
+    cn1 = MOServer(engine=engine, port=0).start()
+    cn2 = MOServer(engine=engine, port=0).start()
+    proxy = MOProxy([("127.0.0.1", cn1.port),
+                     ("127.0.0.1", cn2.port)]).start()
+    yield proxy, cn1, cn2, engine
+    proxy.stop()
+    cn1.stop()
+    cn2.stop()
+
+
+def test_proxy_routes_and_balances(cluster):
+    proxy, cn1, cn2, _ = cluster
+    conns = [client.connect(port=proxy.port) for _ in range(4)]
+    conns[0].execute("create table t (a bigint)")
+    conns[1].execute("insert into t values (1), (2)")
+    # all connections see the same engine through either backend
+    for c in conns:
+        _, rows = c.query("select count(*) from t")
+        assert rows == [("2",)]
+    # least-connections spread: both backends carry sessions
+    stats = proxy.stats()
+    assert all(v > 0 for v in stats.values()), stats
+    for c in conns:
+        c.close()
+    time.sleep(0.2)
+    assert all(v == 0 for v in proxy.stats().values())
+
+
+def test_proxy_drain_for_scale_in(cluster):
+    proxy, cn1, cn2, _ = cluster
+    c1 = client.connect(port=proxy.port)
+    proxy.drain("127.0.0.1", cn1.port)
+    # new connections only land on cn2
+    more = [client.connect(port=proxy.port) for _ in range(3)]
+    stats = proxy.stats()
+    assert stats[f"127.0.0.1:{cn2.port}"] >= 3
+    # existing connection on the draining backend still works
+    c1.execute("create table d (x bigint)")
+    c1.close()
+    time.sleep(0.2)
+    assert proxy.drained("127.0.0.1", cn1.port)
+    for c in more:
+        c.close()
+
+
+def test_proxy_all_backends_draining_rejects(cluster):
+    proxy, cn1, cn2, _ = cluster
+    proxy.drain("127.0.0.1", cn1.port)
+    proxy.drain("127.0.0.1", cn2.port)
+    with pytest.raises(Exception):
+        client.connect(port=proxy.port)
+
+
+def test_proxy_skips_dead_backend():
+    engine = Engine()
+    cn = MOServer(engine=engine, port=0).start()
+    proxy = MOProxy([("127.0.0.1", 1), ("127.0.0.1", cn.port)]).start()
+    try:
+        for _ in range(5):
+            c = client.connect(port=proxy.port)
+            assert c.ping()
+            c.close()
+    finally:
+        proxy.stop()
+        cn.stop()
