@@ -15,6 +15,8 @@ MO_BENCH_SMOKE=1 (tiny shapes, CPU-friendly sanity run).
 
 import json
 import os
+import sys
+import threading
 import time
 
 import jax
@@ -93,7 +95,48 @@ def bench_q1():
     }))
 
 
+PREFLIGHT_S = float(os.environ.get("MO_BENCH_PREFLIGHT_S", 120))
+
+
+def _device_preflight(timeout_s: float = None) -> bool:
+    """Prove the backend answers a trivial op before committing to the
+    full run — a wedged accelerator tunnel must produce a diagnostic JSON
+    line, not an eternal hang (observed: axon tunnel outages)."""
+    if timeout_s is None:
+        timeout_s = PREFLIGHT_S
+    done = threading.Event()
+    err = []
+
+    def probe():
+        try:
+            jax.block_until_ready(jnp.ones((8,)).sum())
+            done.set()
+        except Exception as e:               # noqa: BLE001
+            err.append(repr(e))
+            done.set()
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    if not done.wait(timeout_s) or err:
+        print(json.dumps({
+            "metric": "bench_unavailable",
+            "value": 0,
+            "unit": "error",
+            "vs_baseline": None,
+            # NOTE: no jax.* calls here — backend queries block on the
+            # very wedge this branch reports
+            "error": (err[0] if err else
+                      f"device unresponsive after {timeout_s}s"),
+        }))
+        return False
+    return True
+
+
 def main():
+    if not _device_preflight():
+        sys.stdout.flush()
+        # nonzero: shell consumers must not mistake a dead device for a
+        # successful run; _exit (not exit) skips jax's hanging atexit sync
+        os._exit(1)
     if METRIC == "q1":
         bench_q1()
         return

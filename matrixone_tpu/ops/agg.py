@@ -28,7 +28,9 @@ import jax.numpy as jnp
 
 from matrixone_tpu.ops import hash as mohash
 
-_NULL_GROUP_SENTINEL = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+import numpy as _np
+
+_NULL_GROUP_SENTINEL = _np.uint64(0xFFFFFFFFFFFFFFFF)
 
 
 class GroupIds(NamedTuple):

@@ -11,9 +11,13 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-_GOLDEN = jnp.uint64(0x9E3779B97F4A7C15)
-_MIX1 = jnp.uint64(0xBF58476D1CE4E5B9)
-_MIX2 = jnp.uint64(0x94D049BB133111EB)
+# numpy scalars, NOT jnp: creating a jnp array at import time initializes
+# the backend, which must stay lazy (a wedged device would hang imports)
+import numpy as _np
+
+_GOLDEN = _np.uint64(0x9E3779B97F4A7C15)
+_MIX1 = _np.uint64(0xBF58476D1CE4E5B9)
+_MIX2 = _np.uint64(0x94D049BB133111EB)
 
 
 def splitmix64(x: jnp.ndarray) -> jnp.ndarray:

@@ -31,7 +31,7 @@ from matrixone_tpu.vm.exprs import EvalError, ExecBatch, eval_expr
 from matrixone_tpu.vm.operators import (Operator, _broadcast_full,
                                         _concat_batches, _sort_key_col)
 
-_BIG = jnp.int64(1) << 62
+_BIG = np.int64(1) << 62
 
 
 def _seg_scan(vals: jnp.ndarray, seg: jnp.ndarray, combine):
