@@ -76,6 +76,10 @@ class ConflictError(RuntimeError):
     pass
 
 
+class DuplicateKeyError(RuntimeError):
+    pass
+
+
 class MVCCTable:
     """Versioned columnar table; readers see a snapshot, writers buffer in
     a Workspace until the engine commits them."""
@@ -90,6 +94,16 @@ class MVCCTable:
             c: [] for c, d in meta.schema if d.is_varlen}
         self._dict_idx: Dict[str, Dict[str, int]] = {c: {} for c in self.dicts}
         self.next_auto = 1
+        # PK dedup (reference: colexec/fuzzyfilter): a bloom over existing
+        # keys answers "definitely new" cheaply; only bloom-positive
+        # suspects pay the exact membership check
+        self._pk_bloom = None
+        self._pk_col: Optional[str] = None
+        if len(meta.primary_key) == 1:
+            c = meta.primary_key[0]
+            d = dict(meta.schema).get(c)
+            if d is not None and d.is_integer:
+                self._pk_col = c
 
     def allocate_auto(self, n: int) -> np.ndarray:
         """Allocate n auto_increment values (reference: pkg/incrservice
@@ -156,6 +170,70 @@ class MVCCTable:
             else:
                 arrays[col] = np.asarray(vec.data, dtype=dtype.np_dtype)
         return arrays, validity
+
+    # ------------------------------------------------------------ pk dedup
+    def check_pk_unique(self, arrays: Dict[str, np.ndarray],
+                        extra_deletes: Optional[np.ndarray] = None,
+                        validity: Optional[np.ndarray] = None) -> None:
+        """Raise DuplicateKeyError if the batch collides with existing live
+        PK values or contains internal duplicates (fuzzyfilter analogue).
+        NULL primary keys are rejected outright (PK implies NOT NULL)."""
+        c = self._pk_col
+        if c is None or c not in arrays:
+            return
+        new = np.asarray(arrays[c], np.int64)
+        if validity is not None and not validity.all():
+            raise DuplicateKeyError(
+                f"primary key {self.meta.name!r}.{c} cannot be NULL")
+        uniq, counts = np.unique(new, return_counts=True)
+        if (counts > 1).any():
+            raise DuplicateKeyError(
+                f"duplicate key {int(uniq[counts > 1][0])} within the "
+                f"insert batch for {self.meta.name!r}.{c}")
+        if self._pk_bloom is None:
+            self._rebuild_pk_bloom()
+        suspects = new[self._pk_bloom.probe_int64(new)]
+        if len(suspects) == 0:
+            return
+        dead = self._dead_gids(None, extra_deletes)
+        for seg in self.segments:
+            vals = seg.arrays[c]
+            hit = np.isin(suspects, vals)
+            if hit.any():
+                # a live row with this key? (deleted rows may be re-inserted)
+                for k in suspects[hit]:
+                    rows = np.nonzero(vals == k)[0]
+                    gids = rows + seg.base_gid
+                    alive = ~np.isin(gids, dead) if len(dead) else \
+                        np.ones(len(gids), bool)
+                    if alive.any():
+                        raise DuplicateKeyError(
+                            f"duplicate key {int(k)} for "
+                            f"{self.meta.name!r}.{c}")
+
+    def _rebuild_pk_bloom(self) -> None:
+        from matrixone_tpu import native
+        c = self._pk_col
+        n_live = sum(s.n_rows for s in self.segments)
+        # headroom so incremental adds don't saturate immediately
+        cap = max(n_live * 2, 4096)
+        bloom = native.BloomFilter(cap)
+        for seg in self.segments:
+            bloom.add_int64(np.asarray(seg.arrays[c], np.int64))
+        self._pk_bloom = bloom
+        self._pk_bloom_cap = cap
+        self._pk_bloom_items = n_live
+
+    def _pk_bloom_add(self, arrays: Dict[str, np.ndarray]) -> None:
+        if self._pk_col is None or self._pk_bloom is None \
+                or self._pk_col not in arrays:
+            return
+        vals = np.asarray(arrays[self._pk_col], np.int64)
+        self._pk_bloom_items += len(vals)
+        if self._pk_bloom_items > self._pk_bloom_cap:
+            self._pk_bloom = None   # saturated: lazy rebuild with headroom
+            return
+        self._pk_bloom.add_int64(vals)
 
     # ----------------------------------------------------------- segments
     def make_segment(self, arrays, validity, commit_ts: int) -> Segment:
@@ -536,6 +614,23 @@ class Engine:
                         M.txn_commits.inc(outcome="conflict")
                         raise ConflictError(
                             f"write-write conflict on {tname}")
+            # PK uniqueness before anything durable happens; all of a
+            # txn's batches are checked as ONE key set so duplicates across
+            # statements in the same txn are caught too
+            for tname, segs in inserts.items():
+                t = self.get_table(tname)
+                extra = deletes.get(tname)
+                if t._pk_col is not None and segs:
+                    c = t._pk_col
+                    parts = [np.asarray(a[c], np.int64)
+                             for a, _v in segs if c in a]
+                    vals = [v[c] for a, v in segs if c in v]
+                    if parts:
+                        t.check_pk_unique(
+                            {c: np.concatenate(parts)},
+                            extra_deletes=extra,
+                            validity=(np.concatenate(vals)
+                                      if vals else None))
             commit_ts = self.hlc.now()
             affected = 0
             # WAL first; varchar columns are logged as decoded strings so
@@ -569,6 +664,7 @@ class Engine:
                 for arrays, validity in segs:
                     seg = t.make_segment(arrays, validity, commit_ts)
                     t.apply_segment(seg)
+                    t._pk_bloom_add(arrays)
                     affected += seg.n_rows
                     for fn in self._subscribers:
                         fn(commit_ts, tname, "insert", seg)
@@ -627,6 +723,7 @@ class Engine:
             else:
                 t.segments = []
             t.tombstones = []
+            t._pk_bloom = None     # rebuilt lazily over the merged rows
             self.committed_ts = max(self.committed_ts, merge_ts)
             for ix in self.indexes_on(name):
                 ix.dirty = True       # gids changed: indexes must rebuild

@@ -61,11 +61,13 @@ def gen_lineitem(n_rows: int, seed: int = 0) -> Dict[str, np.ndarray]:
     ra = rng.integers(0, 2, n_rows)
     flag_codes = np.where(old, ra, 2).astype(np.int32)          # 0=A 1=R 2=N
     status_codes = (ship > _days(1995, 6, 17)).astype(np.int32)  # 0=F 1=O
+    idx = np.arange(n_rows)
     return {
-        "l_orderkey": rng.integers(1, n_rows, n_rows).astype(np.int64),
+        # valid composite PK: 7 lines per order, unique (orderkey, lineno)
+        "l_orderkey": (idx // 7 + 1).astype(np.int64),
         "l_partkey": rng.integers(1, 200001, n_rows).astype(np.int64),
         "l_suppkey": rng.integers(1, 10001, n_rows).astype(np.int64),
-        "l_linenumber": rng.integers(1, 8, n_rows).astype(np.int32),
+        "l_linenumber": (idx % 7 + 1).astype(np.int32),
         "l_quantity": qty * 100,          # decimal(15,2) scaled
         "l_extendedprice": extprice,      # already cents
         "l_discount": discount,           # cents scale (0.00-0.10)
@@ -85,7 +87,11 @@ STATUS_CATS = ["F", "O"]
 def load_lineitem(catalog: Catalog, n_rows: int, seed: int = 0,
                   table: str = "lineitem") -> Dict[str, np.ndarray]:
     """Create + bulk-load lineitem; returns raw arrays for oracle checks."""
-    catalog.create_table(TableMeta(table, LINEITEM_SCHEMA, ["l_orderkey"]),
+    # composite PK per the TPC-H spec (orderkey, linenumber); the synthetic
+    # generator draws orderkeys randomly so single-column uniqueness would
+    # be wrong anyway
+    catalog.create_table(TableMeta(table, LINEITEM_SCHEMA,
+                                   ["l_orderkey", "l_linenumber"]),
                          if_not_exists=True)
     t = catalog.get_table(table)
     arrays = gen_lineitem(n_rows, seed)
@@ -233,15 +239,17 @@ def load_ssb(catalog: Catalog, n_rows: int, seed: int = 0):
     disc = rng.integers(0, 11, n_rows).astype(np.int64)
     odate = np.asarray(keys, np.int64)[
         rng.integers(0, len(keys), n_rows)].astype(np.int32)
-    lo = {"lo_orderkey": rng.integers(1, n_rows + 1, n_rows).astype(np.int64),
-          "lo_linenumber": rng.integers(1, 8, n_rows).astype(np.int32),
+    idx = np.arange(n_rows)
+    lo = {"lo_orderkey": (idx // 7 + 1).astype(np.int64),
+          "lo_linenumber": (idx % 7 + 1).astype(np.int32),
           "lo_orderdate": odate,
           "lo_quantity": qty,
           "lo_extendedprice": price,
           "lo_discount": disc,
           "lo_revenue": price * (100 - disc) // 100}
     catalog.create_table(TableMeta("lineorder", LINEORDER_SCHEMA,
-                                   ["lo_orderkey"]), if_not_exists=True)
+                                   ["lo_orderkey", "lo_linenumber"]),
+                         if_not_exists=True)
     catalog.get_table("lineorder").insert_numpy(lo)
     return lo, date_arrays
 

@@ -231,3 +231,53 @@ def test_objectio_compression_roundtrip():
     path2 = objectio.write_object(fs, meta, arrays, validity, compress=False)
     _, a3, _ = objectio.read_object(fs, path2)
     np.testing.assert_array_equal(a3["a"], arrays["a"])
+
+
+def test_pk_uniqueness_fuzzyfilter():
+    from matrixone_tpu.storage.engine import DuplicateKeyError
+    s = Session()
+    s.execute("create table t (id bigint primary key, v varchar(4))")
+    s.execute("insert into t values (1, 'a'), (2, 'b')")
+    with pytest.raises(DuplicateKeyError, match="duplicate key 2"):
+        s.execute("insert into t values (2, 'dup')")
+    with pytest.raises(DuplicateKeyError, match="within the insert batch"):
+        s.execute("insert into t values (3, 'x'), (3, 'y')")
+    # deleted keys are reusable (liveness-aware, not append-only)
+    s.execute("delete from t where id = 2")
+    s.execute("insert into t values (2, 'reuse')")
+    assert len(s.execute("select * from t").rows()) == 2
+    # txn race: both buffer key 9; first committer wins, second gets the
+    # duplicate error at commit
+    s.execute("begin")
+    s.execute("insert into t values (9, 'z')")
+    s2 = Session(catalog=s.catalog)
+    s2.execute("insert into t values (9, 'race')")
+    with pytest.raises(DuplicateKeyError):
+        s.execute("commit")
+    # bloom survives a merge (rebuilt lazily over merged rows)
+    s.catalog.merge_table("t", min_segments=1)
+    with pytest.raises(DuplicateKeyError):
+        s.execute("insert into t values (9, 'again')")
+    s.execute("insert into t values (10, 'ok')")
+
+
+def test_pk_uniqueness_across_txn_statements_and_nulls():
+    from matrixone_tpu.storage.engine import DuplicateKeyError
+    s = Session()
+    s.execute("create table t (id bigint primary key, v bigint)")
+    # two statements in ONE txn inserting the same key
+    s.execute("begin")
+    s.execute("insert into t values (5, 1)")
+    s.execute("insert into t values (5, 2)")
+    with pytest.raises(DuplicateKeyError, match="within the insert batch"):
+        s.execute("commit")
+    # NULL pk rejected (PK implies NOT NULL), not confused with key 0
+    with pytest.raises(DuplicateKeyError, match="cannot be NULL"):
+        s.execute("insert into t values (null, 1)")
+    s.execute("insert into t values (0, 1)")   # literal 0 is a normal key
+    # bloom saturation path: exceed the initial capacity, dedup still works
+    s.execute("insert into t values " +
+              ",".join(f"({i}, 0)" for i in range(1, 6000)))
+    with pytest.raises(DuplicateKeyError):
+        s.execute("insert into t values (4321, 9)")
+    s.execute("insert into t values (60001, 9)")
