@@ -99,11 +99,19 @@ class MVCCTable:
         # suspects pay the exact membership check
         self._pk_bloom = None
         self._pk_col: Optional[str] = None
+        self._pk_cols: List[str] = []     # composite: hashed key columns
+        sd = dict(meta.schema)
+
+        def keyable(d):
+            # integer columns directly; varchar via its table-global
+            # dictionary codes (stable ints)
+            return d is not None and (d.is_integer or d.is_varlen)
         if len(meta.primary_key) == 1:
-            c = meta.primary_key[0]
-            d = dict(meta.schema).get(c)
-            if d is not None and d.is_integer:
-                self._pk_col = c
+            if keyable(sd.get(meta.primary_key[0])):
+                self._pk_col = meta.primary_key[0]
+        elif len(meta.primary_key) > 1:
+            if all(keyable(sd.get(c)) for c in meta.primary_key):
+                self._pk_cols = list(meta.primary_key)
 
     def allocate_auto(self, n: int) -> np.ndarray:
         """Allocate n auto_increment values (reference: pkg/incrservice
@@ -172,24 +180,49 @@ class MVCCTable:
         return arrays, validity
 
     # ------------------------------------------------------------ pk dedup
+    def pk_key_values(self, arrays: Dict[str, np.ndarray]
+                      ) -> Optional[np.ndarray]:
+        """The (possibly synthetic) int64 key array for PK checking: the
+        column itself (varchar via dict codes), or the splitmix-combined
+        hash of a composite key — composite hash matches are verified
+        against the REAL tuples in check_pk_unique before rejecting."""
+        from matrixone_tpu import native
+        if self._pk_col is not None:
+            if self._pk_col not in arrays:
+                return None
+            return np.asarray(arrays[self._pk_col], np.int64)
+        if self._pk_cols and all(c in arrays for c in self._pk_cols):
+            h = None
+            with np.errstate(over="ignore"):
+                for c in self._pk_cols:
+                    hc = native.hash64(np.asarray(arrays[c], np.int64))
+                    h = hc if h is None else native._splitmix_np(
+                        h ^ (hc + np.uint64(0x9E3779B97F4A7C15)
+                             + (h << np.uint64(6)) + (h >> np.uint64(2))))
+            return h.view(np.int64)
+        return None
+
     def check_pk_unique(self, arrays: Dict[str, np.ndarray],
                         extra_deletes: Optional[np.ndarray] = None,
                         validity: Optional[np.ndarray] = None) -> None:
         """Raise DuplicateKeyError if the batch collides with existing live
         PK values or contains internal duplicates (fuzzyfilter analogue).
         NULL primary keys are rejected outright (PK implies NOT NULL)."""
-        c = self._pk_col
-        if c is None or c not in arrays:
+        new = self.pk_key_values(arrays)
+        if new is None:
             return
-        new = np.asarray(arrays[c], np.int64)
+        c = self._pk_col or "+".join(self._pk_cols)
         if validity is not None and not validity.all():
             raise DuplicateKeyError(
                 f"primary key {self.meta.name!r}.{c} cannot be NULL")
         uniq, counts = np.unique(new, return_counts=True)
         if (counts > 1).any():
+            shown = (int(uniq[counts > 1][0]) if self._pk_col is not None
+                     and not dict(self.meta.schema)[c].is_varlen
+                     else "")
             raise DuplicateKeyError(
-                f"duplicate key {int(uniq[counts > 1][0])} within the "
-                f"insert batch for {self.meta.name!r}.{c}")
+                f"duplicate key {shown} within the insert batch for "
+                f"{self.meta.name!r}.{c}".replace("key  ", "key "))
         if self._pk_bloom is None:
             self._rebuild_pk_bloom()
         suspects = new[self._pk_bloom.probe_int64(new)]
@@ -197,38 +230,57 @@ class MVCCTable:
             return
         dead = self._dead_gids(None, extra_deletes)
         for seg in self.segments:
-            vals = seg.arrays[c]
-            hit = np.isin(suspects, vals)
-            if hit.any():
-                # a live row with this key? (deleted rows may be re-inserted)
-                for k in suspects[hit]:
-                    rows = np.nonzero(vals == k)[0]
-                    gids = rows + seg.base_gid
-                    alive = ~np.isin(gids, dead) if len(dead) else \
-                        np.ones(len(gids), bool)
-                    if alive.any():
+            vals = self.pk_key_values(seg.arrays)
+            # vectorized: one alive mask per segment, one membership pass
+            gids = np.arange(seg.base_gid, seg.base_gid + seg.n_rows)
+            alive = ~np.isin(gids, dead) if len(dead) else \
+                np.ones(seg.n_rows, bool)
+            live_vals = vals[alive]
+            collide = suspects[np.isin(suspects, live_vals)]
+            for k in collide:
+                if self._pk_col is not None:
+                    shown = int(k)
+                    if dict(self.meta.schema)[c].is_varlen:
+                        d = self.dicts.get(c, [])
+                        if 0 <= int(k) < len(d):
+                            shown = repr(d[int(k)])
+                    raise DuplicateKeyError(
+                        f"duplicate key {shown} for "
+                        f"{self.meta.name!r}.{c}")
+                # composite keys are routed by HASH: verify the real tuple
+                # before rejecting (a 2^-64 collision must not block an
+                # unrelated insert)
+                in_row = int(np.nonzero(new == k)[0][0])
+                seg_rows = np.nonzero(alive & (vals == k))[0]
+                for r in seg_rows:
+                    if all(int(seg.arrays[cc][r]) == int(arrays[cc][in_row])
+                           for cc in self._pk_cols):
+                        shown = tuple(int(seg.arrays[cc][r])
+                                      for cc in self._pk_cols)
                         raise DuplicateKeyError(
-                            f"duplicate key {int(k)} for "
+                            f"duplicate key {shown} for "
                             f"{self.meta.name!r}.{c}")
 
     def _rebuild_pk_bloom(self) -> None:
         from matrixone_tpu import native
-        c = self._pk_col
         n_live = sum(s.n_rows for s in self.segments)
         # headroom so incremental adds don't saturate immediately
         cap = max(n_live * 2, 4096)
         bloom = native.BloomFilter(cap)
         for seg in self.segments:
-            bloom.add_int64(np.asarray(seg.arrays[c], np.int64))
+            vals = self.pk_key_values(seg.arrays)
+            if vals is not None:
+                bloom.add_int64(vals)
         self._pk_bloom = bloom
         self._pk_bloom_cap = cap
         self._pk_bloom_items = n_live
 
     def _pk_bloom_add(self, arrays: Dict[str, np.ndarray]) -> None:
-        if self._pk_col is None or self._pk_bloom is None \
-                or self._pk_col not in arrays:
+        if self._pk_bloom is None:
             return
-        vals = np.asarray(arrays[self._pk_col], np.int64)
+        vals = self.pk_key_values(arrays)
+        if vals is None:
+            return
         self._pk_bloom_items += len(vals)
         if self._pk_bloom_items > self._pk_bloom_cap:
             self._pk_bloom = None   # saturated: lazy rebuild with headroom
@@ -620,17 +672,23 @@ class Engine:
             for tname, segs in inserts.items():
                 t = self.get_table(tname)
                 extra = deletes.get(tname)
-                if t._pk_col is not None and segs:
-                    c = t._pk_col
-                    parts = [np.asarray(a[c], np.int64)
-                             for a, _v in segs if c in a]
-                    vals = [v[c] for a, v in segs if c in v]
-                    if parts:
-                        t.check_pk_unique(
-                            {c: np.concatenate(parts)},
-                            extra_deletes=extra,
-                            validity=(np.concatenate(vals)
-                                      if vals else None))
+                pk_cols = ([t._pk_col] if t._pk_col else t._pk_cols)
+                if pk_cols and segs:
+                    have = [(a, v) for a, v in segs
+                            if all(c in a for c in pk_cols)]
+                    if have:
+                        combined = {c: np.concatenate(
+                            [np.asarray(a[c], np.int64) for a, _v in have])
+                            for c in pk_cols}
+                        val = np.concatenate([
+                            np.logical_and.reduce(
+                                [v[c] for c in pk_cols if c in v])
+                            if any(c in v for c in pk_cols)
+                            else np.ones(len(next(iter(a.values()))),
+                                         np.bool_)
+                            for a, v in have])
+                        t.check_pk_unique(combined, extra_deletes=extra,
+                                          validity=val)
             commit_ts = self.hlc.now()
             affected = 0
             # WAL first; varchar columns are logged as decoded strings so

@@ -281,3 +281,31 @@ def test_pk_uniqueness_across_txn_statements_and_nulls():
     with pytest.raises(DuplicateKeyError):
         s.execute("insert into t values (4321, 9)")
     s.execute("insert into t values (60001, 9)")
+
+
+def test_composite_pk_uniqueness():
+    from matrixone_tpu.storage.engine import DuplicateKeyError
+    s = Session()
+    s.execute("create table t (a bigint, b bigint, v varchar(4), "
+              "primary key (a, b))")
+    s.execute("insert into t values (1, 1, 'x'), (1, 2, 'y'), (2, 1, 'z')")
+    with pytest.raises(DuplicateKeyError, match=r"\(1, 2\)"):
+        s.execute("insert into t values (1, 2, 'dup')")
+    s.execute("insert into t values (2, 2, 'ok')")   # overlapping parts fine
+    s.execute("delete from t where a = 1 and b = 2")
+    s.execute("insert into t values (1, 2, 'reuse')")
+    with pytest.raises(DuplicateKeyError, match="cannot be NULL"):
+        s.execute("insert into t values (null, 5, 'n')")
+    assert len(s.execute("select * from t").rows()) == 4
+
+
+def test_varchar_pk_uniqueness():
+    from matrixone_tpu.storage.engine import DuplicateKeyError
+    s = Session()
+    s.execute("create table u (name varchar(10) primary key, v bigint)")
+    s.execute("insert into u values ('alice', 1), ('bob', 2)")
+    with pytest.raises(DuplicateKeyError, match="'alice'"):
+        s.execute("insert into u values ('alice', 9)")
+    s.execute("delete from u where name = 'alice'")
+    s.execute("insert into u values ('alice', 3)")     # reusable
+    assert len(s.execute("select * from u").rows()) == 2
