@@ -435,26 +435,53 @@ class Binder:
             if not any(a == u for u in uniq):
                 uniq.append(a)
 
-        bound_aggs: List[AggCall] = []
-        for i, a in enumerate(uniq):
-            if a.distinct:
-                raise BindError(
-                    f"{a.name}(DISTINCT ...) is not supported yet")
-            if a.name in ("min", "max") and a.args:
-                probe = self.bind_expr(a.args[0], scope)
-                if probe.dtype.is_varlen:
-                    raise BindError(
-                        f"{a.name}() over strings is not supported yet")
-            if a.star or (not a.args):
-                if a.name != "count":
-                    raise BindError(f"{a.name}(*) is not valid")
-                bound_aggs.append(AggCall("count", None, False, dt.INT64,
-                                          out_name=f"_agg{i}"))
-                continue
+        # COUNT(DISTINCT x) as the only aggregate: rewrite to
+        # Distinct(keys + x) -> count(x) (colexec would use a dedup hash
+        # table; Distinct is our sort-based dedup)
+        if (len(uniq) == 1 and uniq[0].distinct
+                and uniq[0].name == "count"
+                and len(uniq[0].args) == 1 and not uniq[0].star):
+            a = uniq[0]
             arg = self.bind_expr(a.args[0], scope)
-            out_t = _agg_result_type(a.name, arg.dtype)
-            bound_aggs.append(AggCall(a.name, arg, a.distinct, out_t,
-                                      out_name=f"_agg{i}"))
+            dedup_exprs = group_keys + [arg]
+            dedup_schema = [(f"_g{i}", k.dtype)
+                            for i, k in enumerate(group_keys)] + \
+                [("_dv", arg.dtype)]
+            proj = plan.Project(node, dedup_exprs, dedup_schema)
+            node = plan.Distinct(proj, dedup_schema)
+            group_keys = [BoundCol(f"_g{i}", k.dtype)
+                          for i, k in enumerate(group_keys)]
+            bound_aggs = [AggCall("count", BoundCol("_dv", arg.dtype),
+                                  False, dt.INT64, out_name="_agg0")]
+        else:
+            bound_aggs = []
+            for i, a in enumerate(uniq):
+                if a.distinct:
+                    if a.name == "count" and len(uniq) == 1:
+                        raise BindError(
+                            "count(DISTINCT ...) takes exactly one "
+                            "argument")
+                    if len(uniq) == 1:
+                        raise BindError(
+                            f"{a.name}(DISTINCT ...) is not supported yet")
+                    raise BindError(
+                        f"{a.name}(DISTINCT ...) is not supported yet when "
+                        f"mixed with other aggregates")
+                if a.name in ("min", "max") and a.args:
+                    probe = self.bind_expr(a.args[0], scope)
+                    if probe.dtype.is_varlen:
+                        raise BindError(
+                            f"{a.name}() over strings is not supported yet")
+                if a.star or (not a.args):
+                    if a.name != "count":
+                        raise BindError(f"{a.name}(*) is not valid")
+                    bound_aggs.append(AggCall("count", None, False, dt.INT64,
+                                              out_name=f"_agg{i}"))
+                    continue
+                arg = self.bind_expr(a.args[0], scope)
+                out_t = _agg_result_type(a.name, arg.dtype)
+                bound_aggs.append(AggCall(a.name, arg, a.distinct, out_t,
+                                          out_name=f"_agg{i}"))
 
         key_names = [f"_g{i}" for i in range(len(group_keys))]
         schema = list(zip(key_names, [k.dtype for k in group_keys])) + \

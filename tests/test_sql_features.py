@@ -214,3 +214,24 @@ def test_show_surfaces_and_mo_ctl(tmp_path):
     p.write_text('id,name,e\n10,aa,"[1,1,1,1]"\n11,bb,"[2,2,2,2]"\n')
     assert s.load_csv("t", str(p)) == 2
     assert len(s.execute("select * from t").rows()) == 3
+
+
+def test_count_distinct():
+    s = Session()
+    s.execute("create table t (g varchar(2), v bigint)")
+    s.execute("insert into t values ('a',1),('a',1),('a',2),"
+              "('b',5),('b',5),('c',null)")
+    assert s.execute("select count(distinct v) from t").rows() == [(3,)]
+    assert s.execute("""select g, count(distinct v) c from t
+                        group by g order by g""").rows() == \
+        [("a", 2), ("b", 1), ("c", 0)]      # NULLs don't count
+    assert s.execute("""select g, count(distinct v) c from t group by g
+                        having count(distinct v) > 1""").rows() == [("a", 2)]
+    # distinct over strings too (dict codes)
+    s.execute("create table u (k bigint, s varchar(3))")
+    s.execute("insert into u values (1,'x'),(1,'x'),(1,'y'),(2,'x')")
+    assert s.execute("""select k, count(distinct s) from u
+                        group by k order by k""").rows() == [(1, 2), (2, 1)]
+    import pytest as _pt
+    with _pt.raises(Exception, match="mixed with other"):
+        s.execute("select count(distinct v), sum(v) from t")
