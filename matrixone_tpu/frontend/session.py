@@ -460,9 +460,11 @@ class Session:
         auto = [c.name for c in stmt.columns if c.auto_increment]
         if len(auto) > 1:
             raise BindError("only one AUTO_INCREMENT column allowed")
+        not_null = [c.name for c in stmt.columns if c.not_null]
         self.catalog.create_table(
             TableMeta(stmt.name, schema, stmt.primary_key,
-                      auto_increment=auto[0] if auto else None),
+                      auto_increment=auto[0] if auto else None,
+                      not_null=not_null),
             if_not_exists=stmt.if_not_exists)
         return Result()
 

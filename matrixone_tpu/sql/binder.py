@@ -467,11 +467,6 @@ class Binder:
                     raise BindError(
                         f"{a.name}(DISTINCT ...) is not supported yet when "
                         f"mixed with other aggregates")
-                if a.name in ("min", "max") and a.args:
-                    probe = self.bind_expr(a.args[0], scope)
-                    if probe.dtype.is_varlen:
-                        raise BindError(
-                            f"{a.name}() over strings is not supported yet")
                 if a.star or (not a.args):
                     if a.name != "count":
                         raise BindError(f"{a.name}(*) is not valid")

@@ -282,7 +282,7 @@ class Parser:
 
     def table_primary(self) -> ast.Node:
         if self.accept_op("("):
-            sel = self.select()
+            sel = self.select_or_union()
             self.expect_op(")")
             has_as = self.accept_kw("as")
             if not has_as and self.peek().kind != "ident":

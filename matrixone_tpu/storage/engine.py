@@ -49,6 +49,7 @@ class TableMeta:
     schema: Schema
     primary_key: List[str]
     auto_increment: Optional[str] = None   # column name (incrservice)
+    not_null: List[str] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -77,6 +78,10 @@ class ConflictError(RuntimeError):
 
 
 class DuplicateKeyError(RuntimeError):
+    pass
+
+
+class ConstraintError(RuntimeError):
     pass
 
 
@@ -558,6 +563,8 @@ class Engine:
             self.wal.append({"op": "create_table", "name": meta.name,
                              "ts": self.hlc.now(),
                              "pk": meta.primary_key,
+                             "auto": meta.auto_increment,
+                             "not_null": meta.not_null,
                              "schema": [[c, d.oid.value, d.width, d.scale,
                                          d.dim] for c, d in meta.schema]})
 
@@ -666,6 +673,15 @@ class Engine:
                         M.txn_commits.inc(outcome="conflict")
                         raise ConflictError(
                             f"write-write conflict on {tname}")
+            # NOT NULL constraints (PK columns are implicitly NOT NULL
+            # via the uniqueness check's NULL rejection)
+            for tname, segs in inserts.items():
+                t = self.get_table(tname)
+                for col in t.meta.not_null:
+                    for _a, v in segs:
+                        if col in v and not v[col].all():
+                            raise ConstraintError(
+                                f"column {tname!r}.{col} cannot be NULL")
             # PK uniqueness before anything durable happens; all of a
             # txn's batches are checked as ONE key set so duplicates across
             # statements in the same txn are caught too
@@ -821,6 +837,8 @@ class Engine:
                 "schema": [[c, d.oid.value, d.width, d.scale, d.dim]
                            for c, d in t.meta.schema],
                 "pk": t.meta.primary_key,
+                "auto": t.meta.auto_increment,
+                "not_null": t.meta.not_null,
                 "dicts": t.dicts,
                 "objects": objs,
                 "tombstones": [[ts, g.tolist()] for ts, g in t.tombstones],
@@ -844,7 +862,11 @@ class Engine:
             for name, tm in manifest["tables"].items():
                 schema = [(c, DType(TypeOid(o), width=w, scale=s, dim=dm))
                           for c, o, w, s, dm in tm["schema"]]
-                eng.create_table(TableMeta(name, schema, tm["pk"]), log=False)
+                eng.create_table(
+                    TableMeta(name, schema, tm["pk"],
+                              auto_increment=tm.get("auto"),
+                              not_null=tm.get("not_null", [])),
+                    log=False)
                 t = eng.get_table(name)
                 t.dicts = {k: list(v) for k, v in tm["dicts"].items()}
                 t._dict_idx = {k: {s_: i for i, s_ in enumerate(v)}
@@ -880,9 +902,11 @@ class Engine:
             if op == "create_table":
                 schema = [(c, DType(TypeOid(o), width=w, scale=s, dim=dm))
                           for c, o, w, s, dm in header["schema"]]
-                self.create_table(TableMeta(header["name"], schema,
-                                            header["pk"]), log=False,
-                                  if_not_exists=True)
+                self.create_table(
+                    TableMeta(header["name"], schema, header["pk"],
+                              auto_increment=header.get("auto"),
+                              not_null=header.get("not_null", [])),
+                    log=False, if_not_exists=True)
             elif op == "drop_table":
                 self.drop_table(header["name"], if_exists=True, log=False)
             elif op == "create_snapshot":

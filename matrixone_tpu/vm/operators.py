@@ -181,15 +181,23 @@ class AggOp(Operator):
     # ---- scalar (no GROUP BY)
     def _scalar_agg(self):
         states = [None] * len(self.node.aggs)
+        tracker = _AggDictTracker(self.node.aggs)
         for ex in self.child.execute():
+            tracker.observe(ex)
             for i, a in enumerate(self.node.aggs):
                 states[i] = _scalar_step(a, ex, states[i])
         cols, n1 = {}, jnp.asarray(1, jnp.int32)
+        out_dicts: Dict[str, list] = {}
         for (name, dtype), a, st in zip(self.node.schema[len(self.node.group_keys):],
                                         self.node.aggs, states):
-            cols[name] = _scalar_final(a, st, dtype)
+            col = _scalar_final(a, st, dtype)
+            d = tracker.dicts.get(a.out_name)
+            if d is not None and dtype.is_varlen:
+                col = _rank_to_code(col, d, dtype)
+                out_dicts[name] = d
+            cols[name] = col
         db = DeviceBatch(columns=cols, n_rows=n1)
-        yield ExecBatch(batch=db, dicts={},
+        yield ExecBatch(batch=db, dicts=out_dicts,
                         mask=jnp.ones((1,), jnp.bool_))
 
     # ---- grouped
@@ -197,7 +205,9 @@ class AggOp(Operator):
         nkeys = len(self.node.group_keys)
         state = None   # dict: keys:[arrays], kvalid:[arrays], partials per agg
         key_dicts: List[Optional[List[str]]] = [None] * nkeys
+        self._agg_tracker = _AggDictTracker(self.node.aggs)
         for ex in self.child.execute():
+            self._agg_tracker.observe(ex)
             keys = [eval_expr(k, ex) for k in self.node.group_keys]
             for i, (k_ast, k) in enumerate(zip(self.node.group_keys, keys)):
                 d = _expr_dict(k_ast, ex)
@@ -270,7 +280,12 @@ class AggOp(Operator):
                 dicts[name] = key_dicts[i]
         for (name, dtype), a, part in zip(self.node.schema[nkeys:],
                                           self.node.aggs, state["partials"]):
-            cols[name] = _grouped_final(a, part, dtype)
+            col = _grouped_final(a, part, dtype)
+            d = self._agg_tracker.dicts.get(a.out_name)
+            if d is not None and dtype.is_varlen:
+                col = _rank_to_code(col, d, dtype)
+                dicts[name] = d
+            cols[name] = col
         db = DeviceBatch(columns=cols, n_rows=state["n"])
         return ExecBatch(batch=db, dicts=dicts, mask=state["present"])
 
@@ -285,9 +300,54 @@ def _broadcast_full(col: DeviceColumn, n: int) -> DeviceColumn:
 # agg kernels: per-batch partial, merge, finalize -------------------------
 
 def _agg_value(a: AggCall, ex: ExecBatch):
+    if a.func in ("min", "max") and a.arg.dtype.is_varlen:
+        # aggregate over collation ranks so min/max follow string order,
+        # not dictionary insertion order; finalize maps rank -> string.
+        # (_sort_key_col evaluates the expression itself: one eval only)
+        if _expr_dict(a.arg, ex) is None:
+            raise EvalError(
+                f"{a.func}() over computed strings without a dictionary "
+                f"is not supported yet")
+        return _broadcast_full(_sort_key_col(a.arg, ex), ex.padded_len)
     col = eval_expr(a.arg, ex)
-    col = _broadcast_full(col, ex.padded_len)
-    return col
+    return _broadcast_full(col, ex.padded_len)
+
+
+def _rank_to_code(col: DeviceColumn, d: list, dtype) -> DeviceColumn:
+    """Invert collation rank back to a dictionary code (string min/max
+    finalize; shared by the scalar and grouped paths)."""
+    order = np.argsort(np.asarray(d, dtype=object))
+    code = jnp.asarray(order.astype(np.int32))[
+        jnp.clip(col.data.astype(jnp.int32), 0, len(d) - 1)]
+    return DeviceColumn(code, col.validity, dtype)
+
+
+class _AggDictTracker:
+    """Captures the dictionary behind each string min/max argument and
+    REJECTS mid-stream growth: collation ranks are only comparable across
+    batches when the dictionary is frozen (a union arm or concurrent
+    insert growing it would silently corrupt results otherwise)."""
+
+    def __init__(self, aggs):
+        self.watch = [a for a in aggs
+                      if a.func in ("min", "max") and a.arg is not None
+                      and a.arg.dtype.is_varlen]
+        self.dicts: Dict[str, list] = {}
+        self._sizes: Dict[str, int] = {}
+
+    def observe(self, ex: ExecBatch):
+        for a in self.watch:
+            d = _expr_dict(a.arg, ex)
+            if d is None:
+                continue
+            prev = self.dicts.get(a.out_name)
+            if prev is None:
+                self.dicts[a.out_name] = d
+                self._sizes[a.out_name] = len(d)
+            elif prev is not d or len(d) != self._sizes[a.out_name]:
+                raise EvalError(
+                    f"{a.func}() over strings from a growing dictionary "
+                    f"(union / multi-source) is not supported yet")
 
 
 def _grouped_step(a: AggCall, gi, ex: ExecBatch, mg: int):

@@ -263,3 +263,57 @@ def test_union(sess):
     assert [r[0] for r in rows] == [1, 2, 99]       # distinct merges (1,'a')
     # string dict unification across arms
     assert ("zz" in [r[1] for r in rows])
+
+
+def test_string_min_max_aggregates():
+    s = Session()
+    s.execute("create table t (g bigint, name varchar(8))")
+    s.execute("insert into t values (1,'zeta'),(1,'alpha'),(1,'mid'),"
+              "(2,'beta'),(3,null)")
+    assert s.execute("""select g, min(name), max(name) from t
+                        group by g order by g""").rows() == \
+        [(1, "alpha", "zeta"), (2, "beta", "beta"), (3, None, None)]
+    assert s.execute("select min(name), max(name) from t").rows() == \
+        [("alpha", "zeta")]
+
+
+def test_not_null_enforced():
+    from matrixone_tpu.storage.engine import ConstraintError, Engine
+    from matrixone_tpu.storage.fileservice import MemoryFS
+    fs = MemoryFS()
+    s = Session(catalog=Engine(fs))
+    s.execute("create table t (a bigint not null, b varchar(4))")
+    s.execute("insert into t values (1, null)")      # b is nullable
+    with pytest.raises(ConstraintError, match="cannot be NULL"):
+        s.execute("insert into t values (null, 'x')")
+    with pytest.raises(ConstraintError):
+        s.execute("update t set a = null where a = 1")
+    assert s.execute("select a from t").rows() == [(1,)]
+    # the constraint survives restart (WAL) and checkpoint
+    s2 = Session(catalog=Engine.open(fs))
+    with pytest.raises(ConstraintError):
+        s2.execute("insert into t values (null, 'x')")
+    s2.catalog.checkpoint()
+    s3 = Session(catalog=Engine.open(fs))
+    with pytest.raises(ConstraintError):
+        s3.execute("insert into t values (null, 'x')")
+
+
+def test_string_minmax_growing_dict_rejected():
+    s = Session()
+    s.execute("create table a (name varchar(8))")
+    s.execute("create table b (name varchar(8))")
+    s.execute("insert into a values ('b')")
+    s.execute("insert into b values ('a')")
+    with pytest.raises(Exception, match="growing dictionary"):
+        s.execute("""select max(name) from
+            (select name from a union all select name from b) u""").rows()
+
+
+def test_union_in_derived_table():
+    s = Session()
+    s.execute("create table a (v bigint)")
+    s.execute("insert into a values (3), (1)")
+    rows = s.execute("""select max(v) from
+        (select v from a union all select v + 10 from a) u""").rows()
+    assert rows == [(13,)]
