@@ -178,6 +178,9 @@ def main():
                                      compute_dtype=None)
         truth_batches.append(np.asarray(tidx))
     truth = np.concatenate(truth_batches)
+    # raw dataset + padded copy are dead weight from here (the index holds
+    # its own residual-encoded storage) — free ~6 GB of HBM before search
+    del padded, truth_batches, data
 
     # ---- search: warmup (compile) then timed
     def run_all():

@@ -20,25 +20,57 @@ class MySQLError(RuntimeError):
         self.code = code
 
 
+def _lenenc_bytes(raw: bytes) -> bytes:
+    if len(raw) < 251:
+        return bytes([len(raw)]) + raw
+    if len(raw) < 1 << 16:
+        return b"\xfc" + struct.pack("<H", len(raw)) + raw
+    if len(raw) < 1 << 24:
+        return b"\xfd" + struct.pack("<I", len(raw))[:3] + raw
+    return b"\xfe" + struct.pack("<Q", len(raw)) + raw
+
+
+def native_password_scramble(password: str, nonce: bytes) -> bytes:
+    """Client-side mysql_native_password response:
+    SHA1(pw) XOR SHA1(nonce + SHA1(SHA1(pw))). Lives here (stdlib-only)
+    so the thin client never imports the server/engine stack."""
+    import hashlib
+    if not password:
+        return b""
+    s1 = hashlib.sha1(password.encode()).digest()
+    s2 = hashlib.sha1(s1).digest()
+    mix = hashlib.sha1(nonce + s2).digest()
+    return bytes(a ^ b for a, b in zip(s1, mix))
+
+
 class Connection:
     def __init__(self, host: str = "127.0.0.1", port: int = 6001,
                  user: str = "root", password: str = "",
                  database: str = ""):
         self.sock = socket.create_connection((host, port), timeout=30)
         self.seq = 0
-        self._handshake(user, database)
+        self._handshake(user, password, database)
 
-    # ---- framing
+    # ---- framing (payloads >= 16MB span multiple packets)
     def _send(self, payload: bytes):
-        header = struct.pack("<I", len(payload))[:3] + bytes([self.seq & 0xFF])
-        self.sock.sendall(header + payload)
-        self.seq += 1
+        while True:
+            chunk, payload = payload[:0xFFFFFF], payload[0xFFFFFF:]
+            header = (struct.pack("<I", len(chunk))[:3]
+                      + bytes([self.seq & 0xFF]))
+            self.sock.sendall(header + chunk)
+            self.seq += 1
+            if len(chunk) < 0xFFFFFF:
+                return
 
     def _recv(self) -> bytes:
-        header = self._recv_n(4)
-        length = int.from_bytes(header[:3], "little")
-        self.seq = header[3] + 1
-        return self._recv_n(length)
+        payload = b""
+        while True:
+            header = self._recv_n(4)
+            length = int.from_bytes(header[:3], "little")
+            self.seq = header[3] + 1
+            payload += self._recv_n(length)
+            if length < 0xFFFFFF:
+                return payload
 
     def _recv_n(self, n: int) -> bytes:
         buf = b""
@@ -64,17 +96,32 @@ class Connection:
         return int.from_bytes(data[pos + 1:pos + 9], "little"), pos + 9
 
     # ---- handshake
-    def _handshake(self, user: str, database: str):
+    @staticmethod
+    def _nonce_from_greeting(greeting: bytes) -> bytes:
+        """Extract the 20-byte auth nonce from a HandshakeV10 packet."""
+        pos = 1
+        pos = greeting.index(b"\x00", pos) + 1       # server version
+        pos += 4                                     # connection id
+        part1 = greeting[pos:pos + 8]
+        pos += 8 + 1                                 # nonce part 1 + filler
+        pos += 2 + 1 + 2 + 2 + 1 + 10                # caps/charset/status/len
+        part2 = greeting[pos:pos + 12]
+        return part1 + part2
+
+    def _handshake(self, user: str, password: str, database: str):
         greeting = self._recv()
         assert greeting[0] == 10, "unsupported protocol"
+        nonce = self._nonce_from_greeting(greeting)
+        auth = native_password_scramble(password, nonce)
         caps = 0x0200 | 0x8000 | 0x00200000   # 41 + secure conn + plugin auth
         if database:
             caps |= 0x8                        # CLIENT_CONNECT_WITH_DB
         payload = (struct.pack("<I", caps) + struct.pack("<I", 1 << 24)
                    + bytes([0x21]) + b"\x00" * 23
                    + user.encode() + b"\x00"
-                   + bytes([0])                      # empty auth response
-                   + (database.encode() + b"\x00" if database else b""))
+                   + bytes([len(auth)]) + auth
+                   + (database.encode() + b"\x00" if database else b"")
+                   + b"mysql_native_password\x00")
         self._send(payload)
         resp = self._recv()
         if resp[0] == 0xFF:
@@ -150,6 +197,108 @@ class Connection:
             if pkt[0] == 0xFE and len(pkt) < 9:
                 return 0
 
+    # ---- prepared statements (binary protocol)
+    def prepare(self, sql: str) -> "PreparedStatement":
+        self.seq = 0
+        self._send(b"\x16" + sql.encode())
+        ok = self._recv()
+        if ok[0] == 0xFF:
+            raise self._err(ok)
+        stmt_id = int.from_bytes(ok[1:5], "little")
+        n_cols = int.from_bytes(ok[5:7], "little")
+        n_params = int.from_bytes(ok[7:9], "little")
+        for _ in range(n_params):
+            self._recv()                  # param definitions
+        if n_params:
+            self._recv()                  # EOF
+        for _ in range(n_cols):
+            self._recv()
+        if n_cols:
+            self._recv()
+        return PreparedStatement(self, stmt_id, n_params)
+
+    def _execute_prepared(self, stmt_id: int, params: list):
+        body = (b"\x17" + struct.pack("<I", stmt_id) + b"\x00"
+                + struct.pack("<I", 1))
+        n = len(params)
+        if n:
+            nullmap = bytearray((n + 7) // 8)
+            types = b""
+            values = b""
+            for i, v in enumerate(params):
+                if v is None:
+                    nullmap[i // 8] |= 1 << (i % 8)
+                    types += bytes([6, 0])            # MYSQL_TYPE_NULL
+                elif isinstance(v, bool):
+                    types += bytes([1, 0])
+                    values += bytes([int(v)])
+                elif isinstance(v, int):
+                    types += bytes([8, 0])            # LONGLONG
+                    values += struct.pack("<q", v)
+                elif isinstance(v, float):
+                    types += bytes([5, 0])            # DOUBLE
+                    values += struct.pack("<d", v)
+                else:
+                    import datetime
+                    if isinstance(v, datetime.datetime):
+                        types += bytes([12, 0])
+                        values += bytes([7]) + struct.pack(
+                            "<HBBBBB", v.year, v.month, v.day,
+                            v.hour, v.minute, v.second)
+                    elif isinstance(v, datetime.date):
+                        types += bytes([10, 0])
+                        values += bytes([4]) + struct.pack(
+                            "<HBB", v.year, v.month, v.day)
+                    else:
+                        raw = (v if isinstance(v, bytes)
+                               else str(v).encode())
+                        types += bytes([253, 0])      # VAR_STRING
+                        values += _lenenc_bytes(raw)
+            body += bytes(nullmap) + b"\x01" + types + values
+        self.seq = 0
+        self._send(body)
+        return self._read_binary_result()
+
+    def _read_binary_result(self):
+        first = self._recv()
+        if first[0] == 0xFF:
+            raise self._err(first)
+        if first[0] == 0x00:
+            affected, _ = self._lenenc(first, 1)
+            return [], [], affected or 0
+        ncols, _ = self._lenenc(first, 0)
+        names = []
+        for _ in range(ncols):
+            col = self._recv()
+            pos = 0
+            parts = []
+            for _f in range(6):
+                ln, pos = self._lenenc(col, pos)
+                parts.append(col[pos:pos + (ln or 0)])
+                pos += ln or 0
+            names.append(parts[4].decode())
+        self._recv()                      # EOF after columns
+        rows = []
+        nm_len = (ncols + 2 + 7) // 8
+        while True:
+            pkt = self._recv()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            if pkt[0] == 0xFF:
+                raise self._err(pkt)
+            nullmap = pkt[1:1 + nm_len]
+            pos = 1 + nm_len
+            row = []
+            for i in range(ncols):
+                if nullmap[(i + 2) // 8] & (1 << ((i + 2) % 8)):
+                    row.append(None)
+                    continue
+                ln, pos = self._lenenc(pkt, pos)
+                row.append(pkt[pos:pos + (ln or 0)].decode())
+                pos += ln or 0
+            rows.append(tuple(row))
+        return names, rows, 0
+
     def ping(self) -> bool:
         self.seq = 0
         self._send(b"\x0e")
@@ -162,6 +311,30 @@ class Connection:
         except OSError:
             pass
         self.sock.close()
+
+
+class PreparedStatement:
+    """Client handle for a server-side prepared statement (binary
+    protocol). execute(*params) -> (names, rows, affected)."""
+
+    def __init__(self, conn: Connection, stmt_id: int, n_params: int):
+        self.conn = conn
+        self.stmt_id = stmt_id
+        self.n_params = n_params
+
+    def execute(self, *params):
+        if len(params) != self.n_params:
+            raise ValueError(
+                f"statement takes {self.n_params} parameters, got "
+                f"{len(params)}")
+        return self.conn._execute_prepared(self.stmt_id, list(params))
+
+    def close(self):
+        try:
+            self.conn.seq = 0
+            self.conn._send(b"\x19" + struct.pack("<I", self.stmt_id))
+        except OSError:
+            pass
 
 
 def connect(**kwargs) -> Connection:

@@ -127,6 +127,23 @@ class DType:
         return n
 
 
+_NP_TO_OID = {
+    np.dtype(np.bool_): TypeOid.BOOL, np.dtype(np.int8): TypeOid.INT8,
+    np.dtype(np.int16): TypeOid.INT16, np.dtype(np.int32): TypeOid.INT32,
+    np.dtype(np.int64): TypeOid.INT64, np.dtype(np.uint8): TypeOid.UINT8,
+    np.dtype(np.uint16): TypeOid.UINT16, np.dtype(np.uint32): TypeOid.UINT32,
+    np.dtype(np.uint64): TypeOid.UINT64,
+    np.dtype(np.float32): TypeOid.FLOAT32,
+    np.dtype(np.float64): TypeOid.FLOAT64,
+}
+
+
+def from_jnp(dtype) -> DType:
+    """Physical array dtype -> a DType with the same agg/compare semantics
+    (used to revive spilled columns; logical modifiers are not recovered)."""
+    return DType(_NP_TO_OID[np.dtype(dtype)])
+
+
 # Shorthand constructors (match reference's types.New(...) helpers).
 BOOL = DType(TypeOid.BOOL)
 INT8 = DType(TypeOid.INT8)

@@ -843,6 +843,7 @@ class Engine:
                 "objects": objs,
                 "tombstones": [[ts, g.tolist()] for ts, g in t.tombstones],
                 "next_gid": t.next_gid, "next_seg": t.next_seg,
+                "next_auto": t.next_auto,
             }
         self.fs.write("meta/manifest.json",
                       json.dumps(manifest).encode())
@@ -884,6 +885,14 @@ class Engine:
                                 for ts, g in tm["tombstones"]]
                 t.next_gid = tm["next_gid"]
                 t.next_seg = tm["next_seg"]
+                # incrservice state: older manifests predate the field —
+                # fall back to scanning the committed auto column
+                if "next_auto" in tm:
+                    t.next_auto = tm["next_auto"]
+                elif t.meta.auto_increment:
+                    for seg in t.segments:
+                        t.observe_auto(seg.arrays[t.meta.auto_increment][
+                            seg.validity[t.meta.auto_increment]])
         eng._replay_wal()
         eng.committed_ts = eng.hlc.now()
         return eng
@@ -928,6 +937,9 @@ class Engine:
                             if isinstance(a, list):   # varchar strings
                                 arrays[c] = t.encode_strings_list(c, a)
                         t.apply_segment(t.make_segment(arrays, validity, ts))
+                        ac = t.meta.auto_increment
+                        if ac and ac in arrays:
+                            t.observe_auto(arrays[ac][validity[ac]])
                     else:
                         t.apply_tombstones(ts, np.asarray(h["gids"],
                                                           np.int64))

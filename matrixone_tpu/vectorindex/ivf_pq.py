@@ -75,7 +75,8 @@ def build(dataset: jnp.ndarray, nlist: int, n_subspaces: int = 16,
           metric: str = METRIC_L2, n_iter: int = 10, pq_iter: int = 8,
           seed: int = 0, balance_weight: float = 0.3,
           kmeans_sample: Optional[int] = 262144,
-          compute_dtype=jnp.bfloat16) -> IvfPqIndex:
+          compute_dtype=jnp.bfloat16,
+          max_list_factor: Optional[float] = 4.0) -> IvfPqIndex:
     if metric not in (METRIC_L2, METRIC_COSINE):
         raise ValueError(
             f"ivf_pq supports l2/cosine metrics only (got {metric!r}); "
@@ -91,12 +92,18 @@ def build(dataset: jnp.ndarray, nlist: int, n_subspaces: int = 16,
     km = kmeans.fit(data, nlist, n_iter=n_iter, seed=seed,
                     balance_weight=balance_weight, sample=kmeans_sample,
                     compute_dtype=compute_dtype)
-    order = jnp.argsort(km.labels).astype(jnp.int32)
-    counts = km.cluster_sizes
+    if max_list_factor is not None:
+        labels, counts, _ = kmeans.capped_labels(
+            data, km.centroids, nlist, max_list_factor,
+            compute_dtype=compute_dtype)
+    else:
+        labels = km.labels
+        counts = km.cluster_sizes
+    order = jnp.argsort(labels).astype(jnp.int32)
     offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                                jnp.cumsum(counts).astype(jnp.int32)])
     sorted_vecs = data[order]
-    residuals = sorted_vecs - km.centroids[km.labels[order]]   # [n, d]
+    residuals = sorted_vecs - km.centroids[labels[order]]   # [n, d]
 
     # per-subspace k-means over residual slices (256 codes = 8 bits)
     k_pq = min(256, max(2, n))

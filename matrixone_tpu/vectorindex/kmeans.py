@@ -72,6 +72,96 @@ def _lloyd_step(data, centroids, sizes, k: int, balance_weight: float,
     return new_centroids, labels, counts
 
 
+@partial(jax.jit, static_argnames=("topc", "chunk_size", "compute_dtype"))
+def assign_topc(data: jnp.ndarray, centroids: jnp.ndarray, topc: int,
+                chunk_size: int = 131072, compute_dtype=None):
+    """Top-C nearest centroids per point -> (cand [n,topc] i32,
+    dist [n,topc] f32). Feeds the host-side capacity rebalancer."""
+    n, d = data.shape
+    pad = (-n) % chunk_size
+    padded = jnp.concatenate([data, jnp.zeros((pad, d), data.dtype)]) if pad else data
+    chunks = padded.reshape(-1, chunk_size, d)
+
+    def step(_, chunk):
+        dist = D.l2_distance_sq(chunk, centroids, compute_dtype=compute_dtype)
+        nd, idx = jax.lax.top_k(-dist, topc)
+        return None, (-nd, idx.astype(jnp.int32))
+
+    _, (dists, idxs) = jax.lax.scan(step, None, chunks)
+    return (idxs.reshape(-1, topc)[:n], dists.reshape(-1, topc)[:n])
+
+
+def capacity_assign(cand: "np.ndarray", cdist: "np.ndarray", k: int,
+                    cap: int) -> "np.ndarray":
+    """Greedy capacity-capped assignment: every cluster ends with <= cap
+    members. Points overflowing a full cluster move to their next-nearest
+    candidate centroid (cuVS-style hard balancing — the reference balances
+    for the same reason: an oversized inverted list sets the padded scan
+    budget for EVERY probe, cgo/cuvs blog.md:36). Host numpy: runs once at
+    build, vectorized rounds, guaranteed termination via a final spill pass.
+    """
+    import numpy as np
+    cand = np.asarray(cand)
+    cdist = np.asarray(cdist)
+    n, C = cand.shape
+    if cap * k < n:
+        raise ValueError(f"cap {cap} * nlist {k} < n {n}: no feasible assignment")
+    choice = np.zeros(n, np.int32)
+    labels = cand[:, 0].copy()
+
+    def evicted_overflow(labels):
+        """Indices of points beyond each cluster's first `cap` members
+        (members ranked by distance to their centroid, closest kept)."""
+        d = cdist[np.arange(n), choice]
+        order = np.lexsort((d, labels))
+        sl = labels[order]
+        start = np.searchsorted(sl, sl)          # first index of own label
+        pos = np.arange(n) - start
+        return order[pos >= cap]
+
+    for _ in range(C):
+        counts = np.bincount(labels, minlength=k)
+        if not (counts > cap).any():
+            break
+        ev = evicted_overflow(labels)
+        nc = np.minimum(choice[ev] + 1, C - 1)
+        for _ in range(C):                       # skip candidates already full
+            tgt = cand[ev, nc]
+            bad = (counts[tgt] >= cap) & (nc < C - 1)
+            if not bad.any():
+                break
+            nc = np.where(bad, nc + 1, nc)
+        choice[ev] = nc
+        labels[ev] = cand[ev, nc]
+    counts = np.bincount(labels, minlength=k)
+    if (counts > cap).any():                     # spill pass: place leftovers
+        ev = evicted_overflow(labels)            # wherever space remains
+        free = cap - np.bincount(np.delete(labels, ev), minlength=k)
+        slots = np.repeat(np.arange(k), np.maximum(free, 0))
+        labels[ev] = slots[:len(ev)]
+    return labels
+
+
+def capped_labels(data: jnp.ndarray, centroids: jnp.ndarray, nlist: int,
+                  max_list_factor: float, compute_dtype=None):
+    """Final IVF assignment with a HARD per-list capacity cap
+    (lane-aligned max(256, factor * mean list size)). Returns
+    (labels jnp int32, counts jnp int32, cap). Shared by ivf_flat/ivf_pq
+    builds — one runaway cluster would otherwise set the padded gather
+    budget for every probe."""
+    import numpy as np
+    n = data.shape[0]
+    cap = int(max_list_factor * -(-n // nlist))
+    cap = max(256, ((cap + 127) // 128) * 128)
+    cnd, cds = assign_topc(data, centroids, topc=min(8, nlist),
+                           compute_dtype=compute_dtype)
+    labels_np = capacity_assign(cnd, cds, nlist, cap)
+    labels = jnp.asarray(labels_np, jnp.int32)
+    counts = jnp.asarray(np.bincount(labels_np, minlength=nlist)
+                         .astype(np.int32))
+    return labels, counts, cap
+
+
 def fit(data: jnp.ndarray, k: int, n_iter: int = 10, seed: int = 0,
         balance_weight: float = 0.0, chunk_size: int = 131072,
         compute_dtype=None, sample: int | None = 262144) -> KMeansResult:

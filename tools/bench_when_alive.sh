@@ -1,0 +1,19 @@
+#!/bin/bash
+# Probe the TPU tunnel; run the full bench the moment it answers.
+# Writes the JSON line to bench_r2_result.json on success.
+cd /root/repo
+for i in $(seq 1 100); do
+  if timeout 90 python -c "import jax, jax.numpy as jnp; jnp.ones(8).sum().block_until_ready()" >/dev/null 2>&1; then
+    echo "$(date -u +%T) probe ok, running bench (attempt $i)" >> bench_watch.log
+    if timeout 2400 python bench.py > bench_r2_result.json 2> bench_r2_stderr.log; then
+      echo "$(date -u +%T) bench done: $(cat bench_r2_result.json)" >> bench_watch.log
+      exit 0
+    else
+      echo "$(date -u +%T) bench failed rc=$? (see bench_r2_stderr.log)" >> bench_watch.log
+    fi
+  else
+    echo "$(date -u +%T) probe failed (attempt $i)" >> bench_watch.log
+  fi
+  sleep 300
+done
+exit 1
