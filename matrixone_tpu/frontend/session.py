@@ -286,18 +286,28 @@ class Session:
         return "\n".join(render(op))
 
     # ---------------------------------------------------- subquery inlining
-    def _inline_subqueries(self, node, depth=0, ctes=None):
+    def _run_subquery(self, sel):
+        """Execute a nested subquery with a nesting bound (clean
+        BindError instead of a RecursionError deep in the engine)."""
+        d = getattr(self, "_subq_depth", 0)
+        if d > 64:
+            raise BindError("subquery nesting too deep")
+        self._subq_depth = d + 1
+        try:
+            return self._select(sel)
+        finally:
+            self._subq_depth = d
+
+    def _inline_subqueries(self, node, ctes=None):
         """Execute uncorrelated subqueries once and inline the results
         (reference: the planner turns these into joins; execute-once has
         identical semantics for the uncorrelated case). Correlated
         subqueries surface as 'unknown column' from the inner bind."""
         import dataclasses as dc
-        if depth > 8:
-            raise BindError("subquery nesting too deep")
         if isinstance(node, ast.Subquery):
             if ctes:
                 node.select.ctes = list(ctes) + list(node.select.ctes)
-            r = self._select(node.select)
+            r = self._run_subquery(node.select)
             rows = r.rows()
             if len(r.column_names) != 1:
                 raise BindError("scalar subquery must return one column")
@@ -311,7 +321,7 @@ class Session:
             sub = dc.replace(node.select, limit=inner_limit)
             if ctes:
                 sub.ctes = list(ctes) + list(sub.ctes)
-            r = self._select(sub)
+            r = self._run_subquery(sub)
             has = len(r.rows()) > 0
             return ast.Literal(has != node.negated, "bool")
         if isinstance(node, ast.InList) and len(node.items) == 1 \
@@ -319,7 +329,7 @@ class Session:
             if ctes:
                 node.items[0].select.ctes = \
                     list(ctes) + list(node.items[0].select.ctes)
-            r = self._select(node.items[0].select)
+            r = self._run_subquery(node.items[0].select)
             if len(r.column_names) != 1:
                 raise BindError("IN subquery must return one column")
             vals = [row[0] for row in r.rows()]
@@ -338,12 +348,12 @@ class Session:
                 v = getattr(node, f.name)
                 if isinstance(v, ast.Node):
                     setattr(node, f.name,
-                            self._inline_subqueries(v, depth + 1, ctes))
+                            self._inline_subqueries(v, ctes))
                 elif isinstance(v, list):
                     setattr(node, f.name, [
-                        self._inline_subqueries(x, depth + 1, ctes)
+                        self._inline_subqueries(x, ctes)
                         if isinstance(x, ast.Node) else
-                        tuple(self._inline_subqueries(y, depth + 1, ctes)
+                        tuple(self._inline_subqueries(y, ctes)
                               if isinstance(y, ast.Node) else y
                               for y in x) if isinstance(x, tuple) else x
                         for x in v])
@@ -364,12 +374,36 @@ class Session:
             if isinstance(sub, ast.Select) and not sub.ctes:
                 sub.ctes = list(ctes[:i])
             self._prepare_select(sub)
+        # derived tables in FROM get the same treatment (their subqueries
+        # may be correlated against their own FROM); guarded by a marker so
+        # the decorrelation-added derived table below is prepared exactly
+        # once
+        def prep_from(f):
+            if isinstance(f, ast.SubqueryRef):
+                if getattr(f.select, "_mo_prepared", False):
+                    return
+                if isinstance(f.select, ast.Select) and not f.select.ctes:
+                    f.select.ctes = list(ctes)
+                self._prepare_select(f.select)
+            elif isinstance(f, ast.Join):
+                prep_from(f.left)
+                prep_from(f.right)
+        prep_from(sel.from_)
+        # decorrelate correlated EXISTS / scalar-agg subqueries into joins
+        # (reference: plan builder subquery flattening); uncorrelated ones
+        # are inlined below by executing once
+        from matrixone_tpu.sql.decorrelate import decorrelate_select
+        decorrelate_select(sel, self.catalog, dict(ctes))
+        for sj in sel.semijoins:
+            self._prepare_select(sj.select)
+        prep_from(sel.from_)   # derived tables ADDED by decorrelation
         for it in sel.items:
             it.expr = self._inline_subqueries(it.expr, ctes=ctes)
         if sel.where is not None:
             sel.where = self._inline_subqueries(sel.where, ctes=ctes)
         if sel.having is not None:
             sel.having = self._inline_subqueries(sel.having, ctes=ctes)
+        sel._mo_prepared = True
 
     def _try_mo_ctl(self, sel) -> Optional[Result]:
         """`select mo_ctl('cmd'[, 'arg'])` — ops control functions

@@ -1,0 +1,334 @@
+"""Replicated WAL: 3 log-replica processes + a quorum append client.
+
+Reference analogue: `pkg/logservice` (dragonboat Raft WAL shards,
+store.go:171) — re-designed to the minimum that gives the same durability
+contract for this engine's single-writer TN role:
+
+  * each replica is its own PROCESS owning an append-only frame file;
+  * the engine (sole writer, like the reference TN) appends with a
+    monotonically increasing (epoch, seq); an append is durable once a
+    MAJORITY of replicas ack — losing any minority loses nothing;
+  * writer restart: epoch := max(replica epochs) + 1 fences any stale
+    writer (replicas reject appends from older epochs — the
+    view-change half of viewstamped replication); recovery reads a
+    majority and takes the seq-union, which must contain every
+    majority-acked entry (any 2-of-3 overlap with every ack set);
+    single-writer sequencing means union-dedupe is conflict-free, so no
+    leader election or log repair pass is needed (the full Raft state
+    machine collapses under the one-writer assumption).
+
+Wire protocol (length-prefixed, JSON + raw blob):
+    u32 header_len | header_json | u32 blob_len | blob
+Ops: hello(epoch) | append(epoch, seq) | read | truncate(epoch, upto) |
+ping | stop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+def _send_msg(sock: socket.socket, header: dict, blob: bytes = b"") -> None:
+    hj = json.dumps(header).encode()
+    sock.sendall(struct.pack("<I", len(hj)) + hj
+                 + struct.pack("<I", len(blob)) + blob)
+
+
+def _recv_n(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            raise ConnectionError("peer closed")
+        buf += part
+    return buf
+
+
+def _recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
+    (hlen,) = struct.unpack("<I", _recv_n(sock, 4))
+    header = json.loads(_recv_n(sock, hlen).decode())
+    (blen,) = struct.unpack("<I", _recv_n(sock, 4))
+    return header, _recv_n(sock, blen) if blen else b""
+
+
+_REC = struct.Struct("<QQI")       # epoch, seq, payload_len
+
+
+class LogReplica:
+    """One log replica: append-only frame file + TCP service."""
+
+    def __init__(self, data_dir: str, port: int = 0):
+        os.makedirs(data_dir, exist_ok=True)
+        self.path = os.path.join(data_dir, "replica.log")
+        self.meta_path = os.path.join(data_dir, "replica.meta")
+        self.epoch = 0
+        self.entries: Dict[int, Tuple[int, bytes]] = {}   # seq -> (epoch, payload)
+        self._load()
+        self._lock = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(16)
+        self._stopping = threading.Event()
+
+    def _load(self) -> None:
+        if os.path.exists(self.meta_path):
+            with open(self.meta_path) as f:
+                self.epoch = int(f.read().strip() or 0)
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            blob = f.read()
+        off = 0
+        while off + _REC.size <= len(blob):
+            epoch, seq, plen = _REC.unpack_from(blob, off)
+            if off + _REC.size + plen > len(blob):
+                break                  # torn tail
+            payload = blob[off + _REC.size:off + _REC.size + plen]
+            self.entries[seq] = (epoch, payload)
+            off += _REC.size + plen
+
+    def _persist_epoch(self) -> None:
+        with open(self.meta_path, "w") as f:
+            f.write(str(self.epoch))
+            f.flush()
+            os.fsync(f.fileno())
+
+    def _append(self, epoch: int, seq: int, payload: bytes) -> dict:
+        with self._lock:
+            if epoch < self.epoch:
+                return {"ok": False, "err": f"stale epoch {epoch} < {self.epoch}"}
+            if epoch > self.epoch:
+                self.epoch = epoch
+                self._persist_epoch()
+            self.entries[seq] = (epoch, payload)
+            with open(self.path, "ab") as f:
+                f.write(_REC.pack(epoch, seq, len(payload)) + payload)
+                f.flush()
+                os.fsync(f.fileno())
+            return {"ok": True}
+
+    def _truncate(self, epoch: int, upto: int) -> dict:
+        with self._lock:
+            if epoch < self.epoch:
+                return {"ok": False, "err": "stale epoch"}
+            self.entries = {s: v for s, v in self.entries.items()
+                            if s > upto}
+            tmp = self.path + ".tmp"
+            with open(tmp, "wb") as f:
+                for s in sorted(self.entries):
+                    e, p = self.entries[s]
+                    f.write(_REC.pack(e, s, len(p)) + p)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            return {"ok": True}
+
+    def serve_forever(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def start(self) -> "LogReplica":
+        threading.Thread(target=self.serve_forever, daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                header, blob = _recv_msg(conn)
+                op = header.get("op")
+                if op == "append":
+                    _send_msg(conn, self._append(header["epoch"],
+                                                 header["seq"], blob))
+                elif op == "read":
+                    with self._lock:
+                        seqs = sorted(self.entries)
+                        out = b"".join(
+                            _REC.pack(self.entries[s][0], s,
+                                      len(self.entries[s][1]))
+                            + self.entries[s][1] for s in seqs)
+                    _send_msg(conn, {"ok": True, "epoch": self.epoch,
+                                     "n": len(seqs)}, out)
+                elif op == "hello":
+                    with self._lock:
+                        if header["epoch"] > self.epoch:
+                            self.epoch = header["epoch"]
+                            self._persist_epoch()
+                        _send_msg(conn, {"ok": True, "epoch": self.epoch})
+                elif op == "truncate":
+                    _send_msg(conn, self._truncate(header["epoch"],
+                                                   header["upto"]))
+                elif op == "ping":
+                    _send_msg(conn, {"ok": True, "epoch": self.epoch})
+                elif op == "stop":
+                    _send_msg(conn, {"ok": True})
+                    os._exit(0)        # hard-kill path for tests
+                else:
+                    _send_msg(conn, {"ok": False, "err": f"bad op {op}"})
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class ReplicatedLog:
+    """Quorum append client — the engine's WAL when the log role runs as
+    separate replica processes. Drop-in for storage.wal.WalWriter
+    (append/truncate/replay)."""
+
+    def __init__(self, addrs: List[Tuple[str, int]],
+                 quorum: Optional[int] = None, timeout: float = 5.0):
+        self.addrs = list(addrs)
+        self.quorum = quorum or (len(addrs) // 2 + 1)
+        self.timeout = timeout
+        self._socks: Dict[int, Optional[socket.socket]] = {}
+        self.seq = 0
+        # fence any previous writer: adopt max(epochs) + 1
+        epochs = []
+        for i in range(len(self.addrs)):
+            r = self._call(i, {"op": "ping"})
+            if r is not None:
+                epochs.append(r[0].get("epoch", 0))
+        if len(epochs) < self.quorum:
+            raise ConnectionError(
+                f"only {len(epochs)}/{len(self.addrs)} log replicas "
+                f"reachable; need {self.quorum}")
+        self.epoch = max(epochs) + 1
+        for i in range(len(self.addrs)):
+            self._call(i, {"op": "hello", "epoch": self.epoch})
+        # resume seq past anything already logged
+        for _, entries in self._read_majority():
+            if entries:
+                self.seq = max(self.seq, max(s for s, _ in entries))
+
+    # ---- transport
+    def _sock_for(self, i: int) -> Optional[socket.socket]:
+        s = self._socks.get(i)
+        if s is not None:
+            return s
+        try:
+            s = socket.create_connection(self.addrs[i], timeout=self.timeout)
+            s.settimeout(self.timeout)
+            self._socks[i] = s
+            return s
+        except OSError:
+            self._socks[i] = None
+            return None
+
+    def _call(self, i: int, header: dict, blob: bytes = b""):
+        s = self._sock_for(i)
+        if s is None:
+            return None
+        try:
+            _send_msg(s, header, blob)
+            return _recv_msg(s)
+        except (OSError, ConnectionError):
+            try:
+                s.close()
+            except OSError:
+                pass
+            self._socks[i] = None
+            return None
+
+    # ---- WalWriter interface
+    def append(self, header: dict, arrow_blob: bytes = b"") -> None:
+        hj = json.dumps(header).encode()
+        payload = struct.pack("<I", len(hj)) + hj + arrow_blob
+        self.seq += 1
+        acks = 0
+        errs = []
+        for i in range(len(self.addrs)):
+            r = self._call(i, {"op": "append", "epoch": self.epoch,
+                               "seq": self.seq}, payload)
+            if r is not None and r[0].get("ok"):
+                acks += 1
+            elif r is not None:
+                errs.append(r[0].get("err"))
+        if acks < self.quorum:
+            raise ConnectionError(
+                f"WAL append seq={self.seq}: {acks} acks < quorum "
+                f"{self.quorum} ({errs})")
+
+    def truncate(self) -> None:
+        for i in range(len(self.addrs)):
+            self._call(i, {"op": "truncate", "epoch": self.epoch,
+                           "upto": self.seq})
+
+    def _read_majority(self):
+        """[(replica_idx, [(seq, payload)])] from >= quorum replicas."""
+        out = []
+        for i in range(len(self.addrs)):
+            r = self._call(i, {"op": "read"})
+            if r is None or not r[0].get("ok"):
+                continue
+            blob = r[1]
+            entries, off = [], 0
+            while off + _REC.size <= len(blob):
+                _e, seq, plen = _REC.unpack_from(blob, off)
+                entries.append((seq, blob[off + _REC.size:
+                                          off + _REC.size + plen]))
+                off += _REC.size + plen
+            out.append((i, entries))
+        if len(out) < self.quorum:
+            raise ConnectionError(
+                f"{len(out)} replicas readable < quorum {self.quorum}")
+        return out
+
+    def replay(self) -> Iterator[Tuple[dict, bytes]]:
+        """Union of a majority's entries, seq-ordered (single-writer:
+        union is conflict-free; contains every majority-acked entry)."""
+        merged: Dict[int, bytes] = {}
+        for _, entries in self._read_majority():
+            for seq, payload in entries:
+                merged[seq] = payload
+        for seq in sorted(merged):
+            payload = merged[seq]
+            (hlen,) = struct.unpack_from("<I", payload, 0)
+            header = json.loads(payload[4:4 + hlen].decode())
+            yield header, payload[4 + hlen:]
+
+    def close(self) -> None:
+        for s in self._socks.values():
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+
+def main() -> None:          # replica process entry
+    import argparse
+    import sys
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args()
+    rep = LogReplica(args.dir, args.port)
+    print(f"PORT {rep.port}", flush=True)
+    sys.stdout.flush()
+    rep.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

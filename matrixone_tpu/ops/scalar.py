@@ -67,6 +67,19 @@ def _arith(a: DeviceColumn, b: DeviceColumn, fn, out_dtype: DType,
     return DeviceColumn(data=out, validity=valid, dtype=out_dtype)
 
 
+def _descale_for_float(a: DeviceColumn, b: DeviceColumn):
+    """When decimal math lands in float (mixed operands), the decimal side
+    must enter as its REAL value, not raw scaled ints (cents * 0.2 is off
+    by 10^scale)."""
+    def conv(c):
+        if c.dtype.oid == TypeOid.DECIMAL64:
+            return DeviceColumn(c.data.astype(jnp.float64)
+                                / (10.0 ** c.dtype.scale),
+                                c.validity, dt.FLOAT64)
+        return c
+    return conv(a), conv(b)
+
+
 def add(a: DeviceColumn, b: DeviceColumn) -> DeviceColumn:
     out_t = _result_type(a.dtype, b.dtype)
     if out_t.oid == TypeOid.DECIMAL64:
@@ -75,6 +88,8 @@ def add(a: DeviceColumn, b: DeviceColumn) -> DeviceColumn:
         out_t = dt.decimal64(scale=s)
         return DeviceColumn(jnp.broadcast_to(da, jnp.broadcast_shapes(da.shape, db.shape)) + db,
                             valid, out_t)
+    if out_t.is_float:
+        a, b = _descale_for_float(a, b)
     return _arith(a, b, jnp.add, out_t)
 
 
@@ -84,6 +99,8 @@ def sub(a: DeviceColumn, b: DeviceColumn) -> DeviceColumn:
         da, db, s = _decimal_rescale(a, b)
         _, _, valid = _broadcast2(a, b)
         return DeviceColumn(da - db, valid, dt.decimal64(scale=s))
+    if out_t.is_float:
+        a, b = _descale_for_float(a, b)
     return _arith(a, b, jnp.subtract, out_t)
 
 
@@ -95,6 +112,8 @@ def mul(a: DeviceColumn, b: DeviceColumn) -> DeviceColumn:
         sb = b.dtype.scale if b.dtype.oid == TypeOid.DECIMAL64 else 0
         da, db, valid = _broadcast2(a, b)
         return DeviceColumn(da * db, valid, dt.decimal64(scale=sa + sb))
+    if out_t.is_float:
+        a, b = _descale_for_float(a, b)
     return _arith(a, b, jnp.multiply, out_t)
 
 
@@ -146,6 +165,16 @@ def _cmp(a: DeviceColumn, b: DeviceColumn, fn) -> DeviceColumn:
         db = jnp.broadcast_to(db, (n,))
         return DeviceColumn(fn(da, db), valid, dt.BOOL)
     da, db, valid = _broadcast2(a, b)
+    if TypeOid.DECIMAL64 in (a.dtype.oid, b.dtype.oid) and \
+            (a.dtype.is_float or b.dtype.is_float):
+        # decimal vs float: descale the decimal to real units (cents
+        # compared against a float threshold would be off by 10^scale)
+        if a.dtype.oid == TypeOid.DECIMAL64:
+            da = da.astype(jnp.float64) / (10 ** a.dtype.scale)
+        if b.dtype.oid == TypeOid.DECIMAL64:
+            db = db.astype(jnp.float64) / (10 ** b.dtype.scale)
+        return DeviceColumn(fn(da.astype(jnp.float64),
+                               db.astype(jnp.float64)), valid, dt.BOOL)
     if a.dtype.is_numeric and b.dtype.is_numeric and a.dtype.oid != b.dtype.oid:
         ct = dt.promote(a.dtype, b.dtype).jnp_dtype
         da, db = da.astype(ct), db.astype(ct)

@@ -173,6 +173,22 @@ class Select(Node):
     distinct: bool = False
     ctes: List[Tuple[str, "Select"]] = dataclasses.field(
         default_factory=list)          # WITH name AS (select ...)
+    semijoins: List["SemiJoinSpec"] = dataclasses.field(
+        default_factory=list)          # decorrelated EXISTS predicates
+
+
+@dataclasses.dataclass
+class SemiJoinSpec(Node):
+    """A decorrelated [NOT] EXISTS: semi/anti-join the outer plan against
+    `select` on outer_keys[i] = the i-th projected column of `select`;
+    `residual` (if any) references outer columns + projected residual
+    columns of `select` and must hold for a pair to count as a match."""
+    select: "Select"
+    outer_keys: List[Node]
+    n_keys: int
+    residual: Optional[Node]
+    negated: bool
+    alias: str               # unique tag; projected cols are {alias}_k{i}
 
 
 @dataclasses.dataclass

@@ -178,6 +178,9 @@ class Binder:
             _require_bool(pred, "WHERE")
             node = plan.Filter(node, pred, node.schema)
 
+        for sj in getattr(sel, "semijoins", ()):
+            node = self._bind_semijoin(node, scope, sj)
+
         # expand stars early
         items: List[ast.SelectItem] = []
         for it in sel.items:
@@ -325,6 +328,26 @@ class Binder:
             return plan.Join(kind, lnode, rnode, lkeys, rkeys, residual,
                              schema), sc
         raise BindError(f"unsupported FROM clause {type(from_).__name__}")
+
+    def _bind_semijoin(self, node, scope, sj: "ast.SemiJoinSpec"):
+        """Bind a decorrelated [NOT] EXISTS as a semi/anti join: build side
+        = the rewritten subquery plan (projects {alias}_k* key columns and
+        {alias}_r* residual columns); probe side = the current plan."""
+        subplan = self.bind_select(sj.select) if isinstance(
+            sj.select, ast.Select) else self.bind_statement(sj.select)
+        left_keys = [self.bind_expr(oe, scope) for oe in sj.outer_keys]
+        right_keys = [BoundCol(n, d)
+                      for n, d in subplan.schema[:sj.n_keys]]
+        residual = None
+        if sj.residual is not None:
+            combined = Scope()
+            combined.entries = list(scope.entries) + [
+                (None, n, d) for n, d in subplan.schema]
+            residual = self.bind_expr(sj.residual, combined)
+            _require_bool(residual, "EXISTS residual")
+        kind = "anti" if sj.negated else "semi"
+        return plan.Join(kind, node, subplan, left_keys, right_keys,
+                         residual, list(node.schema))
 
     def _split_join_on(self, on, lscope, rscope, full_scope):
         """Split ON into equi-key pairs + residual predicate."""
@@ -736,14 +759,78 @@ class Binder:
     def _pushdown_filters(self, node: plan.PlanNode) -> plan.PlanNode:
         """Move Filter conjuncts directly above a Scan into Scan.filters
         (feeds zonemap pruning in the reader — readutil analogue)."""
+        node = self._push_join_predicates(node)
+        return self._pushdown_scan_filters(node)
+
+    def _pushdown_scan_filters(self, node):
         for attr in ("child", "left", "right"):
             c = getattr(node, attr, None)
             if c is not None:
-                setattr(node, attr, self._pushdown_filters(c))
+                setattr(node, attr, self._pushdown_scan_filters(c))
         if isinstance(node, plan.Filter) and isinstance(node.child, plan.Scan):
             scan = node.child
             scan.filters = scan.filters + _split_bound_and(node.pred)
             return scan
+        return node
+
+    def _push_join_predicates(self, node) -> plan.PlanNode:
+        """Distribute Filter conjuncts over cross/inner joins: side-local
+        conjuncts sink to that side, two-sided equalities become join keys
+        (cross -> inner). This is what turns `FROM a, b, c WHERE a.k = b.k
+        AND ...` comma joins into hash joins instead of cross products
+        (reference: plan/query_builder.go filter pushdown + join condition
+        extraction)."""
+        if isinstance(node, plan.Filter) and \
+                isinstance(node.child, plan.Join) and \
+                node.child.kind in ("cross", "inner"):
+            j = node.child
+            lnames = {n for n, _ in j.left.schema}
+            rnames = {n for n, _ in j.right.schema}
+            lpush, rpush, keep = [], [], []
+            conjs = []
+            for c0 in _split_bound_and(node.pred):
+                conjs.extend(_split_bound_and(_factor_or(c0)))
+            for c in conjs:
+                refs = _bound_col_names(c)
+                if refs <= lnames:
+                    lpush.append(c)
+                elif refs <= rnames:
+                    rpush.append(c)
+                else:
+                    eq = _as_equi(c, lnames, rnames)
+                    if eq is not None:
+                        j.left_keys.append(eq[0])
+                        j.right_keys.append(eq[1])
+                        j.kind = "inner"
+                    else:
+                        keep.append(c)
+            if lpush:
+                j.left = plan.Filter(j.left, _and_bound(lpush),
+                                     j.left.schema)
+            if rpush:
+                j.right = plan.Filter(j.right, _and_bound(rpush),
+                                      j.right.schema)
+            if j.kind == "cross" and j.left_keys:
+                j.kind = "inner"
+            if keep and j.kind == "cross":
+                # no equi keys: evaluate the mixed predicate as the cross
+                # join's residual (loopjoin analogue) instead of
+                # materializing the full product above it
+                res = _and_bound(keep)
+                j.residual = res if j.residual is None else \
+                    BoundFunc("and", [j.residual, res], dt.BOOL)
+                keep = []
+            out = j if not keep else plan.Filter(j, _and_bound(keep),
+                                                 j.schema)
+            for attr in ("child", "left", "right"):
+                c = getattr(out, attr, None)
+                if c is not None:
+                    setattr(out, attr, self._push_join_predicates(c))
+            return out
+        for attr in ("child", "left", "right"):
+            c = getattr(node, attr, None)
+            if c is not None:
+                setattr(node, attr, self._push_join_predicates(c))
         return node
 
 
@@ -773,6 +860,78 @@ def _split_and(e: ast.Node) -> List[ast.Node]:
 def _split_bound_and(e: BoundExpr) -> List[BoundExpr]:
     if isinstance(e, BoundFunc) and e.op == "and":
         return _split_bound_and(e.args[0]) + _split_bound_and(e.args[1])
+    return [e]
+
+
+def _and_bound(cs: List[BoundExpr]) -> BoundExpr:
+    e = cs[0]
+    for c in cs[1:]:
+        e = BoundFunc("and", [e, c], dt.BOOL)
+    return e
+
+
+def _bound_col_names(e: BoundExpr) -> set:
+    out = set()
+
+    def walk(x):
+        if isinstance(x, BoundCol):
+            out.add(x.name)
+        for f in dataclasses_fields_values(x):
+            if isinstance(f, BoundExpr):
+                walk(f)
+            elif isinstance(f, list):
+                for y in f:
+                    if isinstance(y, BoundExpr):
+                        walk(y)
+                    elif isinstance(y, tuple):
+                        for z in y:
+                            if isinstance(z, BoundExpr):
+                                walk(z)
+    walk(e)
+    return out
+
+
+def _as_equi(c: BoundExpr, lnames: set, rnames: set):
+    """eq(one-side expr, other-side expr) -> (left_expr, right_expr)."""
+    if not (isinstance(c, BoundFunc) and c.op == "eq" and len(c.args) == 2):
+        return None
+    a, b = c.args
+    ra, rb = _bound_col_names(a), _bound_col_names(b)
+    if not ra or not rb:
+        return None
+    if ra <= lnames and rb <= rnames:
+        return a, b
+    if ra <= rnames and rb <= lnames:
+        return b, a
+    return None
+
+
+def _factor_or(e: BoundExpr) -> BoundExpr:
+    """(A and X) or (A and Y) -> A and (X or Y): pull conjuncts common to
+    every OR arm out, so shared equi-join predicates (TPC-H Q19's
+    p_partkey = l_partkey in each arm) become join keys."""
+    if not (isinstance(e, BoundFunc) and e.op == "or"):
+        return e
+    arms = _split_bound_or(e)
+    arm_conjs = [_split_bound_and(a) for a in arms]
+    common = [c for c in arm_conjs[0]
+              if all(any(c == d for d in conj) for conj in arm_conjs[1:])]
+    if not common:
+        return e
+    rest_arms = []
+    for conj in arm_conjs:
+        rest = [c for c in conj if not any(c == d for d in common)]
+        rest_arms.append(_and_bound(rest) if rest
+                         else BoundLiteral(True, dt.BOOL))
+    ored = rest_arms[0]
+    for r in rest_arms[1:]:
+        ored = BoundFunc("or", [ored, r], dt.BOOL)
+    return _and_bound(common + [ored])
+
+
+def _split_bound_or(e: BoundExpr) -> List[BoundExpr]:
+    if isinstance(e, BoundFunc) and e.op == "or":
+        return _split_bound_or(e.args[0]) + _split_bound_or(e.args[1])
     return [e]
 
 

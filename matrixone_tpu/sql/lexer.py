@@ -17,7 +17,7 @@ KEYWORDS = {
     "union", "all", "default", "lists", "op_type", "count", "sum",
     "snapshot", "snapshots", "restore", "of", "timestamp", "avg",
     "auto_increment", "over", "partition",
-    "min", "max",
+    "min", "max", "extract",
 }
 
 OPERATORS = ["<=", ">=", "<>", "!=", "||", "=", "<", ">", "+", "-", "*", "/",

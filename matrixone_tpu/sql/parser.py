@@ -491,6 +491,11 @@ class Parser:
             elif self.at_kw("like"):
                 self.next()
                 left = ast.BinaryOp("like", left, self.additive())
+            elif self.at_kw("not") and self.peek(1).value == "like":
+                self.next()
+                self.next()
+                left = ast.UnaryOp(
+                    "not", ast.BinaryOp("like", left, self.additive()))
             elif self.at_kw("is"):
                 self.next()
                 negated = self.accept_kw("not")
@@ -599,6 +604,14 @@ class Parser:
                 else_ = self.expr() if self.accept_kw("else") else None
                 self.expect_kw("end")
                 return ast.Case(whens, else_)
+            if self.accept_kw("extract"):
+                # EXTRACT(unit FROM expr) -> unit(expr)
+                self.expect_op("(")
+                unit = self.ident().lower()
+                self.expect_kw("from")
+                e = self.expr()
+                self.expect_op(")")
+                return ast.FuncCall(unit, [e])
             if self.accept_kw("cast"):
                 self.expect_op("(")
                 e = self.expr()

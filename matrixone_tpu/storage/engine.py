@@ -532,9 +532,12 @@ def _zonemap_excludes(filters, arrays, validity, qmap, schema) -> bool:
 class Engine:
     """Catalog + single-writer commit service + WAL + checkpoint/replay."""
 
-    def __init__(self, fs: Optional[FileService] = None):
+    def __init__(self, fs: Optional[FileService] = None, wal=None):
         self.fs = fs if fs is not None else MemoryFS()
-        self.wal = walmod.WalWriter(self.fs)
+        # wal: anything with append/truncate/replay — the local CRC log by
+        # default, logservice.replicated.ReplicatedLog for the multi-
+        # process log role (reference: logservice client behind tae/logstore)
+        self.wal = wal if wal is not None else walmod.WalWriter(self.fs)
         self.hlc = HLC()
         self.tables: Dict[str, MVCCTable] = {}
         self.indexes: Dict[str, IndexMeta] = {}
@@ -851,10 +854,10 @@ class Engine:
         self._ckpt_ts = manifest["ckpt_ts"]
 
     @classmethod
-    def open(cls, fs: FileService) -> "Engine":
+    def open(cls, fs: FileService, wal=None) -> "Engine":
         """Restart path: load last checkpoint then replay the WAL tail
         (tae/db/replay.go analogue)."""
-        eng = cls(fs)
+        eng = cls(fs, wal=wal)
         if fs.exists("meta/manifest.json"):
             manifest = json.loads(fs.read("meta/manifest.json").decode())
             eng._ckpt_ts = manifest.get("ckpt_ts", 0)
@@ -900,7 +903,7 @@ class Engine:
     def _replay_wal(self) -> None:
         pending: List[tuple] = []
         max_ts = self._ckpt_ts
-        for header, blob in walmod.replay(self.fs):
+        for header, blob in self.wal.replay():
             op = header["op"]
             # frames at or before the checkpoint are already materialized in
             # the manifest (crash window between manifest write and WAL

@@ -38,6 +38,9 @@ class WalWriter:
     def truncate(self) -> None:
         self.fs.write(self.path, b"")
 
+    def replay(self) -> Iterator[Tuple[dict, bytes]]:
+        return replay(self.fs, self.path)
+
 
 def replay(fs: FileService, path: str = "wal/wal.log"
            ) -> Iterator[Tuple[dict, bytes]]:

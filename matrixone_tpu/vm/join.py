@@ -28,6 +28,24 @@ from matrixone_tpu.vm.exprs import ExecBatch, eval_expr
 from matrixone_tpu.vm.operators import Operator, _broadcast_full, _concat_batches
 
 
+def _maybe_compact(out: ExecBatch) -> ExecBatch:
+    """Join outputs carry np*mm lanes but typically few live rows; without
+    compaction a chain of joins grows lanes multiplicatively (observed:
+    4M-lane batches carrying 40 rows in TPC-H Q2). Compact whenever the
+    live fraction drops below 1/4, padding to the jit bucket."""
+    from matrixone_tpu.container.device import bucket_length
+    lanes = int(out.mask.shape[0])
+    if lanes <= 2048:
+        return out
+    live = int(jax.device_get(jnp.sum(out.mask.astype(jnp.int32))))
+    cap = bucket_length(max(live, 1))
+    if cap * 4 > lanes:
+        return out
+    db = F.compact(out.batch, out.mask, cap)
+    return ExecBatch(batch=db, dicts=out.dicts,
+                     mask=jnp.arange(cap, dtype=jnp.int32) < db.n_rows)
+
+
 class JoinOp(Operator):
     def __init__(self, node: P.Join, left: Operator, right: Operator,
                  max_matches: int = 4):
@@ -39,7 +57,7 @@ class JoinOp(Operator):
 
     def execute(self) -> Iterator[ExecBatch]:
         build_batches = list(self.right.execute())
-        if not build_batches and self.node.kind == "inner":
+        if not build_batches and self.node.kind in ("inner", "semi"):
             return
         build = (_concat_batches(build_batches, self.node.right.schema)
                  if build_batches else None)
@@ -47,6 +65,10 @@ class JoinOp(Operator):
             yield from self._cross(build)
             return
         if build is None:
+            if self.node.kind == "anti":
+                # NOT EXISTS against nothing: every left row passes
+                yield from self.left.execute()
+                return
             # LEFT JOIN with empty right side: all left rows null-extended
             for ex in self.left.execute():
                 yield self._null_extend_all(ex)
@@ -82,7 +104,21 @@ class JoinOp(Operator):
             if not overflow:
                 break
             mm *= 2
-        yield out
+        if self.node.kind in ("semi", "anti"):
+            # collapse match lanes back onto the probe rows: emit each left
+            # row once iff it has (semi) / lacks (anti) a surviving match
+            matched_any = jnp.any(out.mask.reshape(ex.padded_len, mm),
+                                  axis=1)
+            keep = (ex.mask & matched_any if self.node.kind == "semi"
+                    else ex.mask & ~matched_any)
+            db = DeviceBatch(
+                columns={n: _broadcast_full(ex.batch.columns[n],
+                                            ex.padded_len)
+                         for n, _ in self.node.left.schema},
+                n_rows=jnp.sum(keep.astype(jnp.int32)))
+            yield ExecBatch(batch=db, dicts=dict(ex.dicts), mask=keep)
+            return
+        yield _maybe_compact(out)
 
     def _expand(self, ex, build, sorted_hash, border, phash, pvalid,
                 pkeys, bkeys, mm):
@@ -184,4 +220,4 @@ class JoinOp(Operator):
             if self.node.residual is not None:
                 pred = eval_expr(self.node.residual, out)
                 out.mask = out.mask & F.predicate_mask(pred, db)
-            yield out
+            yield _maybe_compact(out)

@@ -13,7 +13,10 @@ from matrixone_tpu.worker.server import pack, unpack
 class WorkerClient:
     def __init__(self, address: str):
         import grpc
-        self.channel = grpc.insecure_channel(address)
+        self.channel = grpc.insecure_channel(
+            address,
+            options=[("grpc.max_receive_message_length", 256 << 20),
+                     ("grpc.max_send_message_length", 256 << 20)])
         self._run = self.channel.unary_unary(
             "/mo.tpu.Worker/Run",
             request_serializer=None, response_deserializer=None)

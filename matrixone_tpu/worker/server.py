@@ -120,6 +120,9 @@ class WorkerCore:
                             arrowio.arrays_to_ipc(arrays_out, val_out))
 
             # group_aggregate: single-batch partial aggregation
+            for fj in header.get("filters", []):
+                pred = eval_expr(expr_from_json(fj), ex)
+                ex.mask = ex.mask & F.predicate_mask(pred, ex.batch)
             keys = [eval_expr(expr_from_json(kj), ex)
                     for kj in header["group_keys"]]
             mg = header.get("max_groups", 4096)
@@ -129,11 +132,19 @@ class WorkerCore:
             kdata = [_broadcast_full(k, ex.padded_len).data for k in keys]
             kvalid = [_broadcast_full(k, ex.padded_len).validity for k in keys]
             gi = A.group_ids(kdata, kvalid, ex.mask, mg)
-            out = {"n_groups": int(jax.device_get(gi.num_groups))}
+            ng = int(jax.device_get(gi.num_groups))
+            if ng > mg:
+                return pack({"error": f"group count {ng} exceeds "
+                             f"max_groups={mg}; re-send with a bigger "
+                             f"bucket", "n_groups": ng})
+            out = {"n_groups": ng}
             arrays_out = {}
-            for i, kd in enumerate(kdata):
+            for i, (kd, kv) in enumerate(zip(kdata, kvalid)):
+                # ship only live groups, not the padded max_groups table
                 arrays_out[f"_g{i}"] = np.asarray(
-                    jax.device_get(kd[gi.rep_rows]))
+                    jax.device_get(kd[gi.rep_rows]))[:ng]
+                arrays_out[f"_gv{i}"] = np.asarray(
+                    jax.device_get(kv[gi.rep_rows]))[:ng]
             for j, aj in enumerate(header["aggs"]):
                 a = agg_from_json(aj)
                 v = (None if (a.func == "count" and a.arg is None)
@@ -141,7 +152,7 @@ class WorkerCore:
                 part = _grouped_step(a, gi, v, ex.mask, mg)
                 for field, arr in part.items():
                     arrays_out[f"_a{j}_{field}"] = np.asarray(
-                        jax.device_get(arr))
+                        jax.device_get(arr))[:ng]
             val_out = {c: np.ones(len(v), np.bool_)
                        for c, v in arrays_out.items()}
             return pack(out, arrowio.arrays_to_ipc(arrays_out, val_out))
@@ -229,7 +240,9 @@ class TpuWorkerServer:
         }
         handler = grpc.method_handlers_generic_handler(self.SERVICE, rpcs)
         self.server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=max_workers))
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[("grpc.max_receive_message_length", 256 << 20),
+                     ("grpc.max_send_message_length", 256 << 20)])
         self.server.add_generic_rpc_handlers((handler,))
         self.port = self.server.add_insecure_port(f"127.0.0.1:{port}")
 
