@@ -529,6 +529,7 @@ class Session:
             except ValueError as e:
                 raise BindError(str(e))
             self.catalog.indexes[stmt.name] = meta
+            indexing.register_in_cache(self.catalog, meta)
             return Result()
         if algo == "fulltext":
             from matrixone_tpu import indexing
@@ -540,6 +541,7 @@ class Session:
                              "fulltext", dict(stmt.options), dirty=True)
             indexing.build_fulltext(self.catalog, meta)
             self.catalog.indexes[stmt.name] = meta
+            indexing.register_in_cache(self.catalog, meta)
             return Result()
         raise BindError(f"unsupported index algo {stmt.using!r}")
 

@@ -38,6 +38,8 @@ def build_ivfflat(engine: Engine, ix: IndexMeta) -> None:
         ix.index_obj = ivf_flat.build(jnp.asarray(data), nlist=nlist,
                                       metric=metric)
     ix.options["_row_gids"] = gids
+    ix.options.pop("_delta_vecs", None)
+    ix.options.pop("_delta_gids", None)
     ix.dirty = False
 
 
@@ -56,6 +58,8 @@ def build_hnsw(engine: Engine, ix: IndexMeta) -> None:
     ix.index_obj = hnsw.build(np.asarray(data), M=m, ef_construction=ef_c,
                               metric=metric)
     ix.options["_row_gids"] = gids
+    ix.options.pop("_delta_vecs", None)
+    ix.options.pop("_delta_gids", None)
     ix.dirty = False
 
 
@@ -84,7 +88,14 @@ def build_fulltext(engine: Engine, ix: IndexMeta) -> None:
     ix.index_obj = FT.build(texts or [])
     ix.options["_row_gids"] = gids if gids is not None \
         else np.zeros(0, np.int64)
+    ix.options.pop("_delta_vecs", None)
+    ix.options.pop("_delta_gids", None)
     ix.dirty = False
+
+
+#: delta fraction beyond which a dirty refresh falls back to a full
+#: recluster (reference: idxcron re-clustering policy)
+RECLUSTER_FRACTION = 0.1
 
 
 def refresh_if_dirty(engine: Engine, ix: IndexMeta) -> None:
@@ -96,8 +107,81 @@ def refresh_if_dirty(engine: Engine, ix: IndexMeta) -> None:
         if not ix.dirty:
             return
         if ix.algo in ("ivfflat", "ivfpq"):
+            if not _try_incremental(engine, ix):
+                build_ivfflat(engine, ix)
+        elif ix.algo == "hnsw":
+            build_hnsw(engine, ix)
+        elif ix.algo == "fulltext":
+            build_fulltext(engine, ix)
+        _register_in_cache(engine, ix)
+
+
+def _try_incremental(engine: Engine, ix: IndexMeta) -> bool:
+    """Incremental refresh (reference: iscp IndexSync feed): rows INSERTED
+    since the last build land in a brute-force delta segment the search
+    path scans exactly; DELETEs need no index change (visible_gids filters
+    dead candidates at search). Falls back to a full recluster when the
+    delta outgrows RECLUSTER_FRACTION of the indexed rows (idxcron role)
+    or when gids were rewritten (table merge)."""
+    if ix.index_obj is None:
+        return False
+    table = engine.get_table(ix.table)
+    data, gids = table.read_column_f32(ix.columns[0])
+    base = np.asarray(ix.options.get("_row_gids", np.zeros(0, np.int64)))
+    dgids = np.asarray(ix.options.get("_delta_gids",
+                                      np.zeros(0, np.int64)))
+    known = np.union1d(base, dgids)
+    new_mask = ~np.isin(gids, known)
+    n_new = int(new_mask.sum())
+    if n_new == 0:
+        ix.dirty = False
+        return True
+    if n_new + len(dgids) > RECLUSTER_FRACTION * max(len(base), 1):
+        return False
+    new_vecs = np.asarray(data)[new_mask]
+    old = ix.options.get("_delta_vecs")
+    ix.options["_delta_vecs"] = (new_vecs if old is None or not len(old)
+                                 else np.concatenate([old, new_vecs]))
+    ix.options["_delta_gids"] = np.concatenate([dgids, gids[new_mask]])
+    ix.dirty = False
+    return True
+
+
+def fold_delta(engine: Engine, ix: IndexMeta) -> bool:
+    """Full recluster folding the delta back in — the idxcron background
+    job body (run via taskservice off the query path). Returns True when
+    a rebuild happened."""
+    with engine._commit_lock:
+        has_delta = len(ix.options.get("_delta_gids", ())) > 0
+        if not (ix.dirty or has_delta):
+            return False
+        if ix.algo in ("ivfflat", "ivfpq"):
             build_ivfflat(engine, ix)
         elif ix.algo == "hnsw":
             build_hnsw(engine, ix)
         elif ix.algo == "fulltext":
             build_fulltext(engine, ix)
+        ix.options.pop("_delta_vecs", None)
+        ix.options.pop("_delta_gids", None)
+        _register_in_cache(engine, ix)
+        return True
+
+
+def register_recluster_task(engine: Engine, tasks, period_s: float = 60.0):
+    """Schedule delta folding on the durable task service
+    (reference: vectorindex/idxcron). Returns the task id."""
+    def body(eng, arg):
+        for ix in list(eng.indexes.values()):
+            fold_delta(eng, ix)
+    tasks.register("index_recluster", body)
+    return tasks.submit("index_recluster", "index_recluster",
+                        interval_s=period_s)
+
+
+def register_in_cache(engine: Engine, ix: IndexMeta) -> None:
+    cache = getattr(engine, "index_cache", None)
+    if cache is not None and ix.index_obj is not None:
+        cache.put(ix)
+
+
+_register_in_cache = register_in_cache

@@ -550,6 +550,8 @@ class Engine:
         self.committed_ts = self.hlc.now()
         from matrixone_tpu.lockservice import LockService
         self.locks = LockService()     # pessimistic mode (pkg/lockservice)
+        from matrixone_tpu.vectorindex.cache import IndexCache
+        self.index_cache = IndexCache()   # budgeted device-index residency
         self.active_txns = 0           # open explicit txns (merge guard)
 
     # ----------------------------------------------------------- catalog
@@ -577,8 +579,10 @@ class Engine:
                 return
             raise ValueError(f"no such table {name}")
         del self.tables[name]
-        self.indexes = {k: v for k, v in self.indexes.items()
-                        if v.table != name}
+        for k, v in list(self.indexes.items()):
+            if v.table == name:
+                del self.indexes[k]
+                self.index_cache.drop(k)    # free device residency + budget
         if log:
             self.wal.append({"op": "drop_table", "name": name,
                              "ts": self.hlc.now()})

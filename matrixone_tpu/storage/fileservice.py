@@ -1,9 +1,9 @@
 """File service: storage backend abstraction (reference: pkg/fileservice
 `file_service.go:31` — redesigned to the minimum the engine needs).
 
-Backends: memory (tests), local disk. The S3 backend slots in behind the
-same interface when object-store credentials exist; all engine code above
-(objectio, WAL, checkpoints) is backend-agnostic.
+Backends: memory (tests), local disk, and S3-compatible object storage
+with tiered caches (storage/s3.py: S3FS + MemCacheFS/DiskCacheFS); all
+engine code above (objectio, WAL, checkpoints) is backend-agnostic.
 """
 
 from __future__ import annotations

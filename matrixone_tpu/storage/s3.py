@@ -1,0 +1,434 @@
+"""S3-compatible object storage backend + tiered read caches.
+
+Reference analogue: `pkg/fileservice` S3 backends (`aws_sdk_v2.go`,
+`minio_sdk.go`) and its cache tiers (`mem_cache.go` in-memory LRU,
+`disk_cache.go` on-disk). Re-designed to the minimum the engine needs, in
+stdlib only:
+
+  * S3FS — the FileService interface over the S3 REST API (GET/PUT/DELETE
+    object, ListObjectsV2, HEAD) with AWS Signature V4 request signing
+    (pure hmac/hashlib; works against AWS, MinIO, localstack, and the
+    in-repo FakeS3Server). `append` is emulated read-modify-write: the
+    engine only appends to the WAL, which in the cloud deployment rides
+    the replicated logservice, not S3 — exactly the reference's split
+    (objects on S3, WAL on logservice).
+  * MemCacheFS / DiskCacheFS — read-through caches stackable over any
+    FileService; byte-budgeted LRU eviction. Objects are immutable
+    (objectio writes once), so the only invalidation needed is
+    write/delete pass-through.
+  * FakeS3Server — an in-process HTTP server implementing the object API
+    subset (unauthenticated; signature parsing is not validated) so S3FS
+    is testable with zero egress, the way the reference uses minio
+    containers in CI.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import http.server
+import os
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from matrixone_tpu.storage.fileservice import FileService
+
+
+# --------------------------------------------------------------- sigv4
+
+def _sign(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sigv4_headers(method: str, url: str, region: str, access_key: str,
+                  secret_key: str, payload: bytes,
+                  now: Optional[datetime.datetime] = None) -> Dict[str, str]:
+    """AWS Signature Version 4 for one S3 request (reference:
+    aws_sdk_v2.go's SDK does this internally; spelled out here)."""
+    u = urllib.parse.urlsplit(url)
+    host = u.netloc
+    if now is None:
+        now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    payload_hash = hashlib.sha256(payload).hexdigest()
+    canonical_query = "&".join(sorted(
+        f"{k}={urllib.parse.quote(v[0], safe='')}"
+        for k, v in urllib.parse.parse_qs(
+            u.query, keep_blank_values=True).items()))
+    signed_headers = "host;x-amz-content-sha256;x-amz-date"
+    canonical = "\n".join([
+        method, urllib.parse.quote(u.path or "/"), canonical_query,
+        f"host:{host}", f"x-amz-content-sha256:{payload_hash}",
+        f"x-amz-date:{amz_date}", "", signed_headers, payload_hash])
+    scope = f"{datestamp}/{region}/s3/aws4_request"
+    to_sign = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                         hashlib.sha256(canonical.encode()).hexdigest()])
+    k = _sign(_sign(_sign(_sign(b"AWS4" + secret_key.encode(), datestamp),
+                          region), "s3"), "aws4_request")
+    signature = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+    return {
+        "x-amz-date": amz_date,
+        "x-amz-content-sha256": payload_hash,
+        "Authorization": (
+            f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+            f"SignedHeaders={signed_headers}, Signature={signature}"),
+    }
+
+
+class S3FS(FileService):
+    """FileService over an S3-compatible endpoint."""
+
+    def __init__(self, endpoint: str, bucket: str, region: str = "us-east-1",
+                 access_key: str = "", secret_key: str = "",
+                 prefix: str = ""):
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.region = region
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.prefix = prefix.strip("/")
+        self._lock = threading.Lock()   # append emulation serialization
+
+    def _url(self, path: str = "", query: str = "") -> str:
+        key = f"{self.prefix}/{path}" if self.prefix else path
+        url = f"{self.endpoint}/{self.bucket}/" + urllib.parse.quote(key)
+        return url + ("?" + query if query else "")
+
+    def _request(self, method: str, url: str, payload: bytes = b""):
+        headers = {}
+        if self.access_key:
+            headers = sigv4_headers(method, url, self.region,
+                                    self.access_key, self.secret_key,
+                                    payload)
+        req = urllib.request.Request(url, data=payload or None,
+                                     method=method, headers=headers)
+        return urllib.request.urlopen(req, timeout=60)
+
+    # ---- FileService
+    def write(self, path, data):
+        self._request("PUT", self._url(path), bytes(data)).read()
+
+    def append(self, path, data):
+        # S3 objects are immutable: emulate via read-modify-write. The
+        # engine's appends are WAL-only and ride logservice in the cloud
+        # shape; this path exists for standalone-on-S3 correctness.
+        with self._lock:
+            try:
+                cur = self.read(path)
+            except FileNotFoundError:
+                cur = b""
+            self.write(path, cur + bytes(data))
+
+    def read(self, path):
+        try:
+            return self._request("GET", self._url(path)).read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise FileNotFoundError(path) from None
+            raise
+
+    def exists(self, path):
+        try:
+            self._request("HEAD", self._url(path)).read()
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return False
+            raise
+
+    def delete(self, path):
+        try:
+            self._request("DELETE", self._url(path)).read()
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+    def list(self, prefix):
+        key_prefix = (f"{self.prefix}/{prefix}" if self.prefix else prefix)
+        q = ("list-type=2&prefix="
+             + urllib.parse.quote(key_prefix, safe=""))
+        url = f"{self.endpoint}/{self.bucket}?{q}"
+        body = self._request("GET", url).read().decode()
+        # minimal ListObjectsV2 XML scrape
+        out = []
+        start = 0
+        while True:
+            i = body.find("<Key>", start)
+            if i < 0:
+                break
+            j = body.find("</Key>", i)
+            key = body[i + 5:j]
+            start = j
+            if self.prefix:
+                key = key[len(self.prefix) + 1:]
+            out.append(urllib.parse.unquote(key))
+        return sorted(out)
+
+
+# ---------------------------------------------------------- cache tiers
+
+class _LRUBytes:
+    def __init__(self, budget: int):
+        self.budget = budget
+        self.used = 0
+        self.items: "OrderedDict[str, bytes]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: str) -> Optional[bytes]:
+        v = self.items.get(key)
+        if v is None:
+            self.misses += 1
+            return None
+        self.items.move_to_end(key)
+        self.hits += 1
+        return v
+
+    def put(self, key: str, value: bytes) -> None:
+        if len(value) > self.budget:
+            return
+        old = self.items.pop(key, None)
+        if old is not None:
+            self.used -= len(old)
+        self.items[key] = value
+        self.used += len(value)
+        while self.used > self.budget:
+            _, ev = self.items.popitem(last=False)
+            self.used -= len(ev)
+
+    def drop(self, key: str) -> None:
+        old = self.items.pop(key, None)
+        if old is not None:
+            self.used -= len(old)
+
+
+class MemCacheFS(FileService):
+    """Read-through in-memory LRU over any FileService
+    (reference: fileservice/mem_cache.go)."""
+
+    def __init__(self, base: FileService, budget_bytes: int = 256 << 20):
+        self.base = base
+        self.cache = _LRUBytes(budget_bytes)
+        self._lock = threading.Lock()
+
+    def read(self, path):
+        with self._lock:
+            v = self.cache.get(path)
+        if v is not None:
+            return v
+        v = self.base.read(path)
+        with self._lock:
+            self.cache.put(path, v)
+        return v
+
+    def write(self, path, data):
+        self.base.write(path, data)
+        with self._lock:
+            self.cache.put(path, bytes(data))
+
+    def append(self, path, data):
+        self.base.append(path, data)
+        with self._lock:
+            self.cache.drop(path)
+
+    def delete(self, path):
+        self.base.delete(path)
+        with self._lock:
+            self.cache.drop(path)
+
+    def exists(self, path):
+        with self._lock:
+            if self.cache.get(path) is not None:
+                return True
+        return self.base.exists(path)
+
+    def list(self, prefix):
+        return self.base.list(prefix)
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        return {"hits": self.cache.hits, "misses": self.cache.misses,
+                "used": self.cache.used}
+
+
+class DiskCacheFS(FileService):
+    """Read-through on-disk cache over a remote FileService
+    (reference: fileservice/disk_cache.go). Keyed by path hash; byte
+    budget enforced by LRU over an in-memory index (cache survives the
+    process only as files; the index rebuilds lazily on miss)."""
+
+    def __init__(self, base: FileService, cache_dir: str,
+                 budget_bytes: int = 4 << 30):
+        self.base = base
+        self.dir = cache_dir
+        os.makedirs(cache_dir, exist_ok=True)
+        self.budget = budget_bytes
+        self._lock = threading.Lock()
+        self._lru: "OrderedDict[str, int]" = OrderedDict()
+        self._used = 0
+        self.hits = 0
+        self.misses = 0
+
+    def _cpath(self, path: str) -> str:
+        return os.path.join(self.dir,
+                            hashlib.sha256(path.encode()).hexdigest())
+
+    def read(self, path):
+        cp = self._cpath(path)
+        with self._lock:
+            if path in self._lru:
+                self._lru.move_to_end(path)
+                try:
+                    with open(cp, "rb") as f:
+                        self.hits += 1
+                        return f.read()
+                except FileNotFoundError:
+                    self._used -= self._lru.pop(path)
+        self.misses += 1
+        v = self.base.read(path)
+        with self._lock:
+            if len(v) <= self.budget:
+                with open(cp + ".tmp", "wb") as f:
+                    f.write(v)
+                os.replace(cp + ".tmp", cp)
+                if path in self._lru:
+                    self._used -= self._lru.pop(path)
+                self._lru[path] = len(v)
+                self._used += len(v)
+                while self._used > self.budget:
+                    old, sz = self._lru.popitem(last=False)
+                    self._used -= sz
+                    try:
+                        os.remove(self._cpath(old))
+                    except FileNotFoundError:
+                        pass
+        return v
+
+    def _drop(self, path):
+        with self._lock:
+            if path in self._lru:
+                self._used -= self._lru.pop(path)
+            try:
+                os.remove(self._cpath(path))
+            except FileNotFoundError:
+                pass
+
+    def write(self, path, data):
+        self.base.write(path, data)
+        self._drop(path)
+
+    def append(self, path, data):
+        self.base.append(path, data)
+        self._drop(path)
+
+    def delete(self, path):
+        self.base.delete(path)
+        self._drop(path)
+
+    def exists(self, path):
+        with self._lock:
+            if path in self._lru:
+                return True
+        return self.base.exists(path)
+
+    def list(self, prefix):
+        return self.base.list(prefix)
+
+
+# ------------------------------------------------------------- fake S3
+
+class FakeS3Server:
+    """In-process S3-compatible HTTP server (object API subset) for tests
+    — the zero-egress stand-in for the minio container the reference's CI
+    uses. Stores objects in memory; accepts any/no signature."""
+
+    def __init__(self, port: int = 0):
+        objects: Dict[Tuple[str, str], bytes] = {}
+        lock = threading.Lock()
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):   # noqa: N802
+                pass
+
+            def _key(self):
+                u = urllib.parse.urlsplit(self.path)
+                parts = u.path.lstrip("/").split("/", 1)
+                bucket = parts[0]
+                key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+                return bucket, key, urllib.parse.parse_qs(u.query)
+
+            def do_PUT(self):            # noqa: N802
+                bucket, key, _ = self._key()
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                with lock:
+                    objects[(bucket, key)] = body
+                self.send_response(200)
+                self.send_header("ETag", '"%s"' %
+                                 hashlib.md5(body).hexdigest())
+                self.end_headers()
+
+            def do_GET(self):            # noqa: N802
+                bucket, key, q = self._key()
+                if not key and "list-type" in q:
+                    prefix = q.get("prefix", [""])[0]
+                    with lock:
+                        keys = sorted(k for (b, k) in objects
+                                      if b == bucket
+                                      and k.startswith(prefix))
+                    body = ("<?xml version='1.0'?><ListBucketResult>"
+                            + "".join(f"<Contents><Key>{k}</Key></Contents>"
+                                      for k in keys)
+                            + "</ListBucketResult>").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                with lock:
+                    body = objects.get((bucket, key))
+                if body is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_HEAD(self):           # noqa: N802
+                bucket, key, _ = self._key()
+                with lock:
+                    ok = (bucket, key) in objects
+                self.send_response(200 if ok else 404)
+                self.end_headers()
+
+            def do_DELETE(self):         # noqa: N802
+                bucket, key, _ = self._key()
+                with lock:
+                    objects.pop((bucket, key), None)
+                self.send_response(204)
+                self.end_headers()
+
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                                     Handler)
+        self.port = self.httpd.server_address[1]
+        self.objects = objects
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "FakeS3Server":
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()

@@ -33,9 +33,28 @@ class VectorTopKOp(Operator):
         from matrixone_tpu import indexing
         catalog = self.ctx.catalog
         ix = catalog.indexes[self.node.index_name]
-        indexing.refresh_if_dirty(catalog, ix)
-        index = ix.index_obj
-        row_gids = np.asarray(ix.options["_row_gids"])
+        cache = getattr(catalog, "index_cache", None)
+        # snapshot index + delta under the commit lock: the recluster task
+        # mutates both atomically, and a concurrent cache eviction mid-read
+        # must retry the refresh instead of yielding an empty result
+        for _ in range(8):
+            indexing.refresh_if_dirty(catalog, ix)
+            with catalog._commit_lock:
+                if ix.dirty:
+                    continue
+                index = ix.index_obj
+                row_gids = np.asarray(ix.options["_row_gids"])
+                delta_vecs = ix.options.get("_delta_vecs")
+                delta_gids = (np.asarray(ix.options["_delta_gids"])
+                              if delta_vecs is not None and len(delta_vecs)
+                              else None)
+                break
+        else:
+            raise RuntimeError(
+                f"index {ix.name} kept getting evicted/dirtied; raise the "
+                f"index cache budget")
+        if cache is not None:
+            cache.touch(ix)
         table = catalog.get_table(self.node.table)
 
         if index is None:        # index over an empty table
@@ -50,8 +69,9 @@ class VectorTopKOp(Operator):
             from matrixone_tpu.vectorindex import hnsw
             k = min(self.node.k, index.n) or 1
             ef = max(64, 2 * k)
-            _, pos2 = hnsw.search(index, q, k=k, ef=ef)
-            pos = pos2[0][pos2[0] >= 0]
+            d2, pos2 = hnsw.search(index, q, k=k, ef=ef)
+            keep = pos2[0] >= 0
+            pos, main_d = pos2[0][keep], np.asarray(d2)[0][keep]
         else:
             nprobe = min(self.node.nprobe, index.nlist)
             pool = nprobe * index.max_cluster_size
@@ -60,8 +80,36 @@ class VectorTopKOp(Operator):
                          else ivf_flat.search)
             dists, pos = search_fn(index, jnp.asarray(q), k=k,
                                    nprobe=nprobe, query_chunk=1)
+            main_d = np.asarray(dists)[0]
             pos = np.asarray(pos)[0]
-        gids = row_gids[pos[pos >= 0]]
+            keep = pos >= 0
+            pos, main_d = pos[keep], main_d[keep]
+        gids = row_gids[pos]
+        # delta segment: rows inserted since the last full build are
+        # scanned exactly and merged by distance (indexing._try_incremental).
+        # Delta distances MUST be commensurate with what each algo's search
+        # returns: ivfflat = sq-l2 | 1-cos | 1-ip; ivfpq cosine = sq-l2 of
+        # NORMALIZED vectors (= 2*(1-cos)); hnsw per its own metric kernel
+        if delta_gids is not None:
+            from matrixone_tpu.ops import distance as D
+            dv = jnp.asarray(np.asarray(delta_vecs, np.float32))
+            qj = jnp.asarray(q)
+            metric = ix.options.get("_metric", "l2")
+            if metric == "l2":
+                dd = np.asarray(D.l2_distance_sq(qj, dv))[0]
+            elif metric == "cosine":
+                if ix.algo == "ivfpq":
+                    dd = np.asarray(D.l2_distance_sq(
+                        D.normalize(qj), D.normalize(dv)))[0]
+                else:
+                    dd = 1.0 - np.asarray(D.inner_product(
+                        D.normalize(qj), D.normalize(dv)))[0]
+            else:                      # ip: search returns 1 - x.q
+                dd = 1.0 - np.asarray(D.inner_product(qj, dv))[0]
+            all_d = np.concatenate([main_d, dd])
+            all_g = np.concatenate([gids, delta_gids])
+            order = np.argsort(all_d)[:self.node.k]
+            gids = all_g[order]
         read_args = self.ctx.table_read_args(self.node.table)
         gids = table.visible_gids(
             gids, snapshot_ts=self.ctx.snapshot_ts,

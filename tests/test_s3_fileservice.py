@@ -1,0 +1,107 @@
+"""S3-compatible fileservice + cache tiers (reference: pkg/fileservice
+aws_sdk_v2.go + mem_cache.go/disk_cache.go). The engine's full
+checkpoint/restart cycle runs against the S3 backend via the in-process
+FakeS3Server, with mem+disk caches stacked like the reference's tiers."""
+
+import tempfile
+
+import pytest
+
+from matrixone_tpu.frontend.session import Session
+from matrixone_tpu.storage.engine import Engine
+from matrixone_tpu.storage.s3 import (DiskCacheFS, FakeS3Server, MemCacheFS,
+                                      S3FS, sigv4_headers)
+
+
+@pytest.fixture()
+def s3():
+    srv = FakeS3Server().start()
+    yield srv
+    srv.stop()
+
+
+def _fs(srv, prefix="eng"):
+    return S3FS(srv.endpoint, "mo-test", access_key="ak", secret_key="sk",
+                prefix=prefix)
+
+
+def test_s3fs_object_roundtrip(s3):
+    fs = _fs(s3)
+    fs.write("a/b.bin", b"hello")
+    assert fs.read("a/b.bin") == b"hello"
+    assert fs.exists("a/b.bin") and not fs.exists("a/c.bin")
+    fs.append("a/b.bin", b" world")
+    assert fs.read("a/b.bin") == b"hello world"
+    fs.write("a/c.bin", b"x")
+    assert fs.list("a/") == ["a/b.bin", "a/c.bin"]
+    fs.delete("a/b.bin")
+    assert fs.list("a/") == ["a/c.bin"]
+    with pytest.raises(FileNotFoundError):
+        fs.read("a/b.bin")
+
+
+def test_sigv4_is_deterministic():
+    import datetime
+    now = datetime.datetime(2026, 7, 29, 12, 0, 0,
+                            tzinfo=datetime.timezone.utc)
+    h1 = sigv4_headers("PUT", "http://x/b/k", "us-east-1", "AK", "SK",
+                       b"payload", now)
+    h2 = sigv4_headers("PUT", "http://x/b/k", "us-east-1", "AK", "SK",
+                       b"payload", now)
+    assert h1 == h2 and h1["Authorization"].startswith("AWS4-HMAC-SHA256")
+
+
+def test_engine_restart_on_s3_backend(s3):
+    """Full ckpt + WAL-tail + restart cycle against the object store."""
+    fs = _fs(s3)
+    s = Session(fs=fs)
+    s.execute("create table t (id bigint primary key, v varchar(16))")
+    s.execute("insert into t values (1, 'a'), (2, 'b')")
+    s.catalog.checkpoint()
+    s.execute("insert into t values (3, 'c')")      # WAL tail on S3
+
+    eng2 = Engine.open(_fs(s3))
+    s2 = Session(catalog=eng2)
+    rows = s2.execute("select id, v from t order by id").rows()
+    assert [(int(a), b) for a, b in rows] == [(1, "a"), (2, "b"), (3, "c")]
+
+
+def test_cache_tiers_serve_reads_and_invalidate(s3):
+    base = _fs(s3, prefix="cache")
+    disk_dir = tempfile.mkdtemp(prefix="mo_diskcache_")
+    fs = MemCacheFS(DiskCacheFS(base, disk_dir, budget_bytes=1 << 20),
+                    budget_bytes=1 << 16)
+    fs.write("obj/one", b"v1" * 100)
+    assert fs.read("obj/one") == b"v1" * 100      # mem hit after write
+    assert fs.stats["hits"] >= 1
+
+    # bypass the cache stack: remote changes invisible until invalidated
+    base.write("obj/one", b"v2")
+    assert fs.read("obj/one") == b"v1" * 100      # served from cache
+    fs.write("obj/one", b"v3")                     # write-through refresh
+    assert fs.read("obj/one") == b"v3"
+    assert base.read("obj/one") == b"v3"
+
+    # mem-tier eviction: oversized value falls through to disk tier
+    big = b"x" * (1 << 17)
+    fs.write("obj/big", big)
+    assert fs.read("obj/big") == big
+    inner = fs.base
+    assert isinstance(inner, DiskCacheFS)
+    base_reads_before = inner.misses
+    assert fs.read("obj/big") == big               # disk tier, not remote
+    assert inner.misses == base_reads_before
+
+
+def test_disk_cache_lru_eviction(s3):
+    base = _fs(s3, prefix="lru")
+    fs = DiskCacheFS(base, tempfile.mkdtemp(prefix="mo_lru_"),
+                     budget_bytes=250)
+    for i in range(5):
+        fs.write(f"k{i}", bytes([i]) * 100)
+    for i in range(5):
+        assert fs.read(f"k{i}") == bytes([i]) * 100
+    # budget 250 -> at most 2 cached; all still readable via remote
+    assert fs._used <= 250
+    for i in range(5):
+        assert fs.read(f"k{i}") == bytes([i]) * 100
