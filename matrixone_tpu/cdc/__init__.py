@@ -6,12 +6,24 @@ changes (insert rows as python dicts, deletes as row-id lists) to a sink,
 tracking a watermark (last shipped commit_ts) so restarts resume without
 loss — events at or below the watermark are skipped on replay.
 
+Tables SHOULD have a primary key (the reference's CDC requires one): a
+PK-less table falls back to all-columns row identity, where a delete of
+one of several identical rows removes them all downstream and replayed
+inserts can duplicate.
+
+Full DML propagates: inserts as rows, deletes as PK-valued rows
+(decoded from the still-readable segments at notify time), updates as the
+engine's delete+insert pairs within one commit ts. `backfill()` replays
+committed state past the watermark from MVCC segments/tombstones, so a
+restarted task resumes at-least-once without a retained event log
+(reference: cdc watermark + logtail re-read).
+
 Sinks:
   * CallbackSink  — python callable (tests, embedding)
   * SQLSink       — re-applies changes to a downstream table over any
                     Session-like executor (a second engine, or a remote
                     MOServer via matrixone_tpu.client) — the reference's
-                    MySQL sinker (cdc/sinker_v2)
+                    MySQL sinker (cdc/sinker_v2); deletes are PK-matched
 """
 
 from __future__ import annotations
@@ -26,44 +38,66 @@ class CallbackSink:
     def __init__(self, fn: Callable):
         self.fn = fn
 
-    def on_insert(self, table: str, rows: List[dict]):
+    def on_insert(self, table: str, rows: List[dict], pk_cols=None):
         self.fn("insert", table, rows)
 
-    def on_delete(self, table: str, gids: List[int]):
-        self.fn("delete", table, gids)
+    def on_delete(self, table: str, pk_rows: List[dict]):
+        self.fn("delete", table, pk_rows)
 
 
 class SQLSink:
-    """Re-applies inserts to a downstream executor (deletes need a PK
-    mapping and land with PK-aware DML in a later round)."""
+    """Re-applies full DML to a downstream executor; deletes match on the
+    upstream PK values shipped with the event."""
 
     def __init__(self, executor, target_table: Optional[str] = None):
         self.executor = executor     # Session or client.Connection
         self.target_table = target_table
 
-    def on_insert(self, table: str, rows: List[dict]):
+    @staticmethod
+    def _lit(v) -> str:
+        if v is None:
+            return "null"
+        if isinstance(v, str):
+            return "'" + v.replace("'", "''") + "'"
+        return str(v)
+
+    def on_insert(self, table: str, rows: List[dict], pk_cols=None):
         target = self.target_table or table
         if not rows:
             return
+        if pk_cols:
+            # at-least-once delivery: replayed inserts (backfill at the
+            # watermark) must not duplicate-key the mirror — remove any
+            # prior copy of these PKs first (delete-then-insert upsert)
+            self.on_delete(table, [{c: r[c] for c in pk_cols}
+                                   for r in rows])
         cols = list(rows[0].keys())
-        values = []
-        for r in rows:
-            parts = []
-            for c in cols:
-                v = r[c]
-                if v is None:
-                    parts.append("null")
-                elif isinstance(v, str):
-                    parts.append("'" + v.replace("'", "''") + "'")
-                else:
-                    parts.append(str(v))
-            values.append("(" + ", ".join(parts) + ")")
+        values = ["(" + ", ".join(self._lit(r[c]) for c in cols) + ")"
+                  for r in rows]
         sql = (f"insert into {target} ({', '.join(cols)}) values "
                + ", ".join(values))
         self.executor.execute(sql)
 
-    def on_delete(self, table: str, gids: List[int]):
-        pass   # PK-mapped deletes: future round
+    @classmethod
+    def _pred(cls, c: str, v) -> str:
+        # SQL three-valued logic: `c = null` never matches
+        return f"{c} is null" if v is None else f"{c} = {cls._lit(v)}"
+
+    def on_delete(self, table: str, pk_rows: List[dict]):
+        target = self.target_table or table
+        if not pk_rows:
+            return
+        cols = list(pk_rows[0].keys())
+        if len(cols) == 1 and all(r[cols[0]] is not None for r in pk_rows):
+            c = cols[0]
+            vals = ", ".join(self._lit(r[c]) for r in pk_rows)
+            self.executor.execute(
+                f"delete from {target} where {c} in ({vals})")
+            return
+        preds = ["(" + " and ".join(self._pred(c, r[c]) for c in cols) + ")"
+                 for r in pk_rows]
+        self.executor.execute(
+            f"delete from {target} where " + " or ".join(preds))
 
 
 class CdcTask:
@@ -112,13 +146,60 @@ class CdcTask:
             return
         with self._lock:
             # one commit publishes several events with the SAME commit_ts
-            # (inserts then deletes); strict < keeps them all and makes
-            # restart delivery at-least-once from the watermark
+            # (deletes then inserts — update pairs); strict < keeps them
+            # all and makes restart delivery at-least-once
             if commit_ts < self.watermark:
                 return     # already shipped (restart replay)
             if kind == "insert":
-                self.sink.on_insert(table, self._decode_segment(payload))
+                pk = self.engine.get_table(self.table).meta.primary_key
+                self.sink.on_insert(table, self._decode_segment(payload),
+                                    pk_cols=pk or None)
             elif kind == "delete":
-                self.sink.on_delete(
-                    table, np.asarray(payload).tolist())
+                self.sink.on_delete(table, self._decode_pk_rows(
+                    np.asarray(payload, np.int64)))
             self.watermark = commit_ts
+
+    def _decode_pk_rows(self, gids: "np.ndarray") -> List[dict]:
+        """PK values for deleted rows (segments still hold the data —
+        tombstones never erase it). Tables without a PK ship all columns
+        as the row identity."""
+        t = self.engine.get_table(self.table)
+        cols = t.meta.primary_key or [c for c, _ in t.meta.schema]
+        arrays, validity = t.fetch_rows(np.asarray(gids, np.int64), cols)
+        sd = dict(t.meta.schema)
+        rows = []
+        for i in range(len(gids)):
+            row = {}
+            for c in cols:
+                if not validity[c][i]:
+                    row[c] = None
+                elif sd[c].is_varlen:
+                    row[c] = t.dicts[c][int(arrays[c][i])]
+                else:
+                    row[c] = arrays[c][i].item()
+            rows.append(row)
+        return rows
+
+    def backfill(self) -> None:
+        """Ship committed changes past the watermark from MVCC state (the
+        restart/resume path: no retained event stream needed). Events
+        replay in commit-ts order, deletes before inserts at equal ts —
+        the live ordering (an UPDATE is delete+insert at one ts)."""
+        was_active = self._active
+        self._active = True      # _on_commit delivers only when active
+        try:
+            self._backfill_events()
+        finally:
+            self._active = was_active
+
+    def _backfill_events(self) -> None:
+        t = self.engine.get_table(self.table)
+        events = []
+        for seg in t.segments:
+            if seg.commit_ts >= self.watermark:
+                events.append((seg.commit_ts, 1, "insert", seg))
+        for ts, gids in t.tombstones:
+            if ts >= self.watermark:
+                events.append((ts, 0, "delete", gids))
+        for ts, _, kind, payload in sorted(events, key=lambda e: e[:2]):
+            self._on_commit(ts, self.table, kind, payload)

@@ -6,9 +6,11 @@ orphan GC), collapsed to the single-service form: an in-process lock table
 keyed by (table, row), shared/exclusive modes, and a wait-for graph
 checked for cycles before every block — the waiter whose edge completes a
 cycle aborts (`DeadlockError`), matching the reference's kill-the-latecomer
-policy. Wakeups race on a shared condition (no fairness queue yet): an
-exclusive waiter can starve under sustained shared traffic — the
-reference's per-lock FIFO queue is the planned refinement.
+policy. Each row lock keeps a FIFO waiter queue (reference: per-lock
+queues in lockservice/lock.go): a new request must not barge past earlier
+waiters, so an exclusive waiter cannot starve under sustained shared
+traffic; wait-for edges include earlier queued waiters, keeping deadlock
+detection sound under queue ordering.
 """
 
 from __future__ import annotations
@@ -31,19 +33,22 @@ class LockTimeoutError(RuntimeError):
 
 
 class _RowLock:
-    __slots__ = ("owners", "mode")
+    __slots__ = ("owners", "mode", "waiters")
 
     def __init__(self):
         self.owners: Set[int] = set()
         self.mode: Optional[str] = None
+        self.waiters: List[Tuple[int, str]] = []   # FIFO arrival order
 
 
 class LockService:
     def __init__(self):
         self._locks: Dict[Tuple[str, int], _RowLock] = {}
         self._held: Dict[int, Set[Tuple[str, int]]] = defaultdict(set)
-        #: waiter txn -> owner txns it is blocked on (wait-for graph)
-        self._waits: Dict[int, Set[int]] = {}
+        #: waiter txn -> (key, mode) it is currently blocked on; wait-for
+        #: edges are DERIVED fresh at cycle-check time (stored edge sets go
+        #: stale the moment an owner releases, producing false deadlocks)
+        self._waiting_on: Dict[int, Tuple[Tuple[str, int], str]] = {}
         self._cond = threading.Condition()
 
     # ------------------------------------------------------------- locking
@@ -61,33 +66,86 @@ class LockService:
             return True
         return False
 
+    def _grantable(self, lk: _RowLock, txn_id: int, mode: str) -> bool:
+        """Owner-compatible AND FIFO-fair: no barging past earlier waiters
+        (two shared requests may be granted together)."""
+        if lk.owners == {txn_id}:
+            return True             # re-entrant / upgrade fast path
+        if txn_id in lk.owners and mode == SHARED and lk.mode == SHARED:
+            return True             # re-reading a shared hold must never
+                                    # queue behind (or deadlock on) waiters
+        if not self._compatible(lk, txn_id, mode):
+            return False
+        for t, m in lk.waiters:
+            if t == txn_id:
+                return True         # nothing ahead of us blocks
+            if m == EXCLUSIVE or mode == EXCLUSIVE:
+                return False        # would barge past an earlier waiter
+        return True
+
+    def _blockers(self, lk: _RowLock, txn_id: int, mode: str) -> Set[int]:
+        out = set(lk.owners) - {txn_id}
+        for t, m in lk.waiters:     # earlier waiters we queue behind
+            if t == txn_id:
+                break
+            if m == EXCLUSIVE or mode == EXCLUSIVE:
+                out.add(t)
+        return out
+
     def _lock_one(self, txn_id: int, key, mode: str, timeout: float):
         deadline = time.monotonic() + timeout
         with self._cond:
             lk = self._locks.setdefault(key, _RowLock())
-            while not self._compatible(lk, txn_id, mode):
-                blockers = lk.owners - {txn_id}
-                self._waits[txn_id] = set(blockers)
-                if self._creates_cycle(txn_id):
-                    self._waits.pop(txn_id, None)
-                    self._cond.notify_all()
-                    raise DeadlockError(
-                        f"txn {txn_id} would deadlock on {key}")
-                remaining = deadline - time.monotonic()
-                if remaining <= 0 or not self._cond.wait(timeout=remaining):
-                    self._waits.pop(txn_id, None)
-                    raise LockTimeoutError(f"txn {txn_id} timed out on {key}")
-                lk = self._locks.setdefault(key, _RowLock())
-            self._waits.pop(txn_id, None)
+            ticket = (txn_id, mode)
+            lk.waiters.append(ticket)
+            try:
+                while not self._grantable(lk, txn_id, mode):
+                    self._waiting_on[txn_id] = (key, mode)
+                    if self._creates_cycle(txn_id):
+                        raise DeadlockError(
+                            f"txn {txn_id} would deadlock on {key}")
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._cond.wait(
+                            timeout=remaining):
+                        raise LockTimeoutError(
+                            f"txn {txn_id} timed out on {key}")
+                    # lk object identity is stable: our queued ticket keeps
+                    # it alive in _locks (unlock_all only deletes entries
+                    # with no owners AND no waiters)
+            except BaseException:
+                try:
+                    lk.waiters.remove(ticket)
+                except ValueError:
+                    pass
+                self._waiting_on.pop(txn_id, None)
+                if not lk.owners and not lk.waiters:
+                    self._locks.pop(key, None)
+                self._cond.notify_all()   # our slot freed: re-evaluate
+                raise
+            lk.waiters.remove(ticket)
+            self._waiting_on.pop(txn_id, None)
             lk.owners.add(txn_id)
             if mode == EXCLUSIVE or lk.mode is None:
                 lk.mode = mode      # never downgrades an EXCLUSIVE hold
             self._held[txn_id].add(key)
+            self._cond.notify_all()   # shared co-grants may now proceed
+
+    def _edges(self, txn: int) -> Set[int]:
+        """Current blockers of a waiting txn, derived from live lock
+        state (owners + earlier queued waiters)."""
+        w = self._waiting_on.get(txn)
+        if w is None:
+            return set()
+        key, mode = w
+        lk = self._locks.get(key)
+        if lk is None:
+            return set()
+        return self._blockers(lk, txn, mode)
 
     def _creates_cycle(self, start: int) -> bool:
-        """DFS over the wait-for graph from start's blockers back to start."""
+        """DFS over the DERIVED wait-for graph from start back to start."""
         seen = set()
-        stack = list(self._waits.get(start, ()))
+        stack = list(self._edges(start))
         while stack:
             t = stack.pop()
             if t == start:
@@ -95,7 +153,7 @@ class LockService:
             if t in seen:
                 continue
             seen.add(t)
-            stack.extend(self._waits.get(t, ()))
+            stack.extend(self._edges(t))
         return False
 
     # ------------------------------------------------------------ release
@@ -106,9 +164,9 @@ class LockService:
                 if lk is None:
                     continue
                 lk.owners.discard(txn_id)
-                if not lk.owners:
+                if not lk.owners and not lk.waiters:
                     del self._locks[key]
-            self._waits.pop(txn_id, None)
+            self._waiting_on.pop(txn_id, None)
             self._cond.notify_all()
 
     # ------------------------------------------------------------- status

@@ -75,6 +75,22 @@ def get_lib():
         lib.mo_bitset_count.argtypes = [u8p, ctypes.c_size_t]
         lib.mo_sorted_contains.argtypes = [i64p, ctypes.c_size_t, i64p,
                                            ctypes.c_size_t, u8p]
+        try:        # an older cached .so may predate the HNSW symbols
+            f32p = ctypes.POINTER(ctypes.c_float)
+            lib.mo_hnsw_build.restype = ctypes.c_void_p
+            lib.mo_hnsw_build.argtypes = [f32p, ctypes.c_int64,
+                                          ctypes.c_int, ctypes.c_int,
+                                          ctypes.c_int, ctypes.c_int,
+                                          ctypes.c_uint64]
+            lib.mo_hnsw_search.argtypes = [ctypes.c_void_p, f32p,
+                                           ctypes.c_int64, ctypes.c_int,
+                                           ctypes.c_int, i64p, f32p]
+            lib.mo_hnsw_n.restype = ctypes.c_int64
+            lib.mo_hnsw_n.argtypes = [ctypes.c_void_p]
+            lib.mo_hnsw_free.argtypes = [ctypes.c_void_p]
+            lib.mo_has_hnsw = True
+        except AttributeError:
+            lib.mo_has_hnsw = False
         _lib = lib
         return _lib
 

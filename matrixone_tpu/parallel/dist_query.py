@@ -104,6 +104,17 @@ def sharded_topk(mesh: Mesh, vectors: jnp.ndarray, queries: jnp.ndarray,
 
 # ---------------------------------------------------------------- shuffle
 
+class ShuffleOverflow(RuntimeError):
+    """cap_per_dest was too small for the key skew; re-run with the
+    reported capacity."""
+
+    def __init__(self, needed: int):
+        super().__init__(
+            f"hash_shuffle bucket overflow: a destination needs capacity "
+            f"{needed}; re-run with cap_per_dest >= {needed}")
+        self.needed = needed
+
+
 def hash_shuffle(mesh: Mesh, keys: jnp.ndarray, values: jnp.ndarray,
                  axis: str = "shard",
                  cap_per_dest: int | None = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -113,9 +124,9 @@ def hash_shuffle(mesh: Mesh, keys: jnp.ndarray, values: jnp.ndarray,
     over morpc, as one ICI all_to_all. `cap_per_dest` is each destination
     bucket's capacity per source shard: default n_per_shard (lossless but
     output is n_dev x input rows per shard — all padding); size it to
-    ~ (n_per_shard / n_dev) * skew_factor to bound memory, accepting that
-    overflow rows beyond the cap are dropped (callers needing exactness
-    keep the default).
+    ~ (n_per_shard / n_dev) * skew_factor to bound memory. Undersized caps
+    raise ShuffleOverflow with the needed capacity — rows are NEVER
+    silently dropped (a shuffle that loses rows is a wrong-answer machine).
 
     Returns (keys', values') re-sharded so equal keys are co-located, with
     key == -1 marking padding slots.
@@ -137,8 +148,8 @@ def hash_shuffle(mesh: Mesh, keys: jnp.ndarray, values: jnp.ndarray,
         seg_start = jnp.where(same == 0, idx, 0)
         start_of_dest = jax.lax.associative_scan(jnp.maximum, seg_start)
         rank = idx - start_of_dest
-        # scatter into [n_dev, cap] buckets (overflow rows dropped; caller
-        # sizes cap for skew)
+        # largest bucket demand (global): the overflow signal
+        max_rank = jax.lax.pmax(jnp.max(rank) + 1, axis)
         slot_k = jnp.full((n_dev, cap), -1, k_sh.dtype)
         slot_v = jnp.zeros((n_dev, cap), v_sh.dtype)
         ok = rank < cap
@@ -149,11 +160,16 @@ def hash_shuffle(mesh: Mesh, keys: jnp.ndarray, values: jnp.ndarray,
         # exchange: bucket p goes to device p
         k_out = jax.lax.all_to_all(slot_k, axis, split_axis=0, concat_axis=0)
         v_out = jax.lax.all_to_all(slot_v, axis, split_axis=0, concat_axis=0)
-        return k_out.reshape(-1), v_out.reshape(-1)
+        return k_out.reshape(-1), v_out.reshape(-1), max_rank
 
     fn = jax.shard_map(step, mesh=mesh, in_specs=(P(axis), P(axis)),
-                       out_specs=(P(axis), P(axis)))
-    return fn(keys, values)
+                       out_specs=(P(axis), P(axis), P()))
+    k_out, v_out, max_need = fn(keys, values)
+    if cap_per_dest is not None:
+        needed = int(jax.device_get(jnp.max(max_need)))
+        if needed > cap_per_dest:
+            raise ShuffleOverflow(needed)
+    return k_out, v_out
 
 
 # ----------------------------------------------------------- full Q1 step

@@ -546,7 +546,7 @@ class Engine:
         self._ckpt_ts = 0
         self.snapshots: Dict[str, int] = {}      # Git-for-data named points
         #: last FULLY applied commit: readers snapshot here so a commit
-        #: mid-apply (segments in, tombstones not yet) can never tear a read
+        #: mid-apply (tombstones in, segments not yet) can never tear a read
         self.committed_ts = self.hlc.now()
         from matrixone_tpu.lockservice import LockService
         self.locks = LockService()     # pessimistic mode (pkg/lockservice)
@@ -739,7 +739,16 @@ class Engine:
                                      "ts": commit_ts,
                                      "gids": np.asarray(gids).tolist()})
             self.wal.append({"op": "commit", "ts": commit_ts})
-            # apply
+            # apply: deletes BEFORE inserts — an UPDATE is delete+insert at
+            # one commit ts, and downstream CDC consumers replaying in
+            # event order must remove the old row before the new one lands
+            # (insert-first would duplicate-key on a PK mirror)
+            for tname, gids in deletes.items():
+                t = self.get_table(tname)
+                t.apply_tombstones(commit_ts, np.asarray(gids, np.int64))
+                affected += len(gids)
+                for fn in self._subscribers:
+                    fn(commit_ts, tname, "delete", gids)
             for tname, segs in inserts.items():
                 t = self.get_table(tname)
                 for arrays, validity in segs:
@@ -749,12 +758,6 @@ class Engine:
                     affected += seg.n_rows
                     for fn in self._subscribers:
                         fn(commit_ts, tname, "insert", seg)
-            for tname, gids in deletes.items():
-                t = self.get_table(tname)
-                t.apply_tombstones(commit_ts, np.asarray(gids, np.int64))
-                affected += len(gids)
-                for fn in self._subscribers:
-                    fn(commit_ts, tname, "delete", gids)
             for tname in set(list(inserts) + list(deletes)):
                 for ix in self.indexes_on(tname):
                     ix.dirty = True

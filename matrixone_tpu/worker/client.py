@@ -49,11 +49,13 @@ class WorkerClient:
         return h, out_arrays, out_val
 
     def load_index(self, name: str, data: np.ndarray, nlist: int = 64,
-                   metric: str = "l2"):
+                   metric: str = "l2", mode: str = "single"):
+        """mode: single | replicated | sharded (cuvs_worker_t multi-device
+        modes)."""
         from matrixone_tpu.storage import arrowio
         val = {"data": np.ones(len(data), np.bool_)}
         return self.run({"op": "load_index", "name": name, "nlist": nlist,
-                         "metric": metric},
+                         "metric": metric, "mode": mode},
                         arrowio.arrays_to_ipc({"data": data}, val))[0]
 
     def search_index(self, name: str, queries: np.ndarray, k: int = 10,

@@ -43,6 +43,77 @@ def unpack(data: bytes):
     return header, data[4 + hlen:]
 
 
+class _MicroBatcher:
+    """Dynamic micro-batching of concurrent searches against one index
+    (reference: cgo/cuvs dynamic_batching.hpp). Drain-loop design: the
+    first arrival becomes the key's dispatcher and loops draining the
+    bucket; requests that land WHILE a dispatch is on the device coalesce
+    into the next batch. Sequential callers pay zero added latency (no
+    collection sleep); batching emerges exactly when there is queueing."""
+
+    def __init__(self, max_batch: int = 256):
+        self.max_batch = max_batch
+        self._lock = threading.Lock()
+        self._pending: Dict[tuple, list] = {}
+        self._busy: Dict[tuple, bool] = {}
+        self.dispatches = 0
+        self.requests = 0
+
+    def run(self, key: tuple, queries: np.ndarray, fn):
+        """fn(all_queries) -> (d, i) arrays; returns this caller's slice."""
+        entry = {"q": queries, "out": None, "err": None,
+                 "ev": threading.Event()}
+        with self._lock:
+            self.requests += 1
+            self._pending.setdefault(key, []).append(entry)
+            leader = not self._busy.get(key, False)
+            if leader:
+                self._busy[key] = True
+        if not leader:
+            entry["ev"].wait(timeout=120)
+            if entry["err"] is not None:
+                raise entry["err"]
+            if entry["out"] is None:
+                raise TimeoutError("batch dispatcher never returned")
+            return entry["out"]
+        try:
+            while True:
+                with self._lock:
+                    bucket = self._pending.get(key, [])
+                    batch, rest = (bucket[:self.max_batch],
+                                   bucket[self.max_batch:])
+                    if rest:
+                        self._pending[key] = rest
+                    else:
+                        self._pending.pop(key, None)
+                    if not batch:
+                        self._busy[key] = False
+                        break
+                    self.dispatches += 1
+                try:
+                    qs = np.concatenate([e["q"] for e in batch])
+                    d, i = fn(qs)
+                    off = 0
+                    for e in batch:
+                        n = len(e["q"])
+                        e["out"] = (d[off:off + n], i[off:off + n])
+                        off += n
+                except Exception as err:   # noqa: BLE001
+                    for e in batch:
+                        e["err"] = err
+                finally:
+                    for e in batch:
+                        e["ev"].set()
+        finally:
+            # interrupt-path safety: never leave the key wedged busy
+            # (queued followers then time out instead of hanging forever)
+            with self._lock:
+                self._busy[key] = False
+        if entry["err"] is not None:
+            raise entry["err"]
+        return entry["out"]
+
+
 class WorkerCore:
     """Device-owning state + stage execution (transport-independent)."""
 
@@ -51,6 +122,7 @@ class WorkerCore:
         self.started = time.time()
         self.stages_run = 0
         self._lock = threading.Lock()
+        self.batcher = _MicroBatcher()
 
     # ---- stage execution
     def run_stage(self, header: dict, blob: bytes) -> bytes:
@@ -159,47 +231,123 @@ class WorkerCore:
 
         if op == "load_index":
             from matrixone_tpu.storage import arrowio
-            from matrixone_tpu.vectorindex import ivf_flat
             arrays, _ = arrowio.ipc_to_arrays(blob)
-            import jax.numpy as jnp
-            with self._lock:
-                self.indexes[header["name"]] = ivf_flat.build(
-                    jnp.asarray(arrays["data"]),
-                    nlist=header.get("nlist", 64),
-                    metric=header.get("metric", "l2"),
-                    storage_dtype=jnp.bfloat16)
-            return pack({"ok": True, "n": int(arrays["data"].shape[0])})
+            return pack(self.load_index(
+                header["name"], arrays["data"],
+                nlist=header.get("nlist", 64),
+                metric=header.get("metric", "l2"),
+                mode=header.get("mode", "single")))
 
         if op == "search_index":
             from matrixone_tpu.storage import arrowio
-            from matrixone_tpu.vectorindex import ivf_flat
-            import jax.numpy as jnp
             arrays, _ = arrowio.ipc_to_arrays(blob)
-            index = self.indexes[header["name"]]
-            q = arrays["queries"].astype(np.float32)
-            if len(q) == 0:
-                empty = {"distances": np.zeros((0, 1), np.float32),
-                         "ids": np.zeros((0, 1), np.int64)}
-                val = {c: np.ones(0, np.bool_) for c in empty}
-                return pack({"ok": True}, arrowio.arrays_to_ipc(empty, val))
-            chunk = min(32, len(q))
-            pad = (-len(q)) % chunk
-            if pad:
-                q = np.concatenate([q, np.zeros((pad, q.shape[1]), q.dtype)])
-            nprobe = min(header.get("nprobe", 8), index.nlist)
-            k = min(header.get("k", 10), index.n,
-                    nprobe * index.max_cluster_size) or 1
-            d, i = ivf_flat.search(index, jnp.asarray(q), k=k,
-                                   nprobe=nprobe, query_chunk=chunk)
-            n = len(arrays["queries"])
-            out = {"distances": np.asarray(d)[:n].astype(np.float32),
-                   "ids": np.asarray(i)[:n].astype(np.int64)}
+            d, i = self.search_index(header["name"],
+                                     arrays["queries"].astype(np.float32),
+                                     k=header.get("k", 10),
+                                     nprobe=header.get("nprobe", 8))
+            out = {"distances": d.astype(np.float32),
+                   "ids": i.astype(np.int64)}
             val = {c: np.ones(len(v), np.bool_) for c, v in out.items()}
-            return pack({"ok": True}, arrowio.arrays_to_ipc(
-                {"distances": out["distances"],
-                 "ids": out["ids"]}, val))
+            return pack({"ok": True}, arrowio.arrays_to_ipc(out, val))
 
         raise ValueError(f"unknown stage op {op!r}")
+
+    # ---- index lifecycle (reference: cuvs_worker_t single / replicated /
+    # sharded multi-device modes, cgo/cuvs/README.md)
+    def load_index(self, name: str, data: np.ndarray, nlist: int = 64,
+                   metric: str = "l2", mode: str = "single") -> dict:
+        import jax
+        import jax.numpy as jnp
+        from matrixone_tpu.vectorindex import ivf_flat
+        devices = jax.devices()
+        if mode == "sharded":
+            # rows split across devices; each shard is its own IVF index
+            # searched in parallel and merged by distance
+            n_shards = min(len(devices), max(1, len(data)))
+            bounds = np.linspace(0, len(data), n_shards + 1).astype(int)
+            parts = []
+            for s in range(n_shards):
+                lo, hi = int(bounds[s]), int(bounds[s + 1])
+                if hi <= lo:
+                    continue
+                with jax.default_device(devices[s]):
+                    idx = ivf_flat.build(
+                        jnp.asarray(data[lo:hi]),
+                        nlist=max(1, min(nlist // n_shards or 1, hi - lo)),
+                        metric=metric, storage_dtype=jnp.bfloat16)
+                parts.append((idx, lo))
+            entry = {"mode": "sharded", "parts": parts, "n": len(data)}
+        elif mode == "replicated":
+            idx = ivf_flat.build(jnp.asarray(data),
+                                 nlist=max(1, min(nlist, len(data))),
+                                 metric=metric, storage_dtype=jnp.bfloat16)
+            replicas = [jax.device_put(idx, d) for d in devices]
+            entry = {"mode": "replicated", "replicas": replicas,
+                     "rr": [0], "n": len(data)}
+        else:
+            idx = ivf_flat.build(jnp.asarray(data),
+                                 nlist=max(1, min(nlist, len(data))),
+                                 metric=metric, storage_dtype=jnp.bfloat16)
+            entry = {"mode": "single", "index": idx, "n": len(data)}
+        with self._lock:
+            self.indexes[name] = entry
+        return {"ok": True, "n": len(data), "mode": mode,
+                "devices": len(devices)}
+
+    def search_index(self, name: str, queries: np.ndarray, k: int = 10,
+                     nprobe: int = 8):
+        """Batched (dynamic micro-batching) search against a loaded index;
+        returns (distances [n,k], ids [n,k])."""
+        entry = self.indexes[name]
+        if len(queries) == 0:
+            return (np.zeros((0, 1), np.float32), np.zeros((0, 1), np.int64))
+        key = (name, k, nprobe)
+        return self.batcher.run(
+            key, queries, lambda qs: self._search_all(entry, qs, k, nprobe))
+
+    def _search_all(self, entry: dict, q: np.ndarray, k: int, nprobe: int):
+        import jax.numpy as jnp
+        from matrixone_tpu.vectorindex import ivf_flat
+        n = len(q)
+        # bucket to power-of-2 row counts: dynamic batch sizes must reuse
+        # a small set of compiled shapes, or per-size recompiles stall the
+        # batch leader and fragment the queue (cuvs compile-cache role)
+        chunk = 32
+        bucket = max(chunk, 1 << (max(n - 1, 0)).bit_length())
+        pad = bucket - n
+        if pad:
+            q = np.concatenate([q, np.zeros((pad, q.shape[1]), q.dtype)])
+
+        def dispatch(idx):
+            np_ = min(nprobe, idx.nlist)
+            kk = min(k, idx.n, np_ * idx.max_cluster_size) or 1
+            return ivf_flat.search(idx, jnp.asarray(q), k=kk,
+                                   nprobe=np_, query_chunk=chunk)
+
+        def one(idx, offset):
+            d, i = dispatch(idx)
+            return (np.asarray(d)[:n],
+                    np.asarray(i)[:n].astype(np.int64) + offset)
+
+        if entry["mode"] == "sharded":
+            # dispatch every shard before materializing any: the device
+            # calls are async, so shards overlap instead of serializing on
+            # the first shard's np.asarray
+            lazy = [(dispatch(idx), off) for idx, off in entry["parts"]]
+            ds = [np.asarray(d)[:n] for (d, _i), _ in lazy]
+            ids = [np.asarray(i)[:n].astype(np.int64) + off
+                   for (_d, i), off in lazy]
+            all_d = np.concatenate(ds, axis=1)
+            all_i = np.concatenate(ids, axis=1)
+            order = np.argsort(all_d, axis=1)[:, :k]
+            return (np.take_along_axis(all_d, order, axis=1),
+                    np.take_along_axis(all_i, order, axis=1))
+        if entry["mode"] == "replicated":
+            with self._lock:
+                r = entry["rr"][0]
+                entry["rr"][0] = (r + 1) % len(entry["replicas"])
+            return one(entry["replicas"][r], 0)
+        return one(entry["index"], 0)
 
     def health(self) -> dict:
         import jax
@@ -207,7 +355,9 @@ class WorkerCore:
                 "devices": [str(d) for d in jax.devices()],
                 "uptime_s": round(time.time() - self.started, 1),
                 "stages_run": self.stages_run,
-                "indexes": sorted(self.indexes)}
+                "indexes": sorted(self.indexes),
+                "batch_requests": self.batcher.requests,
+                "batch_dispatches": self.batcher.dispatches}
 
 
 class TpuWorkerServer:

@@ -188,3 +188,41 @@ def test_orphaned_txn_releases_locks():
     s2.execute("begin")
     s2.execute("update t set v = 2 where id = 1")   # acquires immediately
     s2.execute("commit")
+
+
+def test_exclusive_waiter_not_starved_by_shared_stream():
+    """VERDICT r1 Weak #10: per-lock FIFO — an exclusive waiter queued
+    behind one shared holder must be granted ahead of later shared
+    requests (no barging)."""
+    from matrixone_tpu.lockservice import SHARED
+    ls = LockService()
+    ls.lock(1, "t", [7], SHARED)
+    order = []
+    started = threading.Event()
+
+    def writer():
+        started.set()
+        ls.lock(2, "t", [7], EXCLUSIVE, timeout=10)
+        order.append("writer")
+        ls.unlock_all(2)
+
+    def reader(txn):
+        ls.lock(txn, "t", [7], SHARED, timeout=10)
+        order.append(f"reader{txn}")
+        ls.unlock_all(txn)
+
+    tw = threading.Thread(target=writer)
+    tw.start()
+    started.wait()
+    time.sleep(0.1)               # writer is queued behind txn 1
+    readers = [threading.Thread(target=reader, args=(10 + i,))
+               for i in range(4)]
+    for r in readers:             # sustained shared traffic arrives later
+        r.start()
+    time.sleep(0.1)
+    ls.unlock_all(1)              # release the original shared hold
+    tw.join(timeout=10)
+    for r in readers:
+        r.join(timeout=10)
+    assert order[0] == "writer", order   # FIFO: writer first, then readers
+    assert len(order) == 5

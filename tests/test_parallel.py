@@ -99,3 +99,21 @@ def test_distributed_q1_matches_oracle(mesh, rng):
         assert int(sc[g]) == o["sum_charge"]
         assert int(cnt[g]) == o["count_order"]
         assert bool(present[g])
+
+
+def test_hash_shuffle_overflow_is_loud(mesh):
+    """VERDICT r1 Weak #3: undersized caps must raise with the needed
+    capacity, never silently drop rows."""
+    import pytest
+    from matrixone_tpu.parallel import dist_query
+    n = 64 * mesh.devices.size
+    k = jnp.zeros((n,), jnp.int64)          # all rows hash to ONE shard
+    v = jnp.arange(n, dtype=jnp.int64)
+    with pytest.raises(dist_query.ShuffleOverflow) as ei:
+        dist_query.hash_shuffle(mesh, k, v, cap_per_dest=8)
+    # retry with the reported capacity succeeds and loses nothing
+    k2, v2 = dist_query.hash_shuffle(mesh, k, v,
+                                     cap_per_dest=ei.value.needed)
+    import numpy as np
+    kept = np.asarray(v2)[np.asarray(k2) != -1]
+    assert len(kept) == n and set(kept.tolist()) == set(range(n))

@@ -94,3 +94,63 @@ def test_cdc_sql_sink_mirrors_table():
     src_sess.execute("insert into t values (3, 'o''k')")   # quote escaping
     rows = dst_sess.execute("select id, v from t order by id").rows()
     assert rows == [(1, "x"), (2, None), (3, "o'k")]
+
+
+def test_cdc_full_dml_mirror_with_restart():
+    """VERDICT r1 #9: sink mirrors a table through insert/update/delete and
+    a task restart resumes from the watermark (backfill from MVCC state)."""
+    src = Session()
+    dst = Session()
+    src.execute("create table m (id bigint primary key, v varchar(8))")
+    dst.execute("create table m (id bigint primary key, v varchar(8))")
+    task = CdcTask(src.catalog, "m", SQLSink(dst)).start()
+    src.execute("insert into m values (1, 'a'), (2, 'b'), (3, 'c')")
+    src.execute("update m set v = 'B2' where id = 2")     # delete+insert
+    src.execute("delete from m where id = 1")
+    rows = dst.execute("select id, v from m order by id").rows()
+    assert [(int(a), b) for a, b in rows] == [(2, "B2"), (3, "c")]
+
+    # restart: task goes away, DML continues, a new task resumes from the
+    # saved watermark via backfill
+    wm = task.watermark
+    task.stop()
+    src.execute("insert into m values (4, 'd')")
+    src.execute("delete from m where id = 3")
+    task2 = CdcTask(src.catalog, "m", SQLSink(dst), from_ts=wm)
+    task2.backfill()
+    task2.start()
+    src.execute("insert into m values (5, 'e')")
+    rows = dst.execute("select id, v from m order by id").rows()
+    assert [(int(a), b) for a, b in rows] == [
+        (2, "B2"), (4, "d"), (5, "e")]
+
+
+def test_cdc_composite_pk_deletes():
+    src = Session()
+    got = []
+    src.execute("create table cp (a bigint, b varchar(4), x int, "
+                "primary key (a, b))")
+    CdcTask(src.catalog, "cp", CallbackSink(
+        lambda kind, table, payload: got.append((kind, payload)))).start()
+    src.execute("insert into cp values (1, 'p', 10), (1, 'q', 20)")
+    src.execute("delete from cp where b = 'q'")
+    assert got[-1][0] == "delete"
+    assert got[-1][1] == [{"a": 1, "b": "q"}]
+
+
+def test_cdc_backfill_replays_insert_idempotently():
+    """At-least-once delivery: the event AT the watermark may re-ship; a
+    replayed INSERT must not duplicate-key the PK mirror (delete-then-
+    insert upsert in SQLSink)."""
+    src = Session()
+    dst = Session()
+    src.execute("create table u (id bigint primary key, v varchar(4))")
+    dst.execute("create table u (id bigint primary key, v varchar(4))")
+    task = CdcTask(src.catalog, "u", SQLSink(dst)).start()
+    src.execute("insert into u values (1, 'a')")     # LAST event = insert
+    wm = task.watermark
+    task.stop()
+    task2 = CdcTask(src.catalog, "u", SQLSink(dst), from_ts=wm)
+    task2.backfill()                                  # replays the insert
+    rows = dst.execute("select id, v from u order by id").rows()
+    assert [(int(a), b) for a, b in rows] == [(1, "a")]

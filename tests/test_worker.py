@@ -89,3 +89,42 @@ def test_group_aggregate_stage(worker):
     got = dict(zip(out["_g0"][:7].tolist(), out["_a0_sum"][:7].tolist()))
     for g in range(7):
         assert got[g] == int(vals[keys == g].sum())
+
+
+def test_dynamic_batching_coalesces_concurrent_searches(worker):
+    """VERDICT r1 #7: concurrency-N search must coalesce into far fewer
+    device dispatches (cuvs dynamic_batching analogue)."""
+    import threading
+    rng = np.random.default_rng(5)
+    data = rng.normal(size=(2000, 8)).astype(np.float32)
+    worker.load_index("batched", data, nlist=8)
+    h0 = worker.health()
+    results = [None] * 40
+
+    def one(i):
+        q = data[i * 3:i * 3 + 1]
+        d, ids = worker.search_index("batched", q, k=1, nprobe=8)
+        results[i] = int(ids[0][0])
+
+    ts = [threading.Thread(target=one, args=(i,)) for i in range(40)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert all(results[i] == i * 3 for i in range(40)), results[:5]
+    h1 = worker.health()
+    reqs = h1["batch_requests"] - h0["batch_requests"]
+    disp = h1["batch_dispatches"] - h0["batch_dispatches"]
+    assert reqs == 40
+    assert disp < reqs / 2, (reqs, disp)   # the batching win
+
+
+def test_sharded_and_replicated_modes(worker):
+    rng = np.random.default_rng(6)
+    data = rng.normal(size=(1200, 8)).astype(np.float32)
+    q = data[17:18]
+    for mode in ("sharded", "replicated"):
+        r = worker.load_index(f"ix_{mode}", data, nlist=8, mode=mode)
+        assert r["mode"] == mode
+        d, ids = worker.search_index(f"ix_{mode}", q, k=3, nprobe=8)
+        assert int(ids[0][0]) == 17, (mode, ids[0])
