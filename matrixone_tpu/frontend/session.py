@@ -121,6 +121,7 @@ class Session:
                 raise BindError("EXPLAIN supports SELECT only for now")
             self._prepare_select(stmt.stmt)
             node = binder.bind_statement(stmt.stmt)
+            node = self._cbo(node)
             node = apply_indices(
                 node, self.catalog,
                 nprobe=int(self.variables.get("ivf_nprobe", 8)),
@@ -128,6 +129,15 @@ class Session:
             if stmt.analyze:
                 return Result(text=self._explain_analyze(node))
             return Result(text=P.explain(node))
+        if isinstance(stmt, ast.AnalyzeTable):
+            from matrixone_tpu.sql.stats import provider_for
+            st = provider_for(self.catalog).refresh(stmt.name)
+            b = Batch.from_pydict(
+                {"table": [stmt.name], "rows": [st.row_count],
+                 "columns": [len(st.cols)]},
+                {"table": dt.VARCHAR, "rows": dt.INT64,
+                 "columns": dt.INT64})
+            return Result(batch=b)
         if isinstance(stmt, ast.ShowTables):
             names = sorted(self.catalog.tables)
             b = Batch.from_pydict({"Tables": names},
@@ -450,6 +460,14 @@ class Session:
         b = Batch.from_pydict({"mo_ctl": [out]}, {"mo_ctl": dt.VARCHAR})
         return Result(batch=b)
 
+    def _cbo(self, node):
+        """Stats-driven join reordering (reference: plan/query_builder.go
+        determineJoinOrder). `SET cbo = 0` disables it for plan debugging."""
+        if str(self.variables.get("cbo", 1)) in ("0", "off", "false"):
+            return node
+        from matrixone_tpu.sql.cbo import optimize_plan
+        return optimize_plan(node, self.catalog)
+
     # ------------------------------------------------------------- select
     def _select(self, sel: ast.Select) -> Result:
         from matrixone_tpu.sql.optimize import apply_indices
@@ -458,6 +476,7 @@ class Session:
             return ctl
         self._prepare_select(sel)
         node = Binder(self.catalog).bind_statement(sel)
+        node = self._cbo(node)
         node = apply_indices(node, self.catalog,
                              nprobe=int(self.variables.get("ivf_nprobe", 8)),
                              skip_tables=self._index_skip_tables())

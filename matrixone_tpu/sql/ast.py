@@ -303,6 +303,11 @@ class ShowIndexes(Node):
 
 
 @dataclasses.dataclass
+class AnalyzeTable(Node):
+    name: str
+
+
+@dataclasses.dataclass
 class SetVariable(Node):
     name: str
     value: Node

@@ -24,7 +24,8 @@ from matrixone_tpu.container.dtypes import DType, TypeOid
 from matrixone_tpu.sql import ast, plan
 from matrixone_tpu.sql.expr import (AggCall, BoundCase, BoundCast, BoundCol,
                                     BoundExpr, BoundFunc, BoundInList,
-                                    BoundIsNull, BoundLike, BoundLiteral)
+                                    BoundIsNull, BoundLike, BoundLiteral,
+                                    and_all)
 
 AGG_FUNCS = {"count", "sum", "avg", "min", "max"}
 WINDOW_ONLY_FUNCS = {"row_number", "rank", "dense_rank"}
@@ -805,10 +806,10 @@ class Binder:
                     else:
                         keep.append(c)
             if lpush:
-                j.left = plan.Filter(j.left, _and_bound(lpush),
+                j.left = plan.Filter(j.left, and_all(lpush),
                                      j.left.schema)
             if rpush:
-                j.right = plan.Filter(j.right, _and_bound(rpush),
+                j.right = plan.Filter(j.right, and_all(rpush),
                                       j.right.schema)
             if j.kind == "cross" and j.left_keys:
                 j.kind = "inner"
@@ -816,11 +817,11 @@ class Binder:
                 # no equi keys: evaluate the mixed predicate as the cross
                 # join's residual (loopjoin analogue) instead of
                 # materializing the full product above it
-                res = _and_bound(keep)
+                res = and_all(keep)
                 j.residual = res if j.residual is None else \
                     BoundFunc("and", [j.residual, res], dt.BOOL)
                 keep = []
-            out = j if not keep else plan.Filter(j, _and_bound(keep),
+            out = j if not keep else plan.Filter(j, and_all(keep),
                                                  j.schema)
             for attr in ("child", "left", "right"):
                 c = getattr(out, attr, None)
@@ -861,13 +862,6 @@ def _split_bound_and(e: BoundExpr) -> List[BoundExpr]:
     if isinstance(e, BoundFunc) and e.op == "and":
         return _split_bound_and(e.args[0]) + _split_bound_and(e.args[1])
     return [e]
-
-
-def _and_bound(cs: List[BoundExpr]) -> BoundExpr:
-    e = cs[0]
-    for c in cs[1:]:
-        e = BoundFunc("and", [e, c], dt.BOOL)
-    return e
 
 
 def _bound_col_names(e: BoundExpr) -> set:
@@ -921,12 +915,12 @@ def _factor_or(e: BoundExpr) -> BoundExpr:
     rest_arms = []
     for conj in arm_conjs:
         rest = [c for c in conj if not any(c == d for d in common)]
-        rest_arms.append(_and_bound(rest) if rest
+        rest_arms.append(and_all(rest) if rest
                          else BoundLiteral(True, dt.BOOL))
     ored = rest_arms[0]
     for r in rest_arms[1:]:
         ored = BoundFunc("or", [ored, r], dt.BOOL)
-    return _and_bound(common + [ored])
+    return and_all(common + [ored])
 
 
 def _split_bound_or(e: BoundExpr) -> List[BoundExpr]:

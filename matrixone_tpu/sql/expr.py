@@ -13,7 +13,7 @@ from __future__ import annotations
 import dataclasses
 from typing import List, Optional, Tuple
 
-from matrixone_tpu.container.dtypes import DType
+from matrixone_tpu.container.dtypes import BOOL, DType
 
 
 class BoundExpr:
@@ -102,3 +102,12 @@ def walk(e: BoundExpr):
 
 def columns_used(e: BoundExpr) -> List[str]:
     return [n.name for n in walk(e) if isinstance(n, BoundCol)]
+
+
+def and_all(cs: List[BoundExpr]) -> BoundExpr:
+    """Fold conjuncts into one left-deep AND tree (canonical helper for
+    binder pushdown / CBO residual re-attachment)."""
+    e = cs[0]
+    for c in cs[1:]:
+        e = BoundFunc("and", [e, c], BOOL)
+    return e

@@ -117,6 +117,10 @@ class Parser:
         if t0.kind == "ident" and t0.value.lower() in ("describe", "desc_table"):
             self.next()
             return ast.ShowColumns(self.ident())
+        if self.at_kw("analyze"):
+            self.next()
+            self.expect_kw("table")
+            return ast.AnalyzeTable(self.ident())
         if self.at_kw("restore"):
             self.next()
             self.expect_kw("table")

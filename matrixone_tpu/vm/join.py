@@ -28,6 +28,27 @@ from matrixone_tpu.vm.exprs import ExecBatch, eval_expr
 from matrixone_tpu.vm.operators import Operator, _broadcast_full, _concat_batches
 
 
+def _probe_scans(op, name: str):
+    """Resolve a probe-key column down to the scans that produce it,
+    walking only through operators where a pre-filter is always safe
+    (Filter: conjunctive; Project: plain column renames)."""
+    from matrixone_tpu.sql.expr import BoundCol
+    from matrixone_tpu.vm import operators as O
+    if isinstance(op, O.FilterOp):
+        return _probe_scans(op.child, name)
+    if isinstance(op, O.ProjectOp):
+        for (n, _), e in zip(op.node.schema, op.node.exprs):
+            if n == name:
+                if isinstance(e, BoundCol):
+                    return _probe_scans(op.child, e.name)
+                return []
+        return []
+    if isinstance(op, O.ScanOp):
+        if any(n == name for n, _ in op.node.schema):
+            return [(op, name)]
+    return []
+
+
 def _maybe_compact(out: ExecBatch) -> ExecBatch:
     """Join outputs carry np*mm lanes but typically few live rows; without
     compaction a chain of joins grows lanes multiplicatively (observed:
@@ -86,8 +107,52 @@ class JoinOp(Operator):
         order = jnp.argsort(bhash).astype(jnp.int32)
         sorted_hash = bhash[order]
 
+        if self.node.kind in ("inner", "semi"):
+            self._push_runtime_filters(bkeys, bvalid)
         for ex in self.left.execute():
             yield from self._probe(ex, build, sorted_hash, order, bkeys)
+
+    def _push_runtime_filters(self, bkeys, bvalid) -> None:
+        """Build-side key min/max pushed into probe-side scans before the
+        probe starts (reference: runtimeFilterMsg sent hashbuild -> scan).
+        Inner/semi only — removing non-matching probe rows early cannot
+        change the result. Ranges ride the scan's zonemap pruning, so
+        whole chunks outside the build key range are never read."""
+        from matrixone_tpu.sql.expr import BoundCol, BoundFunc, BoundLiteral
+        any_valid = bool(jax.device_get(jnp.any(bvalid)))
+        if not any_valid:
+            return
+        for lk, bk in zip(self.node.left_keys, bkeys):
+            if not isinstance(lk, BoundCol):
+                continue
+            dtype = lk.dtype
+            int_like = dtype.is_integer or dtype.oid in (
+                dt.TypeOid.DATE, dt.TypeOid.DECIMAL64)
+            if not int_like or dtype.is_varlen:
+                continue
+            # scales/widths must agree for a raw-unit range to be valid
+            if bk.dtype != dtype and not (bk.dtype.is_integer
+                                          and dtype.is_integer):
+                continue
+            data = bk.data
+            if data.ndim != 1:
+                continue
+            big = jnp.iinfo(data.dtype).max
+            lo = int(jax.device_get(
+                jnp.min(jnp.where(bvalid, data, big))))
+            hi = int(jax.device_get(
+                jnp.max(jnp.where(bvalid, data, -big - 1))))
+            if dtype.is_integer:
+                import numpy as _np
+                info = _np.iinfo(dtype.np_dtype)
+                lo = max(lo, int(info.min))
+                hi = min(hi, int(info.max))
+            for scan, name in _probe_scans(self.left, lk.name):
+                col = BoundCol(name, dtype)
+                scan.runtime_filters.append(
+                    BoundFunc("ge", [col, BoundLiteral(lo, dtype)], dt.BOOL))
+                scan.runtime_filters.append(
+                    BoundFunc("le", [col, BoundLiteral(hi, dtype)], dt.BOOL))
 
     def _probe(self, ex: ExecBatch, build, sorted_hash, border, bkeys):
         pkeys = [_broadcast_full(eval_expr(k, ex), ex.padded_len)

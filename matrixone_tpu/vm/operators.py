@@ -73,6 +73,10 @@ class ScanOp(Operator):
         self.batch_rows = batch_rows
         self.schema = node.schema
         self.ctx = ctx
+        # filters injected at run time by upstream joins (build-side key
+        # ranges — reference: vm/message/runtimeFilterMsg.go); they ride
+        # the same zonemap-pruning + early-mask path as planned filters
+        self.runtime_filters: List[BoundExpr] = []
 
     def execute(self) -> Iterator[ExecBatch]:
         from matrixone_tpu.utils import metrics as M
@@ -84,8 +88,9 @@ class ScanOp(Operator):
         if self.node.as_of_ts is not None:
             # time travel: a historical read, independent of the txn view
             read_args = {"snapshot_ts": self.node.as_of_ts}
+        filters = self.node.filters + self.runtime_filters
         for chunk in self.rel.iter_chunks(self.node.columns, self.batch_rows,
-                                          filters=self.node.filters,
+                                          filters=filters,
                                           qualified_names=qnames,
                                           **read_args):
             arrays, validity, dicts, n = chunk
@@ -94,7 +99,7 @@ class ScanOp(Operator):
                                     self.node.columns, self.node.schema)
             # evaluate pushed filters as an early mask (zonemap pruning
             # already dropped fully-excluded chunks host-side)
-            for f in self.node.filters:
+            for f in filters:
                 pred = eval_expr(f, ex)
                 ex.mask = ex.mask & F.predicate_mask(pred, ex.batch)
             yield ex

@@ -76,6 +76,7 @@ class _MicroBatcher:
             if entry["out"] is None:
                 raise TimeoutError("batch dispatcher never returned")
             return entry["out"]
+        clean_exit = False
         try:
             while True:
                 with self._lock:
@@ -88,6 +89,7 @@ class _MicroBatcher:
                         self._pending.pop(key, None)
                     if not batch:
                         self._busy[key] = False
+                        clean_exit = True
                         break
                     self.dispatches += 1
                 try:
@@ -105,10 +107,14 @@ class _MicroBatcher:
                     for e in batch:
                         e["ev"].set()
         finally:
-            # interrupt-path safety: never leave the key wedged busy
-            # (queued followers then time out instead of hanging forever)
-            with self._lock:
-                self._busy[key] = False
+            # interrupt-path safety: never leave the key wedged busy.
+            # Only on the abnormal path — after a clean exit the flag was
+            # already released under the lock, and a NEWER leader may have
+            # claimed it since; stomping it here would let two dispatchers
+            # run concurrently for one key.
+            if not clean_exit:
+                with self._lock:
+                    self._busy[key] = False
         if entry["err"] is not None:
             raise entry["err"]
         return entry["out"]
@@ -301,7 +307,9 @@ class WorkerCore:
         entry = self.indexes[name]
         if len(queries) == 0:
             return (np.zeros((0, 1), np.float32), np.zeros((0, 1), np.int64))
-        key = (name, k, nprobe)
+        # query dim is part of the key: a malformed-dim request must fail
+        # alone, not poison the np.concatenate of a whole co-batch
+        key = (name, k, nprobe, int(queries.shape[1]))
         return self.batcher.run(
             key, queries, lambda qs: self._search_all(entry, qs, k, nprobe))
 
