@@ -148,10 +148,18 @@ class SubqueryRef(Node):
 
 @dataclasses.dataclass
 class Join(Node):
-    kind: str                # 'inner' | 'left' | 'right' | 'cross'
+    kind: str                # 'inner' | 'left' | 'right' | 'full' | 'cross'
     left: Node
     right: Node
     on: Optional[Node] = None
+
+
+@dataclasses.dataclass
+class SampleRef(Node):
+    """FROM t SAMPLE n ROWS | SAMPLE p PERCENT (colexec/sample analogue)."""
+    child: Node
+    value: float
+    unit: str                # 'rows' | 'percent'
 
 
 @dataclasses.dataclass
@@ -175,6 +183,8 @@ class Select(Node):
         default_factory=list)          # WITH name AS (select ...)
     semijoins: List["SemiJoinSpec"] = dataclasses.field(
         default_factory=list)          # decorrelated EXISTS predicates
+    # GROUP BY ... FILL(PREV | LINEAR | VALUE, x): (mode, const_or_None)
+    fill: Optional[Tuple[str, Optional[float]]] = None
 
 
 @dataclasses.dataclass

@@ -208,6 +208,21 @@ class Binder:
             if sel.having is not None:
                 raise BindError("HAVING without aggregation")
 
+        # GROUP BY ... FILL(mode): null-fill over the grouped output,
+        # ordered by the first group key (reference: colexec/fill)
+        if sel.fill is not None:
+            agg_node = node
+            while isinstance(agg_node, plan.Filter):
+                agg_node = agg_node.child
+            if not isinstance(agg_node, plan.Aggregate) \
+                    or not agg_node.group_keys:
+                raise BindError("FILL requires GROUP BY")
+            nk = len(agg_node.group_keys)
+            key_names = [n for n, _ in agg_node.schema[:nk]]
+            mode, const = sel.fill
+            node = plan.Fill(node, mode, const, key_names[0], key_names,
+                             node.schema)
+
         # window functions: compute as hidden columns below the projection
         node, scope, win_map = self._bind_windows(node, scope, items,
                                                   agg_sub)
@@ -324,10 +339,26 @@ class Binder:
             if from_.on is not None:
                 lkeys, rkeys, residual = self._split_join_on(
                     from_.on, lscope, rscope, sc)
+            elif kind == "full":
+                raise BindError("FULL OUTER JOIN requires an ON clause")
             elif kind != "cross":
                 kind = "cross"
+            if kind == "full" and not lkeys:
+                raise BindError(
+                    "FULL OUTER JOIN requires at least one equi-key")
             return plan.Join(kind, lnode, rnode, lkeys, rkeys, residual,
                              schema), sc
+        if isinstance(from_, ast.SampleRef):
+            child, sc = self._bind_from(from_.child)
+            if from_.unit == "rows":
+                node = plan.Sample(child, int(from_.value), None,
+                                   child.schema)
+            else:
+                if not (0 < from_.value <= 100):
+                    raise BindError("SAMPLE percent must be in (0, 100]")
+                node = plan.Sample(child, None, float(from_.value),
+                                   child.schema)
+            return node, sc
         raise BindError(f"unsupported FROM clause {type(from_).__name__}")
 
     def _bind_semijoin(self, node, scope, sj: "ast.SemiJoinSpec"):
@@ -1034,6 +1065,9 @@ _SCALAR_FUNCS = {
     "starts_with": ("starts_with", lambda ts: dt.BOOL),
     "ends_with": ("ends_with", lambda ts: dt.BOOL),
     "match_against": ("match_against", lambda ts: dt.FLOAT64),
+    # timewin role (colexec/timewin): tumbling time windows via bucketed
+    # GROUP BY — time_bucket(ts_col, width) floors to the window start
+    "time_bucket": ("time_bucket", lambda ts: ts[0]),
     "l2_distance": ("l2_distance", lambda ts: dt.FLOAT64),
     "l2_distance_sq": ("l2_distance_sq", lambda ts: dt.FLOAT64),
     "cosine_distance": ("cosine_distance", lambda ts: dt.FLOAT64),

@@ -182,6 +182,14 @@ def estimate(node: P.PlanNode, sp: StatsProvider) -> Est:
         if node.kind in ("semi", "anti", "left"):
             cols = dict(cols) if node.kind == "left" else le.cols
         return Est(max(rows, _EPS), cols)
+    if isinstance(node, P.Sample):
+        ch = estimate(node.child, sp)
+        if node.n_rows is not None:
+            return Est(min(float(node.n_rows), ch.rows), ch.cols)
+        return Est(ch.rows * node.percent / 100.0, ch.cols)
+    if isinstance(node, P.Fill):
+        ch = estimate(node.child, sp)
+        return Est(ch.rows, ch.cols)
     if isinstance(node, P.Union):
         rows = sum(estimate(c, sp).rows for c in node.children)
         return Est(rows, {})
@@ -205,6 +213,8 @@ def _join_rows(kind: str, le: Est, re_: Est, lkeys, rkeys) -> float:
     inner = _equi_rows(le, re_, lkeys, rkeys)
     if kind == "left":
         return max(inner, le.rows)
+    if kind == "full":
+        return max(inner, le.rows, re_.rows)
     return inner
 
 

@@ -210,11 +210,36 @@ class Parser:
             from_ = self.table_expr()
         where = self.expr() if self.accept_kw("where") else None
         group_by: List[ast.Node] = []
+        fill = None
         if self.accept_kw("group"):
             self.expect_kw("by")
             group_by.append(self.expr())
             while self.accept_op(","):
                 group_by.append(self.expr())
+            t = self.peek()
+            if t.kind == "ident" and t.value.lower() == "fill" \
+                    and self.peek(1).kind == "op" \
+                    and self.peek(1).value == "(":
+                # GROUP BY ... FILL(PREV | LINEAR | VALUE, x)
+                # (reference: colexec/fill null-fill modes)
+                self.next()
+                self.expect_op("(")
+                mode = self.ident().lower()
+                if mode not in ("prev", "linear", "value", "none"):
+                    raise ParseError(f"unknown FILL mode {mode!r}")
+                const = None
+                if mode == "value":
+                    self.expect_op(",")
+                    neg = self.accept_op("-")
+                    tok = self.next()
+                    if tok.kind not in ("int", "float"):
+                        raise ParseError(
+                            f"FILL(VALUE, ...) requires a numeric literal "
+                            f"(near {tok.value!r}, pos {tok.pos})")
+                    const = float(tok.value) * (-1 if neg else 1)
+                self.expect_op(")")
+                if mode != "none":
+                    fill = (mode, const)
         having = self.expr() if self.accept_kw("having") else None
         order_by: List[ast.OrderItem] = []
         if self.accept_kw("order"):
@@ -233,7 +258,7 @@ class Parser:
         return ast.Select(items=items, from_=from_, where=where,
                           group_by=group_by, having=having,
                           order_by=order_by, limit=limit, offset=offset,
-                          distinct=distinct)
+                          distinct=distinct, fill=fill)
 
     def select_item(self) -> ast.SelectItem:
         if self.at_op("*"):
@@ -264,7 +289,9 @@ class Parser:
                 left = ast.Join("cross", left, right)
                 continue
             kind = None
-            if self.at_kw("join", "inner", "left", "right", "cross"):
+            at_full = self._at_full_join()
+            if self.at_kw("join", "inner", "left", "right", "cross") \
+                    or at_full:
                 if self.accept_kw("inner"):
                     kind = "inner"
                 elif self.accept_kw("left"):
@@ -273,6 +300,10 @@ class Parser:
                 elif self.accept_kw("right"):
                     self.accept_kw("outer")
                     kind = "right"
+                elif at_full:
+                    self.next()
+                    self.accept_kw("outer")
+                    kind = "full"
                 elif self.accept_kw("cross"):
                     kind = "cross"
                 else:
@@ -294,7 +325,7 @@ class Parser:
                     f"derived table requires an alias (near "
                     f"{self.peek().value!r}, pos {self.peek().pos})")
             alias = self.ident()
-            return ast.SubqueryRef(sel, alias)
+            return self._maybe_sample(ast.SubqueryRef(sel, alias))
         name = self.ident()
         snapshot = None
         as_of_ts = None
@@ -313,9 +344,35 @@ class Parser:
         alias = None
         if self.accept_kw("as"):
             alias = self.ident()
-        elif self.peek().kind == "ident":
+        elif self.peek().kind == "ident" and not self._at_sample() \
+                and not self._at_full_join():
             alias = self.ident()
-        return ast.TableRef(name, alias, snapshot=snapshot, as_of_ts=as_of_ts)
+        return self._maybe_sample(
+            ast.TableRef(name, alias, snapshot=snapshot, as_of_ts=as_of_ts))
+
+    def _at_full_join(self) -> bool:
+        t = self.peek()
+        return (t.kind == "ident" and t.value.lower() == "full"
+                and self.peek(1).kind == "kw"
+                and self.peek(1).value in ("outer", "join"))
+
+    def _at_sample(self) -> bool:
+        t = self.peek()
+        return (t.kind == "ident" and t.value.lower() == "sample"
+                and self.peek(1).kind in ("int", "float"))
+
+    def _maybe_sample(self, ref: ast.Node) -> ast.Node:
+        """`t SAMPLE 100 ROWS` / `t SAMPLE 1.5 PERCENT` table suffix
+        (reference: colexec/sample)."""
+        if not self._at_sample():
+            return ref
+        self.next()
+        v = float(self.next().value)
+        u = self.peek()
+        if u.kind == "ident" and u.value.lower() in ("rows", "percent"):
+            self.next()
+            return ast.SampleRef(ref, v, u.value.lower())
+        raise ParseError("SAMPLE requires ROWS or PERCENT")
 
     # ---- DDL / DML
     def create(self) -> ast.Node:

@@ -83,7 +83,7 @@ class Limit(PlanNode):
 
 @dataclasses.dataclass
 class Join(PlanNode):
-    kind: str               # inner | left | cross  (right is flipped to left)
+    kind: str    # inner | left | full | semi | anti | cross (right->left)
     left: PlanNode
     right: PlanNode
     left_keys: List[BoundExpr]
@@ -117,6 +117,31 @@ class Union(PlanNode):
 @dataclasses.dataclass
 class Values(PlanNode):
     rows: List[list]
+    schema: Schema
+
+
+@dataclasses.dataclass
+class Sample(PlanNode):
+    """Random sample of the child (reference: colexec/sample): either a
+    fixed number of rows (single-pass random-key top-N reservoir) or a
+    percentage (per-row Bernoulli mask)."""
+    child: PlanNode
+    n_rows: Optional[int]
+    percent: Optional[float]
+    schema: Schema
+    seed: int = 42
+
+
+@dataclasses.dataclass
+class Fill(PlanNode):
+    """Null-fill over ordered grouped output (reference: colexec/fill):
+    materializes the child, orders by the first group key, and fills NULL
+    values in the non-key columns by mode prev | linear | value."""
+    child: PlanNode
+    mode: str
+    const: Optional[float]
+    order_col: str           # first group-key output column
+    key_cols: List[str]      # group-key outputs (never filled)
     schema: Schema
 
 

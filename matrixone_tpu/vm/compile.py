@@ -35,6 +35,10 @@ def compile_plan(node: P.PlanNode, ctx) -> ops.Operator:
         return WindowOp(node, compile_plan(node.child, ctx))
     if isinstance(node, P.Distinct):
         return ops.DistinctOp(node, compile_plan(node.child, ctx))
+    if isinstance(node, P.Sample):
+        return ops.SampleOp(node, compile_plan(node.child, ctx))
+    if isinstance(node, P.Fill):
+        return ops.FillOp(node, compile_plan(node.child, ctx))
     if isinstance(node, P.Union):
         return ops.UnionOp(node, [compile_plan(c, ctx)
                                   for c in node.children])

@@ -308,6 +308,17 @@ def _eval_func(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
         a = eval_expr(e.args[0], ex)
         digits = e.args[1].value if len(e.args) > 1 else 0
         return S.round_(a, int(digits))
+    if op == "time_bucket":
+        from matrixone_tpu.sql.expr import BoundLiteral as _BL
+        if not isinstance(e.args[1], _BL):
+            raise EvalError("time_bucket width must be a literal")
+        width = int(e.args[1].value)
+        if width <= 0:
+            raise EvalError("time_bucket width must be positive")
+        a = eval_expr(e.args[0], ex)
+        data = a.data.astype(jnp.int64)
+        out = (data // width) * width     # floor division: window start
+        return DeviceColumn(out.astype(a.data.dtype), a.validity, e.dtype)
     if op == "date_add_days":
         a = eval_expr(e.args[0], ex)
         delta = eval_expr(e.args[1], ex)

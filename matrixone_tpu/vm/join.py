@@ -109,8 +109,37 @@ class JoinOp(Operator):
 
         if self.node.kind in ("inner", "semi"):
             self._push_runtime_filters(bkeys, bvalid)
+        if self.node.kind == "full":
+            self._build_matched = jnp.zeros(build.padded_len, jnp.bool_)
+            self._probe_dicts = {}
         for ex in self.left.execute():
+            if self.node.kind == "full":
+                self._probe_dicts.update(ex.dicts)
             yield from self._probe(ex, build, sorted_hash, order, bkeys)
+        if self.node.kind == "full":
+            # FULL OUTER: emit build rows no probe row matched, probe-side
+            # columns null-extended (the probe loop already null-extended
+            # unmatched probe rows via the shared left-join path)
+            unmatched = build.mask & ~self._build_matched
+            nb = build.padded_len
+            cols = {}
+            for name, dtype in self.node.left.schema:
+                jt = jnp.int32 if dtype.is_varlen else dtype.jnp_dtype
+                shape = (nb, dtype.dim) if dtype.is_vector else (nb,)
+                cols[name] = DeviceColumn(jnp.zeros(shape, jt),
+                                          jnp.zeros((nb,), jnp.bool_), dtype)
+            for name, _ in self.node.right.schema:
+                c = _broadcast_full(build.batch.columns[name], nb)
+                cols[name] = DeviceColumn(c.data, c.validity, c.dtype)
+            db = DeviceBatch(columns=cols,
+                             n_rows=jnp.sum(unmatched.astype(jnp.int32)))
+            # probe-side varchar columns are all-NULL here but expressions
+            # above the join still resolve them through their dictionary
+            dicts = {**self._probe_dicts, **build.dicts}
+            for name, dtype in self.node.left.schema:
+                if dtype.is_varlen:
+                    dicts.setdefault(name, [""])
+            yield ExecBatch(batch=db, dicts=dicts, mask=unmatched)
 
     def _push_runtime_filters(self, bkeys, bvalid) -> None:
         """Build-side key min/max pushed into probe-side scans before the
@@ -233,7 +262,12 @@ class JoinOp(Operator):
         if self.node.residual is not None:
             pred = eval_expr(self.node.residual, out)
             out.mask = out.mask & F.predicate_mask(pred, db)
-        if self.node.kind == "left":
+        if self.node.kind == "full":
+            # record which build rows matched (post-residual, pre-null-
+            # extension) — monotonic across overflow re-runs
+            self._build_matched = self._build_matched.at[build_idx].max(
+                out.mask)
+        if self.node.kind in ("left", "full"):
             matched_any = jnp.any(out.mask.reshape(np_, mm), axis=1)
             lane0 = jnp.tile(lane == 0, (np_,))
             null_emit = lane0 & ~jnp.repeat(matched_any, mm) & \

@@ -866,6 +866,134 @@ def _apply_offset(db: DeviceBatch, offset: int, k: Optional[int]) -> DeviceBatch
     return F.gather(db, idx, remaining)
 
 
+class SampleOp(Operator):
+    """Random sampling (reference: colexec/sample). PERCENT is a streaming
+    per-row Bernoulli mask; N ROWS is a single-pass reservoir expressed
+    TPU-style as top-N over per-row random keys — the same top_k kernel
+    TopK uses, so no per-row host loop and a bounded device footprint."""
+
+    def __init__(self, node: P.Sample, child: Operator):
+        self.node = node
+        self.child = child
+        self.schema = node.schema
+
+    def execute(self) -> Iterator[ExecBatch]:
+        rng = np.random.default_rng(self.node.seed)
+        if self.node.percent is not None:
+            p = self.node.percent / 100.0
+            for ex in self.child.execute():
+                u = jnp.asarray(rng.random(ex.padded_len,
+                                           dtype=np.float32))
+                ex.mask = ex.mask & (u < p)
+                yield ex
+            return
+        n = self.node.n_rows
+        schema_k = list(self.schema) + [("__sample_key", dt.FLOAT32)]
+        winners = None        # running k-row reservoir: O(k + batch) device
+        for ex in self.child.execute():
+            u = rng.random(ex.padded_len, dtype=np.float32)
+            key = jnp.where(ex.mask, jnp.asarray(u), jnp.float32(np.inf))
+            kcol = DeviceColumn(key, jnp.ones_like(ex.mask), dt.FLOAT32)
+            ex.batch.columns["__sample_key"] = kcol
+            merged = ex if winners is None else _concat_batches(
+                [winners, ex], schema_k)
+            key = merged.batch.columns["__sample_key"]
+            k = min(n, merged.padded_len)
+            idx, count = msort.top_k_indices(key.data, key.validity, False,
+                                             merged.mask, k)
+            out = F.gather(merged.batch, idx, jnp.minimum(count, k))
+            winners = ExecBatch(batch=out, dicts=dict(merged.dicts),
+                                mask=out.row_mask())
+        if winners is None:
+            return
+        del winners.batch.columns["__sample_key"]
+        yield winners
+
+
+class FillOp(Operator):
+    """Null-fill of grouped output (reference: colexec/fill). Materializes
+    the (small, post-aggregate) child on host, orders rows by the first
+    group key, and fills NULLs in non-key columns: PREV carries the last
+    non-null value forward, LINEAR interpolates between the surrounding
+    non-null values on the order axis, VALUE writes a constant."""
+
+    def __init__(self, node: P.Fill, child: Operator):
+        self.node = node
+        self.child = child
+        self.schema = node.schema
+
+    def execute(self) -> Iterator[ExecBatch]:
+        from matrixone_tpu.container import device as dev
+        batches = list(self.child.execute())
+        if not batches:
+            return
+        ex = _concat_batches(batches, self.schema)
+        mask = np.asarray(jax.device_get(ex.mask))
+        host, val = {}, {}
+        for name, dtype in self.schema:
+            c = _broadcast_full(ex.batch.columns[name], ex.padded_len)
+            host[name] = np.asarray(jax.device_get(c.data))[mask]
+            val[name] = np.asarray(jax.device_get(c.validity))[mask]
+        ocol = self.node.order_col
+        odtype = dict(self.schema)[ocol]
+        if odtype.is_varlen:
+            # order by decoded strings, not dict codes (insertion order)
+            d = ex.dicts.get(ocol, [])
+            decoded = np.array([d[c] if 0 <= c < len(d) else ""
+                                for c in host[ocol]], dtype=object)
+            order = np.argsort(decoded, kind="stable")
+            # LINEAR has no numeric axis over strings: use row positions
+            x = np.arange(len(order), dtype=np.float64)
+        else:
+            order = np.argsort(host[ocol], kind="stable")
+            x = host[ocol][order].astype(np.float64)
+        keyset = set(self.node.key_cols)
+        for name, dtype in self.schema:
+            if name in keyset:
+                host[name] = host[name][order]
+                val[name] = val[name][order]
+                continue
+            a = host[name][order].copy()
+            v = val[name][order].copy()
+            miss = ~v
+            if miss.any():
+                if self.node.mode == "value":
+                    if dtype.is_varlen:
+                        raise EvalError("FILL(VALUE) on string column")
+                    cv = self.node.const
+                    if dtype.oid == TypeOid.DECIMAL64:
+                        cv = round(cv * 10 ** dtype.scale)
+                    a[miss] = np.asarray(cv).astype(a.dtype)
+                    v[:] = True
+                elif self.node.mode == "prev":
+                    idx = np.where(v, np.arange(len(a)), -1)
+                    idx = np.maximum.accumulate(idx)
+                    ok = idx >= 0
+                    a[ok] = a[np.maximum(idx[ok], 0)]
+                    v = ok
+                elif self.node.mode == "linear":
+                    if dtype.is_varlen:
+                        raise EvalError("FILL(LINEAR) on string column")
+                    good = np.nonzero(v)[0]
+                    if len(good) >= 2:
+                        interp = np.interp(x, x[good],
+                                           a[good].astype(np.float64))
+                        a[miss] = interp[miss].astype(a.dtype)
+                        v = np.ones_like(v)
+                        # outside the known range np.interp clamps —
+                        # matches FILL(LINEAR)'s edge-hold behavior
+            host[name] = a
+            val[name] = v
+        dtypes = {n: (dt.INT32 if d.is_varlen else d)
+                  for n, d in self.schema}
+        db = dev.from_numpy(host, dtypes, val, n_rows=len(order))
+        for name, dtype in self.schema:
+            if dtype.is_varlen:
+                c = db.columns[name]
+                db.columns[name] = DeviceColumn(c.data, c.validity, dtype)
+        yield ExecBatch(batch=db, dicts=dict(ex.dicts), mask=db.row_mask())
+
+
 class LimitOp(Operator):
     def __init__(self, node: P.Limit, child: Operator):
         self.node = node

@@ -1,0 +1,161 @@
+"""FULL OUTER JOIN, SAMPLE, time_bucket (timewin), FILL
+(reference: colexec/{join,sample,timewin,fill})."""
+
+import sqlite3
+
+import numpy as np
+import pytest
+
+from matrixone_tpu.embed import Cluster
+
+
+@pytest.fixture()
+def s():
+    return Cluster().session()
+
+
+def _col(r, name):
+    return r.batch.columns[name].to_pylist()
+
+
+def test_full_outer_join_exact(s):
+    s.execute("create table a (k int primary key, x int)")
+    s.execute("create table b (k int primary key, y int)")
+    s.execute("insert into a values (1,10),(2,20),(3,30),(7,70)")
+    s.execute("insert into b values (2,200),(3,300),(5,500),(9,900)")
+    r = s.execute("select a.k ak, a.x, b.k bk, b.y from a "
+                  "full outer join b on a.k = b.k order by a.k, b.k")
+    got = list(zip(_col(r, "ak"), _col(r, "x"), _col(r, "bk"), _col(r, "y")))
+    con = sqlite3.connect(":memory:")
+    con.execute("create table a (k int, x int)")
+    con.execute("create table b (k int, y int)")
+    con.executemany("insert into a values (?,?)",
+                    [(1, 10), (2, 20), (3, 30), (7, 70)])
+    con.executemany("insert into b values (?,?)",
+                    [(2, 200), (3, 300), (5, 500), (9, 900)])
+    want = con.execute(
+        "select a.k, a.x, b.k, b.y from a full outer join b on a.k = b.k "
+        "order by a.k, b.k").fetchall()
+    assert sorted(got, key=str) == sorted([tuple(w) for w in want], key=str)
+
+
+def test_full_join_empty_sides(s):
+    s.execute("create table fa (k int primary key)")
+    s.execute("create table fb (k int primary key)")
+    s.execute("insert into fa values (1),(2)")
+    r = s.execute("select fa.k ka, fb.k kb from fa full join fb "
+                  "on fa.k = fb.k order by fa.k")
+    assert _col(r, "ka") == [1, 2]
+    assert _col(r, "kb") == [None, None]
+    # both directions: empty probe side
+    r = s.execute("select fa.k ka, fb.k kb from fb full join fa "
+                  "on fb.k = fa.k order by fa.k")
+    assert _col(r, "ka") == [1, 2]
+    assert _col(r, "kb") == [None, None]
+
+
+def test_full_join_residual(s):
+    s.execute("create table ra (k int primary key, v int)")
+    s.execute("create table rb (k int primary key, w int)")
+    s.execute("insert into ra values (1,5),(2,50)")
+    s.execute("insert into rb values (1,1),(2,2)")
+    # residual drops the k=1 pair -> both sides null-extend
+    r = s.execute("select ra.k ka, rb.k kb from ra full join rb "
+                  "on ra.k = rb.k and ra.v > 10 order by ra.k, rb.k")
+    got = set(zip(_col(r, "ka"), _col(r, "kb")))
+    assert got == {(1, None), (2, 2), (None, 1)}
+
+
+def test_sample_rows(s):
+    s.execute("create table st (id int primary key, v int)")
+    vals = ",".join(f"({i},{i})" for i in range(5000))
+    s.execute(f"insert into st values {vals}")
+    r = s.execute("select count(*) c "
+                  "from (select id from st sample 100 rows) q")
+    assert _col(r, "c") == [100]
+    r = s.execute("select count(distinct id) d "
+                  "from (select id from st sample 100 rows) q")
+    assert _col(r, "d") == [100]         # distinct rows, no repeats
+    # sample larger than the table returns everything
+    r = s.execute("select count(*) c from (select id from st sample "
+                  "10000 rows) q")
+    assert _col(r, "c") == [5000]
+
+
+def test_sample_percent(s):
+    s.execute("create table sp (id int primary key)")
+    vals = ",".join(f"({i})" for i in range(20000))
+    s.execute(f"insert into sp values {vals}")
+    r = s.execute("select count(*) c from (select id from sp sample "
+                  "10 percent) q")
+    c = _col(r, "c")[0]
+    assert 1600 < c < 2400, c            # ~2000 expected, binomial spread
+
+
+def test_time_bucket_group(s):
+    s.execute("create table ts (t int, v int)")
+    rows = [(i * 7, i) for i in range(100)]
+    s.execute("insert into ts values " +
+              ",".join(f"({t},{v})" for t, v in rows))
+    r = s.execute("select time_bucket(t, 100) b, sum(v) sv from ts "
+                  "group by time_bucket(t, 100) order by b")
+    want = {}
+    for t, v in rows:
+        want.setdefault(t // 100 * 100, 0)
+        want[t // 100 * 100] += v
+    assert _col(r, "b") == sorted(want)
+    assert _col(r, "sv") == [want[k] for k in sorted(want)]
+
+
+def test_fill_prev_and_value(s):
+    s.execute("create table g (b int, v int)")
+    # bucket 0 and 2 have data; bucket 1's values are all NULL
+    s.execute("insert into g values (0,10),(0,20),(1,null),(2,40)")
+    r = s.execute("select b, sum(v) sv from g group by b fill(prev) "
+                  "order by b")
+    assert _col(r, "b") == [0, 1, 2]
+    assert _col(r, "sv") == [30, 30, 40]     # bucket 1 carried forward
+    r = s.execute("select b, sum(v) sv from g group by b fill(value, -1) "
+                  "order by b")
+    assert _col(r, "sv") == [30, -1, 40]
+
+
+def test_fill_linear(s):
+    s.execute("create table gl (b int, v int)")
+    s.execute("insert into gl values (0,10),(1,null),(2,30)")
+    r = s.execute("select b, sum(v) sv from gl group by b fill(linear) "
+                  "order by b")
+    assert _col(r, "sv") == [10, 20, 30]     # midpoint interpolation
+
+
+def test_full_join_string_predicate_above(s):
+    # the unmatched-build tail batch must carry probe-side dictionaries:
+    # string predicates above the join evaluate over all-NULL varchar cols
+    s.execute("create table sa (k int primary key, name varchar(10))")
+    s.execute("create table sb (k int primary key, y int)")
+    s.execute("insert into sa values (1,'x'),(2,'z')")
+    s.execute("insert into sb values (2,200),(5,500)")
+    r = s.execute("select sa.name, sb.y from sa full join sb "
+                  "on sa.k = sb.k where sa.name = 'x' or sb.y = 500")
+    got = set(zip(_col(r, "name"), _col(r, "y")))
+    assert got == {("x", None), (None, 500)}
+
+
+def test_fill_varchar_key_string_order(s):
+    # FILL must order by decoded strings, not dictionary codes: 'c' is
+    # inserted first (code 0) but sorts last
+    s.execute("create table m (name varchar(10), v double)")
+    s.execute("insert into m values ('c',30.0),('a',null),('b',null)")
+    r = s.execute("select name, sum(v) sv from m group by name fill(prev) "
+                  "order by name")
+    assert _col(r, "name") == ["a", "b", "c"]
+    assert _col(r, "sv") == [None, None, 30.0]
+
+
+def test_sample_alias_not_confused(s):
+    # an alias literally named "sample" still works when not followed by
+    # a number
+    s.execute("create table tt (id int primary key)")
+    s.execute("insert into tt values (1)")
+    r = s.execute("select sample.id from tt sample")
+    assert _col(r, "id") == [1]
