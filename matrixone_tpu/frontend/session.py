@@ -129,6 +129,10 @@ class Session:
             if stmt.analyze:
                 return Result(text=self._explain_analyze(node))
             return Result(text=P.explain(node))
+        if isinstance(stmt, ast.AlterPartition):
+            return self._alter_partition(stmt)
+        if isinstance(stmt, ast.ShowPartitions):
+            return self._show_partitions(stmt)
         if isinstance(stmt, ast.AnalyzeTable):
             from matrixone_tpu.sql.stats import provider_for
             st = provider_for(self.catalog).refresh(stmt.name)
@@ -514,12 +518,86 @@ class Session:
         if len(auto) > 1:
             raise BindError("only one AUTO_INCREMENT column allowed")
         not_null = [c.name for c in stmt.columns if c.not_null]
+        part = None
+        if stmt.partition_by is not None:
+            from matrixone_tpu.storage.partition import build_spec
+            part = build_spec(stmt.partition_by, schema)
         self.catalog.create_table(
             TableMeta(stmt.name, schema, stmt.primary_key,
                       auto_increment=auto[0] if auto else None,
-                      not_null=not_null),
+                      not_null=not_null, partition=part),
             if_not_exists=stmt.if_not_exists)
         return Result()
+
+    def _alter_partition(self, stmt: ast.AlterPartition) -> Result:
+        """TRUNCATE/DROP PARTITION (partitionservice management ops):
+        rows leave via an ordinary tombstone commit, so MVCC snapshots
+        and time travel keep seeing the pre-truncate state."""
+        import numpy as np
+        t = self.catalog.get_table(stmt.table)
+        spec = t.meta.partition
+        if spec is None:
+            raise BindError(f"table {stmt.table!r} is not partitioned")
+        if stmt.part not in spec.names:
+            raise BindError(f"no partition {stmt.part!r} on {stmt.table!r}")
+        if stmt.action == "drop":
+            # validate BEFORE the tombstone commit: a refused DROP must
+            # not have already destroyed the partition's rows
+            if spec.kind != "range":
+                raise BindError("DROP PARTITION requires RANGE partitioning")
+            if len(spec.names) == 1:
+                raise BindError("cannot drop the last partition")
+        pid = spec.names.index(stmt.part)
+        dead = t._dead_gids(None, None)
+        gids = []
+        for seg in t.segments:
+            if seg.part_id != pid:
+                continue
+            g = np.arange(seg.base_gid, seg.base_gid + seg.n_rows,
+                          dtype=np.int64)
+            if len(dead):
+                g = g[~np.isin(g, dead)]
+            gids.append(g)
+        all_gids = (np.concatenate(gids) if gids
+                    else np.zeros(0, np.int64))
+        if len(all_gids):
+            self.catalog.commit_txn(None, {}, {stmt.table: all_gids})
+        if stmt.action == "drop":
+            self.catalog.alter_partition_drop(stmt.table, stmt.part)
+        b = Batch.from_pydict(
+            {"partition": [stmt.part], "rows_removed": [len(all_gids)]},
+            {"partition": dt.VARCHAR, "rows_removed": dt.INT64})
+        return Result(batch=b)
+
+    def _show_partitions(self, stmt: ast.ShowPartitions) -> Result:
+        import numpy as np
+        t = self.catalog.get_table(stmt.name)
+        spec = t.meta.partition
+        if spec is None:
+            raise BindError(f"table {stmt.name!r} is not partitioned")
+        dead = t._dead_gids(None, None)
+        rows = {i: 0 for i in range(spec.n_parts)}
+        for seg in t.segments:
+            if seg.part_id < 0:
+                continue
+            alive = seg.n_rows
+            if len(dead):
+                g = np.arange(seg.base_gid, seg.base_gid + seg.n_rows,
+                              dtype=np.int64)
+                alive = int((~np.isin(g, dead)).sum())
+            rows[seg.part_id] = rows.get(seg.part_id, 0) + alive
+        bounds = [("MAXVALUE" if b is None else str(b))
+                  for b in spec.bounds] if spec.kind == "range" \
+            else [""] * spec.n_parts
+        b = Batch.from_pydict(
+            {"partition": list(spec.names),
+             "method": [spec.kind] * spec.n_parts,
+             "expr": [spec.column] * spec.n_parts,
+             "bound": bounds,
+             "rows": [rows[i] for i in range(spec.n_parts)]},
+            {"partition": dt.VARCHAR, "method": dt.VARCHAR,
+             "expr": dt.VARCHAR, "bound": dt.VARCHAR, "rows": dt.INT64})
+        return Result(batch=b)
 
     def _create_index(self, stmt: ast.CreateIndex) -> Result:
         table = self.catalog.get_table(stmt.table)

@@ -227,6 +227,9 @@ class CreateTable(Node):
     columns: List[ColumnDef]
     primary_key: List[str] = dataclasses.field(default_factory=list)
     if_not_exists: bool = False
+    # raw PARTITION BY parse: {"kind":"hash","column",...,"n"} |
+    # {"kind":"range","column",...,"parts":[(name, bound|None), ...]}
+    partition_by: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -314,6 +317,18 @@ class ShowIndexes(Node):
 
 @dataclasses.dataclass
 class AnalyzeTable(Node):
+    name: str
+
+
+@dataclasses.dataclass
+class AlterPartition(Node):
+    table: str
+    action: str              # 'truncate' | 'drop'
+    part: str
+
+
+@dataclasses.dataclass
+class ShowPartitions(Node):
     name: str
 
 

@@ -17,6 +17,7 @@ decimal comparisons and arithmetic stay in the exact int64 domain.
 from __future__ import annotations
 
 import datetime
+import itertools
 from typing import Dict, List, Optional, Tuple
 
 from matrixone_tpu.container import dtypes as dt
@@ -28,6 +29,10 @@ from matrixone_tpu.sql.expr import (AggCall, BoundCase, BoundCast, BoundCol,
                                     and_all)
 
 AGG_FUNCS = {"count", "sum", "avg", "min", "max"}
+
+# SAMPLE seeds: each bound Sample node (and each re-bind of the same
+# query) draws an independent random stream
+_sample_seed = itertools.count(1)
 WINDOW_ONLY_FUNCS = {"row_number", "rank", "dense_rank"}
 
 _TYPE_NAMES = {
@@ -350,14 +355,15 @@ class Binder:
                              schema), sc
         if isinstance(from_, ast.SampleRef):
             child, sc = self._bind_from(from_.child)
+            seed = next(_sample_seed)   # distinct stream per Sample node
             if from_.unit == "rows":
                 node = plan.Sample(child, int(from_.value), None,
-                                   child.schema)
+                                   child.schema, seed=seed)
             else:
                 if not (0 < from_.value <= 100):
                     raise BindError("SAMPLE percent must be in (0, 100]")
                 node = plan.Sample(child, None, float(from_.value),
-                                   child.schema)
+                                   child.schema, seed=seed)
             return node, sc
         raise BindError(f"unsupported FROM clause {type(from_).__name__}")
 

@@ -117,6 +117,15 @@ class Parser:
         if t0.kind == "ident" and t0.value.lower() in ("describe", "desc_table"):
             self.next()
             return ast.ShowColumns(self.ident())
+        if t0.kind == "ident" and t0.value.lower() == "alter":
+            self.next()
+            self.expect_kw("table")
+            table = self.ident()
+            act = self.ident().lower()
+            if act not in ("truncate", "drop"):
+                raise ParseError(f"unsupported ALTER TABLE action {act!r}")
+            self.expect_kw("partition")
+            return ast.AlterPartition(table, act, self.ident())
         if self.at_kw("analyze"):
             self.next()
             self.expect_kw("table")
@@ -148,6 +157,10 @@ class Parser:
         if self.accept_kw("snapshots"):
             return ast.ShowSnapshots()
         nxt = self.peek()
+        if nxt.kind == "ident" and nxt.value.lower() == "partitions":
+            self.next()
+            self.expect_kw("from")
+            return ast.ShowPartitions(self.ident())
         if nxt.kind == "ident" and nxt.value.lower() == "columns":
             self.next()
             self.expect_kw("from")
@@ -403,9 +416,66 @@ class Parser:
             for c in cols:
                 if c.primary_key and c.name not in pk:
                     pk.append(c.name)
-            return ast.CreateTable(name, cols, pk, if_not)
+            part = self._partition_clause()
+            return ast.CreateTable(name, cols, pk, if_not,
+                                   partition_by=part)
         if self.accept_kw("snapshot"):
             return ast.CreateSnapshot(self.ident())
+        return self._create_rest()
+
+    def _partition_clause(self):
+        """PARTITION BY RANGE(col) (PARTITION p VALUES LESS THAN (x|
+        MAXVALUE), ...) | PARTITION BY HASH(col) PARTITIONS n."""
+        if not self.accept_kw("partition"):
+            return None
+        self.expect_kw("by")
+        kind = self.ident().lower()
+        if kind not in ("range", "hash"):
+            raise ParseError(f"unsupported PARTITION BY {kind!r}")
+        self.expect_op("(")
+        col = self.ident()
+        self.expect_op(")")
+        if kind == "hash":
+            t = self.peek()
+            if not (t.kind == "ident" and t.value.lower() == "partitions"):
+                raise ParseError("HASH partitioning requires PARTITIONS n")
+            self.next()
+            n = int(self.next().value)
+            if n < 1:
+                raise ParseError("PARTITIONS must be >= 1")
+            return {"kind": "hash", "column": col, "n": n}
+        self.expect_op("(")
+        parts = []
+        while True:
+            self.expect_kw("partition")
+            pname = self.ident()
+            self.expect_kw("values")
+            less = self.ident()
+            than = self.ident()
+            if less.lower() != "less" or than.lower() != "than":
+                raise ParseError("expected VALUES LESS THAN")
+            self.expect_op("(")
+            t = self.peek()
+            if t.kind == "ident" and t.value.lower() == "maxvalue":
+                self.next()
+                bound = None
+            else:
+                neg = self.accept_op("-")
+                tok = self.next()
+                if tok.kind in ("int", "float"):
+                    bound = float(tok.value) * (-1 if neg else 1)
+                elif tok.kind == "str" and not neg:
+                    bound = tok.value        # date string, bound later
+                else:
+                    raise ParseError("bad partition bound")
+            self.expect_op(")")
+            parts.append((pname, bound))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return {"kind": "range", "column": col, "parts": parts}
+
+    def _create_rest(self) -> ast.Node:
         if self.accept_kw("index"):
             name = self.ident()
             using = None

@@ -50,6 +50,8 @@ class TableMeta:
     primary_key: List[str]
     auto_increment: Optional[str] = None   # column name (incrservice)
     not_null: List[str] = dataclasses.field(default_factory=list)
+    # partitionservice: segments are split per partition on insert
+    partition: "object" = None             # Optional[partition.PartitionSpec]
 
 
 @dataclasses.dataclass
@@ -71,6 +73,7 @@ class Segment:
     validity: Dict[str, np.ndarray]
     n_rows: int
     base_gid: int
+    part_id: int = -1                    # -1 = unpartitioned table
 
 
 class ConflictError(RuntimeError):
@@ -305,6 +308,26 @@ class MVCCTable:
     def apply_segment(self, seg: Segment) -> None:
         self.segments.append(seg)
 
+    def insert_segments(self, arrays, validity, commit_ts: int
+                        ) -> List[Segment]:
+        """Apply an insert batch, splitting rows per partition so each
+        segment holds exactly one partition (partitionservice role —
+        pruning becomes a structural per-segment skip). Shared by the
+        commit pipeline and WAL replay so both produce the same layout."""
+        from matrixone_tpu.storage.partition import split_by_partition
+        if self.meta.partition is None:
+            seg = self.make_segment(arrays, validity, commit_ts)
+            self.apply_segment(seg)
+            return [seg]
+        segs = []
+        for pid, pa, pv in split_by_partition(self.meta.partition,
+                                              arrays, validity):
+            seg = self.make_segment(pa, pv, commit_ts)
+            seg.part_id = pid
+            self.apply_segment(seg)
+            segs.append(seg)
+        return segs
+
     def apply_tombstones(self, commit_ts: int, gids: np.ndarray) -> None:
         if len(gids):
             self.tombstones.append((commit_ts, np.asarray(gids, np.int64)))
@@ -337,7 +360,15 @@ class MVCCTable:
                 if snapshot_ts is None or s.commit_ts <= snapshot_ts]
         segs = segs + list(extra_segments or [])
         qmap = dict(zip(qualified_names or columns, columns))
+        allowed_parts = None
+        if self.meta.partition is not None and filters:
+            from matrixone_tpu.storage import partition as partmod
+            allowed_parts = partmod.prune(self.meta.partition, filters,
+                                          qmap)
         for seg in segs:
+            if allowed_parts is not None and seg.part_id >= 0 \
+                    and seg.part_id not in allowed_parts:
+                continue
             for start in range(0, seg.n_rows, batch_rows):
                 end = min(start + batch_rows, seg.n_rows)
                 gids = np.arange(seg.base_gid + start, seg.base_gid + end,
@@ -570,6 +601,9 @@ class Engine:
                              "pk": meta.primary_key,
                              "auto": meta.auto_increment,
                              "not_null": meta.not_null,
+                             "partition": (meta.partition.to_json()
+                                           if meta.partition is not None
+                                           else None),
                              "schema": [[c, d.oid.value, d.width, d.scale,
                                          d.dim] for c, d in meta.schema]})
 
@@ -586,6 +620,29 @@ class Engine:
         if log:
             self.wal.append({"op": "drop_table", "name": name,
                              "ts": self.hlc.now()})
+
+    def alter_partition_drop(self, table: str, part: str,
+                             log: bool = True) -> None:
+        """Remove a RANGE partition definition (rows are tombstoned by the
+        caller via a normal delete commit; this only shrinks the spec)."""
+        t = self.get_table(table)
+        spec = t.meta.partition
+        if spec is None or part not in spec.names:
+            return
+        pid = spec.names.index(part)
+        spec.names.pop(pid)
+        spec.bounds.pop(pid)
+        # part_ids above the dropped slot shift down; the dropped slot's
+        # segments (all rows tombstoned by the caller) become unpartitioned
+        # so they are never structurally pruned against the new layout
+        for seg in t.segments:
+            if seg.part_id == pid:
+                seg.part_id = -1
+            elif seg.part_id > pid:
+                seg.part_id -= 1
+        if log:
+            self.wal.append({"op": "alter_partition_drop", "table": table,
+                             "part": part, "ts": self.hlc.now()})
 
     def get_table(self, name: str) -> MVCCTable:
         if name not in self.tables:
@@ -752,12 +809,12 @@ class Engine:
             for tname, segs in inserts.items():
                 t = self.get_table(tname)
                 for arrays, validity in segs:
-                    seg = t.make_segment(arrays, validity, commit_ts)
-                    t.apply_segment(seg)
-                    t._pk_bloom_add(arrays)
-                    affected += seg.n_rows
-                    for fn in self._subscribers:
-                        fn(commit_ts, tname, "insert", seg)
+                    for seg in t.insert_segments(arrays, validity,
+                                                 commit_ts):
+                        t._pk_bloom_add(seg.arrays)
+                        affected += seg.n_rows
+                        for fn in self._subscribers:
+                            fn(commit_ts, tname, "insert", seg)
             for tname in set(list(inserts) + list(deletes)):
                 for ix in self.indexes_on(tname):
                     ix.dirty = True
@@ -802,8 +859,10 @@ class Engine:
             if kept:
                 arrays = {c: np.concatenate(parts_a[c]) for c in cols}
                 validity = {c: np.concatenate(parts_v[c]) for c in cols}
-                seg = t.make_segment(arrays, validity, merge_ts)
-                t.segments = [seg]
+                # partitioned tables re-split so the merged layout keeps
+                # one-partition-per-segment (structural pruning invariant)
+                t.segments = []
+                t.insert_segments(arrays, validity, merge_ts)
             else:
                 t.segments = []
             t.tombstones = []
@@ -842,7 +901,8 @@ class Engine:
                                              seg.validity)
                 objs.append({"path": path, "seg_id": seg.seg_id,
                              "base_gid": seg.base_gid,
-                             "commit_ts": seg.commit_ts})
+                             "commit_ts": seg.commit_ts,
+                             "part_id": seg.part_id})
             manifest["tables"][name] = {
                 "schema": [[c, d.oid.value, d.width, d.scale, d.dim]
                            for c, d in t.meta.schema],
@@ -854,6 +914,8 @@ class Engine:
                 "tombstones": [[ts, g.tolist()] for ts, g in t.tombstones],
                 "next_gid": t.next_gid, "next_seg": t.next_seg,
                 "next_auto": t.next_auto,
+                "partition": (t.meta.partition.to_json()
+                              if t.meta.partition is not None else None),
             }
         self.fs.write("meta/manifest.json",
                       json.dumps(manifest).encode())
@@ -873,10 +935,13 @@ class Engine:
             for name, tm in manifest["tables"].items():
                 schema = [(c, DType(TypeOid(o), width=w, scale=s, dim=dm))
                           for c, o, w, s, dm in tm["schema"]]
+                from matrixone_tpu.storage.partition import PartitionSpec
                 eng.create_table(
                     TableMeta(name, schema, tm["pk"],
                               auto_increment=tm.get("auto"),
-                              not_null=tm.get("not_null", [])),
+                              not_null=tm.get("not_null", []),
+                              partition=PartitionSpec.from_json(
+                                  tm.get("partition"))),
                     log=False)
                 t = eng.get_table(name)
                 t.dicts = {k: list(v) for k, v in tm["dicts"].items()}
@@ -889,7 +954,8 @@ class Engine:
                                   commit_ts=ob["commit_ts"],
                                   arrays=arrays, validity=validity,
                                   n_rows=meta.n_rows,
-                                  base_gid=ob["base_gid"])
+                                  base_gid=ob["base_gid"],
+                                  part_id=ob.get("part_id", -1))
                     t.apply_segment(seg)
                 t.tombstones = [(ts, np.asarray(g, np.int64))
                                 for ts, g in tm["tombstones"]]
@@ -919,15 +985,21 @@ class Engine:
             if hts and hts <= self._ckpt_ts:
                 continue
             if op == "create_table":
+                from matrixone_tpu.storage.partition import PartitionSpec
                 schema = [(c, DType(TypeOid(o), width=w, scale=s, dim=dm))
                           for c, o, w, s, dm in header["schema"]]
                 self.create_table(
                     TableMeta(header["name"], schema, header["pk"],
                               auto_increment=header.get("auto"),
-                              not_null=header.get("not_null", [])),
+                              not_null=header.get("not_null", []),
+                              partition=PartitionSpec.from_json(
+                                  header.get("partition"))),
                     log=False, if_not_exists=True)
             elif op == "drop_table":
                 self.drop_table(header["name"], if_exists=True, log=False)
+            elif op == "alter_partition_drop":
+                self.alter_partition_drop(header["table"], header["part"],
+                                          log=False)
             elif op == "create_snapshot":
                 self.snapshots[header["name"]] = header["ts"]
             elif op == "drop_snapshot":
@@ -946,7 +1018,7 @@ class Engine:
                         for c, a in list(arrays.items()):
                             if isinstance(a, list):   # varchar strings
                                 arrays[c] = t.encode_strings_list(c, a)
-                        t.apply_segment(t.make_segment(arrays, validity, ts))
+                        t.insert_segments(arrays, validity, ts)
                         ac = t.meta.auto_increment
                         if ac and ac in arrays:
                             t.observe_auto(arrays[ac][validity[ac]])

@@ -152,6 +152,19 @@ def test_fill_varchar_key_string_order(s):
     assert _col(r, "sv") == [None, None, 30.0]
 
 
+def test_two_samples_independent(s):
+    # two SAMPLE clauses must draw independent streams: the self-join of
+    # two 10% samples overlaps ~1%, not ~10%
+    s.execute("create table ind (id int primary key)")
+    s.execute("insert into ind values " +
+              ",".join(f"({i})" for i in range(20000)))
+    r = s.execute(
+        "select count(*) c from (select id from ind sample 10 percent) a, "
+        "(select id from ind sample 10 percent) b where a.id = b.id")
+    c = _col(r, "c")[0]
+    assert c < 600, c      # ~200 expected for independent draws
+
+
 def test_sample_alias_not_confused(s):
     # an alias literally named "sample" still works when not followed by
     # a number
