@@ -26,7 +26,8 @@ from matrixone_tpu.taskservice import TaskService
 class Cluster:
     def __init__(self, n_sessions: int = 1, data_dir: Optional[str] = None,
                  wire: bool = True, checkpoint_interval_s: float = 0.0,
-                 with_worker: bool = False):
+                 with_worker: bool = False, with_hakeeper: bool = False,
+                 hk_down_after_s: float = 2.0):
         self._tmp = None
         if data_dir == ":tmp:":
             self._tmp = tempfile.mkdtemp(prefix="mo_tpu_")
@@ -54,6 +55,32 @@ class Cluster:
             from matrixone_tpu.worker import TpuWorkerServer, WorkerClient
             self.worker = TpuWorkerServer(port=0).start()
             self.worker_client = WorkerClient(f"127.0.0.1:{self.worker.port}")
+        self.hakeeper = None
+        self._ha_agents = []
+        if with_hakeeper:
+            from matrixone_tpu.hakeeper import HAClient, HAKeeper
+            import json as _json
+            self.hakeeper = HAKeeper(
+                down_after_s=hk_down_after_s,
+                persist=lambda snap: fs.write(
+                    "meta/hakeeper.json", _json.dumps(snap).encode()),
+                restore=lambda: (_json.loads(
+                    fs.read("meta/hakeeper.json").decode())
+                    if fs.exists("meta/hakeeper.json") else None)
+            ).start()
+            hk_addr = ("127.0.0.1", self.hakeeper.port)
+            eng = self.engine
+            self._ha_agents.append(HAClient(
+                hk_addr, "tn", "tn-0",
+                stats_fn=lambda: {"committed_ts": eng.committed_ts,
+                                  "tables": len(eng.tables)}).start())
+            for i, _s in enumerate(self.sessions):
+                self._ha_agents.append(
+                    HAClient(hk_addr, "cn", f"cn-{i}").start())
+            if self.server is not None:
+                self._ha_agents.append(HAClient(
+                    hk_addr, "server", "server-0",
+                    service_addr=f"127.0.0.1:{self.server.port}").start())
 
     # ------------------------------------------------------------- access
     def session(self, i: int = 0) -> Session:
@@ -70,6 +97,12 @@ class Cluster:
 
     # ---------------------------------------------------------- lifecycle
     def close(self, cleanup: bool = False):
+        for s in self.sessions:
+            s.close()
+        for a in self._ha_agents:
+            a.stop()
+        if self.hakeeper is not None:
+            self.hakeeper.stop()
         self.tasks.stop()
         if self.server is not None:
             self.server.stop()

@@ -458,6 +458,7 @@ class _Conn:
         except (OSError, ConnectionError):
             return   # client went away mid-exchange; nothing to clean up
         finally:
+            self.session.close()   # release the processlist slot
             try:
                 self.sock.close()
             except OSError:

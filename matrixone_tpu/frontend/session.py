@@ -52,11 +52,20 @@ class Session:
     """One client session (reference: frontend.Session); system variables
     and (later) transaction state hang off this object."""
 
-    def __init__(self, catalog: Optional[Engine] = None, fs=None):
+    def __init__(self, catalog: Optional[Engine] = None, fs=None,
+                 user: str = "root"):
+        from matrixone_tpu.queryservice import registry_for
         self.catalog = catalog if catalog is not None else Engine(fs)
         self.txn_client = TxnClient(self.catalog)
         self.txn = None                 # active explicit transaction
         self.variables = {"gpu_mode": 1, "batch_rows": 1 << 20}
+        self._procs = registry_for(self.catalog)
+        self.conn_id = self._procs.register(user)
+
+    def close(self) -> None:
+        """Release the session's process-registry slot (the wire server
+        and embed cluster call this on disconnect/shutdown)."""
+        self._procs.unregister(self.conn_id)
 
     def _ctx(self) -> ExecContext:
         return ExecContext(catalog=self.catalog, txn=self.txn,
@@ -86,7 +95,12 @@ class Session:
             stmts = [_substitute_params(st, params) for st in stmts]
         results = []
         for st in stmts:
+            if self._procs.is_terminated(self.conn_id):
+                from matrixone_tpu.queryservice import QueryKilled
+                raise QueryKilled(
+                    f"connection {self.conn_id} was killed")
             t0 = _time.perf_counter()
+            self._procs.start_query(self.conn_id, sql)
             try:
                 r = self._execute_stmt(st)
             except Exception as e:
@@ -95,6 +109,8 @@ class Session:
                 self.catalog.stmt_recorder.record(
                     sql, "error", dt_, 0, error=str(e)[:1024])
                 raise
+            finally:
+                self._procs.end_query(self.conn_id)
             dt_ = _time.perf_counter() - t0
             M.query_seconds.observe(dt_)
             rows_out = len(r.batch) if r.batch is not None else r.affected
@@ -129,6 +145,22 @@ class Session:
             if stmt.analyze:
                 return Result(text=self._explain_analyze(node))
             return Result(text=P.explain(node))
+        if isinstance(stmt, ast.ShowProcesslist):
+            pl = self._procs.processlist()
+            b = Batch.from_pydict(
+                {"Id": [p["Id"] for p in pl],
+                 "User": [p["User"] for p in pl],
+                 "State": [p["State"] for p in pl],
+                 "Time": [p["Time"] for p in pl],
+                 "Query": [p["Query"] for p in pl]},
+                {"Id": dt.INT64, "User": dt.VARCHAR, "State": dt.VARCHAR,
+                 "Time": dt.FLOAT64, "Query": dt.TEXT})
+            return Result(batch=b)
+        if isinstance(stmt, ast.Kill):
+            if not self._procs.kill(stmt.conn_id,
+                                    query_only=stmt.query_only):
+                raise BindError(f"no connection {stmt.conn_id}")
+            return Result()
         if isinstance(stmt, ast.AlterPartition):
             return self._alter_partition(stmt)
         if isinstance(stmt, ast.ShowPartitions):
@@ -487,6 +519,9 @@ class Session:
         op = compile_plan(node, self._ctx())
         out_batches = []
         for ex in op.execute():
+            # KILL lands between device batches (queryservice): the pull
+            # loop is the engine's natural preemption point
+            self._procs.check_killed(self.conn_id)
             out_batches.append(self._to_host(ex, node.schema))
         if not out_batches:
             empty = {n: Vector.from_values([], d) for n, d in node.schema}

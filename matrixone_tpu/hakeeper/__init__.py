@@ -1,0 +1,304 @@
+"""HAKeeper: the cluster control plane — membership, failure detection,
+and repair.
+
+Reference analogue: `pkg/hakeeper` (the Raft-backed cluster brain:
+heartbeat ingestion per service kind, checkers/coordinator.go:32 turning
+state deltas into repair operators, logservice/clusterservice feeding
+routing) — redesigned to this engine's shape: one keeper process/thread
+with a TCP API (same length-prefixed JSON frames as the log service),
+services push heartbeats, a ticker marks services DOWN after
+`down_after_s` of silence and runs registered repair hooks (the
+"operator" half of the reference's checkers). Cluster state is
+persisted through a pluggable store function so a restarted keeper
+resumes the same membership view (the reference stores it in the Raft
+state machine; here the fileservice plays that role).
+
+The keeper is deliberately the HUB of membership (the reference adds
+memberlist gossip for CN discovery; with a keeper present gossip is an
+optimization, not a requirement — `details()` is the clusterservice
+query surface the proxy/router consumes).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from matrixone_tpu.logservice.replicated import _recv_msg, _send_msg
+
+STATE_UP = "up"
+STATE_DOWN = "down"
+
+
+class HAKeeper:
+    """Cluster-state keeper + failure detector + repair coordinator."""
+
+    def __init__(self, port: int = 0, down_after_s: float = 2.0,
+                 tick_s: float = 0.5,
+                 persist: Optional[Callable[[dict], None]] = None,
+                 restore: Optional[Callable[[], Optional[dict]]] = None):
+        self.down_after_s = down_after_s
+        self.tick_s = tick_s
+        self.persist = persist
+        # sid -> record dict
+        self.services: Dict[str, dict] = {}
+        if restore is not None:
+            # resume the persisted membership view (the reference keeps it
+            # in the HAKeeper Raft state machine); restored services get a
+            # fresh heartbeat grace window before the checker may expire
+            # them
+            try:
+                snap = restore() or {}
+            except Exception:
+                snap = {}
+            for sid, rec in snap.items():
+                r = dict(rec)
+                r["meta"] = dict(rec.get("meta", {}))
+                r["last_hb"] = time.monotonic()
+                self.services[sid] = r
+        self.operators: List[dict] = []     # repair audit log
+        self._repair: Dict[str, Callable[[dict], None]] = {}
+        self._lock = threading.Lock()
+        self._stopping = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(32)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "HAKeeper":
+        threading.Thread(target=self._serve, daemon=True).start()
+        threading.Thread(target=self._tick_loop, daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def on_down(self, kind: str, fn: Callable[[dict], None]) -> None:
+        """Register a repair hook for a service kind (checkers analogue):
+        called once per up->down transition with the service record."""
+        self._repair[kind] = fn
+
+    # ------------------------------------------------------------ state ops
+    def register(self, kind: str, sid: str, addr: str = "",
+                 meta: Optional[dict] = None) -> None:
+        with self._lock:
+            self.services[sid] = {
+                "kind": kind, "sid": sid, "addr": addr,
+                "meta": meta or {}, "state": STATE_UP,
+                "last_hb": time.monotonic(), "registered_at": time.time(),
+                "downs": self.services.get(sid, {}).get("downs", 0),
+            }
+            self._persist_locked()
+
+    def heartbeat(self, sid: str, stats: Optional[dict] = None) -> bool:
+        with self._lock:
+            rec = self.services.get(sid)
+            if rec is None:
+                return False            # caller must re-register
+            rec["last_hb"] = time.monotonic()
+            if stats:
+                rec["meta"].update(stats)
+            if rec["state"] == STATE_DOWN:
+                rec["state"] = STATE_UP   # service came back on its own
+            return True
+
+    def deregister(self, sid: str) -> None:
+        with self._lock:
+            self.services.pop(sid, None)
+            self._persist_locked()
+
+    def details(self, kind: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            out = []
+            for rec in self.services.values():
+                if kind is None or rec["kind"] == kind:
+                    r = dict(rec)
+                    r["meta"] = dict(rec["meta"])   # deep enough: callers
+                    # serialize/iterate outside the lock while heartbeats
+                    # mutate the live meta dict
+                    r["age_s"] = time.monotonic() - rec["last_hb"]
+                    out.append(r)
+            return sorted(out, key=lambda r: r["sid"])
+
+    def up_addrs(self, kind: str) -> List[str]:
+        """Healthy endpoints of one kind — the clusterservice routing
+        query the proxy consumes."""
+        return [r["addr"] for r in self.details(kind)
+                if r["state"] == STATE_UP and r["addr"]]
+
+    def _persist_locked(self) -> None:
+        if self.persist is None:
+            return
+        snap = {sid: {k: v for k, v in rec.items() if k != "last_hb"}
+                for sid, rec in self.services.items()}
+        try:
+            self.persist(snap)
+        except Exception:
+            pass                         # persistence is best-effort
+
+    # ------------------------------------------------------- failure check
+    def _tick_loop(self) -> None:
+        while not self._stopping.wait(self.tick_s):
+            self.tick()
+
+    def tick(self) -> None:
+        """One checker pass (coordinator.go:32 analogue): expire silent
+        services, run repair hooks on up->down edges."""
+        now = time.monotonic()
+        newly_down = []
+        with self._lock:
+            for rec in self.services.values():
+                if rec["state"] == STATE_UP and \
+                        now - rec["last_hb"] > self.down_after_s:
+                    rec["state"] = STATE_DOWN
+                    rec["downs"] += 1
+                    snap = dict(rec)
+                    snap["meta"] = dict(rec["meta"])
+                    newly_down.append(snap)
+            if newly_down:
+                self._persist_locked()
+        for rec in newly_down:
+            op = {"op": "service_down", "sid": rec["sid"],
+                  "kind": rec["kind"], "at": time.time()}
+            repair = self._repair.get(rec["kind"])
+            if repair is not None:
+                op["repair"] = "dispatched"
+                try:
+                    repair(rec)
+                except Exception as e:   # noqa: BLE001
+                    op["repair"] = f"failed: {e}"
+            with self._lock:
+                self.operators.append(op)
+
+    # ---------------------------------------------------------- TCP server
+    def _serve(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                header, _ = _recv_msg(conn)
+                op = header.get("op")
+                if op == "register":
+                    self.register(header["kind"], header["sid"],
+                                  header.get("addr", ""),
+                                  header.get("meta"))
+                    _send_msg(conn, {"ok": True})
+                elif op == "heartbeat":
+                    ok = self.heartbeat(header["sid"], header.get("stats"))
+                    _send_msg(conn, {"ok": ok})
+                elif op == "details":
+                    _send_msg(conn, {"ok": True,
+                                     "services": self.details(
+                                         header.get("kind"))})
+                elif op == "deregister":
+                    self.deregister(header["sid"])
+                    _send_msg(conn, {"ok": True})
+                else:
+                    _send_msg(conn, {"ok": False, "err": f"bad op {op}"})
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class HAClient:
+    """Service-side agent: registers once and heartbeats on a thread
+    (the reference's per-service heartbeat senders, cnservice/tnservice
+    heartbeat.go)."""
+
+    def __init__(self, addr: Tuple[str, int], kind: str, sid: str,
+                 service_addr: str = "", meta: Optional[dict] = None,
+                 interval_s: float = 0.5,
+                 stats_fn: Optional[Callable[[], dict]] = None):
+        self.addr = addr
+        self.kind = kind
+        self.sid = sid
+        self.service_addr = service_addr
+        self.meta = meta or {}
+        self.interval_s = interval_s
+        self.stats_fn = stats_fn
+        self._stop = threading.Event()
+        self._sock: Optional[socket.socket] = None
+        # serialize frames: stop()'s deregister must not interleave with
+        # an in-flight heartbeat on the shared socket
+        self._call_lock = threading.Lock()
+
+    def _call(self, header: dict) -> Optional[dict]:
+        with self._call_lock:
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(self.addr,
+                                                          timeout=2)
+                    self._sock.settimeout(2)
+                _send_msg(self._sock, header)
+                resp, _ = _recv_msg(self._sock)
+                return resp
+            except (OSError, ConnectionError):
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                self._sock = None
+                return None
+
+    def start(self) -> "HAClient":
+        self._call({"op": "register", "kind": self.kind, "sid": self.sid,
+                    "addr": self.service_addr, "meta": self.meta})
+        threading.Thread(target=self._loop, daemon=True).start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                stats = self.stats_fn() if self.stats_fn else None
+            except Exception:
+                # a metrics read must never kill the heartbeat thread —
+                # that would read as a service failure and trigger repair
+                stats = None
+            r = self._call({"op": "heartbeat", "sid": self.sid,
+                            "stats": stats})
+            if r is not None and r.get("ok") is False:
+                # keeper restarted and lost us: re-register
+                self._call({"op": "register", "kind": self.kind,
+                            "sid": self.sid, "addr": self.service_addr,
+                            "meta": self.meta})
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._call({"op": "deregister", "sid": self.sid})
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+
+def details_via_tcp(addr: Tuple[str, int],
+                    kind: Optional[str] = None) -> List[dict]:
+    """One-shot clusterservice query against a keeper."""
+    s = socket.create_connection(addr, timeout=2)
+    try:
+        _send_msg(s, {"op": "details", "kind": kind})
+        resp, _ = _recv_msg(s)
+        return resp.get("services", [])
+    finally:
+        s.close()

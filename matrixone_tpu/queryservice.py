@@ -1,0 +1,98 @@
+"""Query service: cluster-wide process list + query cancellation.
+
+Reference analogue: `pkg/queryservice` (cross-CN query/kill RPC behind
+SHOW PROCESSLIST and KILL, frontend/mysql_cmd_executor kill handling).
+Redesign: sessions of one engine share a ProcessRegistry keyed by
+connection id; KILL flips a flag the executor's pull loop checks between
+device batches — cancellation lands at batch granularity, which is the
+natural preemption point of the batch-at-a-time XLA execution model
+(mid-batch interruption would mean cancelling a compiled computation).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class QueryKilled(RuntimeError):
+    pass
+
+
+class ProcessRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next_id = 1
+        # conn_id -> record
+        self._procs: Dict[int, dict] = {}
+
+    def register(self, user: str = "root") -> int:
+        with self._lock:
+            cid = self._next_id
+            self._next_id += 1
+            self._procs[cid] = {"id": cid, "user": user, "state": "idle",
+                                "query": "", "started": 0.0,
+                                "killed": False, "terminated": False}
+            return cid
+
+    def unregister(self, cid: int) -> None:
+        with self._lock:
+            self._procs.pop(cid, None)
+
+    def start_query(self, cid: int, sql: str) -> None:
+        with self._lock:
+            rec = self._procs.get(cid)
+            if rec is not None:
+                rec.update(state="running", query=sql,
+                           started=time.monotonic(), killed=False)
+
+    def end_query(self, cid: int) -> None:
+        with self._lock:
+            rec = self._procs.get(cid)
+            if rec is not None:
+                rec.update(state="idle", query="", killed=False)
+
+    def kill(self, cid: int, query_only: bool = True) -> bool:
+        """KILL QUERY interrupts the current statement; plain KILL (the
+        MySQL connection form) additionally marks the connection
+        terminated — every later statement on it fails until the owner
+        closes it."""
+        with self._lock:
+            rec = self._procs.get(cid)
+            if rec is None:
+                return False
+            rec["killed"] = True
+            if not query_only:
+                rec["terminated"] = True
+            return True
+
+    def check_killed(self, cid: int) -> None:
+        with self._lock:
+            rec = self._procs.get(cid)
+            killed = rec is not None and (rec["killed"] or rec["terminated"])
+        if killed:
+            raise QueryKilled(f"query of connection {cid} was killed")
+
+    def is_terminated(self, cid: int) -> bool:
+        with self._lock:
+            rec = self._procs.get(cid)
+            return rec is not None and rec["terminated"]
+
+    def processlist(self):
+        with self._lock:
+            now = time.monotonic()
+            return [{"Id": r["id"], "User": r["user"], "State": r["state"],
+                     "Time": (round(now - r["started"], 3)
+                              if r["state"] == "running" else 0.0),
+                     "Query": r["query"]}
+                    for r in sorted(self._procs.values(),
+                                    key=lambda r: r["id"])]
+
+
+def registry_for(engine) -> ProcessRegistry:
+    reg = getattr(engine, "_queryservice", None)
+    if reg is None:
+        reg = ProcessRegistry()
+        engine._queryservice = reg
+    return reg

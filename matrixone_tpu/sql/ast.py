@@ -333,6 +333,17 @@ class ShowPartitions(Node):
 
 
 @dataclasses.dataclass
+class ShowProcesslist(Node):
+    pass
+
+
+@dataclasses.dataclass
+class Kill(Node):
+    conn_id: int
+    query_only: bool = False     # KILL QUERY id vs KILL id (connection)
+
+
+@dataclasses.dataclass
 class SetVariable(Node):
     name: str
     value: Node

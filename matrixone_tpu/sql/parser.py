@@ -117,6 +117,17 @@ class Parser:
         if t0.kind == "ident" and t0.value.lower() in ("describe", "desc_table"):
             self.next()
             return ast.ShowColumns(self.ident())
+        if t0.kind == "ident" and t0.value.lower() == "kill":
+            self.next()
+            query_only = False
+            t = self.peek()
+            if t.kind == "ident" and t.value.lower() == "query":
+                self.next()
+                query_only = True
+            tok = self.next()
+            if tok.kind != "int":
+                raise ParseError("KILL requires a connection id")
+            return ast.Kill(int(tok.value), query_only=query_only)
         if t0.kind == "ident" and t0.value.lower() == "alter":
             self.next()
             self.expect_kw("table")
@@ -157,6 +168,9 @@ class Parser:
         if self.accept_kw("snapshots"):
             return ast.ShowSnapshots()
         nxt = self.peek()
+        if nxt.kind == "ident" and nxt.value.lower() == "processlist":
+            self.next()
+            return ast.ShowProcesslist()
         if nxt.kind == "ident" and nxt.value.lower() == "partitions":
             self.next()
             self.expect_kw("from")

@@ -1,0 +1,209 @@
+"""HAKeeper control plane: membership, failure detection, log-replica
+repair, queryservice processlist/KILL (reference: pkg/hakeeper
+checkers/coordinator.go, pkg/queryservice)."""
+
+import tempfile
+import threading
+import time
+
+import pytest
+
+from matrixone_tpu.embed import Cluster
+from matrixone_tpu.hakeeper import HAClient, HAKeeper, details_via_tcp
+from matrixone_tpu.logservice.replicated import LogReplica, ReplicatedLog
+
+
+def test_register_heartbeat_details():
+    hk = HAKeeper(down_after_s=0.5, tick_s=0.1).start()
+    try:
+        a = HAClient(("127.0.0.1", hk.port), "cn", "cn-1",
+                     service_addr="127.0.0.1:7001",
+                     interval_s=0.1).start()
+        b = HAClient(("127.0.0.1", hk.port), "tn", "tn-1",
+                     interval_s=0.1,
+                     stats_fn=lambda: {"committed_ts": 42}).start()
+        time.sleep(0.4)
+        cns = details_via_tcp(("127.0.0.1", hk.port), "cn")
+        assert [c["sid"] for c in cns] == ["cn-1"]
+        assert cns[0]["state"] == "up"
+        assert hk.up_addrs("cn") == ["127.0.0.1:7001"]
+        tns = hk.details("tn")
+        assert tns[0]["meta"]["committed_ts"] == 42
+        a.stop()
+        b.stop()
+        assert hk.details("cn") == []    # deregistered on stop
+    finally:
+        hk.stop()
+
+
+def test_down_detection_and_repair_hook():
+    hk = HAKeeper(down_after_s=0.3, tick_s=0.05).start()
+    repaired = []
+    hk.on_down("worker", lambda rec: repaired.append(rec["sid"]))
+    try:
+        hk.register("worker", "w-0", "addr0")
+        time.sleep(0.6)                  # no heartbeats -> down
+        recs = hk.details("worker")
+        assert recs[0]["state"] == "down"
+        assert repaired == ["w-0"]
+        ops = [o for o in hk.operators if o["sid"] == "w-0"]
+        assert ops and ops[0]["repair"] == "dispatched"
+        # service recovers by heartbeating again
+        assert hk.heartbeat("w-0")
+        assert hk.details("worker")[0]["state"] == "up"
+        # keeper restart path: unknown sid heartbeat is refused
+        assert not hk.heartbeat("ghost")
+    finally:
+        hk.stop()
+
+
+def test_log_replica_repair_end_to_end():
+    """Kill one of three log replicas; the keeper detects it and the
+    repair hook restarts it; quorum appends never stop; replay intact."""
+    dirs = [tempfile.mkdtemp(prefix=f"mo_rep{i}_") for i in range(3)]
+    reps = [LogReplica(d).start() for d in dirs]
+    hk = HAKeeper(down_after_s=0.4, tick_s=0.05).start()
+    agents = {}
+
+    def make_agent(i):
+        # replica "heartbeat sender": reports only while the replica's
+        # socket is alive (stand-in for the replica process's own agent)
+        rep = reps[i]
+
+        def alive_stats():
+            return {"port": rep.port}
+        a = HAClient(("127.0.0.1", hk.port), "log", f"log-{i}",
+                     interval_s=0.1, stats_fn=alive_stats)
+        agents[i] = a
+        return a.start()
+
+    for i in range(3):
+        make_agent(i)
+
+    restarted = []
+
+    def repair(rec):
+        i = int(rec["sid"].split("-")[1])
+        reps[i] = LogReplica(dirs[i], port=0).start()
+        make_agent(i)
+        restarted.append(i)
+
+    hk.on_down("log", repair)
+    try:
+        log = ReplicatedLog([("127.0.0.1", r.port) for r in reps])
+        for k in range(5):
+            log.append({"op": "x", "n": k})
+        # kill replica 1 (socket down, agent stops heartbeating)
+        agents[1]._stop.set()
+        reps[1].stop()
+        # appends keep succeeding on the 2/3 quorum
+        for k in range(5, 10):
+            log.append({"op": "x", "n": k})
+        deadline = time.time() + 3
+        while not restarted and time.time() < deadline:
+            time.sleep(0.05)
+        assert restarted == [1]
+        # the restarted replica serves reads again: a FRESH client
+        # (addressing the new port) replays the full union
+        log2 = ReplicatedLog([("127.0.0.1", r.port) for r in reps])
+        seen = [h["n"] for h, _ in log2.replay() if h.get("op") == "x"]
+        assert seen == list(range(10))
+        log.close()
+        log2.close()
+    finally:
+        for a in agents.values():
+            a._stop.set()
+        hk.stop()
+        for r in reps:
+            r.stop()
+
+
+def test_embed_cluster_with_hakeeper():
+    c = Cluster(wire=True, with_hakeeper=True, hk_down_after_s=1.0)
+    try:
+        time.sleep(0.3)
+        kinds = {r["kind"] for r in c.hakeeper.details()}
+        assert {"tn", "cn", "server"} <= kinds
+        tn = c.hakeeper.details("tn")[0]
+        assert tn["state"] == "up"
+        # the TN heartbeat carries engine stats
+        time.sleep(0.7)
+        assert "tables" in c.hakeeper.details("tn")[0]["meta"]
+    finally:
+        c.close()
+
+
+def test_keeper_restore_membership():
+    saved = {}
+    hk = HAKeeper(down_after_s=5, persist=lambda s: saved.update(s))
+    hk.register("cn", "cn-9", "addr9")
+    hk.stop()
+    assert "cn-9" in saved
+    hk2 = HAKeeper(down_after_s=5, restore=lambda: dict(saved))
+    try:
+        recs = hk2.details("cn")
+        assert [r["sid"] for r in recs] == ["cn-9"]
+        # restored services heartbeat without re-registering
+        assert hk2.heartbeat("cn-9")
+    finally:
+        hk2.stop()
+
+
+def test_kill_connection_vs_query():
+    from matrixone_tpu.queryservice import QueryKilled
+    c = Cluster(wire=False, n_sessions=2)
+    s1, s2 = c.sessions
+    try:
+        s1.execute("create table k1 (a int)")
+        # KILL <id> (connection form): every later statement fails
+        s2.execute(f"kill {s1.conn_id}")
+        with pytest.raises(QueryKilled):
+            s1.execute("select 1 a")
+        with pytest.raises(QueryKilled):
+            s1.execute("select 1 a")     # stays dead, not one-shot
+        # session close releases the registry slot
+        s1.close()
+        ids = [row[0] for row in s2.execute("show processlist").rows()]
+        assert s1.conn_id not in ids
+    finally:
+        c.close()
+
+
+def test_processlist_and_kill():
+    from matrixone_tpu.queryservice import QueryKilled
+    from matrixone_tpu.utils.fault import INJECTOR
+    c = Cluster(wire=False, n_sessions=2)
+    s1, s2 = c.sessions
+    s1.execute("create table big (a int)")
+    for _ in range(3):
+        s1.execute("insert into big values " +
+                   ",".join(f"({i})" for i in range(1000)))
+    INJECTOR.add("scan.before", "sleep", 0.5)
+    err = {}
+
+    def run():
+        try:
+            s1.execute("select sum(a) s from big")
+        except QueryKilled as e:
+            err["e"] = e
+
+    th = threading.Thread(target=run)
+    th.start()
+    try:
+        time.sleep(0.2)
+        r = s2.execute("show processlist")
+        rows = r.rows()
+        running = [row for row in rows if row[2] == "running"
+                   and "big" in (row[4] or "")]
+        assert running, rows
+        cid = running[0][0]
+        s2.execute(f"kill query {cid}")
+        th.join(timeout=10)
+        assert not th.is_alive()
+        assert "e" in err                 # the victim saw QueryKilled
+        # the session stays usable afterwards
+        r = s1.execute("select count(*) c from big")
+        assert r.rows()[0][0] == 3000
+    finally:
+        INJECTOR.remove("scan.before")
+        c.close()
