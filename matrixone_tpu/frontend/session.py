@@ -145,6 +145,28 @@ class Session:
             if stmt.analyze:
                 return Result(text=self._explain_analyze(node))
             return Result(text=P.explain(node))
+        if isinstance(stmt, ast.LoadData):
+            return self._load_data(stmt)
+        if isinstance(stmt, ast.CreateStage):
+            self.catalog.create_stage(stmt.name, stmt.url)
+            return Result()
+        if isinstance(stmt, ast.DropStage):
+            self.catalog.drop_stage(stmt.name)
+            return Result()
+        if isinstance(stmt, ast.ShowStages):
+            names = sorted(self.catalog.stages)
+            b = Batch.from_pydict(
+                {"Stage": names,
+                 "URL": [self.catalog.stages[n] for n in names]},
+                {"Stage": dt.VARCHAR, "URL": dt.VARCHAR})
+            return Result(batch=b)
+        if isinstance(stmt, ast.CreateExternalTable):
+            schema = [(c.name, type_from_name(c.type_name, c.type_args))
+                      for c in stmt.columns]
+            fmt = _resolve_format(stmt.fmt, stmt.location)
+            self.catalog.create_external(
+                TableMeta(stmt.name, schema, []), stmt.location, fmt)
+            return Result()
         if isinstance(stmt, ast.ShowProcesslist):
             pl = self._procs.processlist()
             b = Batch.from_pydict(
@@ -167,6 +189,11 @@ class Session:
             return self._show_partitions(stmt)
         if isinstance(stmt, ast.AnalyzeTable):
             from matrixone_tpu.sql.stats import provider_for
+            if getattr(self.catalog.get_table(stmt.name), "is_external",
+                       False):
+                raise BindError(
+                    f"{stmt.name!r} is an external table; it has no "
+                    f"segment statistics to analyze")
             st = provider_for(self.catalog).refresh(stmt.name)
             b = Batch.from_pydict(
                 {"table": [stmt.name], "rows": [st.row_count],
@@ -682,14 +709,34 @@ class Session:
         """Bulk CSV load (reference: colexec/external CSV reader) via
         pyarrow.csv into the table's schema."""
         import pyarrow.csv as pacsv
+        return self._ingest_arrow(table, pacsv.read_csv(path, **read_kwargs))
+
+    def load_parquet(self, table: str, path: str) -> int:
+        """Bulk parquet load (reference: colexec/external parquet path)."""
+        import pyarrow.parquet as papq
+        return self._ingest_arrow(table, papq.read_table(path))
+
+    def _load_data(self, stmt: ast.LoadData) -> Result:
+        """LOAD DATA INFILE: path may be local / file:// / fs:// /
+        stage:// — resolved through the stage registry + fileservice."""
+        import pyarrow.csv as pacsv
+        import pyarrow.parquet as papq
+        from matrixone_tpu.storage.external import open_location
+        fmt = _resolve_format(stmt.fmt, stmt.path)
+        src = open_location(self.catalog, stmt.path)
+        tbl = (papq.read_table(src) if fmt == "parquet"
+               else pacsv.read_csv(src))
+        n = self._ingest_arrow(stmt.table, tbl)
+        return Result(affected=n)
+
+    def _ingest_arrow(self, table: str, tbl) -> int:
         t = self.catalog.get_table(table)
-        tbl = pacsv.read_csv(path, **read_kwargs)
         auto_col = t.meta.auto_increment
         required = [c for c, _ in t.meta.schema if c != auto_col]
         missing = [c for c in required if c not in tbl.schema.names]
         if missing:
             raise BindError(
-                f"CSV {path!r} is missing columns {missing}; "
+                f"load into {table!r}: file is missing columns {missing}; "
                 f"file has {tbl.schema.names}")
         # extra CSV columns are ignored; the auto_increment column may be
         # absent (values are allocated) or present (counter advances past)
@@ -709,7 +756,13 @@ class Session:
                     batch.columns[auto_col] = Vector.from_values(
                         [int(v) for v in t.allocate_auto(n)],
                         schema_map[auto_col])
-            total += t.insert_batch(batch)
+            if self.txn is not None:
+                # LOAD inside BEGIN buffers in the txn workspace like any
+                # INSERT: ROLLBACK discards it, readers never see partials
+                arrays, validity = t.batch_to_arrays(batch)
+                total += self.txn.write_batch(table, arrays, validity)
+            else:
+                total += t.insert_batch(batch)
         return total
 
     # --------------------------------------------------------------- dml
@@ -912,6 +965,16 @@ def _param_literal(v) -> ast.Node:
     if isinstance(v, datetime.date):
         return ast.DateLiteral((v - datetime.date(1970, 1, 1)).days)
     raise BindError(f"unsupported parameter type {type(v).__name__}")
+
+
+def _resolve_format(fmt: str, location: str) -> str:
+    """Shared LOAD/EXTERNAL format defaulting + validation (one place so
+    the two DDL paths cannot drift; always a BindError on bad input)."""
+    if not fmt:
+        fmt = "parquet" if location.endswith(".parquet") else "csv"
+    if fmt not in ("csv", "parquet"):
+        raise BindError(f"unsupported external format {fmt!r}")
+    return fmt
 
 
 def _substitute_params(node, params: list):

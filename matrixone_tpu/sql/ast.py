@@ -344,6 +344,37 @@ class Kill(Node):
 
 
 @dataclasses.dataclass
+class LoadData(Node):
+    path: str                    # local path | file:// | fs:// | stage://
+    table: str
+    fmt: str                     # 'csv' | 'parquet' (from suffix if '')
+
+
+@dataclasses.dataclass
+class CreateStage(Node):
+    name: str
+    url: str
+
+
+@dataclasses.dataclass
+class DropStage(Node):
+    name: str
+
+
+@dataclasses.dataclass
+class ShowStages(Node):
+    pass
+
+
+@dataclasses.dataclass
+class CreateExternalTable(Node):
+    name: str
+    columns: List["ColumnDef"]
+    location: str
+    fmt: str
+
+
+@dataclasses.dataclass
 class SetVariable(Node):
     name: str
     value: Node

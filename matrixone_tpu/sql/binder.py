@@ -791,6 +791,16 @@ class Binder:
         if e.name in AGG_FUNCS:
             raise BindError(f"aggregate {e.name}() not allowed here")
         args = [rec(a) for a in e.args]
+        if e.name == "load_file":
+            # datalink resolution (reference: load_file over the datalink
+            # type): a constant URL reads at bind time through the stage
+            # registry + fileservice
+            if len(args) != 1 or not (isinstance(args[0], BoundLiteral)
+                                      and isinstance(args[0].value, str)):
+                raise BindError("load_file() requires a literal URL")
+            from matrixone_tpu.storage.external import read_datalink
+            return BoundLiteral(read_datalink(self.catalog, args[0].value),
+                                dt.TEXT)
         return bind_scalar_function(e.name, args)
 
     # --------------------------------------------------------- pushdown

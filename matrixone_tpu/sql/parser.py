@@ -117,6 +117,28 @@ class Parser:
         if t0.kind == "ident" and t0.value.lower() in ("describe", "desc_table"):
             self.next()
             return ast.ShowColumns(self.ident())
+        if t0.kind == "ident" and t0.value.lower() == "load":
+            # LOAD DATA INFILE 'path' INTO TABLE t [FORMAT csv|parquet]
+            self.next()
+            w = self.ident()
+            if w.lower() != "data":
+                raise ParseError("expected LOAD DATA")
+            w = self.ident()
+            if w.lower() != "infile":
+                raise ParseError("expected LOAD DATA INFILE")
+            tok = self.next()
+            if tok.kind != "str":
+                raise ParseError("LOAD DATA INFILE requires a path string")
+            path = tok.value
+            self.expect_kw("into")
+            self.expect_kw("table")
+            table = self.ident()
+            fmt = ""
+            t = self.peek()
+            if t.kind == "ident" and t.value.lower() == "format":
+                self.next()
+                fmt = self.ident().lower()
+            return ast.LoadData(path, table, fmt)
         if t0.kind == "ident" and t0.value.lower() == "kill":
             self.next()
             query_only = False
@@ -168,6 +190,9 @@ class Parser:
         if self.accept_kw("snapshots"):
             return ast.ShowSnapshots()
         nxt = self.peek()
+        if nxt.kind == "ident" and nxt.value.lower() == "stages":
+            self.next()
+            return ast.ShowStages()
         if nxt.kind == "ident" and nxt.value.lower() == "processlist":
             self.next()
             return ast.ShowProcesslist()
@@ -404,6 +429,42 @@ class Parser:
     # ---- DDL / DML
     def create(self) -> ast.Node:
         self.expect_kw("create")
+        t0 = self.peek()
+        if t0.kind == "ident" and t0.value.lower() == "stage":
+            # CREATE STAGE name URL = 'url'
+            self.next()
+            name = self.ident()
+            kw = self.ident()
+            if kw.lower() != "url":
+                raise ParseError("CREATE STAGE requires URL = '...'")
+            self.expect_op("=")
+            tok = self.next()
+            if tok.kind != "str":
+                raise ParseError("stage URL must be a string")
+            return ast.CreateStage(name, tok.value)
+        if t0.kind == "ident" and t0.value.lower() == "external":
+            # CREATE EXTERNAL TABLE t (cols) LOCATION 'url' FORMAT fmt
+            self.next()
+            self.expect_kw("table")
+            name = self.ident()
+            self.expect_op("(")
+            cols = [self.column_def()]
+            while self.accept_op(","):
+                cols.append(self.column_def())
+            self.expect_op(")")
+            w = self.ident()
+            if w.lower() != "location":
+                raise ParseError("EXTERNAL TABLE requires LOCATION '...'")
+            tok = self.next()
+            if tok.kind != "str":
+                raise ParseError("LOCATION must be a string")
+            location = tok.value
+            fmt = ""
+            t = self.peek()
+            if t.kind == "ident" and t.value.lower() == "format":
+                self.next()
+                fmt = self.ident().lower()
+            return ast.CreateExternalTable(name, cols, location, fmt)
         if self.accept_kw("table"):
             if_not = False
             if self.accept_kw("if"):
@@ -549,6 +610,10 @@ class Parser:
         self.expect_kw("drop")
         if self.accept_kw("snapshot"):
             return ast.DropSnapshot(self.ident())
+        t0 = self.peek()
+        if t0.kind == "ident" and t0.value.lower() == "stage":
+            self.next()
+            return ast.DropStage(self.ident())
         self.expect_kw("table")
         if_exists = False
         if self.accept_kw("if"):

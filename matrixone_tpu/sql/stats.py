@@ -121,6 +121,8 @@ class StatsProvider:
             t = self.catalog.get_table(name)
         except Exception:
             return None
+        if getattr(t, "is_external", False):
+            return None     # no segment stats for scan-in-place files
         fp = self._fingerprint(t)
         with self._lock:
             hit = self._cache.get(name)

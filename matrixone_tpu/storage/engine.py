@@ -43,6 +43,19 @@ Schema = List[Tuple[str, DType]]
 ROWID = "__rowid"
 
 
+
+def schema_to_json(schema: Schema) -> list:
+    """One canonical (de)serialization for table schemas — WAL records,
+    checkpoint manifests, and external-table defs all share it so a new
+    DType field only needs threading through here."""
+    return [[c, d.oid.value, d.width, d.scale, d.dim] for c, d in schema]
+
+
+def schema_from_json(rows) -> Schema:
+    return [(c, DType(TypeOid(o), width=w, scale=s, dim=dm))
+            for c, o, w, s, dm in rows]
+
+
 @dataclasses.dataclass
 class TableMeta:
     name: str
@@ -576,6 +589,7 @@ class Engine:
         self._subscribers: List[Callable] = []   # logtail analogue
         self._ckpt_ts = 0
         self.snapshots: Dict[str, int] = {}      # Git-for-data named points
+        self.stages: Dict[str, str] = {}         # CREATE STAGE name -> url
         #: last FULLY applied commit: readers snapshot here so a commit
         #: mid-apply (tombstones in, segments not yet) can never tear a read
         self.committed_ts = self.hlc.now()
@@ -604,8 +618,7 @@ class Engine:
                              "partition": (meta.partition.to_json()
                                            if meta.partition is not None
                                            else None),
-                             "schema": [[c, d.oid.value, d.width, d.scale,
-                                         d.dim] for c, d in meta.schema]})
+                             "schema": schema_to_json(meta.schema)})
 
     def drop_table(self, name: str, if_exists=False, log=True) -> None:
         if name not in self.tables:
@@ -619,6 +632,38 @@ class Engine:
                 self.index_cache.drop(k)    # free device residency + budget
         if log:
             self.wal.append({"op": "drop_table", "name": name,
+                             "ts": self.hlc.now()})
+
+    def create_external(self, meta: TableMeta, location: str, fmt: str,
+                        log: bool = True, if_not_exists: bool = False):
+        """Register an external (scan-in-place, read-only) table —
+        colexec/external role; see storage/external.py."""
+        from matrixone_tpu.storage.external import ExternalTable
+        if meta.name in self.tables:
+            if if_not_exists:
+                return
+            raise ValueError(f"table {meta.name} already exists")
+        t = ExternalTable(meta, location, fmt, engine=self)
+        self.tables[meta.name] = t
+        if log:
+            self.wal.append({"op": "create_external", "name": meta.name,
+                             "ts": self.hlc.now(),
+                             "location": location, "fmt": fmt,
+                             "schema": schema_to_json(meta.schema)})
+
+    def create_stage(self, name: str, url: str, log: bool = True) -> None:
+        """Durable named external location (pkg/stage analogue)."""
+        self.stages[name] = url
+        if log:
+            self.wal.append({"op": "create_stage", "name": name,
+                             "url": url, "ts": self.hlc.now()})
+
+    def drop_stage(self, name: str, log: bool = True) -> None:
+        if name not in self.stages:
+            raise ValueError(f"no such stage {name}")
+        del self.stages[name]
+        if log:
+            self.wal.append({"op": "drop_stage", "name": name,
                              "ts": self.hlc.now()})
 
     def alter_partition_drop(self, table: str, part: str,
@@ -888,8 +933,14 @@ class Engine:
 
     def _checkpoint_locked(self) -> None:
         manifest = {"ckpt_ts": self.hlc.now(), "tables": {},
-                    "snapshots": dict(self.snapshots)}
+                    "snapshots": dict(self.snapshots),
+                    "stages": dict(self.stages), "externals": {}}
         for name, t in self.tables.items():
+            if getattr(t, "is_external", False):
+                manifest["externals"][name] = {
+                    "location": t.location, "fmt": t.fmt,
+                    "schema": schema_to_json(t.meta.schema)}
+                continue
             objs = []
             for seg in t.segments:
                 meta = objectio.ObjectMeta(
@@ -904,8 +955,7 @@ class Engine:
                              "commit_ts": seg.commit_ts,
                              "part_id": seg.part_id})
             manifest["tables"][name] = {
-                "schema": [[c, d.oid.value, d.width, d.scale, d.dim]
-                           for c, d in t.meta.schema],
+                "schema": schema_to_json(t.meta.schema),
                 "pk": t.meta.primary_key,
                 "auto": t.meta.auto_increment,
                 "not_null": t.meta.not_null,
@@ -931,10 +981,14 @@ class Engine:
             manifest = json.loads(fs.read("meta/manifest.json").decode())
             eng._ckpt_ts = manifest.get("ckpt_ts", 0)
             eng.snapshots = dict(manifest.get("snapshots", {}))
+            eng.stages = dict(manifest.get("stages", {}))
             eng.hlc.update(eng._ckpt_ts)
+            for name, ex in manifest.get("externals", {}).items():
+                schema = schema_from_json(ex["schema"])
+                eng.create_external(TableMeta(name, schema, []),
+                                    ex["location"], ex["fmt"], log=False)
             for name, tm in manifest["tables"].items():
-                schema = [(c, DType(TypeOid(o), width=w, scale=s, dim=dm))
-                          for c, o, w, s, dm in tm["schema"]]
+                schema = schema_from_json(tm["schema"])
                 from matrixone_tpu.storage.partition import PartitionSpec
                 eng.create_table(
                     TableMeta(name, schema, tm["pk"],
@@ -986,8 +1040,7 @@ class Engine:
                 continue
             if op == "create_table":
                 from matrixone_tpu.storage.partition import PartitionSpec
-                schema = [(c, DType(TypeOid(o), width=w, scale=s, dim=dm))
-                          for c, o, w, s, dm in header["schema"]]
+                schema = schema_from_json(header["schema"])
                 self.create_table(
                     TableMeta(header["name"], schema, header["pk"],
                               auto_increment=header.get("auto"),
@@ -1000,6 +1053,15 @@ class Engine:
             elif op == "alter_partition_drop":
                 self.alter_partition_drop(header["table"], header["part"],
                                           log=False)
+            elif op == "create_external":
+                schema = schema_from_json(header["schema"])
+                self.create_external(TableMeta(header["name"], schema, []),
+                                     header["location"], header["fmt"],
+                                     log=False, if_not_exists=True)
+            elif op == "create_stage":
+                self.stages[header["name"]] = header["url"]
+            elif op == "drop_stage":
+                self.stages.pop(header["name"], None)
             elif op == "create_snapshot":
                 self.snapshots[header["name"]] = header["ts"]
             elif op == "drop_snapshot":
