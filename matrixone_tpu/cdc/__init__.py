@@ -34,6 +34,27 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 
+def sql_literal(v) -> str:
+    """Render one python value as a SQL literal — the ONE renderer shared
+    by every SQL-generating sink/writer (SQLSink, SourceWriter, dynamic
+    table refresh), so type coverage cannot drift between them."""
+    import datetime
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, str):
+        return "'" + v.replace("'", "''") + "'"
+    if isinstance(v, datetime.datetime):
+        epoch = datetime.datetime(1970, 1, 1)
+        return str(int((v - epoch).total_seconds() * 1e6))
+    if isinstance(v, datetime.date):
+        return "'" + v.isoformat() + "'"
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
 class CallbackSink:
     def __init__(self, fn: Callable):
         self.fn = fn
@@ -55,11 +76,7 @@ class SQLSink:
 
     @staticmethod
     def _lit(v) -> str:
-        if v is None:
-            return "null"
-        if isinstance(v, str):
-            return "'" + v.replace("'", "''") + "'"
-        return str(v)
+        return sql_literal(v)
 
     def on_insert(self, table: str, rows: List[dict], pk_cols=None):
         target = self.target_table or table
@@ -180,26 +197,47 @@ class CdcTask:
             rows.append(row)
         return rows
 
-    def backfill(self) -> None:
+    def backfill(self, from_ts: Optional[int] = None) -> None:
         """Ship committed changes past the watermark from MVCC state (the
         restart/resume path: no retained event stream needed). Events
         replay in commit-ts order, deletes before inserts at equal ts —
-        the live ordering (an UPDATE is delete+insert at one ts)."""
+        the live ordering (an UPDATE is delete+insert at one ts).
+
+        `from_ts` pins the replay start: a caller that subscribed live
+        BEFORE backfilling passes the pre-subscribe watermark, so a live
+        commit that advanced the watermark in between cannot make
+        backfill skip history (duplicates are fine — delivery is
+        at-least-once and PK sinks upsert)."""
         was_active = self._active
         self._active = True      # _on_commit delivers only when active
         try:
-            self._backfill_events()
+            self._backfill_events(self.watermark if from_ts is None
+                                  else from_ts)
         finally:
             self._active = was_active
 
-    def _backfill_events(self) -> None:
+    def _backfill_events(self, from_ts: int) -> None:
         t = self.engine.get_table(self.table)
         events = []
         for seg in t.segments:
-            if seg.commit_ts >= self.watermark:
+            if seg.commit_ts >= from_ts:
                 events.append((seg.commit_ts, 1, "insert", seg))
         for ts, gids in t.tombstones:
-            if ts >= self.watermark:
+            if ts >= from_ts:
                 events.append((ts, 0, "delete", gids))
         for ts, _, kind, payload in sorted(events, key=lambda e: e[:2]):
-            self._on_commit(ts, self.table, kind, payload)
+            self._replay_event(ts, kind, payload)
+
+    def _replay_event(self, commit_ts: int, kind: str, payload) -> None:
+        """Deliver one backfill event regardless of the current watermark
+        (which a live commit may have advanced past this event)."""
+        with self._lock:
+            if kind == "insert":
+                pk = self.engine.get_table(self.table).meta.primary_key
+                self.sink.on_insert(self.table,
+                                    self._decode_segment(payload),
+                                    pk_cols=pk or None)
+            else:
+                self.sink.on_delete(self.table, self._decode_pk_rows(
+                    np.asarray(payload, np.int64)))
+            self.watermark = max(self.watermark, commit_ts)

@@ -145,6 +145,34 @@ class Session:
             if stmt.analyze:
                 return Result(text=self._explain_analyze(node))
             return Result(text=P.explain(node))
+        if isinstance(stmt, ast.CreatePublication):
+            self.catalog.create_publication(stmt.name, stmt.tables)
+            return Result()
+        if isinstance(stmt, ast.DropPublication):
+            self.catalog.drop_publication(stmt.name)
+            return Result()
+        if isinstance(stmt, ast.ShowPublications):
+            names = sorted(self.catalog.publications)
+            b = Batch.from_pydict(
+                {"Publication": names,
+                 "Tables": [", ".join(self.catalog.publications[n])
+                            for n in names]},
+                {"Publication": dt.VARCHAR, "Tables": dt.VARCHAR})
+            return Result(batch=b)
+        if isinstance(stmt, ast.CreateSource):
+            schema = [(c.name, type_from_name(c.type_name, c.type_args))
+                      for c in stmt.columns]
+            self.catalog.create_table(TableMeta(stmt.name, schema, []))
+            self.catalog.mark_source(stmt.name)
+            return Result()
+        if isinstance(stmt, ast.CreateDynamicTable):
+            return self._create_dynamic_table(stmt)
+        if isinstance(stmt, ast.RefreshDynamicTable):
+            from matrixone_tpu.stream import refresh_dynamic_table
+            if stmt.name not in self.catalog.dynamic_tables:
+                raise BindError(f"no such dynamic table {stmt.name!r}")
+            n = refresh_dynamic_table(self, stmt.name)
+            return Result(affected=n)
         if isinstance(stmt, ast.LoadData):
             return self._load_data(stmt)
         if isinstance(stmt, ast.CreateStage):
@@ -590,6 +618,35 @@ class Session:
                       not_null=not_null, partition=part),
             if_not_exists=stmt.if_not_exists)
         return Result()
+
+    def _create_dynamic_table(self, stmt: ast.CreateDynamicTable) -> Result:
+        """CREATE DYNAMIC TABLE name AS SELECT ... — materialize once now,
+        store the defining SELECT for REFRESH (reference: stream dynamic
+        tables driven by the task framework)."""
+        import re
+        from matrixone_tpu.stream import refresh_dynamic_table
+        self._prepare_select(stmt.select)
+        node = Binder(self.catalog).bind_statement(stmt.select)
+        # result schema -> backing table (strip alias qualifiers)
+        schema = [(n.split(".")[-1], d) for n, d in node.schema]
+        if len({c for c, _ in schema}) != len(schema):
+            raise BindError(
+                "dynamic table SELECT has duplicate output names")
+        for c, _ in schema:
+            if not re.match(r"^[A-Za-z_][A-Za-z0-9_]*$", c):
+                raise BindError(
+                    f"dynamic table output {c!r} is not a valid column "
+                    f"name; alias the expression (AS name)")
+        self.catalog.create_table(TableMeta(stmt.name, schema, []))
+        self.catalog.register_dynamic(stmt.name, stmt.sql_text)
+        try:
+            n = refresh_dynamic_table(self, stmt.name)
+        except Exception:
+            # no orphan catalog/WAL state from a failed CREATE: the
+            # drop is WAL-logged too, so replay converges to "absent"
+            self.catalog.drop_table(stmt.name, if_exists=True)
+            raise
+        return Result(affected=n)
 
     def _alter_partition(self, stmt: ast.AlterPartition) -> Result:
         """TRUNCATE/DROP PARTITION (partitionservice management ops):

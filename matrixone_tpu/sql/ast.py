@@ -375,6 +375,41 @@ class CreateExternalTable(Node):
 
 
 @dataclasses.dataclass
+class CreatePublication(Node):
+    name: str
+    tables: List[str]
+
+
+@dataclasses.dataclass
+class DropPublication(Node):
+    name: str
+
+
+@dataclasses.dataclass
+class ShowPublications(Node):
+    pass
+
+
+@dataclasses.dataclass
+class CreateSource(Node):
+    name: str
+    columns: List["ColumnDef"]
+
+
+@dataclasses.dataclass
+class CreateDynamicTable(Node):
+    name: str
+    select: Node
+    sql_text: str            # the defining SELECT, verbatim (re-run on
+                             # every refresh)
+
+
+@dataclasses.dataclass
+class RefreshDynamicTable(Node):
+    name: str
+
+
+@dataclasses.dataclass
 class SetVariable(Node):
     name: str
     value: Node

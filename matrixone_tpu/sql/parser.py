@@ -20,7 +20,7 @@ AGG_FUNCS = {"count", "sum", "avg", "min", "max"}
 
 def parse(sql: str) -> List[ast.Node]:
     """Parse a semicolon-separated script -> list of statements."""
-    return Parser(tokenize(sql)).parse_script()
+    return Parser(tokenize(sql), src=sql).parse_script()
 
 
 def parse_one(sql: str) -> ast.Node:
@@ -31,8 +31,9 @@ def parse_one(sql: str) -> ast.Node:
 
 
 class Parser:
-    def __init__(self, tokens: List[Token]):
+    def __init__(self, tokens: List[Token], src: str = ""):
         self.toks = tokens
+        self.src = src
         self.i = 0
 
     # ---- token helpers
@@ -139,6 +140,13 @@ class Parser:
                 self.next()
                 fmt = self.ident().lower()
             return ast.LoadData(path, table, fmt)
+        if t0.kind == "ident" and t0.value.lower() == "refresh":
+            self.next()
+            w = self.ident()
+            if w.lower() != "dynamic":
+                raise ParseError("expected REFRESH DYNAMIC TABLE")
+            self.expect_kw("table")
+            return ast.RefreshDynamicTable(self.ident())
         if t0.kind == "ident" and t0.value.lower() == "kill":
             self.next()
             query_only = False
@@ -193,6 +201,9 @@ class Parser:
         if nxt.kind == "ident" and nxt.value.lower() == "stages":
             self.next()
             return ast.ShowStages()
+        if nxt.kind == "ident" and nxt.value.lower() == "publications":
+            self.next()
+            return ast.ShowPublications()
         if nxt.kind == "ident" and nxt.value.lower() == "processlist":
             self.next()
             return ast.ShowProcesslist()
@@ -442,6 +453,38 @@ class Parser:
             if tok.kind != "str":
                 raise ParseError("stage URL must be a string")
             return ast.CreateStage(name, tok.value)
+        if t0.kind == "ident" and t0.value.lower() == "publication":
+            # CREATE PUBLICATION name TABLE t1 [, t2 ...]
+            self.next()
+            name = self.ident()
+            self.expect_kw("table")
+            tables = [self.ident()]
+            while self.accept_op(","):
+                tables.append(self.ident())
+            return ast.CreatePublication(name, tables)
+        if t0.kind == "ident" and t0.value.lower() == "source":
+            # CREATE SOURCE name (cols): append-only connector-fed table
+            self.next()
+            name = self.ident()
+            self.expect_op("(")
+            cols = [self.column_def()]
+            while self.accept_op(","):
+                cols.append(self.column_def())
+            self.expect_op(")")
+            return ast.CreateSource(name, cols)
+        if t0.kind == "ident" and t0.value.lower() == "dynamic":
+            # CREATE DYNAMIC TABLE name AS select ...
+            self.next()
+            self.expect_kw("table")
+            name = self.ident()
+            self.expect_kw("as")
+            start = self.peek().pos
+            sel = self.select_or_union() if self.at_kw("select") \
+                else self.with_select()
+            end = (self.peek().pos if self.peek().kind != "eof"
+                   else len(self.src))
+            return ast.CreateDynamicTable(
+                name, sel, self.src[start:end].rstrip().rstrip(";"))
         if t0.kind == "ident" and t0.value.lower() == "external":
             # CREATE EXTERNAL TABLE t (cols) LOCATION 'url' FORMAT fmt
             self.next()
@@ -614,6 +657,9 @@ class Parser:
         if t0.kind == "ident" and t0.value.lower() == "stage":
             self.next()
             return ast.DropStage(self.ident())
+        if t0.kind == "ident" and t0.value.lower() == "publication":
+            self.next()
+            return ast.DropPublication(self.ident())
         self.expect_kw("table")
         if_exists = False
         if self.accept_kw("if"):

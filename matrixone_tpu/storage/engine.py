@@ -590,6 +590,9 @@ class Engine:
         self._ckpt_ts = 0
         self.snapshots: Dict[str, int] = {}      # Git-for-data named points
         self.stages: Dict[str, str] = {}         # CREATE STAGE name -> url
+        self.publications: Dict[str, List[str]] = {}   # pub -> tables
+        self.sources: set = set()                # SOURCE-marked tables
+        self.dynamic_tables: Dict[str, str] = {}  # name -> defining SELECT
         #: last FULLY applied commit: readers snapshot here so a commit
         #: mid-apply (tombstones in, segments not yet) can never tear a read
         self.committed_ts = self.hlc.now()
@@ -626,6 +629,15 @@ class Engine:
                 return
             raise ValueError(f"no such table {name}")
         del self.tables[name]
+        self.sources.discard(name)
+        self.dynamic_tables.pop(name, None)
+        # publications must not reference dropped tables (a subscriber
+        # would abort on the missing table); empty publications vanish
+        for pub, tabs in list(self.publications.items()):
+            if name in tabs:
+                tabs.remove(name)
+                if not tabs:
+                    del self.publications[pub]
         for k, v in list(self.indexes.items()):
             if v.table == name:
                 del self.indexes[k]
@@ -650,6 +662,41 @@ class Engine:
                              "ts": self.hlc.now(),
                              "location": location, "fmt": fmt,
                              "schema": schema_to_json(meta.schema)})
+
+    def create_publication(self, name: str, tables: List[str],
+                           log: bool = True) -> None:
+        """Durable named table set for cross-cluster sharing (reference:
+        mo_pubs; see matrixone_tpu.publication)."""
+        for t in tables:
+            tab = self.get_table(t)       # must exist
+            if getattr(tab, "is_external", False):
+                raise ValueError(
+                    f"cannot publish external table {t!r}")
+        self.publications[name] = list(tables)
+        if log:
+            self.wal.append({"op": "create_publication", "name": name,
+                             "tables": list(tables), "ts": self.hlc.now()})
+
+    def drop_publication(self, name: str, log: bool = True) -> None:
+        if name not in self.publications:
+            raise ValueError(f"no such publication {name}")
+        del self.publications[name]
+        if log:
+            self.wal.append({"op": "drop_publication", "name": name,
+                             "ts": self.hlc.now()})
+
+    def mark_source(self, name: str, log: bool = True) -> None:
+        self.sources.add(name)
+        if log:
+            self.wal.append({"op": "mark_source", "name": name,
+                             "ts": self.hlc.now()})
+
+    def register_dynamic(self, name: str, sql: str,
+                         log: bool = True) -> None:
+        self.dynamic_tables[name] = sql
+        if log:
+            self.wal.append({"op": "create_dynamic", "name": name,
+                             "sql": sql, "ts": self.hlc.now()})
 
     def create_stage(self, name: str, url: str, log: bool = True) -> None:
         """Durable named external location (pkg/stage analogue)."""
@@ -934,7 +981,11 @@ class Engine:
     def _checkpoint_locked(self) -> None:
         manifest = {"ckpt_ts": self.hlc.now(), "tables": {},
                     "snapshots": dict(self.snapshots),
-                    "stages": dict(self.stages), "externals": {}}
+                    "stages": dict(self.stages), "externals": {},
+                    "publications": {k: list(v) for k, v
+                                     in self.publications.items()},
+                    "sources": sorted(self.sources),
+                    "dynamic_tables": dict(self.dynamic_tables)}
         for name, t in self.tables.items():
             if getattr(t, "is_external", False):
                 manifest["externals"][name] = {
@@ -982,6 +1033,10 @@ class Engine:
             eng._ckpt_ts = manifest.get("ckpt_ts", 0)
             eng.snapshots = dict(manifest.get("snapshots", {}))
             eng.stages = dict(manifest.get("stages", {}))
+            eng.publications = {k: list(v) for k, v in
+                                manifest.get("publications", {}).items()}
+            eng.sources = set(manifest.get("sources", []))
+            eng.dynamic_tables = dict(manifest.get("dynamic_tables", {}))
             eng.hlc.update(eng._ckpt_ts)
             for name, ex in manifest.get("externals", {}).items():
                 schema = schema_from_json(ex["schema"])
@@ -1062,6 +1117,14 @@ class Engine:
                 self.stages[header["name"]] = header["url"]
             elif op == "drop_stage":
                 self.stages.pop(header["name"], None)
+            elif op == "create_publication":
+                self.publications[header["name"]] = list(header["tables"])
+            elif op == "drop_publication":
+                self.publications.pop(header["name"], None)
+            elif op == "mark_source":
+                self.sources.add(header["name"])
+            elif op == "create_dynamic":
+                self.dynamic_tables[header["name"]] = header["sql"]
             elif op == "create_snapshot":
                 self.snapshots[header["name"]] = header["ts"]
             elif op == "drop_snapshot":

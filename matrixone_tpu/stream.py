@@ -1,0 +1,98 @@
+"""Stream sources and dynamic tables.
+
+Reference analogue: MatrixOne's `CREATE SOURCE` (Kafka connector-fed
+append-only tables, pkg/stream/connector) and `CREATE DYNAMIC TABLE ...
+AS SELECT` (continuously refreshed materializations driven by the task
+framework). Redesign:
+
+  * a SOURCE is an append-only engine table (no PK) plus a SourceWriter
+    — the connector seam: external feeders (a Kafka consumer loop, a
+    log tailer) push dict-rows; the writer micro-batches them into
+    commits on a flush interval, which is exactly the shape of the
+    reference's connector pipeline (buffer -> batch -> insert);
+  * a DYNAMIC TABLE stores its defining SELECT in the catalog and
+    re-materializes on demand (`REFRESH DYNAMIC TABLE`) or on a
+    taskservice interval. Refresh is transactional-per-statement:
+    readers see either the old or the new materialization, never a
+    partial one (DELETE + INSERT ... SELECT inside one explicit txn).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class SourceWriter:
+    """Connector-side buffered writer for a SOURCE table."""
+
+    def __init__(self, session, source: str, flush_rows: int = 4096,
+                 flush_interval_s: float = 0.5):
+        self.session = session
+        self.source = source
+        self.flush_rows = flush_rows
+        self.flush_interval_s = flush_interval_s
+        self._buf: List[dict] = []
+        self._lock = threading.Lock()
+        self._last_flush = time.monotonic()
+
+    def write(self, row: dict) -> None:
+        self.write_many([row])
+
+    def write_many(self, rows: List[dict]) -> None:
+        with self._lock:
+            self._buf.extend(rows)
+            should = (len(self._buf) >= self.flush_rows
+                      or time.monotonic() - self._last_flush
+                      >= self.flush_interval_s)
+        if should:
+            self.flush()
+
+    def flush(self) -> int:
+        with self._lock:
+            rows, self._buf = self._buf, []
+            self._last_flush = time.monotonic()
+        if not rows:
+            return 0
+        from matrixone_tpu.cdc import sql_literal
+        t = self.session.catalog.get_table(self.source)
+        cols = [c for c, _ in t.meta.schema]
+        values = ["(" + ", ".join(sql_literal(r.get(c)) for c in cols) + ")"
+                  for r in rows]
+        self.session.execute(
+            f"insert into {self.source} ({', '.join(cols)}) values "
+            + ", ".join(values))
+        return len(rows)
+
+
+def refresh_dynamic_table(session, name: str) -> int:
+    """Re-materialize one dynamic table from its stored SELECT."""
+    dts = getattr(session.catalog, "dynamic_tables", {})
+    if name not in dts:
+        raise ValueError(f"no such dynamic table {name!r}")
+    from matrixone_tpu.cdc import sql_literal
+    sql = dts[name]
+    r = session.execute(sql)
+    b = r.batch
+    cols = list(b.columns)
+    # swap contents atomically w.r.t. statement snapshots: a single txn
+    # deletes the old materialization and inserts the new one
+    session.execute("begin")
+    try:
+        session.execute(f"delete from {name}")
+        rows = []
+        pylists = {c: b.columns[c].to_pylist() for c in cols}
+        n = len(b)
+        for i in range(n):
+            rows.append("(" + ", ".join(sql_literal(pylists[c][i])
+                                        for c in cols) + ")")
+        if rows:
+            session.execute(
+                f"insert into {name} ({', '.join(cols)}) values "
+                + ", ".join(rows))
+        session.execute("commit")
+    except Exception:
+        session.execute("rollback")
+        raise
+    return n
