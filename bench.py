@@ -20,6 +20,13 @@ import threading
 import time
 
 import jax
+
+# The image's sitecustomize pins JAX_PLATFORMS=axon; for the CPU fallback
+# run the env var alone is not enough (same reason as tests/conftest.py) —
+# must force the platform before the backend initializes.
+if os.environ.get("MO_BENCH_CPU_FALLBACK") == "1":
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -96,9 +103,10 @@ def bench_q1():
 
 
 PREFLIGHT_S = float(os.environ.get("MO_BENCH_PREFLIGHT_S", 120))
+_LAST_PREFLIGHT_ERR = [None]   # concrete backend error for wedge triage
 
 
-def _device_preflight(timeout_s: float = None) -> bool:
+def _device_preflight(timeout_s: float = None, announce: bool = True):
     """Prove the backend answers a trivial op before committing to the
     full run — a wedged accelerator tunnel must produce a diagnostic JSON
     line, not an eternal hang (observed: axon tunnel outages)."""
@@ -117,26 +125,65 @@ def _device_preflight(timeout_s: float = None) -> bool:
     t = threading.Thread(target=probe, daemon=True)
     t.start()
     if not done.wait(timeout_s) or err:
-        print(json.dumps({
-            "metric": "bench_unavailable",
-            "value": 0,
-            "unit": "error",
-            "vs_baseline": None,
-            # NOTE: no jax.* calls here — backend queries block on the
-            # very wedge this branch reports
-            "error": (err[0] if err else
-                      f"device unresponsive after {timeout_s}s"),
-        }))
+        _LAST_PREFLIGHT_ERR[0] = (err[0] if err else
+                                  f"device unresponsive after {timeout_s}s")
+        if announce:
+            print(json.dumps({
+                "metric": "bench_unavailable",
+                "value": 0,
+                "unit": "error",
+                "vs_baseline": None,
+                # NOTE: no jax.* calls here — backend queries block on
+                # the very wedge this branch reports
+                "error": (err[0] if err else
+                          f"device unresponsive after {timeout_s}s"),
+            }))
         return False
     return True
 
 
+def _cpu_fallback():
+    """TPU tunnel dead: re-exec ourselves on the CPU backend at reduced
+    scale so the round still records an honest trend line (VERDICT r2 #1:
+    'a scoreboard with honest CPU numbers beats an empty one').  The JSON
+    line carries backend=cpu so nobody mistakes it for a chip number."""
+    import subprocess
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MO_BENCH_CPU_FALLBACK"] = "1"
+    # CPU-tractable shapes: 200k x 256 IVF (still >toy), or 1M-row Q1
+    if not SMOKE:
+        if METRIC != "q1":
+            env.setdefault("MO_BENCH_N", "200000")
+            env.setdefault("MO_BENCH_D", "256")
+            env.setdefault("MO_BENCH_Q", "512")
+        else:
+            env.setdefault("MO_BENCH_N", "1000000")
+    r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                       env=env, timeout=3600)
+    return r.returncode
+
+
 def main():
-    if not _device_preflight():
+    if os.environ.get("MO_BENCH_CPU_FALLBACK") != "1" and \
+            not _device_preflight(announce=False):
         sys.stdout.flush()
-        # nonzero: shell consumers must not mistake a dead device for a
-        # successful run; _exit (not exit) skips jax's hanging atexit sync
-        os._exit(1)
+        try:
+            rc = _cpu_fallback()
+        except Exception:                     # noqa: BLE001
+            rc = 1
+        if rc != 0:
+            # fallback also failed: emit the diagnostic line so shell
+            # consumers never mistake a dead device for a success
+            print(json.dumps({
+                "metric": "bench_unavailable", "value": 0,
+                "unit": "error", "vs_baseline": None,
+                "error": f"{_LAST_PREFLIGHT_ERR[0]}; "
+                         "cpu fallback also failed",
+            }))
+            sys.stdout.flush()
+        # _exit (not exit) skips jax's hanging atexit sync
+        os._exit(rc)
     if METRIC == "q1":
         bench_q1()
         return
@@ -204,11 +251,17 @@ def main():
         dt = time.time() - t0
         best_qps = max(best_qps, NQ / dt)
 
+    # vs_baseline only when the config actually matches the published
+    # baseline (IVF-Flat, 1M x 768, chip run) — a reduced-scale CPU
+    # fallback ratio would be apples-to-oranges
+    comparable = (INDEX_KIND == "ivfflat" and N == 1_000_000 and D == 768
+                  and jax.default_backend() not in ("cpu",))
     result = {
         "metric": f"{INDEX_KIND}_search_qps_{N}x{D}_top{K}_nprobe{NPROBE}",
         "value": round(best_qps, 1),
         "unit": "qps",
-        "vs_baseline": round(best_qps / BASELINE_QPS, 2),
+        "vs_baseline": (round(best_qps / BASELINE_QPS, 2)
+                        if comparable else None),
         "recall_at_20": round(rec, 4),
         "build_seconds": round(t_build, 2),
         "data_seconds": round(t_data, 2),

@@ -126,7 +126,17 @@ class CdcTask:
         self.table = table
         self.sink = sink
         self.watermark = from_ts
-        self._lock = threading.Lock()
+        # RLock: a sink that writes back into the same engine re-enters
+        # _on_commit on this thread and must not self-deadlock
+        self._lock = threading.RLock()
+        # backfill-in-progress queue: live events arriving mid-backfill
+        # are deferred, NOT applied (a live DELETE applied before its
+        # row's backfill INSERT replays would be resurrected by that
+        # INSERT) and NOT blocked on (holding the lock across the whole
+        # backfill would ABBA-deadlock against the engine commit lock
+        # when the sink writes into the same engine)
+        self._buffering = False
+        self._buffer: List[tuple] = []
         self._active = False
 
     def start(self) -> "CdcTask":
@@ -162,19 +172,26 @@ class CdcTask:
         if not self._active or table != self.table:
             return
         with self._lock:
+            if self._buffering:
+                self._buffer.append((commit_ts, kind, payload))
+                return     # backfill drains the queue after its replay
             # one commit publishes several events with the SAME commit_ts
             # (deletes then inserts — update pairs); strict < keeps them
             # all and makes restart delivery at-least-once
             if commit_ts < self.watermark:
                 return     # already shipped (restart replay)
-            if kind == "insert":
-                pk = self.engine.get_table(self.table).meta.primary_key
-                self.sink.on_insert(table, self._decode_segment(payload),
-                                    pk_cols=pk or None)
-            elif kind == "delete":
-                self.sink.on_delete(table, self._decode_pk_rows(
-                    np.asarray(payload, np.int64)))
-            self.watermark = commit_ts
+            self._apply_event(commit_ts, kind, payload)
+
+    def _apply_event(self, commit_ts: int, kind: str, payload) -> None:
+        """Deliver one event to the sink; caller holds self._lock."""
+        if kind == "insert":
+            pk = self.engine.get_table(self.table).meta.primary_key
+            self.sink.on_insert(self.table, self._decode_segment(payload),
+                                pk_cols=pk or None)
+        elif kind == "delete":
+            self.sink.on_delete(self.table, self._decode_pk_rows(
+                np.asarray(payload, np.int64)))
+        self.watermark = max(self.watermark, commit_ts)
 
     def _decode_pk_rows(self, gids: "np.ndarray") -> List[dict]:
         """PK values for deleted rows (segments still hold the data —
@@ -217,27 +234,41 @@ class CdcTask:
             self._active = was_active
 
     def _backfill_events(self, from_ts: int) -> None:
-        t = self.engine.get_table(self.table)
-        events = []
-        for seg in t.segments:
-            if seg.commit_ts >= from_ts:
-                events.append((seg.commit_ts, 1, "insert", seg))
-        for ts, gids in t.tombstones:
-            if ts >= from_ts:
-                events.append((ts, 0, "delete", gids))
-        for ts, _, kind, payload in sorted(events, key=lambda e: e[:2]):
-            self._replay_event(ts, kind, payload)
+        """Live commits must observably serialize AFTER the whole
+        backfill: a live DELETE applied mid-backfill for a row whose
+        backfill INSERT has not replayed yet would be a no-op, and the
+        later replayed INSERT would resurrect the deleted row
+        permanently.  Achieved by buffering (not blocking): the event
+        list is built with buffering armed, the replay runs with the
+        lock taken per event, and arrivals queue in _buffer — drained in
+        arrival order once the replay finishes.  Duplicates between the
+        list and the queue are fine (at-least-once, PK sinks upsert)."""
+        with self._lock:
+            self._buffering = True
+            t = self.engine.get_table(self.table)
+            events = []
+            for seg in t.segments:
+                if seg.commit_ts >= from_ts:
+                    events.append((seg.commit_ts, 1, "insert", seg))
+            for ts, gids in t.tombstones:
+                if ts >= from_ts:
+                    events.append((ts, 0, "delete", gids))
+        try:
+            for ts, _, kind, payload in sorted(events, key=lambda e: e[:2]):
+                self._replay_event(ts, kind, payload)
+        finally:
+            while True:
+                with self._lock:
+                    if not self._buffer:
+                        self._buffering = False
+                        break
+                    queued = self._buffer
+                    self._buffer = []
+                    for ts, kind, payload in queued:
+                        self._apply_event(ts, kind, payload)
 
     def _replay_event(self, commit_ts: int, kind: str, payload) -> None:
         """Deliver one backfill event regardless of the current watermark
         (which a live commit may have advanced past this event)."""
         with self._lock:
-            if kind == "insert":
-                pk = self.engine.get_table(self.table).meta.primary_key
-                self.sink.on_insert(self.table,
-                                    self._decode_segment(payload),
-                                    pk_cols=pk or None)
-            else:
-                self.sink.on_delete(self.table, self._decode_pk_rows(
-                    np.asarray(payload, np.int64)))
-            self.watermark = max(self.watermark, commit_ts)
+            self._apply_event(commit_ts, kind, payload)

@@ -801,25 +801,35 @@ class Session:
         from matrixone_tpu.container.batch import Batch as _B
         total = 0
         schema_map = dict(t.meta.schema)
-        for rb in tbl.select(want).to_batches(max_chunksize=1 << 20):
-            batch = _B.from_arrow(rb, schema=schema_map)
-            if auto_col is not None:
-                if auto_col in batch.columns:
-                    t.observe_auto(np.asarray(
-                        batch.columns[auto_col].data, np.int64))
-                else:
-                    n = len(batch)
-                    from matrixone_tpu.container.vector import Vector
-                    batch.columns[auto_col] = Vector.from_values(
-                        [int(v) for v in t.allocate_auto(n)],
-                        schema_map[auto_col])
-            if self.txn is not None:
-                # LOAD inside BEGIN buffers in the txn workspace like any
-                # INSERT: ROLLBACK discards it, readers never see partials
+        # every chunk buffers in a txn workspace — explicit txn or a
+        # statement-scoped one — so a KILL (or any error) mid-file
+        # discards the WHOLE statement; chunk-at-a-time autocommit would
+        # leave a killed LOAD half-applied (MySQL rolls the statement back)
+        txn = self.txn or self.txn_client.begin()
+        try:
+            for rb in tbl.select(want).to_batches(max_chunksize=1 << 20):
+                # KILL cancels long LOAD DATA between chunks (MySQL KILL
+                # QUERY semantics; same preemption contract as _select)
+                self._procs.check_killed(self.conn_id)
+                batch = _B.from_arrow(rb, schema=schema_map)
+                if auto_col is not None:
+                    if auto_col in batch.columns:
+                        t.observe_auto(np.asarray(
+                            batch.columns[auto_col].data, np.int64))
+                    else:
+                        n = len(batch)
+                        from matrixone_tpu.container.vector import Vector
+                        batch.columns[auto_col] = Vector.from_values(
+                            [int(v) for v in t.allocate_auto(n)],
+                            schema_map[auto_col])
                 arrays, validity = t.batch_to_arrays(batch)
-                total += self.txn.write_batch(table, arrays, validity)
-            else:
-                total += t.insert_batch(batch)
+                total += txn.write_batch(table, arrays, validity)
+            if self.txn is None:
+                txn.commit()
+        except BaseException:
+            if self.txn is None:
+                txn.rollback()
+            raise
         return total
 
     # --------------------------------------------------------------- dml
@@ -916,6 +926,7 @@ class Session:
             op = compile_plan(proj, ctx)
             gids = []
             for ex in op.execute():
+                self._procs.check_killed(self.conn_id)   # KILL during DML
                 b = self._to_host(ex, proj.schema)
                 gids.extend(b.columns[ROWID].data.tolist())
             return np.asarray(gids, np.int64), None
@@ -943,6 +954,7 @@ class Session:
             op = compile_plan(proj, ctx)
             gids, new_cols = [], {c: [] for c, _ in schema}
             for ex in op.execute():
+                self._procs.check_killed(self.conn_id)   # KILL during DML
                 b = self._to_host(ex, proj.schema)
                 gids.extend(b.columns[ROWID].data.tolist())
                 for c, _ in schema:

@@ -66,15 +66,18 @@ def collect_table_stats(table) -> TableStats:
     for col, dtype in table.meta.schema:
         if dtype.is_vector:
             continue
-        parts, taken = [], 0
+        # spread the sample budget across ALL segments proportionally
+        # (a prefix of the earliest segments biases NDV/lo/hi for
+        # time-correlated inserts)
+        parts = []
         vparts = []
         for seg in table.segments:
-            if taken >= SAMPLE_CAP:
-                break
-            take = min(seg.n_rows, SAMPLE_CAP - taken)
+            if total <= SAMPLE_CAP:
+                take = seg.n_rows
+            else:
+                take = max(1, (SAMPLE_CAP * seg.n_rows) // total)
             parts.append(seg.arrays[col][:take])
             vparts.append(seg.validity[col][:take])
-            taken += take
         if not parts:
             cols[col] = ColumnStats(0.0, None, None, 0.0)
             continue
@@ -86,7 +89,10 @@ def collect_table_stats(table) -> TableStats:
             cols[col] = ColumnStats(0.0, None, None, 1.0)
             continue
         d = len(np.unique(valid))
-        ndv = _estimate_ndv(d, len(a), total)
+        # sample size = VALID values only (d counts distinct over valid);
+        # scale to the non-null population, not the raw row count
+        total_valid = max(1, round(total * (1.0 - null_frac)))
+        ndv = _estimate_ndv(d, len(valid), total_valid)
         if dtype.is_varlen:
             lo = hi = None
         else:

@@ -168,7 +168,11 @@ def _raw_col(name: str, qmap: Dict[str, str]) -> str:
     return qmap.get(name, name.split(".")[-1])
 
 
-def _lit_raw(lit: BoundLiteral, col_dtype) -> Optional[int]:
+def _lit_raw(lit: BoundLiteral, col_dtype):
+    """Literal in the partition key's raw domain.  Fractional floats are
+    returned as-is (NOT truncated): int(10.5)->10 would let `col < 10.5`
+    prune the partition holding col=10 — interval tests below run fine in
+    the float domain."""
     v = lit.value
     if isinstance(v, bool) or not isinstance(v, (int, float)):
         return None
@@ -178,6 +182,14 @@ def _lit_raw(lit: BoundLiteral, col_dtype) -> Optional[int]:
         if lit.dtype.oid == TypeOid.DECIMAL64 or lit.dtype.is_integer:
             return int(v * 10 ** (col_dtype.scale - ls))
         return None
+    if lit.dtype.oid == TypeOid.DECIMAL64 and (lit.dtype.scale or 0) > 0:
+        # decimal literal against an INTEGER partition column: descale the
+        # stored scaled-int (18.5 arrives as 185 @ scale 1); a raw int(v)
+        # here compared 185 against the partition bounds
+        fv = v / (10 ** lit.dtype.scale)
+        return int(fv) if float(fv).is_integer() else fv
+    if isinstance(v, float) and not v.is_integer():
+        return v
     return int(v)
 
 
@@ -191,6 +203,8 @@ def _prune_one(spec: PartitionSpec, f, qmap, col_dtype=None
         for v in f.values:
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 return None
+            if isinstance(v, float) and not v.is_integer():
+                continue                   # no integer key equals 10.5
             out |= _point(spec, int(v))
         return out
     if not (isinstance(f, BoundFunc)
@@ -210,8 +224,13 @@ def _prune_one(spec: PartitionSpec, f, qmap, col_dtype=None
     if lv is None:
         return None
     if spec.kind == "hash":
-        return _point(spec, lv) if op == "eq" else None
-    # range: map the predicate interval onto partition intervals
+        if op == "eq" and isinstance(lv, int):
+            return _point(spec, lv)
+        return None                        # fractional eq: no int matches;
+    #                                        conservative keep-all is safe
+    # range: map the predicate interval onto partition intervals; all
+    # comparisons are valid with lv int OR fractional float (partition
+    # members are the ints in [lo, hi), so "some x > lv" ⟺ hi-1 > lv)
     ends = [np.iinfo(np.int64).max if e is None else e for e in spec.bounds]
     starts = [np.iinfo(np.int64).min] + ends[:-1]
     out = set()
@@ -224,9 +243,9 @@ def _prune_one(spec: PartitionSpec, f, qmap, col_dtype=None
         elif op == "le":
             ok = lo <= lv
         elif op == "gt":
-            ok = hi > lv + 1               # some x in [lo,hi) with x > lv
+            ok = hi - 1 > lv               # some x in [lo,hi) with x > lv
         else:                              # ge
-            ok = hi > lv
+            ok = hi - 1 >= lv
         if ok:
             out.add(i)
     return out

@@ -54,6 +54,56 @@ def test_prune_range():
     assert prune(spec, both, qmap) == {1}
 
 
+def test_prune_range_fractional_literals():
+    """ADVICE r2 (high): int(10.5)->10 truncation let `k < 10.5` prune the
+    [10, 20) partition even though k=10 satisfies the predicate."""
+    spec = PartitionSpec("range", "k", ["p0", "p1", "p2"], [10, 20, None])
+    col = BoundCol("t.k", dt.INT64)
+
+    def f(op, v):
+        return [BoundFunc(op, [col, BoundLiteral(v, dt.FLOAT64)], dt.BOOL)]
+    qmap = {"t.k": "k"}
+    assert prune(spec, f("lt", 10.5), qmap) == {0, 1}   # k=10 matches
+    assert prune(spec, f("le", 10.5), qmap) == {0, 1}
+    assert prune(spec, f("gt", 19.5), qmap) == {2}      # only k>=20 match
+    assert prune(spec, f("ge", 19.5), qmap) == {2}
+    assert prune(spec, f("gt", 18.5), qmap) == {1, 2}   # k=19 matches
+    assert prune(spec, f("eq", 10.5), qmap) == {1}      # conservative keep
+    # integral float behaves exactly like the int literal
+    assert prune(spec, f("lt", 10.0), qmap) == {0}
+
+
+def test_prune_sql_decimal_literal_correct_rows():
+    """SQL binds 18.5 as DECIMAL64 (scaled int 185 @ scale 1); pruning an
+    INT64 partition column must descale it, not compare 185 against the
+    bounds (found by e2e drive: `k > 18.5` silently dropped k=19 rows)."""
+    c = Cluster()
+    s = c.session()
+    s.execute("create table pm (k bigint, v bigint) partition by range(k) ("
+              "partition p0 values less than (10), "
+              "partition p1 values less than (20), "
+              "partition p2 values less than (maxvalue))")
+    s.execute("insert into pm values "
+              + ",".join(f"({i % 30},{i})" for i in range(300)))
+    rows = [(i % 30, i) for i in range(300)]
+    for pred, keep in [("k > 18.5", lambda k: k > 18.5),
+                       ("k < 10.5", lambda k: k < 10.5),
+                       ("k >= 19.5", lambda k: k >= 19.5),
+                       ("k <= 9.5", lambda k: k <= 9.5)]:
+        got = s.execute(f"select count(*) from pm where {pred}").rows()[0][0]
+        want = sum(1 for k, _ in rows if keep(k))
+        assert got == want, (pred, got, want)
+
+
+def test_prune_hash_fractional_eq_no_prune():
+    spec = PartitionSpec("hash", "k", ["p0", "p1", "p2", "p3"])
+    col = BoundCol("t.k", dt.INT64)
+    qmap = {"t.k": "k"}
+    # eq against 7.5 can't match an integer key; keep-all is the safe call
+    assert prune(spec, [BoundFunc("eq", [col, BoundLiteral(7.5, dt.FLOAT64)],
+                                  dt.BOOL)], qmap) is None
+
+
 def test_prune_hash_eq_only():
     spec = PartitionSpec("hash", "k", ["p0", "p1", "p2", "p3"])
     col = BoundCol("t.k", dt.INT64)
