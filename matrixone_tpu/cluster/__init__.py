@@ -1,0 +1,26 @@
+"""CN/TN split: the reference's defining cluster shape, TPU-native.
+
+Reference analogue (what to match, not how):
+  * TN — one process owns storage, the commit pipeline, WAL, checkpoints
+    (`pkg/tnservice`, `pkg/vm/engine/tae`, tae/rpc/handle.go:547
+    HandleCommit) and generates the logtail push stream
+    (tae/logtail/service/server.go:192);
+  * CN — N stateless processes hold logtail-replayed partition state and
+    serve snapshot reads merging that state with shared-storage objects,
+    never touching the TN on the read path
+    (`pkg/vm/engine/disttae`, disttae/logtail_consumer.go:296).
+
+Redesign here: the TN's WAL record stream IS the logtail (one
+serialization, two consumers: durability + replication). A CN bootstraps
+from the shared checkpoint manifest + objectio objects, subscribes from
+its checkpoint ts, and applies records with the same WalApplier the
+restart replay uses. Writes from a CN ship the txn workspace to the TN
+(commit RPC); read-your-writes holds until the logtail catches up to the
+returned commit ts (the waitCanServeTableSnapshot gate,
+disttae/logtail_consumer.go:389).
+"""
+
+from matrixone_tpu.cluster.cn import CNService, LogtailConsumer, RemoteCatalog
+from matrixone_tpu.cluster.tn import TNService
+
+__all__ = ["TNService", "CNService", "LogtailConsumer", "RemoteCatalog"]

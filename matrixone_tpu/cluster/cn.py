@@ -1,0 +1,473 @@
+"""CN service: stateless compute node over logtail-replayed state.
+
+Reference analogue: `pkg/vm/engine/disttae` — the CN keeps per-table
+partition state replayed from the TN's logtail push stream
+(disttae/logtail_consumer.go:296 PushClient.init / apply loop), serves
+snapshot reads merging that state with shared-storage objects, ships its
+txn workspace to the TN at commit (txn/rpc CN->TN), and gates
+read-your-writes on the logtail catching up to the commit ts
+(logtail_consumer.go:389 waitCanServeTableSnapshot).
+
+Redesign: the replica is a full `Engine` built by `open_checkpoint`
+(manifest + objectio objects from shared storage, no WAL) and advanced
+record-by-record by `WalApplier` — the exact code path a TN restart
+replay uses, so CN state can never diverge from what a recovery would
+rebuild.  `RemoteCatalog` exposes the whole Engine surface to an
+unmodified `frontend.Session`: reads hit the replica, mutations become
+TN RPCs.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from matrixone_tpu.cluster.rpc import ERR_TYPES, pack_blobs
+from matrixone_tpu.logservice.replicated import _recv_msg, _send_msg
+from matrixone_tpu.storage import wal as walmod
+from matrixone_tpu.storage.engine import (Engine, WalApplier,
+                                          schema_to_json)
+from matrixone_tpu.storage.fileservice import FileService, LocalFS
+
+
+def _parse_addr(addr) -> tuple:
+    if isinstance(addr, (tuple, list)):
+        return addr[0], int(addr[1])
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)
+
+
+class _TNClient:
+    """One serialized request/response socket to the TN (morpc backend
+    analogue, minimum form). Reconnects once per call on failure."""
+
+    def __init__(self, addr, timeout: float = 30.0):
+        self.addr = _parse_addr(addr)
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection(self.addr, timeout=self.timeout)
+        s.settimeout(self.timeout)
+        return s
+
+    def call(self, header: dict, blob: bytes = b""):
+        with self._lock:
+            for attempt in (0, 1):
+                if self._sock is None:
+                    self._sock = self._connect()
+                try:
+                    _send_msg(self._sock, header, blob)
+                    return _recv_msg(self._sock)
+                except (OSError, ConnectionError):
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                    if attempt:
+                        raise
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+
+class LogtailConsumer:
+    """Subscribe to the TN's logtail and apply records into the replica.
+
+    Resubscribes from `applied_ts` after a TN restart (the CNs-resubscribe
+    half of the reference's logtail client). `wait_ts` is the
+    read-your-writes gate."""
+
+    def __init__(self, replica: Engine, addr):
+        self.replica = replica
+        self.addr = _parse_addr(addr)
+        self.applied_ts = replica._ckpt_ts
+        self.last_error: Optional[str] = None
+        self._cv = threading.Condition()
+        self._caught_up = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, timeout: float = 60.0) -> "LogtailConsumer":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        if not self._caught_up.wait(timeout):
+            raise TimeoutError("logtail subscription never caught up")
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # ------------------------------------------------------------ loop
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._consume_once()
+            except (OSError, ConnectionError):
+                # TN down or restarting: resubscribe from what we have
+                time.sleep(0.25)
+            except Exception as e:            # noqa: BLE001
+                # an apply error must NOT silently kill replication —
+                # surface it and resubscribe (the re-sent group may
+                # apply cleanly; persistent failures keep logging)
+                import sys
+                print(f"[cn-logtail] apply error, resubscribing: {e!r}",
+                      file=sys.stderr, flush=True)
+                self.last_error = repr(e)
+                time.sleep(1.0)
+
+    def _consume_once(self) -> None:
+        sock = socket.create_connection(self.addr, timeout=30.0)
+        sock.settimeout(1.0)
+        try:
+            _send_msg(sock, {"op": "subscribe", "from_ts": self.applied_ts})
+            applier = WalApplier(self.replica, skip_ts=self.applied_ts)
+            while not self._stop.is_set():
+                try:
+                    h, b = _recv_msg(sock)
+                except socket.timeout:
+                    continue
+                self._apply(applier, h, b)
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _apply(self, applier: WalApplier, h: dict, b: bytes) -> None:
+        op = h.get("op")
+        if op == "__caught_up__":
+            self._caught_up.set()
+            return
+        rep = self.replica
+        if op == "__resync__":
+            # our applied_ts predates the TN's last checkpoint: the
+            # records in the gap were truncated — rebuild the whole
+            # replica from the manifest, then stream from ckpt ts
+            self._resync_full()
+            self._advance(h.get("ts", 0), commit=True)
+            return
+        if op == "merge_table":
+            self._resync_table(h["name"])
+            self._advance(h.get("ts", 0), commit=True)
+            return
+        with rep._commit_lock:
+            ts = applier.apply(h, b)
+        if ts is not None:
+            self._advance(ts, commit=True)
+        elif op not in ("insert", "delete") and h.get("ts"):
+            self._advance(h["ts"], commit=False)
+
+    def _advance(self, ts: int, commit: bool) -> None:
+        rep = self.replica
+        with self._cv:
+            if commit and ts > rep.committed_ts:
+                rep.committed_ts = ts
+            rep.hlc.update(ts)
+            self.applied_ts = max(self.applied_ts, ts)
+            self._cv.notify_all()
+
+    def _resync_table(self, name: str) -> None:
+        """A TN merge rewrote the table's gids: rebuild from the fresh
+        manifest (written before the merge record was appended)."""
+        import json
+        rep = self.replica
+        manifest = json.loads(rep.fs.read("meta/manifest.json").decode())
+        with rep._commit_lock:
+            tm = manifest["tables"].get(name)
+            if tm is not None:
+                rep._load_manifest_table(name, tm, replace=True)
+            else:
+                rep.tables.pop(name, None)
+            for ix in rep.indexes_on(name):
+                ix.dirty = True     # gids changed under any local index
+
+    def _resync_full(self) -> None:
+        """Rebuild the whole replica from the latest manifest (the
+        subscribe gap was truncated away)."""
+        rep = self.replica
+        with rep._commit_lock:
+            rep.tables = {}
+            rep.snapshots = {}
+            rep.stages = {}
+            rep.publications = {}
+            rep.sources = set()
+            rep.dynamic_tables = {}
+            rep._load_checkpoint()
+            for ix in rep.indexes.values():
+                ix.dirty = True
+            rep.committed_ts = max(rep.committed_ts, rep._ckpt_ts)
+
+    # ------------------------------------------------------------ gate
+    def wait_ts(self, ts: int, timeout: float = 30.0) -> None:
+        with self._cv:
+            if not self._cv.wait_for(lambda: self.applied_ts >= ts,
+                                     timeout):
+                raise TimeoutError(
+                    f"logtail did not reach ts {ts} within {timeout}s "
+                    f"(applied {self.applied_ts})")
+
+
+class _TableProxy:
+    """Replica table + write-path interception: auto-increment allocation
+    is a TN RPC (pkg/incrservice — a per-CN counter would collide), and
+    autocommit inserts ship to the TN commit pipeline."""
+
+    def __init__(self, rc: "RemoteCatalog", t):
+        object.__setattr__(self, "_rc", rc)
+        object.__setattr__(self, "_t", t)
+
+    def __getattr__(self, k):
+        return getattr(object.__getattribute__(self, "_t"), k)
+
+    def __setattr__(self, k, v):
+        setattr(object.__getattribute__(self, "_t"), k, v)
+
+    def allocate_auto(self, n: int) -> np.ndarray:
+        resp = self._rc._call({"op": "alloc_auto",
+                               "table": self._t.meta.name, "n": int(n)})
+        return np.asarray(resp["vals"], np.int64)
+
+    def observe_auto(self, values) -> None:
+        vals = np.asarray(values).tolist()
+        if vals:
+            self._rc._call({"op": "observe_auto",
+                            "table": self._t.meta.name, "vals": vals})
+
+    def insert_batch(self, batch) -> int:
+        arrays, validity = self._t.batch_to_arrays(batch)
+        return self._rc.commit_write(self._t.meta.name, arrays, validity)
+
+    def insert_numpy(self, arrays, validity=None, strings=None) -> int:
+        t = self._t
+        strings = strings or {}
+        full, val = {}, {}
+        n = None
+        for col, dtype in t.meta.schema:
+            if dtype.is_varlen:
+                codes, cats = strings[col]
+                arr = t.remap_codes(col, codes, cats)
+            else:
+                arr = np.asarray(arrays[col], dtype=dtype.np_dtype)
+            if n is None:
+                n = len(arr)
+            full[col] = arr
+            v = None if validity is None else validity.get(col)
+            val[col] = v.copy() if v is not None else np.ones(n, np.bool_)
+        return self._rc.commit_write(t.meta.name, full, val)
+
+
+class RemoteCatalog:
+    """The Engine surface for a CN session: reads -> replica, mutations ->
+    TN RPC + logtail wait. An unmodified `frontend.Session` runs on it."""
+
+    def __init__(self, tn_addr, fs: Optional[FileService] = None,
+                 data_dir: Optional[str] = None):
+        if fs is None:
+            fs = LocalFS(data_dir)
+        self._replica = Engine.open_checkpoint(fs)
+        self._client = _TNClient(tn_addr)
+        self.consumer = LogtailConsumer(self._replica, tn_addr).start()
+        # CN-local open-txn counter (txn/client.py increments it through
+        # this object); guards merge forwarding below.  Cross-CN open
+        # txns are NOT visible here — see merge_table's caveat.
+        self.active_txns = 0
+
+    def close(self) -> None:
+        self.consumer.stop()
+        self._client.close()
+
+    # --------------------------------------------------------- plumbing
+    def __getattr__(self, k):
+        # reads and shared state (tables, committed_ts, hlc, locks, fs,
+        # index_cache, _commit_lock, ...) come from the replica
+        return getattr(self._replica, k)
+
+    def _call(self, header: dict, blob: bytes = b"") -> dict:
+        resp, _ = self._client.call(header, blob)
+        if not resp.get("ok"):
+            err = resp.get("err", "TN error")
+            raise ERR_TYPES.get(resp.get("etype"), ValueError)(err)
+        return resp
+
+    def _ddl(self, record: dict) -> dict:
+        resp = self._call({"op": "ddl", "record": record})
+        self.consumer.wait_ts(resp["applied_ts"])
+        return resp
+
+    def get_table(self, name: str):
+        return _TableProxy(self, self._replica.get_table(name))
+
+    def get_table_meta(self, name: str):
+        return self._replica.get_table_meta(name)
+
+    # ------------------------------------------------------------ writes
+    def commit_write(self, table: str, arrays, validity) -> int:
+        return self.commit_txn(None, {table: [(arrays, validity)]}, {})
+
+    def commit_txn(self, snapshot_ts, inserts: Dict[str, list],
+                   deletes: Dict[str, np.ndarray]) -> int:
+        """Ship the workspace to the TN (txn/rpc sender -> tae/rpc
+        HandleCommit). Varchar columns travel as decoded strings — CN and
+        TN dictionaries evolve independently (each is only locally
+        consistent, same as WAL records)."""
+        tables, blobs = [], []
+        for tname, segs in inserts.items():
+            t = self._replica.get_table(tname)
+            varlen = {c for c, d in t.meta.schema if d.is_varlen}
+            for arrays, validity in segs:
+                enc = {}
+                for c, a in arrays.items():
+                    if c in varlen:
+                        lut = t.dicts[c]
+                        v = np.asarray(validity[c])
+                        enc[c] = [lut[int(code)] if ok else None
+                                  for code, ok in zip(
+                                      np.asarray(a).tolist(), v.tolist())]
+                    else:
+                        enc[c] = np.asarray(a)
+                blobs.append(walmod.arrays_to_arrow(enc, validity))
+                tables.append(tname)
+        header = {
+            "op": "commit", "snapshot_ts": snapshot_ts, "tables": tables,
+            "deletes": {t: np.asarray(g, np.int64).tolist()
+                        for t, g in deletes.items()},
+        }
+        resp = self._call(header, pack_blobs(blobs))
+        # read-your-writes: block until our own commit is applied locally
+        self.consumer.wait_ts(resp["ts"])
+        return resp["affected"]
+
+    # --------------------------------------------------------------- ddl
+    def create_table(self, meta, if_not_exists=False, log=True) -> None:
+        self._ddl({
+            "op": "create_table", "name": meta.name,
+            "schema": schema_to_json(meta.schema),
+            "pk": meta.primary_key, "auto": meta.auto_increment,
+            "not_null": meta.not_null,
+            "partition": (meta.partition.to_json()
+                          if meta.partition is not None else None),
+            "if_not_exists": if_not_exists})
+
+    def drop_table(self, name: str, if_exists=False, log=True) -> None:
+        if name not in self._replica.tables and if_exists:
+            return
+        self._ddl({"op": "drop_table", "name": name,
+                   "if_exists": if_exists})
+
+    def create_external(self, meta, location: str, fmt: str, log=True,
+                        if_not_exists: bool = False) -> None:
+        self._ddl({"op": "create_external", "name": meta.name,
+                   "schema": schema_to_json(meta.schema),
+                   "location": location, "fmt": fmt,
+                   "if_not_exists": if_not_exists})
+
+    def create_publication(self, name, tables, log=True) -> None:
+        self._ddl({"op": "create_publication", "name": name,
+                   "tables": list(tables)})
+
+    def drop_publication(self, name, log=True) -> None:
+        self._ddl({"op": "drop_publication", "name": name})
+
+    def mark_source(self, name, log=True) -> None:
+        self._ddl({"op": "mark_source", "name": name})
+
+    def register_dynamic(self, name, sql, log=True) -> None:
+        self._ddl({"op": "create_dynamic", "name": name, "sql": sql})
+
+    def create_stage(self, name, url, log=True) -> None:
+        self._ddl({"op": "create_stage", "name": name, "url": url})
+
+    def drop_stage(self, name, log=True) -> None:
+        self._ddl({"op": "drop_stage", "name": name})
+
+    def alter_partition_drop(self, table, part, log=True) -> None:
+        self._ddl({"op": "alter_partition_drop", "table": table,
+                   "part": part})
+
+    def drop_snapshot(self, name) -> None:
+        self._ddl({"op": "drop_snapshot", "name": name})
+
+    def create_snapshot(self, name) -> int:
+        resp = self._call({"op": "create_snapshot", "name": name})
+        self.consumer.wait_ts(resp["applied_ts"])
+        return resp["ts"]
+
+    def restore_table(self, table: str, ts: int) -> int:
+        resp = self._call({"op": "restore_table", "table": table,
+                           "ts": int(ts)})
+        self.consumer.wait_ts(resp["applied_ts"])
+        return resp["affected"]
+
+    def merge_table(self, name: str, min_segments: int = 2,
+                    checkpoint: bool = True) -> int:
+        """Forwarded to the TN; the logtail merge record triggers a local
+        resync.  Deferred (-2, same contract as Engine.merge_table) while
+        THIS CN has open transactions — their pinned snapshots would see
+        zero rows once the resync replaces the table.  Caveat: open txns
+        on OTHER CNs are not visible here; a cluster-wide guard needs txn
+        registration on the TN (reference: TAE tracks active txns
+        centrally because commit runs there)."""
+        if self.active_txns > 0:
+            return -2
+        resp = self._call({"op": "merge_table", "name": name,
+                           "min_segments": min_segments})
+        self.consumer.wait_ts(resp["applied_ts"])
+        return resp["kept"]
+
+    def checkpoint(self) -> None:
+        self._call({"op": "checkpoint"})
+
+
+class CNService:
+    """One CN process: replica + logtail consumer + MySQL wire server."""
+
+    def __init__(self, tn_addr, fs: Optional[FileService] = None,
+                 data_dir: Optional[str] = None, port: int = 0,
+                 users: Optional[dict] = None, insecure: bool = True):
+        from matrixone_tpu.frontend.server import MOServer
+        self.catalog = RemoteCatalog(tn_addr, fs=fs, data_dir=data_dir)
+        self.server = MOServer(engine=self.catalog, port=port,
+                               users=users, insecure=insecure)
+
+    def start(self) -> "CNService":
+        self.server.start()
+        return self
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    def stop(self) -> None:
+        self.server.stop()
+        self.catalog.close()
+
+
+def main() -> None:
+    import argparse
+    import sys
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tn", required=True, help="host:port of the TN")
+    ap.add_argument("--dir", required=True, help="shared storage dir")
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args()
+    cn = CNService(args.tn, data_dir=args.dir, port=args.port).start()
+    print(f"PORT {cn.port}", flush=True)
+    sys.stdout.flush()
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

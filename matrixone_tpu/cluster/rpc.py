@@ -1,0 +1,37 @@
+"""Shared wire helpers for the CN<->TN RPC: blob framing and error-type
+mapping. One definition — the framing is a cross-process protocol and
+hand-maintained copies would drift."""
+
+from __future__ import annotations
+
+import struct
+from typing import List
+
+from matrixone_tpu.storage.engine import (ConflictError, ConstraintError,
+                                          DuplicateKeyError)
+
+ERR_TYPES = {"conflict": ConflictError, "duplicate": DuplicateKeyError,
+             "constraint": ConstraintError}
+
+
+def err_name(e: Exception) -> str:
+    if isinstance(e, ConflictError):
+        return "conflict"
+    if isinstance(e, DuplicateKeyError):
+        return "duplicate"
+    if isinstance(e, ConstraintError):
+        return "constraint"
+    return "error"
+
+
+def pack_blobs(blobs: List[bytes]) -> bytes:
+    return b"".join(struct.pack("<I", len(b)) + b for b in blobs)
+
+
+def unpack_blobs(blob: bytes) -> List[bytes]:
+    out, off = [], 0
+    while off + 4 <= len(blob):
+        (n,) = struct.unpack_from("<I", blob, off)
+        out.append(blob[off + 4:off + 4 + n])
+        off += 4 + n
+    return out

@@ -1,0 +1,313 @@
+"""TN service: the storage/commit owner + logtail push server.
+
+Reference analogue: `pkg/tnservice` + `tae/rpc/handle.go:537,547`
+(HandlePreCommitWrite/HandleCommit — CN commits arrive over RPC) and
+`tae/logtail/service/server.go:192` (logtail push server fanning commit
+deltas to subscribed CNs). The transport is the same length-prefixed
+JSON+blob framing the log replicas use — one fabric, every role.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from matrixone_tpu.logservice.replicated import _recv_msg, _send_msg
+from matrixone_tpu.storage import wal as walmod
+from matrixone_tpu.storage.engine import (ConflictError, ConstraintError,
+                                          DuplicateKeyError, Engine)
+from matrixone_tpu.storage.fileservice import FileService, LocalFS
+
+
+class LogtailHub:
+    """Tee over the engine's WAL: every append is durable (inner wal) AND
+    fanned out to subscriber queues — the logtail stream is the WAL
+    stream (tae/logtail derives its stream from the commit pipeline).
+
+    subscribe() snapshots the backlog and registers the live queue under
+    ONE lock, so no record can fall between backlog and stream."""
+
+    def __init__(self, wal):
+        self.wal = wal
+        self.last_ts = 0
+        self._subs: List[queue.Queue] = []
+        self._lock = threading.RLock()
+
+    # ---- WalWriter interface (engine-facing)
+    def append(self, header: dict, arrow_blob: bytes = b"") -> None:
+        with self._lock:
+            self.wal.append(header, arrow_blob)
+            self.last_ts = max(self.last_ts, header.get("ts", 0))
+            for q in self._subs:
+                q.put((header, arrow_blob))
+
+    def truncate(self) -> None:
+        with self._lock:
+            self.wal.truncate()
+
+    def replay(self):
+        return self.wal.replay()
+
+    # ---- logtail side
+    def subscribe(self, from_ts: int) -> Tuple[list, queue.Queue]:
+        """Records after from_ts, in WAL order. A subscribe landing
+        mid-commit-group may end the backlog with dangling insert/delete
+        records — the consumer's WalApplier buffers those until the
+        commit record arrives on the live queue (same contract as a
+        restart replay hitting a torn tail)."""
+        with self._lock:
+            backlog = []
+            for h, b in self.wal.replay():
+                hts = h.get("ts", 0)
+                if hts and hts <= from_ts:
+                    continue
+                backlog.append((h, b))
+            q = queue.Queue()
+            self._subs.append(q)
+            return backlog, q
+
+    def unsubscribe(self, q: queue.Queue) -> None:
+        with self._lock:
+            self._subs = [s for s in self._subs if s is not q]
+
+
+from matrixone_tpu.cluster.rpc import err_name as _err_name, unpack_blobs
+
+
+class TNService:
+    """One TN process: Engine + commit RPC + logtail push + DDL apply."""
+
+    def __init__(self, fs: Optional[FileService] = None,
+                 data_dir: Optional[str] = None, port: int = 0, wal=None):
+        if fs is None:
+            fs = LocalFS(data_dir)
+        self.engine = Engine.open(fs, wal=wal)
+        self.hub = LogtailHub(self.engine.wal)
+        self.engine.wal = self.hub
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(64)
+        self._stopping = threading.Event()
+
+    # ------------------------------------------------------------- serve
+    def start(self) -> "TNService":
+        threading.Thread(target=self.serve_forever, daemon=True).start()
+        return self
+
+    def serve_forever(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def stop(self) -> None:
+        self._stopping.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # ----------------------------------------------------------- handlers
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                header, blob = _recv_msg(conn)
+                op = header.get("op")
+                if op == "subscribe":
+                    self._serve_logtail(conn, header.get("from_ts", 0))
+                    return
+                try:
+                    resp, rblob = self._dispatch(op, header, blob)
+                except Exception as e:        # noqa: BLE001
+                    resp, rblob = {"ok": False, "err": str(e),
+                                   "etype": _err_name(e)}, b""
+                _send_msg(conn, resp, rblob)
+                if op == "stop":
+                    import os
+                    os._exit(0)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, op: str, header: dict, blob: bytes):
+        eng = self.engine
+        if op == "ping":
+            return {"ok": True, "committed_ts": eng.committed_ts,
+                    "ckpt_ts": eng._ckpt_ts}, b""
+        if op == "commit":
+            return self._handle_commit(header, blob), b""
+        if op == "ddl":
+            return self._handle_ddl(header["record"]), b""
+        if op == "alloc_auto":
+            t = eng.get_table(header["table"])
+            vals = t.allocate_auto(int(header["n"]))
+            return {"ok": True,
+                    "vals": np.asarray(vals).tolist()}, b""
+        if op == "observe_auto":
+            t = eng.get_table(header["table"])
+            t.observe_auto(np.asarray(header["vals"], np.int64))
+            return {"ok": True}, b""
+        if op == "create_snapshot":
+            ts = eng.create_snapshot(header["name"])
+            return {"ok": True, "ts": ts,
+                    "applied_ts": self.hub.last_ts}, b""
+        if op == "restore_table":
+            n = eng.restore_table(header["table"], int(header["ts"]))
+            return {"ok": True, "affected": n,
+                    "applied_ts": self.hub.last_ts}, b""
+        if op == "merge_table":
+            kept = eng.merge_table(header["name"],
+                                   min_segments=header.get("min_segments",
+                                                           2))
+            return {"ok": True, "kept": kept,
+                    "applied_ts": self.hub.last_ts}, b""
+        if op == "checkpoint":
+            eng.checkpoint()
+            return {"ok": True}, b""
+        if op == "stop":
+            return {"ok": True}, b""
+        return {"ok": False, "err": f"bad op {op}"}, b""
+
+    def _handle_commit(self, header: dict, blob: bytes) -> dict:
+        """tae/rpc/handle.go:547 HandleCommit: rebuild the shipped
+        workspace, re-encode strings into TN dictionaries, run the
+        authoritative commit pipeline.  The whole rebuild+commit runs
+        under the commit lock (reentrant) so two CN connection threads
+        cannot interleave dictionary encoding with each other's commit."""
+        eng = self.engine
+        with eng._commit_lock:
+            blobs = unpack_blobs(blob)
+            inserts: Dict[str, list] = {}
+            for tname, b in zip(header.get("tables", []), blobs):
+                t = eng.get_table(tname)
+                arrays, validity = walmod.arrow_to_arrays(b)
+                for c, a in list(arrays.items()):
+                    if isinstance(a, list):   # varchar shipped as strings
+                        arrays[c] = t.encode_strings_list(c, a)
+                inserts.setdefault(tname, []).append((arrays, validity))
+            deletes = {t: np.asarray(g, np.int64)
+                       for t, g in header.get("deletes", {}).items()}
+            try:
+                affected = eng.commit_txn(header.get("snapshot_ts"),
+                                          inserts, deletes)
+            except (ConflictError, DuplicateKeyError,
+                    ConstraintError) as e:
+                return {"ok": False, "err": str(e), "etype": _err_name(e)}
+            return {"ok": True, "affected": affected,
+                    "ts": eng.committed_ts}
+
+    def _handle_ddl(self, rec: dict) -> dict:
+        """Catalog mutation forwarded from a CN. Applied through the
+        real engine methods with log=True, so the WAL record streams to
+        every subscriber (including the requesting CN, which applies it
+        exactly as restart replay would)."""
+        from matrixone_tpu.sql.binder import BindError  # noqa: F401
+        from matrixone_tpu.storage.engine import (TableMeta,
+                                                  schema_from_json)
+        from matrixone_tpu.storage.partition import PartitionSpec
+        eng = self.engine
+        op = rec["op"]
+        if op == "create_table":
+            eng.create_table(
+                TableMeta(rec["name"], schema_from_json(rec["schema"]),
+                          rec.get("pk") or [],
+                          auto_increment=rec.get("auto"),
+                          not_null=rec.get("not_null", []),
+                          partition=PartitionSpec.from_json(
+                              rec.get("partition"))),
+                if_not_exists=rec.get("if_not_exists", False))
+        elif op == "drop_table":
+            eng.drop_table(rec["name"], if_exists=rec.get("if_exists",
+                                                          False))
+        elif op == "create_external":
+            eng.create_external(
+                TableMeta(rec["name"], schema_from_json(rec["schema"]),
+                          []),
+                rec["location"], rec["fmt"],
+                if_not_exists=rec.get("if_not_exists", False))
+        elif op == "create_stage":
+            eng.create_stage(rec["name"], rec["url"])
+        elif op == "drop_stage":
+            eng.drop_stage(rec["name"])
+        elif op == "create_publication":
+            eng.create_publication(rec["name"], list(rec["tables"]))
+        elif op == "drop_publication":
+            eng.drop_publication(rec["name"])
+        elif op == "mark_source":
+            eng.mark_source(rec["name"])
+        elif op == "create_dynamic":
+            eng.register_dynamic(rec["name"], rec["sql"])
+        elif op == "drop_snapshot":
+            eng.drop_snapshot(rec["name"])
+        elif op == "alter_partition_drop":
+            eng.alter_partition_drop(rec["table"], rec["part"])
+        else:
+            return {"ok": False, "err": f"bad ddl {op}"}
+        return {"ok": True, "applied_ts": self.hub.last_ts}
+
+    def _serve_logtail(self, conn: socket.socket, from_ts: int) -> None:
+        """Backlog then live push; the connection becomes one-way.
+
+        If the subscriber's from_ts predates the last checkpoint, the
+        records it needs were truncated — it must rebuild from the
+        manifest first (__resync__), then stream from the checkpoint ts.
+        The retry loop closes the race against a checkpoint truncating
+        the WAL between reading _ckpt_ts and registering the queue."""
+        while True:
+            ck = self.engine._ckpt_ts
+            eff_ts = max(from_ts, ck)
+            backlog, q = self.hub.subscribe(eff_ts)
+            if self.engine._ckpt_ts == ck:
+                break
+            self.hub.unsubscribe(q)
+        try:
+            if ck > from_ts:
+                _send_msg(conn, {"op": "__resync__", "ts": ck})
+            for h, b in backlog:
+                _send_msg(conn, h, b)
+            _send_msg(conn, {"op": "__caught_up__",
+                             "ts": self.engine.committed_ts})
+            while not self._stopping.is_set():
+                try:
+                    h, b = q.get(timeout=1.0)
+                except queue.Empty:
+                    continue
+                _send_msg(conn, h, b)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self.hub.unsubscribe(q)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def main() -> None:
+    import argparse
+    import sys
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args()
+    tn = TNService(data_dir=args.dir, port=args.port)
+    print(f"PORT {tn.port}", flush=True)
+    sys.stdout.flush()
+    tn.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

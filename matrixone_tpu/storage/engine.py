@@ -161,32 +161,38 @@ class MVCCTable:
         return total - dead
 
     # -------------------------------------------------------- dict encode
+    # Both encoders run under the engine commit lock (reentrant): the
+    # check-then-append on the dictionary must not interleave between a
+    # session thread and a concurrent committer / the CN logtail
+    # consumer — two strings sharing one code is silent data corruption.
     def encode_strings_list(self, col: str, values) -> np.ndarray:
-        lut, d = self._dict_idx[col], self.dicts[col]
-        out = np.zeros(len(values), dtype=np.int32)
-        for i, s in enumerate(values):
-            if s is None:
-                continue
-            code = lut.get(s)
-            if code is None:
-                code = len(d)
-                lut[s] = code
-                d.append(s)
-            out[i] = code
-        return out
+        with self.engine._commit_lock:
+            lut, d = self._dict_idx[col], self.dicts[col]
+            out = np.zeros(len(values), dtype=np.int32)
+            for i, s in enumerate(values):
+                if s is None:
+                    continue
+                code = lut.get(s)
+                if code is None:
+                    code = len(d)
+                    lut[s] = code
+                    d.append(s)
+                out[i] = code
+            return out
 
     def remap_codes(self, col: str, codes: np.ndarray, cats: List[str]
                     ) -> np.ndarray:
-        lut, d = self._dict_idx[col], self.dicts[col]
-        remap = np.empty(len(cats), dtype=np.int32)
-        for i, s in enumerate(cats):
-            code = lut.get(s)
-            if code is None:
-                code = len(d)
-                lut[s] = code
-                d.append(s)
-            remap[i] = code
-        return remap[np.asarray(codes, dtype=np.int64)]
+        with self.engine._commit_lock:
+            lut, d = self._dict_idx[col], self.dicts[col]
+            remap = np.empty(len(cats), dtype=np.int32)
+            for i, s in enumerate(cats):
+                code = lut.get(s)
+                if code is None:
+                    code = len(d)
+                    lut[s] = code
+                    d.append(s)
+                remap[i] = code
+            return remap[np.asarray(codes, dtype=np.int64)]
 
     def batch_to_arrays(self, batch: Batch):
         arrays, validity = {}, {}
@@ -585,7 +591,11 @@ class Engine:
         self.hlc = HLC()
         self.tables: Dict[str, MVCCTable] = {}
         self.indexes: Dict[str, IndexMeta] = {}
-        self._commit_lock = threading.Lock()
+        # RLock: the commit pipeline calls table helpers (observe_auto)
+        # that take the lock themselves, and the CN logtail consumer
+        # applies whole commit groups under it — same-thread
+        # re-acquisition must not deadlock
+        self._commit_lock = threading.RLock()
         self._subscribers: List[Callable] = []   # logtail analogue
         self._ckpt_ts = 0
         self.snapshots: Dict[str, int] = {}      # Git-for-data named points
@@ -601,6 +611,7 @@ class Engine:
         from matrixone_tpu.vectorindex.cache import IndexCache
         self.index_cache = IndexCache()   # budgeted device-index residency
         self.active_txns = 0           # open explicit txns (merge guard)
+        self._pending_merge_records: Dict[str, int] = {}   # name -> merge ts
 
     # ----------------------------------------------------------- catalog
     def create_table(self, meta: TableMeta, if_not_exists=False,
@@ -962,9 +973,15 @@ class Engine:
             self.committed_ts = max(self.committed_ts, merge_ts)
             for ix in self.indexes_on(name):
                 ix.dirty = True       # gids changed: indexes must rebuild
+            # merge rewrites gids, which invalidates CN replicas built
+            # from the logtail — queue the announcement; _checkpoint_locked
+            # emits it AFTER the manifest is durable so a consumer
+            # resyncing the table reads post-merge state.  Batched-merge
+            # callers (checkpoint=False + one checkpoint()) get their
+            # records at that later checkpoint — same ordering guarantee.
+            self._pending_merge_records[name] = merge_ts
             # durability: the merged state IS the new truth — checkpoint
-            # so replay never resurrects pre-merge rows (callers merging
-            # many tables batch this: checkpoint=False + one checkpoint)
+            # so replay never resurrects pre-merge rows
             if checkpoint:
                 self._checkpoint_locked()
             return kept
@@ -1022,136 +1039,218 @@ class Engine:
                       json.dumps(manifest).encode())
         self.wal.truncate()
         self._ckpt_ts = manifest["ckpt_ts"]
+        # announce merges only once their post-merge manifest is durable
+        # (CN replicas resync the table from it)
+        for nm, ts in self._pending_merge_records.items():
+            self.wal.append({"op": "merge_table", "name": nm, "ts": ts})
+        self._pending_merge_records = {}
 
     @classmethod
     def open(cls, fs: FileService, wal=None) -> "Engine":
         """Restart path: load last checkpoint then replay the WAL tail
         (tae/db/replay.go analogue)."""
         eng = cls(fs, wal=wal)
-        if fs.exists("meta/manifest.json"):
-            manifest = json.loads(fs.read("meta/manifest.json").decode())
-            eng._ckpt_ts = manifest.get("ckpt_ts", 0)
-            eng.snapshots = dict(manifest.get("snapshots", {}))
-            eng.stages = dict(manifest.get("stages", {}))
-            eng.publications = {k: list(v) for k, v in
-                                manifest.get("publications", {}).items()}
-            eng.sources = set(manifest.get("sources", []))
-            eng.dynamic_tables = dict(manifest.get("dynamic_tables", {}))
-            eng.hlc.update(eng._ckpt_ts)
-            for name, ex in manifest.get("externals", {}).items():
-                schema = schema_from_json(ex["schema"])
-                eng.create_external(TableMeta(name, schema, []),
-                                    ex["location"], ex["fmt"], log=False)
-            for name, tm in manifest["tables"].items():
-                schema = schema_from_json(tm["schema"])
-                from matrixone_tpu.storage.partition import PartitionSpec
-                eng.create_table(
-                    TableMeta(name, schema, tm["pk"],
-                              auto_increment=tm.get("auto"),
-                              not_null=tm.get("not_null", []),
-                              partition=PartitionSpec.from_json(
-                                  tm.get("partition"))),
-                    log=False)
-                t = eng.get_table(name)
-                t.dicts = {k: list(v) for k, v in tm["dicts"].items()}
-                t._dict_idx = {k: {s_: i for i, s_ in enumerate(v)}
-                               for k, v in t.dicts.items()}
-                for ob in tm["objects"]:
-                    meta, arrays, validity = objectio.read_object(
-                        fs, ob["path"])
-                    seg = Segment(seg_id=ob["seg_id"],
-                                  commit_ts=ob["commit_ts"],
-                                  arrays=arrays, validity=validity,
-                                  n_rows=meta.n_rows,
-                                  base_gid=ob["base_gid"],
-                                  part_id=ob.get("part_id", -1))
-                    t.apply_segment(seg)
-                t.tombstones = [(ts, np.asarray(g, np.int64))
-                                for ts, g in tm["tombstones"]]
-                t.next_gid = tm["next_gid"]
-                t.next_seg = tm["next_seg"]
-                # incrservice state: older manifests predate the field —
-                # fall back to scanning the committed auto column
-                if "next_auto" in tm:
-                    t.next_auto = tm["next_auto"]
-                elif t.meta.auto_increment:
-                    for seg in t.segments:
-                        t.observe_auto(seg.arrays[t.meta.auto_increment][
-                            seg.validity[t.meta.auto_increment]])
+        eng._load_checkpoint()
         eng._replay_wal()
         eng.committed_ts = eng.hlc.now()
         return eng
 
+    @classmethod
+    def open_checkpoint(cls, fs: FileService) -> "Engine":
+        """CN bootstrap path: base state = last checkpoint manifest +
+        objects ONLY — the WAL tail belongs to the TN and reaches a CN as
+        the logtail stream, never by reading the log directly
+        (disttae/logtail_consumer.go:296 subscribes from the replayed
+        checkpoint ts). The replica never appends: its wal is a no-op."""
+        eng = cls(fs, wal=_NullWal())
+        eng._load_checkpoint()
+        eng.committed_ts = max(eng._ckpt_ts, eng.committed_ts)
+        return eng
+
+    def _load_checkpoint(self) -> None:
+        fs = self.fs
+        if not fs.exists("meta/manifest.json"):
+            return
+        manifest = json.loads(fs.read("meta/manifest.json").decode())
+        self._ckpt_ts = manifest.get("ckpt_ts", 0)
+        self.snapshots = dict(manifest.get("snapshots", {}))
+        self.stages = dict(manifest.get("stages", {}))
+        self.publications = {k: list(v) for k, v in
+                             manifest.get("publications", {}).items()}
+        self.sources = set(manifest.get("sources", []))
+        self.dynamic_tables = dict(manifest.get("dynamic_tables", {}))
+        self.hlc.update(self._ckpt_ts)
+        for name, ex in manifest.get("externals", {}).items():
+            schema = schema_from_json(ex["schema"])
+            self.create_external(TableMeta(name, schema, []),
+                                 ex["location"], ex["fmt"], log=False)
+        for name, tm in manifest["tables"].items():
+            self._load_manifest_table(name, tm)
+
+    def _load_manifest_table(self, name: str, tm: dict,
+                             replace: bool = False) -> None:
+        """Materialize one table from its manifest entry (open path; also
+        the CN resync path after a TN merge rewrote gids)."""
+        from matrixone_tpu.storage.partition import PartitionSpec
+        schema = schema_from_json(tm["schema"])
+        if replace:
+            self.tables.pop(name, None)
+        self.create_table(
+            TableMeta(name, schema, tm["pk"],
+                      auto_increment=tm.get("auto"),
+                      not_null=tm.get("not_null", []),
+                      partition=PartitionSpec.from_json(
+                          tm.get("partition"))),
+            log=False)
+        t = self.get_table(name)
+        t.dicts = {k: list(v) for k, v in tm["dicts"].items()}
+        t._dict_idx = {k: {s_: i for i, s_ in enumerate(v)}
+                       for k, v in t.dicts.items()}
+        for ob in tm["objects"]:
+            meta, arrays, validity = objectio.read_object(
+                self.fs, ob["path"])
+            seg = Segment(seg_id=ob["seg_id"],
+                          commit_ts=ob["commit_ts"],
+                          arrays=arrays, validity=validity,
+                          n_rows=meta.n_rows,
+                          base_gid=ob["base_gid"],
+                          part_id=ob.get("part_id", -1))
+            t.apply_segment(seg)
+        t.tombstones = [(ts, np.asarray(g, np.int64))
+                        for ts, g in tm["tombstones"]]
+        t.next_gid = tm["next_gid"]
+        t.next_seg = tm["next_seg"]
+        # incrservice state: older manifests predate the field —
+        # fall back to scanning the committed auto column
+        if "next_auto" in tm:
+            t.next_auto = tm["next_auto"]
+        elif t.meta.auto_increment:
+            for seg in t.segments:
+                t.observe_auto(seg.arrays[t.meta.auto_increment][
+                    seg.validity[t.meta.auto_increment]])
+
     def _replay_wal(self) -> None:
-        pending: List[tuple] = []
-        max_ts = self._ckpt_ts
+        ap = WalApplier(self, skip_ts=self._ckpt_ts)
         for header, blob in self.wal.replay():
-            op = header["op"]
-            # frames at or before the checkpoint are already materialized in
-            # the manifest (crash window between manifest write and WAL
-            # truncation) — skip them
-            hts = header.get("ts", 0)
-            if hts and hts <= self._ckpt_ts:
-                continue
-            if op == "create_table":
-                from matrixone_tpu.storage.partition import PartitionSpec
-                schema = schema_from_json(header["schema"])
-                self.create_table(
-                    TableMeta(header["name"], schema, header["pk"],
-                              auto_increment=header.get("auto"),
-                              not_null=header.get("not_null", []),
-                              partition=PartitionSpec.from_json(
-                                  header.get("partition"))),
-                    log=False, if_not_exists=True)
-            elif op == "drop_table":
-                self.drop_table(header["name"], if_exists=True, log=False)
-            elif op == "alter_partition_drop":
-                self.alter_partition_drop(header["table"], header["part"],
-                                          log=False)
-            elif op == "create_external":
-                schema = schema_from_json(header["schema"])
-                self.create_external(TableMeta(header["name"], schema, []),
-                                     header["location"], header["fmt"],
-                                     log=False, if_not_exists=True)
-            elif op == "create_stage":
-                self.stages[header["name"]] = header["url"]
-            elif op == "drop_stage":
-                self.stages.pop(header["name"], None)
-            elif op == "create_publication":
-                self.publications[header["name"]] = list(header["tables"])
-            elif op == "drop_publication":
-                self.publications.pop(header["name"], None)
-            elif op == "mark_source":
-                self.sources.add(header["name"])
-            elif op == "create_dynamic":
-                self.dynamic_tables[header["name"]] = header["sql"]
-            elif op == "create_snapshot":
-                self.snapshots[header["name"]] = header["ts"]
-            elif op == "drop_snapshot":
-                self.snapshots.pop(header["name"], None)
-            elif op == "insert":
-                pending.append(("insert", header, blob))
-            elif op == "delete":
-                pending.append(("delete", header, None))
-            elif op == "commit":
-                ts = header["ts"]
-                max_ts = max(max_ts, ts)
-                for kind, h, b in pending:
-                    t = self.get_table(h["table"])
-                    if kind == "insert":
-                        arrays, validity = walmod.arrow_to_arrays(b)
-                        for c, a in list(arrays.items()):
-                            if isinstance(a, list):   # varchar strings
-                                arrays[c] = t.encode_strings_list(c, a)
-                        t.insert_segments(arrays, validity, ts)
-                        ac = t.meta.auto_increment
-                        if ac and ac in arrays:
-                            t.observe_auto(arrays[ac][validity[ac]])
-                    else:
-                        t.apply_tombstones(ts, np.asarray(h["gids"],
-                                                          np.int64))
-                pending = []
-        self.hlc.update(max_ts)
+            ap.apply(header, blob)
+        self.hlc.update(ap.max_ts)
+
+
+class _NullWal:
+    """WAL of a CN replica: a replica never logs — durability is the TN's
+    job; the replica's mutations all ARRIVE from the TN's log."""
+
+    def append(self, header: dict, arrow_blob: bytes = b"") -> None:
+        pass
+
+    def truncate(self) -> None:
+        pass
+
+    def replay(self):
+        return iter(())
+
+
+class WalApplier:
+    """Applies WAL-format records to an engine one at a time.
+
+    Shared by the restart replay (`Engine._replay_wal`) and the CN
+    logtail consumer (`matrixone_tpu.cluster`): the TN's WAL record
+    stream IS the logtail (reference: tae/logtail derives the push
+    stream from the commit pipeline, logtail/service/server.go:192).
+    Insert/delete records buffer until their commit record; catalog
+    records apply immediately. `apply` returns the commit_ts when a
+    commit was applied, else None."""
+
+    def __init__(self, eng: "Engine", skip_ts: int = 0):
+        self.eng = eng
+        self.skip_ts = skip_ts
+        self.pending: List[tuple] = []
+        self.max_ts = skip_ts
+
+    def apply(self, header: dict, blob: bytes = b""):
+        eng = self.eng
+        op = header["op"]
+        # frames at or before the checkpoint are already materialized in
+        # the manifest (crash window between manifest write and WAL
+        # truncation) — skip them
+        hts = header.get("ts", 0)
+        if hts and hts <= self.skip_ts:
+            return None
+        if op == "create_table":
+            from matrixone_tpu.storage.partition import PartitionSpec
+            schema = schema_from_json(header["schema"])
+            eng.create_table(
+                TableMeta(header["name"], schema, header["pk"],
+                          auto_increment=header.get("auto"),
+                          not_null=header.get("not_null", []),
+                          partition=PartitionSpec.from_json(
+                              header.get("partition"))),
+                log=False, if_not_exists=True)
+        elif op == "drop_table":
+            eng.drop_table(header["name"], if_exists=True, log=False)
+        elif op == "alter_partition_drop":
+            eng.alter_partition_drop(header["table"], header["part"],
+                                     log=False)
+        elif op == "create_external":
+            schema = schema_from_json(header["schema"])
+            eng.create_external(TableMeta(header["name"], schema, []),
+                                header["location"], header["fmt"],
+                                log=False, if_not_exists=True)
+        elif op == "create_stage":
+            eng.stages[header["name"]] = header["url"]
+        elif op == "drop_stage":
+            eng.stages.pop(header["name"], None)
+        elif op == "create_publication":
+            eng.publications[header["name"]] = list(header["tables"])
+        elif op == "drop_publication":
+            eng.publications.pop(header["name"], None)
+        elif op == "mark_source":
+            eng.sources.add(header["name"])
+        elif op == "create_dynamic":
+            eng.dynamic_tables[header["name"]] = header["sql"]
+        elif op == "create_snapshot":
+            eng.snapshots[header["name"]] = header["ts"]
+        elif op == "drop_snapshot":
+            eng.snapshots.pop(header["name"], None)
+        elif op == "insert":
+            self.pending.append(("insert", header, blob))
+        elif op == "delete":
+            self.pending.append(("delete", header, None))
+        elif op == "commit":
+            ts = header["ts"]
+            self.max_ts = max(self.max_ts, ts)
+            touched = set()
+            # deletes BEFORE inserts, matching commit_txn's apply order
+            # (engine.py commit pipeline): an UPDATE is delete+insert at
+            # one ts, and CDC consumers hanging off a replica would
+            # duplicate-key a PK mirror if the insert fired first
+            ordered = ([p for p in self.pending if p[0] == "delete"]
+                       + [p for p in self.pending if p[0] == "insert"])
+            for kind, h, b in ordered:
+                t = eng.get_table(h["table"])
+                touched.add(h["table"])
+                if kind == "insert":
+                    arrays, validity = walmod.arrow_to_arrays(b)
+                    for c, a in list(arrays.items()):
+                        if isinstance(a, list):   # varchar strings
+                            arrays[c] = t.encode_strings_list(c, a)
+                    for seg in t.insert_segments(arrays, validity, ts):
+                        for fn in eng._subscribers:
+                            fn(ts, h["table"], "insert", seg)
+                    ac = t.meta.auto_increment
+                    if ac and ac in arrays:
+                        t.observe_auto(arrays[ac][validity[ac]])
+                else:
+                    gids = np.asarray(h["gids"], np.int64)
+                    t.apply_tombstones(ts, gids)
+                    for fn in eng._subscribers:
+                        fn(ts, h["table"], "delete", gids)
+            for tname in touched:
+                for ix in eng.indexes_on(tname):
+                    ix.dirty = True
+            self.pending = []
+            return ts
+        return None
 
 
 #: back-compat alias: older code paths call this a Catalog
