@@ -20,7 +20,9 @@ returned commit ts (the waitCanServeTableSnapshot gate,
 disttae/logtail_consumer.go:389).
 """
 
-from matrixone_tpu.cluster.cn import CNService, LogtailConsumer, RemoteCatalog
+from matrixone_tpu.cluster.cn import (CNService, LogtailConsumer,
+                                      RemoteCatalog, ReplicaBrokenError)
 from matrixone_tpu.cluster.tn import TNService
 
-__all__ = ["TNService", "CNService", "LogtailConsumer", "RemoteCatalog"]
+__all__ = ["TNService", "CNService", "LogtailConsumer", "RemoteCatalog",
+           "ReplicaBrokenError"]

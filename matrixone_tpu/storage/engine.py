@@ -34,7 +34,7 @@ from matrixone_tpu.container.batch import Batch
 from matrixone_tpu.container.dtypes import DType, TypeOid
 from matrixone_tpu.sql.expr import (BoundCol, BoundExpr, BoundFunc,
                                     BoundLiteral)
-from matrixone_tpu.storage import objectio, wal as walmod
+from matrixone_tpu.storage import arrowio, objectio, wal as walmod
 from matrixone_tpu.storage.fileservice import FileService, MemoryFS
 from matrixone_tpu.txn.hlc import HLC
 
@@ -179,6 +179,16 @@ class MVCCTable:
                     d.append(s)
                 out[i] = code
             return out
+
+    def encode_dict_encoded(self, col: str, de) -> np.ndarray:
+        """Remap a batch-local `arrowio.DictEncoded` into table-global
+        codes: O(cats) Python under the commit lock, O(n) numpy — the
+        vectorized inverse of `to_dict_encoded` (replaces the per-row
+        string decode the CN commit path used to pay)."""
+        if not de.cats:
+            return np.zeros(len(de.codes), np.int32)
+        enc = self.encode_strings_list(col, de.cats)
+        return np.asarray(enc, np.int32)[np.asarray(de.codes, np.int64)]
 
     def remap_codes(self, col: str, codes: np.ndarray, cats: List[str]
                     ) -> np.ndarray:
@@ -801,6 +811,19 @@ class Engine:
             inserts = {}
         return self.commit_txn(None, inserts, {table: cur_gids})
 
+    # ------------------------------------------------------- txn registry
+    def txn_opened(self, txn_id: int) -> None:
+        """An explicit txn opened against this engine (merge guard).
+        On a CN, RemoteCatalog overrides this to ALSO register the txn
+        with the TN so merges defer cluster-wide (reference: TAE tracks
+        active txns centrally because commit runs there)."""
+        with self._commit_lock:
+            self.active_txns += 1
+
+    def txn_closed(self, txn_id: int) -> None:
+        with self._commit_lock:
+            self.active_txns -= 1
+
     def subscribe(self, fn: Callable) -> None:
         """Register a logtail subscriber: fn(commit_ts, table, kind, payload)
         — kind in ('insert','delete'); feeds CDC/index maintenance."""
@@ -828,6 +851,16 @@ class Engine:
             M.txn_commits.inc(outcome="fault")
             raise RuntimeError("injected commit failure")
         with self._commit_lock:
+            # normalize: varchar columns may arrive as batch-local
+            # DictEncoded (CN-shipped workspaces) — remap to table-global
+            # codes before any constraint check sees them
+            for tname, segs in inserts.items():
+                t = self.get_table(tname)
+                varlen = {c for c, d in t.meta.schema if d.is_varlen}
+                for arrays, _validity in segs:
+                    for c in varlen & set(arrays):
+                        if isinstance(arrays[c], arrowio.DictEncoded):
+                            arrays[c] = t.encode_dict_encoded(c, arrays[c])
             # write-write conflict: someone deleted my victim after my
             # snapshot (first-committer-wins)
             if snapshot_ts is not None:
@@ -874,8 +907,9 @@ class Engine:
                                           validity=val)
             commit_ts = self.hlc.now()
             affected = 0
-            # WAL first; varchar columns are logged as decoded strings so
-            # replay re-encodes them into the (rebuilt) table dictionary
+            # WAL first; varchar columns are logged dictionary-encoded
+            # (batch-local codes + categories) so replay re-encodes them
+            # into the (rebuilt) table dictionary without per-row decode
             for tname, segs in inserts.items():
                 t = self.get_table(tname)
                 varlen = {c for c, d in t.meta.schema if d.is_varlen}
@@ -883,11 +917,8 @@ class Engine:
                     wal_arrays = {}
                     for c, a in arrays.items():
                         if c in varlen:
-                            lut = t.dicts[c]
-                            v = validity[c]
-                            wal_arrays[c] = [
-                                lut[code] if ok else None
-                                for code, ok in zip(a.tolist(), v.tolist())]
+                            wal_arrays[c] = arrowio.to_dict_encoded(
+                                t.dicts[c], a, validity[c])
                         else:
                             wal_arrays[c] = a
                     self.wal.append(
@@ -1232,7 +1263,9 @@ class WalApplier:
                 if kind == "insert":
                     arrays, validity = walmod.arrow_to_arrays(b)
                     for c, a in list(arrays.items()):
-                        if isinstance(a, list):   # varchar strings
+                        if isinstance(a, arrowio.DictEncoded):
+                            arrays[c] = t.encode_dict_encoded(c, a)
+                        elif isinstance(a, list):   # legacy varchar strings
                             arrays[c] = t.encode_strings_list(c, a)
                     for seg in t.insert_segments(arrays, validity, ts):
                         for fn in eng._subscribers:

@@ -47,15 +47,13 @@ class TxnHandle:
         self.state = TxnState.ACTIVE
         self.workspace: Dict[str, TableWorkspace] = {}
         self._txn_id = next(_txn_counter)   # never reused (id(self) can be)
-        with engine._commit_lock:
-            engine.active_txns += 1
+        engine.txn_opened(self._txn_id)
         self._closed = False
 
     def _close(self):
         if not self._closed:
             self._closed = True
-            with self.engine._commit_lock:
-                self.engine.active_txns -= 1
+            self.engine.txn_closed(self._txn_id)
 
     def __del__(self):
         # orphan GC (reference: lockservice orphan-txn cleanup): an

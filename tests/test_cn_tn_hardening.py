@@ -1,0 +1,228 @@
+"""CN/TN hardening (VERDICT r3 directive 3): cluster-wide merge guard,
+incremental logtail backlog, poisoned-record circuit breaker, and
+vectorized (Arrow-dictionary) varchar shipping.
+
+Reference analogues: TAE's central active-txn table (merge/checkpoint
+defer cluster-wide), tae/logtail/service/server.go:192 (incremental
+per-table logtail collection, not a WAL re-read per subscriber), and
+disttae's logtail consumer error handling.
+"""
+
+import csv
+import os
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from matrixone_tpu.cluster import (RemoteCatalog, ReplicaBrokenError,
+                                   TNService)
+from matrixone_tpu.frontend import Session
+from matrixone_tpu.storage import arrowio
+
+
+@pytest.fixture
+def tn_pair():
+    d = tempfile.mkdtemp(prefix="mo_cntn_hard_")
+    tn = TNService(data_dir=d).start()
+    cat1 = RemoteCatalog(("127.0.0.1", tn.port), data_dir=d)
+    cat2 = RemoteCatalog(("127.0.0.1", tn.port), data_dir=d)
+    yield tn, cat1, cat2
+    cat1.close()
+    cat2.close()
+    tn.stop()
+
+
+def _sync(*cats):
+    ts = max(c.committed_ts for c in cats)
+    for c in cats:
+        c.consumer.wait_ts(ts)
+
+
+# ---------------------------------------------------- dict-encoded wire
+def test_dict_encoded_roundtrip_with_nulls():
+    dictionary = ["ab", "cd", "ef"]
+    codes = np.array([2, 0, 0, 1, 2], np.int32)
+    valid = np.array([True, True, False, True, True])
+    de = arrowio.to_dict_encoded(dictionary, codes, valid)
+    # batch-local: only the categories the batch uses, codes remapped
+    assert sorted(de.cats) == ["ab", "cd", "ef"]
+    blob = arrowio.arrays_to_ipc({"v": de}, {"v": valid})
+    arrays, validity = arrowio.ipc_to_arrays(blob)
+    out = arrays["v"]
+    assert isinstance(out, arrowio.DictEncoded)
+    decoded = [out.cats[c] if ok else None
+               for c, ok in zip(out.codes.tolist(), validity["v"].tolist())]
+    assert decoded == ["ef", "ab", None, "cd", "ef"]
+
+
+def test_varchar_through_cn_with_nulls_and_unicode(tn_pair):
+    tn, cat1, cat2 = tn_pair
+    s1, s2 = Session(catalog=cat1), Session(catalog=cat2)
+    s1.execute("create table v (id bigint primary key, s varchar(32))")
+    s1.execute("insert into v values (1,'héllo'), (2,NULL), (3,'世界'),"
+               " (4,'plain')")
+    _sync(cat1, cat2)
+    rows = s2.execute("select id, s from v order by id").rows()
+    assert [(int(a), b) for a, b in rows] == [
+        (1, "héllo"), (2, None), (3, "世界"), (4, "plain")]
+    # TN restart replay decodes the dict-encoded WAL frames identically
+    from matrixone_tpu.storage.engine import Engine
+    from matrixone_tpu.storage.fileservice import LocalFS
+    eng = Engine.open(LocalFS(tn.engine.fs.root))
+    t = eng.get_table("v")
+    texts, _gids = t.read_texts("s")
+    assert texts == ["héllo", None, "世界", "plain"]
+
+
+def test_load_through_cn_throughput(tn_pair):
+    """Directive: a 10k-row LOAD through a CN at >100k rows/s — the
+    per-row Python decode/re-encode on the commit path is gone."""
+    tn, cat1, cat2 = tn_pair
+    s1 = Session(catalog=cat1)
+    s1.execute("create table ld (id bigint primary key, name varchar(32),"
+               " city varchar(32), qty bigint)")
+    n = 20000
+    path = os.path.join(tempfile.mkdtemp(prefix="mo_ld_"), "rows.csv")
+    cities = ["tokyo", "paris", "lima", "oslo", "cairo"]
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["id", "name", "city", "qty"])
+        for i in range(n):
+            w.writerow([i, f"name-{i % 97}", cities[i % 5], i * 3])
+    t0 = time.perf_counter()
+    loaded = s1.load_csv("ld", path)
+    dt = time.perf_counter() - t0
+    assert loaded == n
+    rate = n / dt
+    assert rate > 100_000, f"LOAD through CN ran at {rate:.0f} rows/s"
+    # and the rows are genuinely replicated, not just acked
+    _sync(cat1, cat2)
+    s2 = Session(catalog=cat2)
+    r = s2.execute("select count(*), sum(qty) from ld").rows()[0]
+    assert (int(r[0]), int(r[1])) == (n, 3 * n * (n - 1) // 2)
+
+
+# ------------------------------------------------- cluster-wide merges
+def test_merge_defers_while_other_cn_txn_open(tn_pair):
+    tn, cat1, cat2 = tn_pair
+    s1, s2 = Session(catalog=cat1), Session(catalog=cat2)
+    s1.execute("create table m (id bigint primary key, x bigint)")
+    s1.execute("insert into m values (1,1)")
+    s1.execute("insert into m values (2,2)")
+    _sync(cat1, cat2)
+    # CN2 holds an open snapshot txn; CN1 requests the merge — the TN's
+    # registry must defer it even though CN1 itself has no open txns
+    s2.execute("begin")
+    assert len(s2.execute("select * from m").rows()) == 2
+    assert cat1.merge_table("m") == -2
+    assert len(s2.execute("select * from m").rows()) == 2
+    s2.execute("commit")
+    assert cat1.merge_table("m") == 2
+
+
+def test_merge_lease_expiry_unblocks(tn_pair):
+    """A kill -9'd CN cannot pin merges forever: its txn lease expires."""
+    tn, cat1, cat2 = tn_pair
+    s1 = Session(catalog=cat1)
+    s1.execute("create table e (id bigint primary key)")
+    s1.execute("insert into e values (1)")
+    s1.execute("insert into e values (2)")
+    # simulate a crashed CN: a lease that is never renewed or ended
+    cat2._call({"op": "txn_begin", "lease": 0.3})
+    assert cat1.merge_table("e") == -2
+    time.sleep(0.5)
+    assert cat1.merge_table("e") == 2
+
+
+# -------------------------------------------------- incremental backlog
+def test_subscribe_never_rereads_wal(tn_pair):
+    """The hub serves subscriptions from its in-memory backlog; the WAL
+    file is read exactly once (at hub startup), never per subscriber."""
+    tn, cat1, cat2 = tn_pair
+    s1 = Session(catalog=cat1)
+    s1.execute("create table b (id bigint primary key, v varchar(8))")
+    for i in range(5):
+        s1.execute(f"insert into b values ({i}, 'r{i}')")
+
+    def boom():
+        raise AssertionError("subscribe re-read the WAL from disk")
+    tn.hub.wal.replay = boom
+    cat3 = RemoteCatalog(("127.0.0.1", tn.port),
+                         data_dir=tn.engine.fs.root)
+    try:
+        s3 = Session(catalog=cat3)
+        ts = cat1.committed_ts
+        cat3.consumer.wait_ts(ts)
+        assert len(s3.execute("select * from b").rows()) == 5
+    finally:
+        cat3.close()
+
+
+def test_commits_not_blocked_by_slow_subscriber(tn_pair):
+    """Fan-out runs on the dispatcher thread: a subscriber that never
+    drains its queue must not stall the commit path."""
+    tn, cat1, cat2 = tn_pair
+    s1 = Session(catalog=cat1)
+    s1.execute("create table sl (id bigint primary key)")
+    # a dead-weight subscriber: registered queue, never drained
+    backlog, q = tn.hub.subscribe(0)
+    t0 = time.perf_counter()
+    for i in range(20):
+        s1.execute(f"insert into sl values ({i})")
+    dt = time.perf_counter() - t0
+    tn.hub.unsubscribe(q)
+    assert dt < 5.0, f"20 commits took {dt:.1f}s with an idle subscriber"
+    assert len(s1.execute("select * from sl").rows()) == 20
+
+
+# ----------------------------------------------------- circuit breaker
+def test_poisoned_logtail_trips_breaker(tn_pair):
+    tn, cat1, cat2 = tn_pair
+    s1, s2 = Session(catalog=cat1), Session(catalog=cat2)
+    s1.execute("create table p (id bigint primary key)")
+    s1.execute("insert into p values (1)")
+    _sync(cat1, cat2)
+    # a deterministically poisoned record: references a table that does
+    # not exist, so every apply (and the post-resync replay) fails
+    ts = tn.engine.hlc.now()
+    from matrixone_tpu.storage import wal as walmod
+    blob = walmod.arrays_to_arrow({"id": np.array([1], np.int64)},
+                                  {"id": np.array([True])})
+    tn.hub.append({"op": "insert", "table": "no_such_table", "ts": ts},
+                  blob)
+    tn.hub.append({"op": "commit", "ts": ts})
+    deadline = time.time() + 30
+    while time.time() < deadline and not cat2.consumer.broken:
+        time.sleep(0.1)
+    assert cat2.consumer.broken, "breaker never opened"
+    assert "no_such_table" in (cat2.consumer.last_error or "")
+    # reads fail loudly instead of silently serving frozen data
+    with pytest.raises(ReplicaBrokenError):
+        s2.execute("select * from p")
+
+
+def test_transient_error_heals_without_breaking(tn_pair):
+    """One bad group then clean stream: strikes reset on progress, the
+    breaker stays closed, and replication continues."""
+    tn, cat1, cat2 = tn_pair
+    s1, s2 = Session(catalog=cat1), Session(catalog=cat2)
+    s1.execute("create table h (id bigint primary key)")
+    s1.execute("insert into h values (1)")
+    _sync(cat1, cat2)
+    # fail exactly the next apply on CN2, then restore
+    orig = cat2.consumer._apply
+    state = {"failed": False}
+
+    def flaky(applier, h, b):
+        if not state["failed"] and h.get("op") == "commit":
+            state["failed"] = True
+            raise RuntimeError("transient apply hiccup")
+        return orig(applier, h, b)
+    cat2.consumer._apply = flaky
+    s1.execute("insert into h values (2)")
+    _sync(cat1, cat2)
+    assert not cat2.consumer.broken
+    assert len(s2.execute("select * from h").rows()) == 2
+    assert cat2.consumer.strikes == 0
