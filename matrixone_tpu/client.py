@@ -46,8 +46,8 @@ def native_password_scramble(password: str, nonce: bytes) -> bytes:
 class Connection:
     def __init__(self, host: str = "127.0.0.1", port: int = 6001,
                  user: str = "root", password: str = "",
-                 database: str = ""):
-        self.sock = socket.create_connection((host, port), timeout=30)
+                 database: str = "", timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
         self.seq = 0
         self._handshake(user, password, database)
 

@@ -26,61 +26,16 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from matrixone_tpu.cluster.rpc import ERR_TYPES, pack_blobs
+from matrixone_tpu.cluster.rpc import (ERR_TYPES, RpcClient, pack_blobs,
+                                       parse_addr as _parse_addr)
 from matrixone_tpu.logservice.replicated import _recv_msg, _send_msg
 from matrixone_tpu.storage import arrowio, wal as walmod
 from matrixone_tpu.storage.engine import (Engine, WalApplier,
                                           schema_to_json)
 from matrixone_tpu.storage.fileservice import FileService, LocalFS
 
-
-def _parse_addr(addr) -> tuple:
-    if isinstance(addr, (tuple, list)):
-        return addr[0], int(addr[1])
-    host, port = addr.rsplit(":", 1)
-    return host, int(port)
-
-
-class _TNClient:
-    """One serialized request/response socket to the TN (morpc backend
-    analogue, minimum form). Reconnects once per call on failure."""
-
-    def __init__(self, addr, timeout: float = 30.0):
-        self.addr = _parse_addr(addr)
-        self.timeout = timeout
-        self._sock: Optional[socket.socket] = None
-        self._lock = threading.Lock()
-
-    def _connect(self) -> socket.socket:
-        s = socket.create_connection(self.addr, timeout=self.timeout)
-        s.settimeout(self.timeout)
-        return s
-
-    def call(self, header: dict, blob: bytes = b""):
-        with self._lock:
-            for attempt in (0, 1):
-                if self._sock is None:
-                    self._sock = self._connect()
-                try:
-                    _send_msg(self._sock, header, blob)
-                    return _recv_msg(self._sock)
-                except (OSError, ConnectionError):
-                    try:
-                        self._sock.close()
-                    except OSError:
-                        pass
-                    self._sock = None
-                    if attempt:
-                        raise
-
-    def close(self) -> None:
-        with self._lock:
-            if self._sock is not None:
-                try:
-                    self._sock.close()
-                except OSError:
-                    pass
-                self._sock = None
+#: CN->TN request/response channel (shared framing, cluster/rpc.py)
+_TNClient = RpcClient
 
 
 class ReplicaBrokenError(RuntimeError):
@@ -350,6 +305,9 @@ class RemoteCatalog:
     def close(self) -> None:
         self._closed.set()
         self.consumer.stop()
+        pool = getattr(self, "_frag_pool", None)
+        if pool is not None:
+            pool.close()
         self._client.close()
 
     # ----------------------------------------------------- txn registry
@@ -532,18 +490,91 @@ class RemoteCatalog:
         self._call({"op": "checkpoint"})
 
 
+class FragmentServer:
+    """CN<->CN pipeline endpoint: executes shipped plan fragments against
+    this CN's replica (reference: cnservice's pipeline RPC server +
+    compile/remoterunServer.go decoding scopes from peer CNs)."""
+
+    def __init__(self, catalog, port: int = 0):
+        self.catalog = catalog
+        self.frags_run = 0
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(32)
+        self._stopping = threading.Event()
+
+    def start(self) -> "FragmentServer":
+        threading.Thread(target=self._serve, daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _serve(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        from matrixone_tpu.parallel.fragments import execute_fragment
+        try:
+            while True:
+                header, _blob = _recv_msg(conn)
+                op = header.get("op")
+                if op == "ping":
+                    _send_msg(conn, {"ok": True})
+                    continue
+                if op == "stats":
+                    _send_msg(conn, {"ok": True,
+                                     "frags_run": self.frags_run})
+                    continue
+                if op != "run_fragment":
+                    _send_msg(conn, {"ok": False, "err": f"bad op {op}"})
+                    continue
+                try:
+                    resp, rblob = execute_fragment(self.catalog, header)
+                    self.frags_run += 1
+                except Exception as e:           # noqa: BLE001
+                    resp, rblob = {"ok": False,
+                                   "err": f"{type(e).__name__}: {e}"}, b""
+                _send_msg(conn, resp, rblob)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
 class CNService:
-    """One CN process: replica + logtail consumer + MySQL wire server."""
+    """One CN process: replica + logtail consumer + MySQL wire server +
+    fragment endpoint for distributed scopes."""
 
     def __init__(self, tn_addr, fs: Optional[FileService] = None,
                  data_dir: Optional[str] = None, port: int = 0,
-                 users: Optional[dict] = None, insecure: bool = True):
+                 users: Optional[dict] = None, insecure: bool = True,
+                 frag_port: int = 0, peers: Optional[list] = None):
         from matrixone_tpu.frontend.server import MOServer
         self.catalog = RemoteCatalog(tn_addr, fs=fs, data_dir=data_dir)
+        self.fragments = FragmentServer(self.catalog, port=frag_port)
+        if peers:
+            self.catalog.dist_peers = list(peers)
         self.server = MOServer(engine=self.catalog, port=port,
                                users=users, insecure=insecure)
 
     def start(self) -> "CNService":
+        self.fragments.start()
         self.server.start()
         return self
 
@@ -551,8 +582,13 @@ class CNService:
     def port(self) -> int:
         return self.server.port
 
+    @property
+    def frag_port(self) -> int:
+        return self.fragments.port
+
     def stop(self) -> None:
         self.server.stop()
+        self.fragments.stop()
         self.catalog.close()
 
 
@@ -563,9 +599,16 @@ def main() -> None:
     ap.add_argument("--tn", required=True, help="host:port of the TN")
     ap.add_argument("--dir", required=True, help="shared storage dir")
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--frag-port", type=int, default=0)
+    ap.add_argument("--peers", default="",
+                    help="comma-separated fragment endpoints of ALL "
+                         "CNs (including this one) for distributed scopes")
     args = ap.parse_args()
-    cn = CNService(args.tn, data_dir=args.dir, port=args.port).start()
+    peers = [p for p in args.peers.split(",") if p]
+    cn = CNService(args.tn, data_dir=args.dir, port=args.port,
+                   frag_port=args.frag_port, peers=peers).start()
     print(f"PORT {cn.port}", flush=True)
+    print(f"FRAGPORT {cn.frag_port}", flush=True)
     sys.stdout.flush()
     threading.Event().wait()
 

@@ -1,14 +1,69 @@
-"""Shared wire helpers for the CN<->TN RPC: blob framing and error-type
-mapping. One definition — the framing is a cross-process protocol and
-hand-maintained copies would drift."""
+"""Shared wire helpers for the CN<->TN and CN<->CN RPC: blob framing,
+error-type mapping, and the request/response client. One definition —
+the framing is a cross-process protocol and hand-maintained copies would
+drift."""
 
 from __future__ import annotations
 
+import socket
 import struct
-from typing import List
+import threading
+from typing import List, Optional
 
 from matrixone_tpu.storage.engine import (ConflictError, ConstraintError,
                                           DuplicateKeyError)
+
+
+def parse_addr(addr) -> tuple:
+    if isinstance(addr, (tuple, list)):
+        return addr[0], int(addr[1])
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)
+
+
+class RpcClient:
+    """One serialized request/response socket (morpc backend analogue,
+    minimum form). Reconnects once per call on failure. Used for CN->TN
+    commits/DDL and CN->CN fragment shipping."""
+
+    def __init__(self, addr, timeout: float = 30.0):
+        self.addr = parse_addr(addr)
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection(self.addr, timeout=self.timeout)
+        s.settimeout(self.timeout)
+        return s
+
+    def call(self, header: dict, blob: bytes = b""):
+        from matrixone_tpu.logservice.replicated import (_recv_msg,
+                                                         _send_msg)
+        with self._lock:
+            for attempt in (0, 1):
+                if self._sock is None:
+                    self._sock = self._connect()
+                try:
+                    _send_msg(self._sock, header, blob)
+                    return _recv_msg(self._sock)
+                except (OSError, ConnectionError):
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                    if attempt:
+                        raise
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
 
 ERR_TYPES = {"conflict": ConflictError, "duplicate": DuplicateKeyError,
              "constraint": ConstraintError}

@@ -571,7 +571,9 @@ class Session:
         node = apply_indices(node, self.catalog,
                              nprobe=int(self.variables.get("ivf_nprobe", 8)),
                              skip_tables=self._index_skip_tables())
-        op = compile_plan(node, self._ctx())
+        ctx = self._ctx()
+        node = self._maybe_distribute(node, ctx)
+        op = compile_plan(node, ctx)
         out_batches = []
         for ex in op.execute():
             # KILL lands between device batches (queryservice): the pull
@@ -591,6 +593,25 @@ class Session:
                 vals.extend(b.columns[n].to_pylist())
             cols[n] = Vector.from_values(vals, d)
         return Result(batch=Batch(cols))
+
+    def _maybe_distribute(self, node, ctx):
+        """Distributed scopes (reference: compile decides Magic: Remote,
+        compile/types.go:162): when this CN knows peer fragment
+        endpoints, qualifying plans execute their lower subtree across
+        the peers and re-enter locally as a Materialized node. `SET
+        dist = 0` disables; `dist_min_rows` tunes the size threshold."""
+        peers = getattr(self.catalog, "dist_peers", None)
+        if not peers or self.txn is not None:
+            return node
+        if str(self.variables.get("dist", 1)) in ("0", "off", "false"):
+            return node
+        from matrixone_tpu.parallel import fragments as FR
+        pool = FR.pool_for(self.catalog)
+        rebuilt = FR.try_distribute(
+            node, self.catalog, ctx, pool,
+            min_rows=int(self.variables.get("dist_min_rows", 100_000)),
+            batch_rows=int(self.variables.get("dist_batch_rows", 1 << 16)))
+        return rebuilt if rebuilt is not None else node
 
     def _to_host(self, ex, schema) -> Batch:
         from matrixone_tpu.ops import filter as F

@@ -31,6 +31,10 @@ class Scan(PlanNode):
     filters: List[BoundExpr] = dataclasses.field(default_factory=list)
     # time-travel read (AS OF SNAPSHOT/TIMESTAMP): overrides the txn snapshot
     as_of_ts: Optional[int] = None
+    # distributed execution: (shard_idx, n_shards) — this scan reads only
+    # every n-th chunk (reference: RemoteRun ships scopes whose readers
+    # cover disjoint block ranges, compile/scope.go:423)
+    shard: Optional[Tuple[int, int]] = None
 
 
 @dataclasses.dataclass
@@ -118,6 +122,19 @@ class Union(PlanNode):
 class Values(PlanNode):
     rows: List[list]
     schema: Schema
+
+
+@dataclasses.dataclass
+class Materialized(PlanNode):
+    """Host arrays injected into a plan (never serialized): the
+    coordinator substitutes merged fragment results for the subtree the
+    peers executed, then runs the remaining upper plan locally."""
+    arrays: dict                 # col -> np.ndarray | list[str|None]
+    validity: dict               # col -> np.ndarray[bool]
+    schema: Schema
+    # varlen columns may arrive pre-encoded: arrays[col] holds int32
+    # codes into dicts[col] (skips two per-row Python passes)
+    dicts: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass

@@ -94,3 +94,105 @@ def agg_from_json(d: dict) -> AggCall:
                    expr_from_json(d["arg"]) if d["arg"] else None,
                    d["distinct"], dtype_from_json(d["dtype"]),
                    d["out_name"])
+
+
+# --------------------------------------------------------------- plans
+# Operator-subtree shipping (reference: compile/remoterun.go:86
+# encodeScope + proto/pipeline.proto:529 — protobuf scopes to peer CNs;
+# here: JSON plan fragments to peer CN fragment servers).
+
+def schema_cols_to_json(schema) -> list:
+    return [[n, dtype_to_json(d)] for n, d in schema]
+
+
+def schema_cols_from_json(rows) -> list:
+    return [(n, dtype_from_json(d)) for n, d in rows]
+
+
+def plan_to_json(node) -> dict:
+    from matrixone_tpu.sql import plan as P
+    s = {"schema": schema_cols_to_json(node.schema)}
+    if isinstance(node, P.Scan):
+        return {**s, "t": "scan", "table": node.table,
+                "columns": list(node.columns),
+                "filters": [expr_to_json(f) for f in node.filters],
+                "as_of_ts": node.as_of_ts, "shard": node.shard}
+    if isinstance(node, P.Filter):
+        return {**s, "t": "filter", "child": plan_to_json(node.child),
+                "pred": expr_to_json(node.pred)}
+    if isinstance(node, P.Project):
+        return {**s, "t": "project", "child": plan_to_json(node.child),
+                "exprs": [expr_to_json(e) for e in node.exprs]}
+    if isinstance(node, P.Aggregate):
+        return {**s, "t": "aggregate", "child": plan_to_json(node.child),
+                "group_keys": [expr_to_json(k) for k in node.group_keys],
+                "aggs": [agg_to_json(a) for a in node.aggs]}
+    if isinstance(node, P.Sort):
+        return {**s, "t": "sort", "child": plan_to_json(node.child),
+                "keys": [expr_to_json(k) for k in node.keys],
+                "descendings": list(node.descendings)}
+    if isinstance(node, P.TopK):
+        return {**s, "t": "topk", "child": plan_to_json(node.child),
+                "keys": [expr_to_json(k) for k in node.keys],
+                "descendings": list(node.descendings),
+                "k": node.k, "offset": node.offset}
+    if isinstance(node, P.Limit):
+        return {**s, "t": "limit", "child": plan_to_json(node.child),
+                "n": node.n, "offset": node.offset}
+    if isinstance(node, P.Join):
+        return {**s, "t": "join", "kind": node.kind,
+                "left": plan_to_json(node.left),
+                "right": plan_to_json(node.right),
+                "left_keys": [expr_to_json(k) for k in node.left_keys],
+                "right_keys": [expr_to_json(k) for k in node.right_keys],
+                "residual": (expr_to_json(node.residual)
+                             if node.residual is not None else None)}
+    if isinstance(node, P.Distinct):
+        return {**s, "t": "distinct", "child": plan_to_json(node.child)}
+    if isinstance(node, P.Values):
+        return {**s, "t": "values", "rows": node.rows}
+    raise TypeError(f"cannot serialize plan node {type(node).__name__}")
+
+
+def plan_from_json(d: dict):
+    from matrixone_tpu.sql import plan as P
+    t = d["t"]
+    schema = schema_cols_from_json(d["schema"])
+    if t == "scan":
+        return P.Scan(d["table"], list(d["columns"]), schema,
+                      filters=[expr_from_json(f) for f in d["filters"]],
+                      as_of_ts=d.get("as_of_ts"),
+                      shard=tuple(d["shard"]) if d.get("shard") else None)
+    if t == "filter":
+        return P.Filter(plan_from_json(d["child"]),
+                        expr_from_json(d["pred"]), schema)
+    if t == "project":
+        return P.Project(plan_from_json(d["child"]),
+                         [expr_from_json(e) for e in d["exprs"]], schema)
+    if t == "aggregate":
+        return P.Aggregate(plan_from_json(d["child"]),
+                           [expr_from_json(k) for k in d["group_keys"]],
+                           [agg_from_json(a) for a in d["aggs"]], schema)
+    if t == "sort":
+        return P.Sort(plan_from_json(d["child"]),
+                      [expr_from_json(k) for k in d["keys"]],
+                      list(d["descendings"]), schema)
+    if t == "topk":
+        return P.TopK(plan_from_json(d["child"]),
+                      [expr_from_json(k) for k in d["keys"]],
+                      list(d["descendings"]), d["k"], d["offset"], schema)
+    if t == "limit":
+        return P.Limit(plan_from_json(d["child"]), d["n"], d["offset"],
+                       schema)
+    if t == "join":
+        return P.Join(d["kind"], plan_from_json(d["left"]),
+                      plan_from_json(d["right"]),
+                      [expr_from_json(k) for k in d["left_keys"]],
+                      [expr_from_json(k) for k in d["right_keys"]],
+                      (expr_from_json(d["residual"])
+                       if d.get("residual") else None), schema)
+    if t == "distinct":
+        return P.Distinct(plan_from_json(d["child"]), schema)
+    if t == "values":
+        return P.Values(d["rows"], schema)
+    raise TypeError(f"cannot deserialize plan kind {t}")

@@ -18,6 +18,8 @@ def compile_plan(node: P.PlanNode, ctx) -> ops.Operator:
         return ops.ScanOp(node, rel, ctx=ctx)
     if isinstance(node, P.Values):
         return ops.ValuesOp(node)
+    if isinstance(node, P.Materialized):
+        return ops.MaterializedOp(node)
     if isinstance(node, P.Filter):
         return ops.FilterOp(node, compile_plan(node.child, ctx))
     if isinstance(node, P.Project):

@@ -89,10 +89,19 @@ class ScanOp(Operator):
             # time travel: a historical read, independent of the txn view
             read_args = {"snapshot_ts": self.node.as_of_ts}
         filters = self.node.filters + self.runtime_filters
-        for chunk in self.rel.iter_chunks(self.node.columns, self.batch_rows,
-                                          filters=filters,
-                                          qualified_names=qnames,
-                                          **read_args):
+        batch_rows = self.batch_rows
+        if self.ctx is not None and self.ctx.variables:
+            batch_rows = int(self.ctx.variables.get("batch_rows",
+                                                    batch_rows))
+        shard = self.node.shard
+        for ci, chunk in enumerate(self.rel.iter_chunks(
+                self.node.columns, batch_rows, filters=filters,
+                qualified_names=qnames, **read_args)):
+            if shard is not None and ci % shard[1] != shard[0]:
+                # distributed scan: peers cover disjoint chunk strides of
+                # the SAME deterministic chunk sequence (same snapshot,
+                # same filters -> same pruning on every replica)
+                continue
             arrays, validity, dicts, n = chunk
             M.rows_scanned.inc(n, table=self.node.table)
             ex = chunk_to_execbatch(arrays, validity, dicts, n,
@@ -103,6 +112,50 @@ class ScanOp(Operator):
                 pred = eval_expr(f, ex)
                 ex.mask = ex.mask & F.predicate_mask(pred, ex.batch)
             yield ex
+
+
+class MaterializedOp(Operator):
+    """Host arrays as a plan input (P.Materialized): the coordinator's
+    merged fragment results re-enter the local operator tree here."""
+
+    def __init__(self, node):
+        self.node = node
+        self.schema = node.schema
+
+    def execute(self) -> Iterator[ExecBatch]:
+        arrays, validity, dicts = {}, {}, {}
+        n = None
+        for name, dtype in self.node.schema:
+            a = self.node.arrays[name]
+            if dtype.is_varlen and name in self.node.dicts:
+                arrays[name] = np.asarray(a, np.int32)
+                dicts[name] = self.node.dicts[name]
+            elif dtype.is_varlen and isinstance(a, list):
+                d: List[str] = []
+                lut: Dict[str, int] = {}
+                codes = np.zeros(len(a), np.int32)
+                for i, s_ in enumerate(a):
+                    if s_ is None:
+                        continue
+                    code = lut.get(s_)
+                    if code is None:
+                        code = len(d)
+                        lut[s_] = code
+                        d.append(s_)
+                    codes[i] = code
+                arrays[name] = codes
+                dicts[name] = d
+            else:
+                arrays[name] = np.asarray(a)
+            v = self.node.validity.get(name)
+            validity[name] = (np.asarray(v, bool) if v is not None
+                              else np.ones(len(arrays[name]), np.bool_))
+            n = len(arrays[name])
+        if n is None or n == 0:
+            return
+        yield chunk_to_execbatch(arrays, validity, dicts, n,
+                                 [c for c, _ in self.node.schema],
+                                 self.node.schema)
 
 
 class ValuesOp(Operator):
