@@ -81,6 +81,12 @@ def _descale_for_float(a: DeviceColumn, b: DeviceColumn):
 
 
 def add(a: DeviceColumn, b: DeviceColumn) -> DeviceColumn:
+    if b.dtype.oid == TypeOid.DATE and a.dtype.is_integer:
+        a, b = b, a
+    if a.dtype.oid == TypeOid.DATE and b.dtype.is_integer:
+        da, db, valid = _broadcast2(a, b)
+        return DeviceColumn(da.astype(jnp.int32) + db.astype(jnp.int32),
+                            valid, dt.DATE)
     out_t = _result_type(a.dtype, b.dtype)
     if out_t.oid == TypeOid.DECIMAL64:
         da, db, s = _decimal_rescale(a, b)
@@ -94,6 +100,14 @@ def add(a: DeviceColumn, b: DeviceColumn) -> DeviceColumn:
 
 
 def sub(a: DeviceColumn, b: DeviceColumn) -> DeviceColumn:
+    if a.dtype.oid == TypeOid.DATE and b.dtype.oid == TypeOid.DATE:
+        da, db, valid = _broadcast2(a, b)
+        return DeviceColumn((da.astype(jnp.int64) - db.astype(jnp.int64)),
+                            valid, dt.INT64)
+    if a.dtype.oid == TypeOid.DATE and b.dtype.is_integer:
+        da, db, valid = _broadcast2(a, b)
+        return DeviceColumn(da.astype(jnp.int32) - db.astype(jnp.int32),
+                            valid, dt.DATE)
     out_t = _result_type(a.dtype, b.dtype)
     if out_t.oid == TypeOid.DECIMAL64:
         da, db, s = _decimal_rescale(a, b)
@@ -319,3 +333,73 @@ def round_(a: DeviceColumn, digits: int = 0) -> DeviceColumn:
     if a.dtype.oid == TypeOid.DECIMAL64:
         return cast(a, dt.decimal64(scale=digits))
     return _unary_float(a, lambda x: jnp.round(x, digits))
+
+
+def tan(a): return _unary_float(a, jnp.tan)
+def asin(a): return _unary_float(a, jnp.arcsin)
+def acos(a): return _unary_float(a, jnp.arccos)
+def atan(a): return _unary_float(a, jnp.arctan)
+def cot(a): return _unary_float(a, lambda x: 1.0 / jnp.tan(x))
+def degrees(a): return _unary_float(a, jnp.degrees)
+def radians(a): return _unary_float(a, jnp.radians)
+def log2(a): return _unary_float(a, jnp.log2)
+def log10(a): return _unary_float(a, jnp.log10)
+
+
+def atan2(a: DeviceColumn, b: DeviceColumn) -> DeviceColumn:
+    da, db, valid = _broadcast2(a, b)
+    out = jnp.arctan2(da.astype(jnp.float64), db.astype(jnp.float64))
+    return DeviceColumn(out, valid, dt.FLOAT64)
+
+
+def sign(a: DeviceColumn) -> DeviceColumn:
+    # scale never changes the sign, so decimals need no rescale
+    return DeviceColumn(jnp.sign(a.data).astype(jnp.int64), a.validity,
+                        dt.INT64)
+
+
+def truncate(a: DeviceColumn, digits: int = 0) -> DeviceColumn:
+    """TRUNCATE(x, d): toward zero (ROUND's half-away sibling)."""
+    if a.dtype.oid == TypeOid.DECIMAL64:
+        diff = a.dtype.scale - digits
+        if diff <= 0:
+            return a
+        f = 10 ** diff
+        d = a.data
+        # zero the truncated digits but KEEP the scale (the bound output
+        # type is the input type)
+        q = jnp.sign(d) * (jnp.abs(d) // f) * f
+        return DeviceColumn(q.astype(d.dtype), a.validity, a.dtype)
+    f = 10.0 ** digits
+    return _unary_float(a, lambda x: jnp.trunc(x * f) / f)
+
+
+def _pick2(a: DeviceColumn, b: DeviceColumn, fn) -> DeviceColumn:
+    """GREATEST/LEAST pairwise step: NULL if either side is NULL
+    (MySQL semantics), decimal scales aligned first."""
+    if TypeOid.DECIMAL64 in (a.dtype.oid, b.dtype.oid) \
+            and not (a.dtype.is_float or b.dtype.is_float):
+        da_, db_, s_ = _decimal_rescale(a, b)
+        a = DeviceColumn(da_, a.validity, dt.decimal64(scale=s_))
+        b = DeviceColumn(db_, b.validity, dt.decimal64(scale=s_))
+    da, db, valid = _broadcast2(a, b)
+    if da.dtype != db.dtype:
+        ct = jnp.promote_types(da.dtype, db.dtype)
+        da, db = da.astype(ct), db.astype(ct)
+    out_t = (a.dtype if a.dtype.oid == b.dtype.oid
+             else _result_type(a.dtype, b.dtype))
+    return DeviceColumn(fn(da, db), valid, out_t)
+
+
+def greatest(*cols: DeviceColumn) -> DeviceColumn:
+    out = cols[0]
+    for c in cols[1:]:
+        out = _pick2(out, c, jnp.maximum)
+    return out
+
+
+def least(*cols: DeviceColumn) -> DeviceColumn:
+    out = cols[0]
+    for c in cols[1:]:
+        out = _pick2(out, c, jnp.minimum)
+    return out

@@ -50,7 +50,11 @@ from matrixone_tpu.sql.serde import (agg_from_json, agg_to_json,
 from matrixone_tpu.storage import arrowio
 from matrixone_tpu.vm.process import ExecContext
 
-_ALLOWED_AGGS = frozenset(["sum", "count", "min", "max", "avg"])
+from matrixone_tpu.sql.parser import BASIC_AGGS, STDDEV_AGGS
+
+# the second-moment family distributes too: its sum/sumsq/count fields
+# merge by addition, same as the classic five's fields
+_ALLOWED_AGGS = BASIC_AGGS | STDDEV_AGGS
 _dist_ids = itertools.count(1 << 40)
 
 
@@ -214,6 +218,8 @@ def _run_partial_scalar(child_op, aggs) -> Tuple[dict, bytes]:
         have = True
         if a.func == "count":
             fields = {"count": st}
+        elif a.func in STDDEV_AGGS:
+            fields = {"sum": st[0], "sumsq": st[1], "count": st[2]}
         elif a.func in ("sum", "avg"):
             fields = {"sum": st[0], "count": st[1]}
         else:
@@ -376,12 +382,16 @@ def pool_for(catalog) -> "FragmentPeers":
 
 
 class FragmentPeers:
-    """Connection pool over the peer CNs' fragment endpoints."""
+    """Connection pool over the peer CNs' fragment endpoints. The
+    timeout is generous: a cold peer jit-compiles every fragment shape
+    on its first query, and a premature timeout silently downgrades the
+    cluster to local execution."""
 
-    def __init__(self, addrs):
+    def __init__(self, addrs, timeout: float = 180.0):
         from matrixone_tpu.cluster.rpc import RpcClient
         self.addrs = list(addrs)
-        self.clients = [RpcClient(a) for a in self.addrs]
+        self.clients = [RpcClient(a, timeout=timeout)
+                        for a in self.addrs]
 
     def close(self) -> None:
         for c in self.clients:
@@ -570,7 +580,7 @@ def _merge_grouped(agg: P.Aggregate, results) -> P.Materialized:
         merged: Dict[str, jnp.ndarray] = {}
         for f, vals in fields[j].items():
             v = jnp.asarray(vals)
-            if f in ("sum", "count"):
+            if f in ("sum", "count", "sumsq"):
                 merged[f] = A.seg_sum(v, gi.gids, mask, mg)
             elif f == "min":
                 merged[f] = A.seg_min(v, gi.gids, mask, mg)
@@ -603,6 +613,10 @@ def _merge_scalar(agg: P.Aggregate, results) -> P.Materialized:
             state = None
         elif a.func == "count":
             state = jnp.asarray(np.sum(fields["count"]))
+        elif "sumsq" in fields:       # stddev/variance family
+            state = (jnp.asarray(np.sum(fields["sum"], axis=0)),
+                     jnp.asarray(np.sum(fields["sumsq"], axis=0)),
+                     jnp.asarray(np.sum(fields["count"])))
         else:
             cnt = jnp.asarray(np.sum(fields["count"]))
             if a.func in ("sum", "avg"):

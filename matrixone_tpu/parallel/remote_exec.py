@@ -127,7 +127,7 @@ class RemoteScopeCoordinator:
             # already done, so merge "half" with an empty second state
             part = {}
             for f, vals in half.items():
-                if f in ("sum", "count"):
+                if f in ("sum", "count", "sumsq"):
                     part[f] = A.seg_sum(vals, gi.gids, mask, mg)
                 elif f == "min":
                     part[f] = A.seg_min(vals, gi.gids, mask, mg)

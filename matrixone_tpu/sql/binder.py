@@ -28,7 +28,8 @@ from matrixone_tpu.sql.expr import (AggCall, BoundCase, BoundCast, BoundCol,
                                     BoundIsNull, BoundLike, BoundLiteral,
                                     and_all)
 
-AGG_FUNCS = {"count", "sum", "avg", "min", "max"}
+from matrixone_tpu.sql.parser import (AGG_FUNCS, BASIC_AGGS, BIT_AGGS,
+                                      STDDEV_AGGS)
 
 # SAMPLE seeds: each bound Sample node (and each re-bind of the same
 # query) draws an independent random stream
@@ -535,8 +536,16 @@ class Binder:
                                               out_name=f"_agg{i}"))
                     continue
                 arg = self.bind_expr(a.args[0], scope)
-                out_t = _agg_result_type(a.name, arg.dtype)
-                bound_aggs.append(AggCall(a.name, arg, a.distinct, out_t,
+                fname = "min" if a.name == "any_value" else a.name
+                if fname in STDDEV_AGGS | BIT_AGGS and \
+                        not arg.dtype.is_numeric:
+                    raise BindError(
+                        f"{a.name}() requires a numeric argument")
+                if fname in BIT_AGGS and not arg.dtype.is_integer:
+                    raise BindError(
+                        f"{a.name}() requires an integer argument")
+                out_t = _agg_result_type(fname, arg.dtype)
+                bound_aggs.append(AggCall(fname, arg, a.distinct, out_t,
                                           out_name=f"_agg{i}"))
 
         key_names = [f"_g{i}" for i in range(len(group_keys))]
@@ -620,7 +629,7 @@ class Binder:
         schema = list(node.schema)
         for i, fc in enumerate(calls):
             fn = fc.name
-            if fn not in AGG_FUNCS and fn not in WINDOW_ONLY_FUNCS:
+            if fn not in BASIC_AGGS and fn not in WINDOW_ONLY_FUNCS:
                 raise BindError(f"{fn}() is not a window function")
             if fc.distinct:
                 raise BindError(
@@ -630,7 +639,7 @@ class Binder:
             if fn in WINDOW_ONLY_FUNCS and (fc.args or fc.star):
                 raise BindError(f"{fn}() takes no arguments")
             arg = None
-            if fn in AGG_FUNCS and not fc.star:
+            if fn in BASIC_AGGS and not fc.star:
                 if not fc.args:
                     raise BindError(f"{fn}() needs an argument")
                 arg = bind(fc.args[0])
@@ -641,7 +650,7 @@ class Binder:
             part = [bind(p) for p in fc.window.partition_by]
             okeys = [bind(o.expr) for o in fc.window.order_by]
             odescs = [o.descending for o in fc.window.order_by]
-            if fn in AGG_FUNCS:
+            if fn in BASIC_AGGS:
                 out_t = _agg_result_type(fn, arg.dtype) if arg is not None \
                     else dt.INT64
             else:
@@ -773,6 +782,9 @@ class Binder:
                 raise BindError("LIKE pattern must be a literal")
             return BoundLike(left, str(right.value), False, dt.BOOL)
         if e.op in ("and", "or"):
+            # typeless NULL / 0-1 integer literals coerce in logic
+            # contexts (MySQL: NULL AND 0 is 0)
+            left, right = _coerce_bool(left), _coerce_bool(right)
             _require_bool(left, e.op.upper())
             _require_bool(right, e.op.upper())
             return BoundFunc(e.op, [left, right], dt.BOOL)
@@ -976,6 +988,15 @@ def _split_bound_or(e: BoundExpr) -> List[BoundExpr]:
     return [e]
 
 
+def _coerce_bool(e: BoundExpr) -> BoundExpr:
+    if isinstance(e, BoundLiteral) and e.dtype.oid != TypeOid.BOOL:
+        if e.value is None:
+            return BoundLiteral(None, dt.BOOL)
+        if isinstance(e.value, int):
+            return BoundLiteral(bool(e.value), dt.BOOL)
+    return e
+
+
 def _require_bool(e: BoundExpr, where: str):
     if e.dtype.oid != TypeOid.BOOL:
         raise BindError(f"{where} requires a boolean expression")
@@ -1043,10 +1064,15 @@ def _agg_result_type(func: str, arg: DType) -> DType:
         if arg.is_integer:
             return dt.INT64
         return dt.FLOAT64
+    if func in STDDEV_AGGS:
+        return dt.FLOAT64
+    if func in BIT_AGGS:
+        return dt.UINT64
     return arg  # min / max
 
 
 _SCALAR_FUNCS = {
+    "mod": ("mod", lambda ts: _arith_result("mod", ts[0], ts[1])),
     "abs": ("abs", lambda ts: ts[0]),
     "floor": ("floor", lambda ts: dt.FLOAT64),
     "ceil": ("ceil", lambda ts: dt.FLOAT64),
@@ -1084,6 +1110,80 @@ _SCALAR_FUNCS = {
     # timewin role (colexec/timewin): tumbling time windows via bucketed
     # GROUP BY — time_bucket(ts_col, width) floors to the window start
     "time_bucket": ("time_bucket", lambda ts: ts[0]),
+    # ---- math long tail
+    "tan": ("tan", lambda ts: dt.FLOAT64),
+    "asin": ("asin", lambda ts: dt.FLOAT64),
+    "acos": ("acos", lambda ts: dt.FLOAT64),
+    "atan": ("atan", lambda ts: dt.FLOAT64),
+    "atan2": ("atan2", lambda ts: dt.FLOAT64),
+    "cot": ("cot", lambda ts: dt.FLOAT64),
+    "degrees": ("degrees", lambda ts: dt.FLOAT64),
+    "radians": ("radians", lambda ts: dt.FLOAT64),
+    "log2": ("log2", lambda ts: dt.FLOAT64),
+    "log10": ("log10", lambda ts: dt.FLOAT64),
+    "sign": ("sign", lambda ts: dt.INT64),
+    "truncate": ("truncate", lambda ts: ts[0]),
+    "greatest": ("greatest", lambda ts: _common_numeric(ts)),
+    "least": ("least", lambda ts: _common_numeric(ts)),
+    # ---- string long tail (dictionary-level evaluation, vm/exprs.py)
+    "lpad": ("lpad", lambda ts: dt.VARCHAR),
+    "rpad": ("rpad", lambda ts: dt.VARCHAR),
+    "repeat": ("repeat", lambda ts: dt.VARCHAR),
+    "space": ("space", lambda ts: dt.VARCHAR),
+    "instr": ("instr", lambda ts: dt.INT64),
+    "locate": ("locate", lambda ts: dt.INT64),
+    "position": ("locate", lambda ts: dt.INT64),
+    "ascii": ("ascii", lambda ts: dt.INT64),
+    "bit_length": ("bit_length", lambda ts: dt.INT64),
+    "hex": ("hex", lambda ts: dt.VARCHAR),
+    "unhex": ("unhex", lambda ts: dt.VARCHAR),
+    "md5": ("md5", lambda ts: dt.VARCHAR),
+    "sha1": ("sha1", lambda ts: dt.VARCHAR),
+    "sha": ("sha1", lambda ts: dt.VARCHAR),
+    "sha2": ("sha2", lambda ts: dt.VARCHAR),
+    "crc32": ("crc32", lambda ts: dt.INT64),
+    "to_base64": ("to_base64", lambda ts: dt.VARCHAR),
+    "from_base64": ("from_base64", lambda ts: dt.VARCHAR),
+    "substring_index": ("substring_index", lambda ts: dt.VARCHAR),
+    "field": ("field", lambda ts: dt.INT64),
+    "find_in_set": ("find_in_set", lambda ts: dt.INT64),
+    "strcmp": ("strcmp", lambda ts: dt.INT64),
+    "soundex": ("soundex", lambda ts: dt.VARCHAR),
+    "quote": ("quote", lambda ts: dt.VARCHAR),
+    "bin": ("bin", lambda ts: dt.VARCHAR),
+    "oct": ("oct", lambda ts: dt.VARCHAR),
+    "conv": ("conv", lambda ts: dt.VARCHAR),
+    # ---- regexp family (Python re semantics on dictionary entries)
+    "regexp_like": ("regexp_like", lambda ts: dt.BOOL),
+    "regexp_instr": ("regexp_instr", lambda ts: dt.INT64),
+    "regexp_substr": ("regexp_substr", lambda ts: dt.VARCHAR),
+    "regexp_replace": ("regexp_replace", lambda ts: dt.VARCHAR),
+    # ---- JSON family
+    "json_extract": ("json_extract", lambda ts: dt.VARCHAR),
+    "json_unquote": ("json_unquote", lambda ts: dt.VARCHAR),
+    "json_valid": ("json_valid", lambda ts: dt.BOOL),
+    "json_length": ("json_length", lambda ts: dt.INT64),
+    "json_type": ("json_type", lambda ts: dt.VARCHAR),
+    "json_keys": ("json_keys", lambda ts: dt.VARCHAR),
+    # ---- date/time long tail
+    "weekday": ("weekday", lambda ts: dt.INT32),
+    "dayofweek": ("dayofweek", lambda ts: dt.INT32),
+    "dayofmonth": ("day", lambda ts: dt.INT32),
+    "dayofyear": ("dayofyear", lambda ts: dt.INT32),
+    "quarter": ("quarter", lambda ts: dt.INT32),
+    "week": ("week", lambda ts: dt.INT32),
+    "last_day": ("last_day", lambda ts: dt.DATE),
+    "to_days": ("to_days", lambda ts: dt.INT64),
+    "from_days": ("from_days", lambda ts: dt.DATE),
+    "datediff": ("datediff", lambda ts: dt.INT64),
+    "hour": ("hour", lambda ts: dt.INT32),
+    "minute": ("minute", lambda ts: dt.INT32),
+    "second": ("second", lambda ts: dt.INT32),
+    "date": ("date", lambda ts: dt.DATE),
+    "unix_timestamp": ("unix_timestamp", lambda ts: dt.INT64),
+    "from_unixtime": ("from_unixtime", lambda ts: dt.DATETIME),
+    "monthname": ("monthname", lambda ts: dt.VARCHAR),
+    "dayname": ("dayname", lambda ts: dt.VARCHAR),
     "l2_distance": ("l2_distance", lambda ts: dt.FLOAT64),
     "l2_distance_sq": ("l2_distance_sq", lambda ts: dt.FLOAT64),
     "cosine_distance": ("cosine_distance", lambda ts: dt.FLOAT64),
@@ -1092,14 +1192,62 @@ _SCALAR_FUNCS = {
 }
 
 
+def _common_numeric(ts: List[DType]) -> DType:
+    out = ts[0]
+    for t in ts[1:]:
+        if out.oid == t.oid and out.oid != TypeOid.DECIMAL64:
+            continue
+        if out.is_numeric and t.is_numeric:
+            if TypeOid.DECIMAL64 in (out.oid, t.oid) \
+                    and not (out.is_float or t.is_float):
+                so = out.scale if out.oid == TypeOid.DECIMAL64 else 0
+                st = t.scale if t.oid == TypeOid.DECIMAL64 else 0
+                out = dt.decimal64(18, max(so, st))
+            else:
+                out = dt.promote(out, t)
+    return out
+
+
 def bind_scalar_function(name: str, args: List[BoundExpr]) -> BoundExpr:
+    import math
+    # sugar rewrites (reference: many of the 554 ids are compositions)
+    if name == "pi" and not args:
+        return BoundLiteral(math.pi, dt.FLOAT64)
+    if name == "if" and len(args) == 3:
+        _require_bool(args[0], "if()")
+        vt = (args[1].dtype if not (isinstance(args[1], BoundLiteral)
+                                    and args[1].value is None)
+              else args[2].dtype)
+        return BoundCase([(args[0], args[1])], args[2], vt)
+    if name == "ifnull" and len(args) == 2:
+        name, args = "coalesce", args
+    if name == "nullif" and len(args) == 2:
+        eqf = BoundFunc("eq", [args[0], args[1]], dt.BOOL)
+        return BoundCase([(eqf, BoundLiteral(None, args[0].dtype))],
+                         args[0], args[0].dtype)
+    if name == "isnull" and len(args) == 1:
+        from matrixone_tpu.sql.expr import BoundIsNull
+        return BoundIsNull(args[0], False, dt.BOOL)
     if name not in _SCALAR_FUNCS:
         raise BindError(f"unknown function {name}()")
+    if name in ("greatest", "least"):
+        if len(args) < 2:
+            raise BindError(f"{name}() needs at least two arguments")
+        if any(not a.dtype.is_numeric for a in args):
+            # comparing dictionary codes across columns is meaningless
+            raise BindError(
+                f"{name}() over non-numeric arguments is not "
+                f"supported yet")
     op, result = _SCALAR_FUNCS[name]
     # vector literals arrive as '[1,2,...]' strings (MySQL-client style)
-    for i, a in enumerate(args):
-        if isinstance(a, BoundLiteral) and isinstance(a.value, str) \
-                and a.value.lstrip().startswith("["):
-            vec = [float(x) for x in a.value.strip()[1:-1].split(",") if x]
-            args[i] = BoundLiteral(vec, dt.vecf32(len(vec)))
+    # — only distance functions take vectors (a regexp character class
+    # also starts with '[' and must stay a string)
+    if op in ("l2_distance", "l2_distance_sq", "cosine_distance",
+              "inner_product", "cosine_similarity"):
+        for i, a in enumerate(args):
+            if isinstance(a, BoundLiteral) and isinstance(a.value, str) \
+                    and a.value.lstrip().startswith("["):
+                vec = [float(x)
+                       for x in a.value.strip()[1:-1].split(",") if x]
+                args[i] = BoundLiteral(vec, dt.vecf32(len(vec)))
     return BoundFunc(op, args, result([a.dtype for a in args]))

@@ -15,7 +15,14 @@ class ParseError(ValueError):
     pass
 
 
-AGG_FUNCS = {"count", "sum", "avg", "min", "max"}
+# THE aggregate name registry (reference: aggexec) — binder, operators,
+# and the distributed-fragment planner all import these; keeping one
+# definition is what stops the families drifting apart
+BASIC_AGGS = frozenset(["count", "sum", "avg", "min", "max"])
+STDDEV_AGGS = frozenset(["stddev", "std", "stddev_pop", "stddev_samp",
+                         "variance", "var_pop", "var_samp"])
+BIT_AGGS = frozenset(["bit_and", "bit_or", "bit_xor"])
+AGG_FUNCS = BASIC_AGGS | STDDEV_AGGS | BIT_AGGS | {"any_value"}
 
 
 def parse(sql: str) -> List[ast.Node]:
@@ -804,8 +811,14 @@ class Parser:
     def unary(self) -> ast.Node:
         if self.accept_op("-"):
             operand = self.unary()
-            if isinstance(operand, ast.Literal) and operand.kind in ("int", "float"):
+            if isinstance(operand, ast.Literal) and operand.kind == "int":
                 return ast.Literal(-operand.value, operand.kind)
+            if isinstance(operand, ast.Literal) and operand.kind == "float":
+                # float literal values are TEXT (decimal scale detection
+                # happens at bind); negate textually
+                text = str(operand.value)
+                return ast.Literal(text[1:] if text.startswith("-")
+                                   else "-" + text, "float")
             return ast.UnaryOp("-", operand)
         if self.accept_op("+"):
             return self.unary()

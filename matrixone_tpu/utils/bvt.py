@@ -1,0 +1,108 @@
+"""BVT golden-SQL harness (reference: test/distributed/cases + the
+external mo-tester runner — 1,133 .sql/.result case files pin the
+reference's SQL behavior; this is the same contract, in-process).
+
+A case file is a sequence of `;`-terminated statements (possibly
+multi-line; `-- comment` lines are skipped). Its golden `.result` holds,
+for each statement, an echo line (`> <sql>`) followed by the result
+block: TAB-separated rows for queries, `ok`/`affected: N` for other
+statements, `ERROR <Type>: <message>` for expected failures.
+
+`run_case` executes against a fresh Session; `record` (re)generates the
+golden. tests/test_bvt.py compares every case in tests/bvt/cases.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from typing import Iterator, List
+
+__all__ = ["split_statements", "run_case", "record", "iter_cases"]
+
+
+def split_statements(text: str) -> Iterator[str]:
+    """Yield `;`-terminated statements; `--` comment lines are dropped.
+    A `;` only terminates at end-of-line (so string literals containing
+    semicolons mid-line survive)."""
+    buf: List[str] = []
+    for line in text.splitlines():
+        if line.strip().startswith("--"):
+            continue
+        buf.append(line)
+        if line.rstrip().endswith(";"):
+            stmt = "\n".join(buf).strip()
+            buf = []
+            stmt = stmt.rstrip(";").strip()
+            if stmt:
+                yield stmt
+    tail = "\n".join(buf).strip().rstrip(";").strip()
+    if tail:
+        yield tail
+
+
+def _fmt_value(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float):
+        if v != v:
+            return "NaN"
+        s = f"{v:.12g}"
+        return "0" if s == "-0" else s
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return str(v)
+    return str(v)
+
+
+def _fmt_result(r) -> List[str]:
+    if r.batch is None:
+        if r.affected:
+            return [f"affected: {r.affected}"]
+        return ["ok"]
+    lines = ["\t".join(r.column_names)]
+    for row in r.rows():
+        lines.append("\t".join(_fmt_value(v) for v in row))
+    return lines
+
+
+def run_case(session, text: str) -> str:
+    """Execute a case's statements; return the canonical output text."""
+    out: List[str] = []
+    for stmt in split_statements(text):
+        echo = " ".join(stmt.split())
+        out.append(f"> {echo}")
+        try:
+            r = session.execute(stmt)
+            out.extend(_fmt_result(r))
+        except Exception as e:           # noqa: BLE001 — errors are golden
+            msg = " ".join(str(e).split())
+            out.append(f"ERROR {type(e).__name__}: {msg}")
+        out.append("")
+    return "\n".join(out).rstrip() + "\n"
+
+
+def iter_cases(root: str) -> List[str]:
+    cases = []
+    for dirpath, _dirs, files in os.walk(root):
+        for f in sorted(files):
+            if f.endswith(".sql"):
+                cases.append(os.path.join(dirpath, f))
+    return sorted(cases)
+
+
+def record(case_path: str, session_factory) -> str:
+    """(Re)generate the .result golden next to `case_path`."""
+    with open(case_path) as f:
+        text = f.read()
+    s = session_factory()
+    try:
+        out = run_case(s, text)
+    finally:
+        close = getattr(s, "close", None)
+        if close:
+            close()
+    with open(case_path[:-4] + ".result", "w") as f:
+        f.write(out)
+    return out

@@ -112,3 +112,5 @@ rows_scanned = REGISTRY.counter(
     "mo_scan_rows_total", "rows scanned by table scans")
 txn_commits = REGISTRY.counter(
     "mo_txn_commit_total", "transaction commits by outcome")
+join_spills = REGISTRY.counter(
+    "mo_join_spill_total", "joins whose build side Grace-spilled to host")

@@ -53,5 +53,5 @@ def compile_plan(node: P.PlanNode, ctx) -> ops.Operator:
     if isinstance(node, P.Join):
         from matrixone_tpu.vm.join import JoinOp
         return JoinOp(node, compile_plan(node.left, ctx),
-                      compile_plan(node.right, ctx))
+                      compile_plan(node.right, ctx), ctx=ctx)
     raise NotImplementedError(f"compile: {type(node).__name__}")

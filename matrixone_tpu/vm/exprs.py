@@ -66,6 +66,10 @@ def _dict_of(e: BoundExpr, ex: ExecBatch) -> Optional[List[str]]:
         return ex.dicts.get(e.name)
     if isinstance(e, BoundCase) and e.dtype.is_varlen:
         return case_string_dict(e)
+    if isinstance(e, BoundFunc) and e.op == "monthname":
+        return list(_MONTH_NAMES)
+    if isinstance(e, BoundFunc) and e.op == "dayname":
+        return list(_DAY_NAMES)
     if isinstance(e, BoundFunc) and e.dtype.is_varlen \
             and e.op in _STRING_FUNCS:
         return string_func_final_dict(e, ex)
@@ -134,7 +138,19 @@ def eval_expr(e: BoundExpr, ex: ExecBatch) -> DeviceColumn:
 
 _STRING_FUNCS = {"upper", "lower", "length", "reverse", "trim", "ltrim",
                  "rtrim", "concat", "substring", "replace", "starts_with",
-                 "ends_with"}
+                 "ends_with",
+                 # long tail (VERDICT r3 directive 6): dictionary-level
+                 # Python semantics, device gather on codes — O(uniques)
+                 # host work per batch, never O(rows)
+                 "lpad", "rpad", "repeat", "instr", "locate", "ascii",
+                 "bit_length", "hex", "unhex", "md5", "sha1", "sha2",
+                 "crc32", "to_base64", "from_base64", "substring_index",
+                 "field", "find_in_set", "strcmp", "space", "soundex",
+                 "quote", "bin", "oct", "conv",
+                 "regexp_like", "regexp_instr", "regexp_substr",
+                 "regexp_replace",
+                 "json_extract", "json_unquote", "json_valid",
+                 "json_length", "json_type", "json_keys"}
 
 
 def _string_arg_info(e, ex, want_col: bool = True):
@@ -172,14 +188,84 @@ def _string_arg_info(e, ex, want_col: bool = True):
     return col, d, lits
 
 
+def _json_parse(s):
+    import json as _json
+    try:
+        return _json.loads(s)
+    except (ValueError, TypeError):
+        return _JSON_BAD
+
+
+_JSON_BAD = object()
+
+
+def _json_path(doc, path: str):
+    """$.a.b[0] subset of MySQL JSON paths; returns _JSON_BAD on miss."""
+    import re as _re
+    if not path.startswith("$"):
+        return _JSON_BAD
+    cur = doc
+    for m in _re.finditer(r"\.([A-Za-z_][A-Za-z_0-9]*)|\[(\d+)\]",
+                          path[1:]):
+        key, idx = m.group(1), m.group(2)
+        if key is not None:
+            if not isinstance(cur, dict) or key not in cur:
+                return _JSON_BAD
+            cur = cur[key]
+        else:
+            i = int(idx)
+            if not isinstance(cur, list) or i >= len(cur):
+                return _JSON_BAD
+            cur = cur[i]
+    return cur
+
+
+def _soundex(s: str) -> str:
+    codes = {**dict.fromkeys("BFPV", "1"), **dict.fromkeys("CGJKQSXZ", "2"),
+             **dict.fromkeys("DT", "3"), "L": "4",
+             **dict.fromkeys("MN", "5"), "R": "6"}
+    s = "".join(c for c in s.upper() if c.isalpha())
+    if not s:
+        return ""
+    out = s[0]
+    prev = codes.get(s[0], "")
+    for c in s[1:]:
+        code = codes.get(c, "")
+        if code and code != prev:
+            out += code
+        if c not in "HW":
+            prev = code
+    return (out + "000")[:4]
+
+
 def _apply_string_func(op, s, lits):
-    """Python-level semantics per dictionary entry (MySQL behavior)."""
+    """Python-level semantics per dictionary entry (MySQL behavior).
+    Returns None for SQL NULL results (invalid input etc.)."""
+    import base64
+    import hashlib
+    import re as _re
+    import zlib
+
+    def args():
+        return [x for x in lits if x is not None]
+
+    def at(i, default=None):
+        """Positional arg: the dictionary entry if the column sits at
+        position i, else the literal there."""
+        if i >= len(lits):
+            return default
+        return s if lits[i] is None else lits[i]
+
     if op == "upper":
         return s.upper()
     if op == "lower":
         return s.lower()
     if op == "length":
         return len(s.encode())
+    if op == "bit_length":
+        return len(s.encode()) * 8
+    if op == "ascii":
+        return ord(s[0]) if s else 0
     if op == "reverse":
         return s[::-1]
     if op == "trim":
@@ -191,29 +277,193 @@ def _apply_string_func(op, s, lits):
     if op == "concat":
         return "".join(s if x is None else str(x) for x in lits)
     if op == "substring":
-        args = [x for x in lits if x is not None]
-        start = int(args[0])
+        a = args()
+        start = int(a[0])
         start = start - 1 if start > 0 else len(s) + start
-        if len(args) > 1:
-            return s[start:start + int(args[1])]
+        if len(a) > 1:
+            return s[start:start + int(a[1])]
         return s[start:]
     if op == "replace":
-        args = [x for x in lits if x is not None]
-        return s.replace(str(args[0]), str(args[1]))
+        a = args()
+        return s.replace(str(a[0]), str(a[1]))
     if op == "starts_with":
-        args = [x for x in lits if x is not None]
-        return s.startswith(str(args[0]))
+        return s.startswith(str(args()[0]))
     if op == "ends_with":
-        args = [x for x in lits if x is not None]
-        return s.endswith(str(args[0]))
+        return s.endswith(str(args()[0]))
+    if op == "lpad":
+        a = args()
+        n, pad = int(a[0]), str(a[1]) if len(a) > 1 else " "
+        if n <= len(s):
+            return s[:n]
+        if not pad:
+            return ""        # MySQL: cannot fill with an empty pad
+        return (pad * n)[:n - len(s)] + s
+    if op == "rpad":
+        a = args()
+        n, pad = int(a[0]), str(a[1]) if len(a) > 1 else " "
+        if n <= len(s):
+            return s[:n]
+        if not pad:
+            return ""
+        return s + (pad * n)[:n - len(s)]
+    if op == "repeat":
+        n = int(args()[0])
+        return s * max(n, 0)
+    if op == "space":
+        return " " * max(int(s), 0)
+    if op == "instr":
+        return str(at(0, "")).find(str(at(1, ""))) + 1
+    if op == "locate":
+        sub, subj = str(at(0, "")), str(at(1, ""))
+        pos = int(at(2, 1))
+        return subj.find(sub, max(pos - 1, 0)) + 1
+    if op == "substring_index":
+        a = args()
+        delim, count = str(a[0]), int(a[1])
+        if not delim:
+            return ""
+        parts = s.split(delim)
+        if count > 0:
+            return delim.join(parts[:count])
+        if count < 0:
+            return delim.join(parts[count:])
+        return ""
+    if op == "field":
+        # the column may sit at ANY position: substitute the dictionary
+        # entry at its placeholder before comparing
+        full = [s if x is None else str(x) for x in lits]
+        try:
+            return full[1:].index(full[0]) + 1
+        except ValueError:
+            return 0
+    if op == "find_in_set":
+        target, setstr = str(at(0, "")), str(at(1, ""))
+        if not setstr:
+            return 0
+        items = setstr.split(",")
+        try:
+            return items.index(target) + 1
+        except ValueError:
+            return 0
+    if op == "strcmp":
+        a0, a1 = str(at(0, "")), str(at(1, ""))
+        return -1 if a0 < a1 else (1 if a0 > a1 else 0)
+    if op == "hex":
+        return s.encode().hex().upper()
+    if op == "unhex":
+        try:
+            return bytes.fromhex(s).decode("utf-8", errors="strict")
+        except ValueError:
+            return None
+    if op == "md5":
+        return hashlib.md5(s.encode()).hexdigest()
+    if op == "sha1":
+        return hashlib.sha1(s.encode()).hexdigest()
+    if op == "sha2":
+        bits = int(args()[0]) if args() else 256
+        fn = {224: hashlib.sha224, 256: hashlib.sha256,
+              384: hashlib.sha384, 512: hashlib.sha512,
+              0: hashlib.sha256}.get(bits)
+        return fn(s.encode()).hexdigest() if fn else None
+    if op == "crc32":
+        return zlib.crc32(s.encode())
+    if op == "to_base64":
+        return base64.b64encode(s.encode()).decode()
+    if op == "from_base64":
+        try:
+            return base64.b64decode(s.encode(), validate=True).decode(
+                "utf-8", errors="strict")
+        except (ValueError, UnicodeDecodeError):
+            return None
+    if op == "soundex":
+        return _soundex(s)
+    if op == "quote":
+        body = s.replace("\\", "\\\\").replace("'", "\\'")
+        return f"'{body}'"
+    if op in ("bin", "oct", "conv"):
+        try:
+            v = int(str(at(0, s)), 10 if op != "conv"
+                    else int(args()[0]))
+        except ValueError:
+            return None
+        if v < 0:
+            # MySQL treats negatives as unsigned 64-bit two's complement
+            v &= 0xFFFFFFFFFFFFFFFF
+        if op == "bin":
+            return format(v, "b")
+        if op == "oct":
+            return format(v, "o")
+        to = int(args()[1])
+        if not (2 <= to <= 36):
+            return None
+        digits = "0123456789abcdefghijklmnopqrstuvwxyz"
+        out = ""
+        while v:
+            out = digits[v % to] + out
+            v //= to
+        return (out or "0").upper()
+    if op == "regexp_like":
+        return bool(_re.search(str(args()[0]), s))
+    if op == "regexp_instr":
+        m = _re.search(str(args()[0]), s)
+        return (m.start() + 1) if m else 0
+    if op == "regexp_substr":
+        m = _re.search(str(args()[0]), s)
+        return m.group(0) if m else None
+    if op == "regexp_replace":
+        a = args()
+        return _re.sub(str(a[0]), str(a[1]), s)
+    if op.startswith("json_"):
+        import json as _json
+        doc = _json_parse(s)
+        if op == "json_valid":
+            return doc is not _JSON_BAD
+        if doc is _JSON_BAD:
+            return None
+        if op == "json_extract":
+            got = _json_path(doc, str(args()[0]))
+            return None if got is _JSON_BAD else _json.dumps(
+                got, separators=(", ", ": "), ensure_ascii=False)
+        if op == "json_unquote":
+            if isinstance(doc, str):
+                return doc
+            return s
+        if op == "json_length":
+            path = args()
+            tgt = doc if not path else _json_path(doc, str(path[0]))
+            if tgt is _JSON_BAD:
+                return None
+            return len(tgt) if isinstance(tgt, (list, dict)) else 1
+        if op == "json_type":
+            tgt = doc
+            if args():
+                tgt = _json_path(doc, str(args()[0]))
+                if tgt is _JSON_BAD:
+                    return None
+            if isinstance(tgt, bool):
+                return "BOOLEAN"
+            if tgt is None:
+                return "NULL"
+            if isinstance(tgt, int):
+                return "INTEGER"
+            if isinstance(tgt, float):
+                return "DOUBLE"
+            if isinstance(tgt, str):
+                return "STRING"
+            return "ARRAY" if isinstance(tgt, list) else "OBJECT"
+        if op == "json_keys":
+            if not isinstance(doc, dict):
+                return None
+            return _json.dumps(list(doc.keys()), ensure_ascii=False)
     raise EvalError(op)
 
 
 def string_func_output_dict(e: BoundFunc, ex: ExecBatch):
     """Transformed dictionary for a varchar-result string function
-    (no device work: dictionaries + literals only)."""
+    (no device work: dictionaries + literals only). Entries may be None
+    (SQL NULL results, e.g. unhex of garbage)."""
     _, d, lits = _string_arg_info(e, ex, want_col=False)
-    return [str(_apply_string_func(e.op, s, lits)) for s in d]
+    return [_apply_string_func(e.op, s, lits) for s in d]
 
 
 def _eval_string_func(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
@@ -222,27 +472,29 @@ def _eval_string_func(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
         # all-literal subject: a const code-0 column over the 1-entry dict
         col = DeviceColumn(jnp.zeros((1,), jnp.int32),
                            jnp.ones((1,), jnp.bool_), dt.VARCHAR)
-    if e.op in ("length",):
-        lut = np.asarray([_apply_string_func(e.op, s, lits) for s in d],
-                         dtype=np.int64)
-        out = jnp.asarray(lut)[jnp.clip(col.data, 0, len(d) - 1)]
-        return DeviceColumn(out, col.validity, dt.INT64)
-    if e.op in ("starts_with", "ends_with"):
-        lut = np.asarray([_apply_string_func(e.op, s, lits) for s in d],
-                         dtype=np.bool_)
-        out = jnp.asarray(lut)[jnp.clip(col.data, 0, len(d) - 1)]
-        return DeviceColumn(out, col.validity, dt.BOOL)
-    # varchar result: codes pass through (the dict is transformed); the
-    # transformed dict may contain duplicates — harmless for output, and
-    # group-by keys on it group by ORIGINAL code... so re-encode to the
-    # transformed value space to keep GROUP BY upper(x) correct:
-    out_dict = string_func_output_dict(e, ex)
+    vals = [_apply_string_func(e.op, s, lits) for s in d]
+    nulls = np.asarray([v is None for v in vals], dtype=np.bool_)
+    codes0 = jnp.clip(col.data, 0, len(d) - 1)
+    validity = col.validity
+    if nulls.any():
+        validity = validity & ~jnp.asarray(nulls)[codes0]
+    if not e.dtype.is_varlen:
+        # result type decides the LUT dtype: the binder already typed
+        # the call (INT64 for length/instr/..., BOOL for regexp_like/...)
+        npdt = (np.bool_ if e.dtype.oid == dt.TypeOid.BOOL
+                else e.dtype.np_dtype)
+        lut = np.asarray([0 if v is None else v for v in vals],
+                         dtype=npdt)
+        out = jnp.asarray(lut)[codes0]
+        return DeviceColumn(out, validity, e.dtype)
+    # varchar result: re-encode to the transformed value space so
+    # GROUP BY upper(x) groups by VALUE, not by original code
     uniq = {}
-    remap = np.empty(len(out_dict), np.int32)
-    for i, v in enumerate(out_dict):
-        remap[i] = uniq.setdefault(v, len(uniq))
-    codes = jnp.asarray(remap)[jnp.clip(col.data, 0, len(out_dict) - 1)]
-    return DeviceColumn(codes, col.validity, e.dtype)
+    remap = np.empty(len(vals), np.int32)
+    for i, v in enumerate(vals):
+        remap[i] = uniq.setdefault("" if v is None else str(v), len(uniq))
+    codes = jnp.asarray(remap)[codes0]
+    return DeviceColumn(codes, validity, e.dtype)
 
 
 def string_func_final_dict(e: BoundFunc, ex: ExecBatch):
@@ -250,7 +502,7 @@ def string_func_final_dict(e: BoundFunc, ex: ExecBatch):
     out_dict = string_func_output_dict(e, ex)
     uniq = {}
     for v in out_dict:
-        uniq.setdefault(v, len(uniq))
+        uniq.setdefault("" if v is None else str(v), len(uniq))
     return list(uniq)
 
 
@@ -260,6 +512,10 @@ _SIMPLE = {
     "abs": S.abs_, "floor": S.floor, "ceil": S.ceil, "sqrt": S.sqrt,
     "exp": S.exp, "ln": S.ln, "sin": S.sin, "cos": S.cos, "power": S.power,
     "coalesce": S.coalesce,
+    "tan": S.tan, "asin": S.asin, "acos": S.acos, "atan": S.atan,
+    "atan2": S.atan2, "cot": S.cot, "degrees": S.degrees,
+    "radians": S.radians, "log2": S.log2, "log10": S.log10,
+    "sign": S.sign, "greatest": S.greatest, "least": S.least,
 }
 
 _CMP = {"eq": S.eq, "ne": S.ne, "lt": S.lt, "le": S.le, "gt": S.gt,
@@ -308,6 +564,12 @@ def _eval_func(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
         a = eval_expr(e.args[0], ex)
         digits = e.args[1].value if len(e.args) > 1 else 0
         return S.round_(a, int(digits))
+    if op == "truncate":
+        a = eval_expr(e.args[0], ex)
+        digits = e.args[1].value if len(e.args) > 1 else 0
+        return S.truncate(a, int(digits))
+    if op in _DATE_FUNCS:
+        return _eval_date_func(e, ex)
     if op == "time_bucket":
         from matrixone_tpu.sql.expr import BoundLiteral as _BL
         if not isinstance(e.args[1], _BL):
@@ -346,6 +608,11 @@ def _eval_compare(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
     a_dict, b_dict = _dict_of(a_raw, ex), _dict_of(b_raw, ex)
     a_is_str_lit = isinstance(a_raw, BoundLiteral) and _is_varchar(a_raw.dtype)
     b_is_str_lit = isinstance(b_raw, BoundLiteral) and _is_varchar(b_raw.dtype)
+    if a_is_str_lit and b_is_str_lit:
+        la, lb = str(a_raw.value), str(b_raw.value)
+        hit = {"eq": la == lb, "ne": la != lb, "lt": la < lb,
+               "le": la <= lb, "gt": la > lb, "ge": la >= lb}[e.op]
+        return DeviceColumn.const(bool(hit), dt.BOOL)
     if a_dict is not None or b_dict is not None or a_is_str_lit or b_is_str_lit:
         # string comparison: evaluate on the dictionary, gather on codes
         if a_dict is not None and (b_is_str_lit or b_dict is not None):
@@ -401,6 +668,107 @@ def _like_regex(pattern: str) -> "re.Pattern":
         else:
             out.append(re.escape(ch))
     return re.compile("^" + "".join(out) + "$", re.DOTALL)
+
+
+_MONTH_NAMES = ["January", "February", "March", "April", "May", "June",
+                "July", "August", "September", "October", "November",
+                "December"]
+_DAY_NAMES = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+              "Saturday", "Sunday"]
+
+_DATE_FUNCS = {"weekday", "dayofweek", "dayofyear", "quarter", "week",
+               "last_day", "to_days", "from_days", "datediff", "hour",
+               "minute", "second", "date", "unix_timestamp",
+               "from_unixtime", "monthname", "dayname"}
+
+_US_PER_DAY = 86_400_000_000
+
+
+def _days_col(col: DeviceColumn) -> jnp.ndarray:
+    """Epoch days from a DATE (days) or DATETIME/TIMESTAMP (micros)."""
+    if col.dtype.oid in (dt.TypeOid.DATETIME, dt.TypeOid.TIMESTAMP):
+        return jnp.floor_divide(col.data.astype(jnp.int64), _US_PER_DAY)
+    return col.data.astype(jnp.int64)
+
+
+def _days_from_civil(y, m, d):
+    """Inverse of _civil_from_days (Hinnant, public domain)."""
+    y = y - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _eval_date_func(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
+    op = e.op
+    a = eval_expr(e.args[0], ex)
+    if op == "datediff":
+        b = eval_expr(e.args[1], ex)
+        da, db, valid = S._broadcast2(a, b)
+        out = (_days_col(DeviceColumn(da, valid, a.dtype))
+               - _days_col(DeviceColumn(db, valid, b.dtype)))
+        return DeviceColumn(out.astype(jnp.int64), valid, dt.INT64)
+    if op == "from_days":
+        out = a.data.astype(jnp.int64) - 719528
+        return DeviceColumn(out.astype(jnp.int32), a.validity, dt.DATE)
+    if op == "from_unixtime":
+        out = a.data.astype(jnp.int64) * 1_000_000
+        return DeviceColumn(out, a.validity, dt.DATETIME)
+    if op in ("hour", "minute", "second"):
+        us = a.data.astype(jnp.int64)
+        sec_of_day = jnp.floor_divide(us, 1_000_000) % 86_400
+        out = {"hour": sec_of_day // 3600,
+               "minute": (sec_of_day // 60) % 60,
+               "second": sec_of_day % 60}[op]
+        return DeviceColumn(out.astype(jnp.int32), a.validity, dt.INT32)
+    days = _days_col(a)
+    if op == "date":
+        return DeviceColumn(days.astype(jnp.int32), a.validity, dt.DATE)
+    if op == "to_days":
+        return DeviceColumn(days + 719528, a.validity, dt.INT64)
+    if op == "unix_timestamp":
+        if a.dtype.oid in (dt.TypeOid.DATETIME, dt.TypeOid.TIMESTAMP):
+            out = jnp.floor_divide(a.data.astype(jnp.int64), 1_000_000)
+        else:
+            out = days * 86_400
+        return DeviceColumn(out, a.validity, dt.INT64)
+    if op == "weekday":        # 0 = Monday (1970-01-01 was a Thursday)
+        return DeviceColumn(((days + 3) % 7).astype(jnp.int32),
+                            a.validity, dt.INT32)
+    if op == "dayofweek":      # 1 = Sunday
+        return DeviceColumn(((days + 4) % 7 + 1).astype(jnp.int32),
+                            a.validity, dt.INT32)
+    if op == "dayname":
+        return DeviceColumn(((days + 3) % 7).astype(jnp.int32),
+                            a.validity, e.dtype)
+    y, m, d = _civil_from_days(days)
+    if op == "monthname":
+        return DeviceColumn((m - 1).astype(jnp.int32), a.validity,
+                            e.dtype)
+    if op == "quarter":
+        return DeviceColumn(((m + 2) // 3).astype(jnp.int32),
+                            a.validity, dt.INT32)
+    if op == "dayofyear":
+        jan1 = _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+        return DeviceColumn((days - jan1 + 1).astype(jnp.int32),
+                            a.validity, dt.INT32)
+    if op == "week":           # MySQL default mode 0: Sunday-start weeks
+        jan1 = _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+        doy = days - jan1 + 1
+        jan1_dow_sun0 = (jan1 + 4) % 7
+        first_sunday_doy = 1 + (7 - jan1_dow_sun0) % 7
+        wk = jnp.where(doy < first_sunday_doy, 0,
+                       (doy - first_sunday_doy) // 7 + 1)
+        return DeviceColumn(wk.astype(jnp.int32), a.validity, dt.INT32)
+    if op == "last_day":
+        ny = jnp.where(m == 12, y + 1, y)
+        nm = jnp.where(m == 12, 1, m + 1)
+        out = _days_from_civil(ny, nm, jnp.ones_like(d)) - 1
+        return DeviceColumn(out.astype(jnp.int32), a.validity, dt.DATE)
+    raise EvalError(op)
 
 
 def _civil_from_days(z: jnp.ndarray):

@@ -11,14 +11,24 @@ cross). TPU re-design:
 Duplicate fan-out beyond max_matches is detected on host and the probe
 re-runs with a doubled budget — the shape-bucketing trick the rest of the
 engine uses, applied to join multiplicity.
+
+Build sides larger than the device budget Grace-spill (reference:
+colexec/spillutil/join_spill.go + spill_threshold.go): both sides are
+hash-partitioned to host disk by the join key, and each partition joins
+with the normal in-memory path — rows with equal keys always share a
+partition, so every join kind except cross partitions exactly.
 """
 
 from __future__ import annotations
 
-from typing import Iterator, List
+import itertools
+import os
+import tempfile
+from typing import Iterator, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from matrixone_tpu.container import dtypes as dt
 from matrixone_tpu.container.device import DeviceBatch, DeviceColumn
@@ -67,17 +77,111 @@ def _maybe_compact(out: ExecBatch) -> ExecBatch:
                      mask=jnp.arange(cap, dtype=jnp.int32) < db.n_rows)
 
 
+class _JoinSpill:
+    """Host-disk partitions of one join's two sides (Grace). Each stored
+    chunk keeps its source batch's dictionaries, so replayed ExecBatches
+    are exactly as expressive as the originals."""
+
+    def __init__(self, n_partitions: int):
+        self.P = n_partitions
+        self.dir = tempfile.mkdtemp(prefix="mo_join_spill_")
+        self._chunks: dict = {}          # (side, p) -> [(path, dicts, n)]
+        self._seq = 0
+
+    def add(self, side: str, p: int, arrays: dict, validity: dict,
+            dicts: dict, n: int) -> None:
+        path = os.path.join(self.dir, f"{side}_{p}_{self._seq}.npz")
+        self._seq += 1
+        payload = {}
+        for c, a in arrays.items():
+            payload[f"d_{c}"] = a
+            payload[f"v_{c}"] = validity[c]
+        np.savez(path, **payload)
+        self._chunks.setdefault((side, p), []).append(
+            (path, dict(dicts), n))
+
+    def chunks(self, side: str, p: int) -> list:
+        return self._chunks.get((side, p), [])
+
+    def cleanup(self) -> None:
+        import shutil
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
+class _ReplayOp(Operator):
+    """Spilled host chunks as an operator (the drain half of Grace)."""
+
+    def __init__(self, chunks: list, schema):
+        self.chunks = chunks
+        self.schema = schema
+
+    def execute(self) -> Iterator[ExecBatch]:
+        from matrixone_tpu.container import device as dev
+        for path, dicts, n in self.chunks:
+            if n == 0:
+                continue
+            z = np.load(path)
+            arrays, validity, dtypes = {}, {}, {}
+            for name, dtype in self.schema:
+                arrays[name] = z[f"d_{name}"]
+                validity[name] = z[f"v_{name}"]
+                dtypes[name] = (dt.INT32 if dtype.is_varlen else dtype)
+            db = dev.from_numpy(arrays, dtypes, validity, n_rows=n)
+            for name, dtype in self.schema:
+                if dtype.is_varlen:
+                    c = db.columns[name]
+                    db.columns[name] = DeviceColumn(c.data, c.validity,
+                                                    dtype)
+            yield ExecBatch(batch=db, dicts=dicts, mask=db.row_mask())
+
+
 class JoinOp(Operator):
+    #: build rows beyond which the join Grace-spills both sides
+    DEFAULT_BUILD_BUDGET = 1 << 22
+
     def __init__(self, node: P.Join, left: Operator, right: Operator,
-                 max_matches: int = 4):
+                 max_matches: int = 4, ctx=None,
+                 spill_partitions: int = 16):
         self.node = node
         self.left = left
         self.right = right
         self.schema = node.schema
         self.max_matches = max_matches
+        self.spill_partitions = spill_partitions
+        self.build_budget = self.DEFAULT_BUILD_BUDGET
+        if ctx is not None and ctx.variables:
+            self.build_budget = int(ctx.variables.get(
+                "join_build_budget", self.build_budget))
 
     def execute(self) -> Iterator[ExecBatch]:
-        build_batches = list(self.right.execute())
+        # stream the build side counting live rows; past the budget,
+        # switch to the Grace path (cross joins have no key to partition
+        # by — they stay in-memory whatever the size)
+        build_batches: List[ExecBatch] = []
+        build_iter = self.right.execute()
+        overflowed = False
+        if self.node.kind != "cross" and self.node.right_keys:
+            # cheap gate first: the padded lane count bounds live rows
+            # from above, so no host sync happens until a build side is
+            # actually near the budget (the common case never syncs)
+            padded = 0
+            pending_sums = []
+            live = 0
+            for ex in build_iter:
+                build_batches.append(ex)
+                padded += int(ex.padded_len)
+                pending_sums.append(jnp.sum(ex.mask.astype(jnp.int64)))
+                if padded <= self.build_budget:
+                    continue
+                live = int(jax.device_get(sum(pending_sums)))
+                if live > self.build_budget:
+                    overflowed = True
+                    break
+        else:
+            build_batches = list(build_iter)
+        if overflowed:
+            yield from self._grace(build_batches, build_iter)
+            return
         if not build_batches and self.node.kind in ("inner", "semi"):
             return
         build = (_concat_batches(build_batches, self.node.right.schema)
@@ -140,6 +244,66 @@ class JoinOp(Operator):
                 if dtype.is_varlen:
                     dicts.setdefault(name, [""])
             yield ExecBatch(batch=db, dicts=dicts, mask=unmatched)
+
+    # ------------------------------------------------------------- grace
+    def _grace(self, prefix: List[ExecBatch], rest) -> Iterator[ExecBatch]:
+        """Build side over budget: hash-partition BOTH sides to host disk
+        by the join key, then run each partition through the normal
+        in-memory join (reference: spillutil/join_spill.go)."""
+        from matrixone_tpu.utils import metrics as M
+        M.join_spills.inc()
+        spill = _JoinSpill(self.spill_partitions)
+        try:
+            for ex in itertools.chain(prefix, rest):
+                self._partition_side(spill, ex, "build",
+                                     self.node.right_keys,
+                                     self.node.right.schema)
+            for ex in self.left.execute():
+                self._partition_side(spill, ex, "probe",
+                                     self.node.left_keys,
+                                     self.node.left.schema)
+            for p in range(spill.P):
+                sub = JoinOp(
+                    self.node,
+                    _ReplayOp(spill.chunks("probe", p),
+                              self.node.left.schema),
+                    _ReplayOp(spill.chunks("build", p),
+                              self.node.right.schema),
+                    max_matches=self.max_matches)
+                # a partition joins in memory; key skew concentrating a
+                # partition past the budget would recurse on identical
+                # hashes forever, so partitions never re-spill
+                sub.build_budget = 1 << 62
+                yield from sub.execute()
+        finally:
+            spill.cleanup()
+
+    def _partition_side(self, spill: _JoinSpill, ex: ExecBatch, side: str,
+                        keys, schema) -> None:
+        """Route each live row to partition hash(key) % P. NULL-key rows
+        ride their hash too: they never match, but left/anti/full joins
+        still emit them from within their partition."""
+        kcols = [_broadcast_full(eval_expr(k, ex), ex.padded_len)
+                 for k in keys]
+        h = H.hash_columns([k.data for k in kcols],
+                           [k.validity for k in kcols])
+        part = (h % jnp.uint64(spill.P)).astype(jnp.int32)
+        part_np = np.asarray(jax.device_get(part))
+        mask_np = np.asarray(jax.device_get(ex.mask))
+        host_cols, host_val = {}, {}
+        for name, _dtype in schema:
+            c = _broadcast_full(ex.batch.columns[name], ex.padded_len)
+            host_cols[name] = np.asarray(jax.device_get(c.data))
+            host_val[name] = np.asarray(jax.device_get(c.validity))
+        for p in range(spill.P):
+            rows = mask_np & (part_np == p)
+            n = int(rows.sum())
+            if n == 0:
+                continue
+            spill.add(side, p,
+                      {name: a[rows] for name, a in host_cols.items()},
+                      {name: v[rows] for name, v in host_val.items()},
+                      ex.dicts, n)
 
     def _push_runtime_filters(self, bkeys, bvalid) -> None:
         """Build-side key min/max pushed into probe-side scans before the

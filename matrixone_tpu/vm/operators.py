@@ -630,6 +630,25 @@ class _AggDictTracker:
                     f"(union / multi-source) is not supported yet")
 
 
+from matrixone_tpu.sql.parser import BIT_AGGS, STDDEV_AGGS  # one registry
+
+_BIT_IDENT = {"bit_and": -1, "bit_or": 0, "bit_xor": 0}
+_BIT_UFUNC = {"bit_and": np.bitwise_and, "bit_or": np.bitwise_or,
+              "bit_xor": np.bitwise_xor}
+
+
+def _host_bit_reduce(func: str, data, gids, mask, mg: int):
+    """Grouped bitwise reduce: XLA has no segment and/or/xor, and the
+    identity values make host ufunc.at both exact and merge-transparent
+    (identity rows vanish under the operator)."""
+    d = np.asarray(jax.device_get(data)).astype(np.int64)
+    g = np.asarray(jax.device_get(gids))
+    m = np.asarray(jax.device_get(mask))
+    out = np.full(mg, _BIT_IDENT[func], np.int64)
+    _BIT_UFUNC[func].at(out, g[m], d[m])
+    return jnp.asarray(out)
+
+
 def _grouped_step(a: AggCall, gi, col: Optional[DeviceColumn],
                   row_mask, mg: int):
     """Per-batch partial for one aggregate over PRE-EVALUATED values
@@ -653,19 +672,37 @@ def _grouped_step(a: AggCall, gi, col: Optional[DeviceColumn],
     if a.func == "max":
         return {"max": A.seg_max(col.data, gi.gids, m, mg),
                 "count": A.seg_count(gi.gids, m, mg)}
+    if a.func in STDDEV_AGGS:
+        x = _float_of(col)
+        return {"sum": A.seg_sum(x, gi.gids, m, mg),
+                "sumsq": A.seg_sum(x * x, gi.gids, m, mg),
+                "count": A.seg_count(gi.gids, m, mg)}
+    if a.func in BIT_AGGS:
+        return {"bits": _host_bit_reduce(a.func, col.data, gi.gids, m,
+                                         mg),
+                "count": A.seg_count(gi.gids, m, mg)}
     raise EvalError(f"unsupported aggregate {a.func}")
+
+
+def _float_of(col: DeviceColumn):
+    x = col.data.astype(jnp.float64)
+    if col.dtype.oid == TypeOid.DECIMAL64:
+        x = x / (10.0 ** col.dtype.scale)
+    return x
 
 
 def _grouped_merge(a: AggCall, p1, p2, gi, mask, mg: int):
     out = {}
     for field, vals in _concat_fields(p1, p2).items():
         m = mask
-        if field in ("sum", "count"):
+        if field in ("sum", "count", "sumsq"):
             out[field] = A.seg_sum(vals, gi.gids, m, mg)
         elif field == "min":
             out[field] = A.seg_min(vals, gi.gids, m, mg)
         elif field == "max":
             out[field] = A.seg_max(vals, gi.gids, m, mg)
+        elif field == "bits":
+            out[field] = _host_bit_reduce(a.func, vals, gi.gids, m, mg)
     return out
 
 
@@ -688,6 +725,12 @@ def _grouped_empty(a: AggCall, mg: int):
                                  else jnp.int64), "count": z64}
     if a.func in ("min", "max"):
         return {a.func: jnp.zeros((mg,), vt), "count": z64}
+    if a.func in STDDEV_AGGS:
+        zf = jnp.zeros((mg,), jnp.float64)
+        return {"sum": zf, "sumsq": zf, "count": z64}
+    if a.func in BIT_AGGS:
+        return {"bits": jnp.full((mg,), _BIT_IDENT[a.func], jnp.int64),
+                "count": z64}
     raise EvalError(a.func)
 
 
@@ -708,6 +751,25 @@ def _grouped_final(a: AggCall, part, dtype: DType) -> DeviceColumn:
         return DeviceColumn(s / c, valid, dt.FLOAT64)
     if a.func in ("min", "max"):
         return DeviceColumn(part[a.func], valid, dtype)
+    if a.func in STDDEV_AGGS:
+        c = part["count"].astype(jnp.float64)
+        mean = part["sum"] / jnp.maximum(c, 1.0)
+        var_pop = jnp.maximum(
+            part["sumsq"] / jnp.maximum(c, 1.0) - mean * mean, 0.0)
+        if a.func in ("stddev_samp", "var_samp"):
+            var = var_pop * c / jnp.maximum(c - 1.0, 1.0)
+            ok = part["count"] > 1
+        else:
+            var = var_pop
+            ok = part["count"] > 0
+        out = var if a.func in ("variance", "var_pop", "var_samp") \
+            else jnp.sqrt(var)
+        return DeviceColumn(out, ok, dt.FLOAT64)
+    if a.func in BIT_AGGS:
+        # MySQL: the neutral value, never NULL (an all-NULL group keeps
+        # the identity — bit_and -> all ones)
+        bits = part["bits"].astype(jnp.uint64)
+        return DeviceColumn(bits, jnp.ones_like(valid), dt.UINT64)
     raise EvalError(a.func)
 
 
@@ -738,6 +800,25 @@ def _scalar_step(a: AggCall, ex: ExecBatch, state):
         c = A.scalar_count(m)
         return (v, c) if state is None else (jnp.maximum(state[0], v),
                                              state[1] + c)
+    if a.func in STDDEV_AGGS:
+        x = _float_of(col)
+        s = A.scalar_sum(x, m)
+        s2 = A.scalar_sum(x * x, m)
+        c = A.scalar_count(m)
+        if state is None:
+            return (s, s2, c)
+        return (state[0] + s, state[1] + s2, state[2] + c)
+    if a.func in BIT_AGGS:
+        d = np.asarray(jax.device_get(col.data)).astype(np.int64)
+        mm = np.asarray(jax.device_get(m))
+        v = _BIT_UFUNC[a.func].reduce(d[mm]) if mm.any() \
+            else _BIT_IDENT[a.func]
+        c = A.scalar_count(m)
+        if state is None:
+            return (jnp.asarray(np.int64(v)), c)
+        merged = _BIT_UFUNC[a.func](
+            np.int64(jax.device_get(state[0])), np.int64(v))
+        return (jnp.asarray(merged), state[1] + c)
     raise EvalError(a.func)
 
 
@@ -746,6 +827,26 @@ def _scalar_final(a: AggCall, state, dtype: DType) -> DeviceColumn:
     if a.func == "count":
         v = jnp.zeros((), jnp.int64) if state is None else state
         return DeviceColumn(v[None].astype(jnp.int64), one, dt.INT64)
+    if a.func in BIT_AGGS:
+        v = (jnp.asarray(_BIT_IDENT[a.func], jnp.int64) if state is None
+             else state[0])
+        return DeviceColumn(v[None].astype(jnp.uint64), one, dt.UINT64)
+    if a.func in STDDEV_AGGS:
+        if state is None:
+            return DeviceColumn.const_null(dt.FLOAT64)
+        s, s2, c = state
+        cf = jnp.maximum(c.astype(jnp.float64), 1.0)
+        mean = s / cf
+        var_pop = jnp.maximum(s2 / cf - mean * mean, 0.0)
+        if a.func in ("stddev_samp", "var_samp"):
+            var = var_pop * cf / jnp.maximum(cf - 1.0, 1.0)
+            ok = c > 1
+        else:
+            var = var_pop
+            ok = c > 0
+        out = var if a.func in ("variance", "var_pop", "var_samp") \
+            else jnp.sqrt(var)
+        return DeviceColumn(out[None], ok[None], dt.FLOAT64)
     if state is None:
         return DeviceColumn.const_null(dtype)
     if a.func == "sum":

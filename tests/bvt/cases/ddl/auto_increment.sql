@@ -1,0 +1,4 @@
+create table ai (id bigint primary key auto_increment, v varchar(8));
+insert into ai (v) values ('a'), ('b');
+insert into ai (v) values ('c');
+select id, v from ai order by id;

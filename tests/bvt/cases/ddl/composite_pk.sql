@@ -1,0 +1,4 @@
+create table cpk (a bigint, b bigint, v bigint, primary key (a, b));
+insert into cpk values (1, 1, 10), (1, 2, 20);
+insert into cpk values (1, 1, 99);
+select * from cpk order by a, b;

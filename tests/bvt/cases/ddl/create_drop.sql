@@ -1,0 +1,5 @@
+create table t1 (id bigint primary key, v varchar(16));
+show tables;
+drop table t1;
+show tables;
+drop table if exists t1;

@@ -1,0 +1,4 @@
+create table t1 (id bigint primary key);
+create table t1 (id bigint primary key);
+create table if not exists t1 (id bigint primary key);
+drop table no_such_table;

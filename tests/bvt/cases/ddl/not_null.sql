@@ -1,0 +1,4 @@
+create table nn (id bigint primary key, v bigint not null);
+insert into nn values (1, 10);
+insert into nn values (2, NULL);
+select * from nn order by id;

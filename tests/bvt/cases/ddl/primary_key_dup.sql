@@ -1,0 +1,5 @@
+create table pk (id bigint primary key, v bigint);
+insert into pk values (1, 10);
+insert into pk values (1, 20);
+insert into pk values (2, 20), (2, 30);
+select * from pk order by id;

@@ -1,0 +1,6 @@
+create table dl (id bigint primary key, v bigint);
+insert into dl values (1, 10), (2, 20), (3, 30), (4, 40);
+delete from dl where v > 25;
+select * from dl order by id;
+delete from dl;
+select count(*) from dl;

@@ -1,0 +1,4 @@
+create table ins (id bigint primary key, a bigint, s varchar(8));
+insert into ins (id) values (1);
+insert into ins values (2, NULL, NULL), (3, 7, 'x');
+select id, a, s from ins order by id;

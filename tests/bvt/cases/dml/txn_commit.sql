@@ -1,0 +1,6 @@
+create table tc (id bigint primary key, v bigint);
+begin;
+insert into tc values (1, 10);
+select count(*) from tc;
+commit;
+select count(*) from tc;

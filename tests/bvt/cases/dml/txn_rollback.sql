@@ -1,0 +1,7 @@
+create table tr (id bigint primary key, v bigint);
+insert into tr values (1, 10);
+begin;
+insert into tr values (2, 20);
+select count(*) from tr;
+rollback;
+select count(*) from tr;

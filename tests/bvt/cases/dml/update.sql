@@ -1,0 +1,6 @@
+create table u (id bigint primary key, v bigint, s varchar(8));
+insert into u values (1, 10, 'a'), (2, 20, 'b'), (3, 30, 'c');
+update u set v = v + 1 where id >= 2;
+select * from u order by id;
+update u set s = 'z' where v = 11;
+select * from u order by id;

@@ -1,0 +1,3 @@
+create table av (g bigint, v bigint);
+insert into av values (1,7),(1,7),(2,3);
+select g, any_value(v) from av group by g order by g;

@@ -1,0 +1,4 @@
+create table ba (g bigint, v bigint);
+insert into ba values (1,2),(1,4),(1,6),(2,5),(2,9),(3,NULL);
+select g, bit_and(v), bit_or(v), bit_xor(v) from ba group by g order by g;
+select bit_and(v), bit_or(v), bit_xor(v) from ba;

@@ -1,0 +1,4 @@
+create table cd (g bigint, v bigint);
+insert into cd values (1,1),(1,1),(1,2),(2,5),(2,5),(2,NULL);
+select g, count(distinct v) from cd group by g order by g;
+select count(distinct g) from cd;

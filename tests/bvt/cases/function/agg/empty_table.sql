@@ -1,0 +1,2 @@
+create table et (v bigint);
+select count(*), sum(v), min(v) from et;

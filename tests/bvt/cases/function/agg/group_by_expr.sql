@@ -1,0 +1,4 @@
+create table ge (v bigint);
+insert into ge values (1),(2),(3),(4),(5),(6);
+select v % 2, count(*), sum(v) from ge group by v % 2 order by v % 2;
+select mod(v, 3), max(v) from ge group by mod(v, 3) order by mod(v, 3);

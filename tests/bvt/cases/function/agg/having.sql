@@ -1,0 +1,4 @@
+create table hv (g bigint, v bigint);
+insert into hv values (1,10),(1,20),(2,5),(3,100),(3,1);
+select g, sum(v) from hv group by g having sum(v) > 20 order by g;
+select g, count(*) from hv group by g having count(*) >= 2 order by g;

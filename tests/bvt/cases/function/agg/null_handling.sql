@@ -1,0 +1,4 @@
+create table nh (v bigint);
+insert into nh values (NULL), (NULL);
+select count(*), count(v), sum(v), avg(v), min(v), max(v) from nh;
+select stddev(v) from nh;

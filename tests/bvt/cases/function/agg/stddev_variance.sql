@@ -1,0 +1,5 @@
+create table sv (g bigint, v bigint);
+insert into sv values (1,2),(1,4),(1,6),(2,5),(2,NULL),(2,9),(3,7);
+select g, round(var_pop(v), 6), round(var_samp(v), 6) from sv group by g order by g;
+select g, round(stddev(v), 6), round(stddev_pop(v), 6), round(stddev_samp(v), 6) from sv group by g order by g;
+select round(variance(v), 6) from sv;

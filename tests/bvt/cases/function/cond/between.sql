@@ -1,0 +1,5 @@
+create table nums (id bigint primary key, a bigint, b double, d decimal(10,2));
+insert into nums values (1, 5, 1.5, 10.25), (2, -3, 2.25, -4.50),
+  (3, 0, 0.0, 0.00), (4, NULL, NULL, NULL), (5, 12, 3.75, 99.99);
+select id from nums where a between 0 and 10 order by id;
+select id from nums where b not between 1 and 2 order by id;

@@ -1,0 +1,2 @@
+select true and false, true or false, not true;
+select (1 < 2) and (3 > 2), (1 > 2) or (2 > 1);

@@ -1,0 +1,5 @@
+create table nums (id bigint primary key, a bigint, b double, d decimal(10,2));
+insert into nums values (1, 5, 1.5, 10.25), (2, -3, 2.25, -4.50),
+  (3, 0, 0.0, 0.00), (4, NULL, NULL, NULL), (5, 12, 3.75, 99.99);
+select id, case when a > 0 then 'pos' when a < 0 then 'neg' else 'zero' end from nums order by id;
+select case 1 + 1 when 2 then 'two' else 'other' end;

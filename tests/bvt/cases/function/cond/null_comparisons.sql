@@ -1,0 +1,2 @@
+select NULL = NULL, NULL <> 1, NULL is null, NULL is not null;
+select 1 = 1 and NULL is null, NULL and 0;

@@ -1,0 +1,1 @@
+select nullif(5, 5), nullif(5, 6), nullif(NULL, 1);

@@ -1,0 +1,5 @@
+create table dates (id bigint primary key, d date);
+insert into dates values (1, date '1970-01-01'), (2, date '1995-03-15'),
+  (3, date '2024-02-29'), (4, NULL), (5, date '2026-12-31');
+select datediff(date '2024-03-01', date '2024-02-28');
+select id, datediff(d, date '1970-01-01') from dates order by id;

@@ -1,0 +1,2 @@
+select last_day(date '2024-02-05'), last_day(date '2023-02-05');
+select last_day(date '2026-12-31'), last_day(date '2026-01-15');

@@ -1,0 +1,4 @@
+create table dates (id bigint primary key, d date);
+insert into dates values (1, date '1970-01-01'), (2, date '1995-03-15'),
+  (3, date '2024-02-29'), (4, NULL), (5, date '2026-12-31');
+select id, dayname(d), monthname(d) from dates order by id;

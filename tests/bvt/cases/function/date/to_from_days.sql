@@ -1,0 +1,2 @@
+select to_days(date '1970-01-01'), from_days(719528);
+select from_days(to_days(date '1995-03-15'));

@@ -1,0 +1,2 @@
+select unix_timestamp(date '1970-01-02');
+select hour(from_unixtime(3661)), minute(from_unixtime(3661)), second(from_unixtime(3661));

@@ -1,0 +1,4 @@
+select json_extract('{"a": {"b": 7}}', '$.a.b');
+select json_extract('[10, 20, 30]', '$[1]');
+select json_extract('{"a": [1, {"c": true}]}', '$.a[1].c');
+select json_extract('{"a": 1}', '$.missing');

@@ -1,0 +1,2 @@
+select json_length('[1,2,3]'), json_length('{"a":1,"b":2}'), json_length('5');
+select json_type('{}'), json_type('[]'), json_type('3'), json_type('3.5'), json_type('"s"'), json_type('true'), json_type('null');

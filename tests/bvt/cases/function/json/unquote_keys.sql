@@ -1,0 +1,2 @@
+select json_unquote('"hello"'), json_unquote('plain');
+select json_keys('{"a": 1, "b": 2}'), json_keys('[1]');

@@ -1,0 +1,2 @@
+select json_valid('{"a": 1}'), json_valid('[1,2]'), json_valid('not json');
+select json_valid('null'), json_valid('');

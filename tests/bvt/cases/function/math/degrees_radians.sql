@@ -1,0 +1,2 @@
+select degrees(pi()), radians(180.0);
+select round(degrees(1.0), 6), round(radians(90.0), 6);

@@ -1,0 +1,2 @@
+select 7 / 2, 7 / 0, 0 / 5;
+select 1.0 / 3;

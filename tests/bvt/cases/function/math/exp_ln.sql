@@ -1,0 +1,2 @@
+select exp(0), exp(1), ln(1);
+select log(1), ln(exp(2));

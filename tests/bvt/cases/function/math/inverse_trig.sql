@@ -1,0 +1,2 @@
+select asin(0), acos(1), atan(0);
+select round(asin(1), 6), round(atan2(1.0, 1.0), 6), round(cot(1.0), 6);

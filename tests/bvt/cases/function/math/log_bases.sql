@@ -1,0 +1,1 @@
+select log2(8), log10(1000), log2(1), log10(0.01);

@@ -1,0 +1,2 @@
+select mod(10, 3), mod(-10, 3), mod(10, -3), 10 % 3;
+select mod(10.5, 3);

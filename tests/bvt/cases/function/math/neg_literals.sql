@@ -1,0 +1,2 @@
+select -5, -5.5, -(-3), +7;
+select - 2 + 10;

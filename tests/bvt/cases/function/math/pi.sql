@@ -1,0 +1,2 @@
+select round(pi(), 6);
+select round(pi() * 2, 6);

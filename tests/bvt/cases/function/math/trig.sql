@@ -1,0 +1,2 @@
+select sin(0), cos(0), tan(0);
+select round(sin(1.5707963267948966), 6), round(cos(3.141592653589793), 6);

@@ -1,0 +1,2 @@
+select truncate(3.789, 1), truncate(-3.789, 1), truncate(3.789, 0);
+select truncate(123.456, 2), truncate(123.456, -1);

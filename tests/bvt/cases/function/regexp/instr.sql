@@ -1,0 +1,1 @@
+select regexp_instr('foobarbar', 'bar'), regexp_instr('abc', 'z');

@@ -1,0 +1,1 @@
+select regexp_substr('key=value', '[a-z]+'), regexp_substr('abc', '[0-9]');

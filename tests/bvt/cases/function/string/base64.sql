@@ -1,0 +1,2 @@
+select to_base64('hi'), from_base64('aGk=');
+select from_base64('!not-base64!');

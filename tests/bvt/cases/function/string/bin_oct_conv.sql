@@ -1,0 +1,2 @@
+select bin(10), oct(10), conv('10', 10, 16), conv('ff', 16, 10);
+select conv('7', 10, 2);

@@ -1,0 +1,3 @@
+create table cg (v varchar(16));
+insert into cg values ('aa'), ('AA'), ('bb');
+select upper(v), count(*) from cg group by upper(v) order by upper(v);

@@ -1,0 +1,6 @@
+create table strs (id bigint primary key, s varchar(64));
+insert into strs values (1, 'Hello World'), (2, ''), (3, NULL),
+  (4, 'abc,def,ghi'), (5, '  padded  '), (6, 'ünïcôde 世界');
+select field('b', 'a', 'b', 'c'), field('z', 'a', 'b');
+select id, find_in_set('def', s) from strs where id = 4;
+select find_in_set('x', 'a,b,c');

@@ -1,0 +1,3 @@
+select md5('abc');
+select sha1('abc'), sha2('abc', 256);
+select crc32('hello'), crc32('');

@@ -1,0 +1,2 @@
+select hex('Ab'), unhex('4142');
+select unhex('zz');

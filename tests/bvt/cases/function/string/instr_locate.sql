@@ -1,0 +1,2 @@
+select instr('foobar', 'bar'), instr('foobar', 'zzz');
+select locate('bar', 'foobar'), locate('o', 'foobar', 4), position('ob', 'foobar');

@@ -1,0 +1,7 @@
+create table strs (id bigint primary key, s varchar(64));
+insert into strs values (1, 'Hello World'), (2, ''), (3, NULL),
+  (4, 'abc,def,ghi'), (5, '  padded  '), (6, 'ünïcôde 世界');
+select id, s from strs where s like 'Hello%' order by id;
+select id, s from strs where s like '%c,d%' order by id;
+select id from strs where s like '_ello World' order by id;
+select id from strs where s not like '%o%' order by id;

@@ -1,0 +1,3 @@
+select lpad('hi', 5, 'ab'), rpad('hi', 5, 'ab');
+select lpad('hello', 3, '*'), rpad('hello', 3, '*');
+select lpad('x', 4, ''), rpad('x', 4, '');

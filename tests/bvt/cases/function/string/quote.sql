@@ -1,0 +1,1 @@
+select quote('it''s'), quote('plain');

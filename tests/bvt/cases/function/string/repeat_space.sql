@@ -1,0 +1,2 @@
+select repeat('xy', 3), repeat('a', 0);
+select concat('[', space(3), ']');

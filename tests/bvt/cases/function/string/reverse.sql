@@ -1,0 +1,1 @@
+select reverse('abc'), reverse(''), reverse('ab cd');

@@ -1,0 +1,2 @@
+select soundex('Robert'), soundex('Rupert'), soundex('Tymczak');
+select soundex('');

@@ -1,0 +1,1 @@
+select strcmp('a', 'b'), strcmp('b', 'b'), strcmp('c', 'b');

@@ -1,0 +1,5 @@
+create table strs (id bigint primary key, s varchar(64));
+insert into strs values (1, 'Hello World'), (2, ''), (3, NULL),
+  (4, 'abc,def,ghi'), (5, '  padded  '), (6, 'ünïcôde 世界');
+select id, substring(s, 1, 5), substr(s, 2) from strs order by id;
+select substring('abcdef', 3), substring('abcdef', -2), substring('abcdef', 2, 3);

@@ -1,0 +1,2 @@
+select substring_index('a,b,c,d', ',', 2), substring_index('a,b,c,d', ',', -1);
+select substring_index('www.example.com', '.', 1), substring_index('abc', 'x', 1);

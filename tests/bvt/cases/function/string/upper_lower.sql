@@ -1,0 +1,5 @@
+create table strs (id bigint primary key, s varchar(64));
+insert into strs values (1, 'Hello World'), (2, ''), (3, NULL),
+  (4, 'abc,def,ghi'), (5, '  padded  '), (6, 'ünïcôde 世界');
+select id, upper(s), lower(s) from strs order by id;
+select ucase('mIxEd'), lcase('MiXeD');

@@ -1,0 +1,5 @@
+create table a1 (x bigint);
+insert into a1 values (1), (2);
+create table b1 (y bigint);
+insert into b1 values (10), (20);
+select x, y from a1, b1 order by x, y;

@@ -1,0 +1,5 @@
+create table emp (id bigint primary key, dept bigint, pay bigint);
+insert into emp values (1, 10, 100), (2, 10, 200), (3, 20, 300), (4, NULL, 400);
+create table dept (id bigint primary key, name varchar(16));
+insert into dept values (10, 'eng'), (20, 'sales'), (30, 'empty');
+select e.id, d.name from emp e left join dept d on e.dept = d.id order by e.id;

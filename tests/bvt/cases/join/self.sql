@@ -1,0 +1,3 @@
+create table sj (id bigint primary key, boss bigint);
+insert into sj values (1, NULL), (2, 1), (3, 1), (4, 2);
+select w.id, b.id from sj w join sj b on w.boss = b.id order by w.id;

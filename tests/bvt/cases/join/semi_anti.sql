@@ -1,0 +1,6 @@
+create table emp (id bigint primary key, dept bigint, pay bigint);
+insert into emp values (1, 10, 100), (2, 10, 200), (3, 20, 300), (4, NULL, 400);
+create table dept (id bigint primary key, name varchar(16));
+insert into dept values (10, 'eng'), (20, 'sales'), (30, 'empty');
+select d.id, d.name from dept d where exists (select 1 from emp e where e.dept = d.id) order by d.id;
+select d.id, d.name from dept d where not exists (select 1 from emp e where e.dept = d.id) order by d.id;

@@ -1,0 +1,2 @@
+select cast(3.7 as bigint), cast(5 as double);
+select cast('42' as bigint) + 1;

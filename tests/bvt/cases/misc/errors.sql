@@ -1,0 +1,3 @@
+select no_such_column;
+select * from no_such_table;
+select unknown_func(1);

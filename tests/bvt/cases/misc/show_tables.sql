@@ -1,0 +1,3 @@
+create table zz1 (id bigint primary key);
+create table aa1 (id bigint primary key);
+show tables;

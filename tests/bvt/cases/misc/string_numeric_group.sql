@@ -1,0 +1,3 @@
+create table mix (s varchar(8), v decimal(8,2));
+insert into mix values ('a', 1.50), ('b', 2.25), ('a', 3.00), (NULL, 4.75);
+select s, sum(v), count(*) from mix group by s order by s;

@@ -1,0 +1,7 @@
+create table nums (id bigint primary key, a bigint, b double, d decimal(10,2));
+insert into nums values (1, 5, 1.5, 10.25), (2, -3, 2.25, -4.50),
+  (3, 0, 0.0, 0.00), (4, NULL, NULL, NULL), (5, 12, 3.75, 99.99);
+with big as (select id, a from nums where a > 0)
+select count(*), sum(a) from big;
+with x as (select a from nums where a is not null), y as (select a from x where a > 0)
+select sum(a) from y;

@@ -1,0 +1,4 @@
+create table dk (v bigint, w varchar(8));
+insert into dk values (1, 'a'), (1, 'a'), (2, 'a'), (2, 'b'), (1, 'a');
+select distinct v, w from dk order by v, w;
+select distinct v from dk order by v;

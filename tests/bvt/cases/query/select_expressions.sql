@@ -1,0 +1,2 @@
+select 1 + 2 * 3, (1 + 2) * 3;
+select 10 > 5, 'a' = 'a', 1 <> 2;

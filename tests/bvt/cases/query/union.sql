@@ -1,0 +1,6 @@
+create table ua (v bigint);
+insert into ua values (1), (2);
+create table ub (v bigint);
+insert into ub values (2), (3);
+select v from ua union all select v from ub order by v;
+select v from ua union select v from ub order by v;

@@ -1,0 +1,3 @@
+create table wa (g bigint, v bigint);
+insert into wa values (1, 10), (1, 20), (2, 5), (2, 15), (2, 30);
+select g, v, sum(v) over (partition by g) from wa order by g, v;

@@ -1,0 +1,3 @@
+create table w (g bigint, v bigint);
+insert into w values (1, 30), (1, 10), (1, 20), (2, 5), (2, 15);
+select g, v, row_number() over (partition by g order by v) from w order by g, v;

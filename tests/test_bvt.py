@@ -1,0 +1,51 @@
+"""BVT golden-SQL regression harness (VERDICT r3 directive 6).
+
+Reference analogue: test/distributed/cases (1,133 .sql/.result files run
+by mo-tester) — each case under tests/bvt/cases executes on a fresh
+Session and its output must match the committed .result golden byte for
+byte. Regenerate intentionally-changed goldens with
+`python tools/bvt_record.py <case.sql>`.
+"""
+
+import difflib
+import os
+
+import pytest
+
+from matrixone_tpu.frontend import Session
+from matrixone_tpu.utils import bvt
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bvt",
+                    "cases")
+CASES = bvt.iter_cases(ROOT)
+
+
+def _rel(p):
+    return os.path.relpath(p, ROOT)[:-4]
+
+
+@pytest.mark.parametrize("case", CASES, ids=[_rel(c) for c in CASES])
+def test_bvt_case(case):
+    with open(case) as f:
+        text = f.read()
+    golden_path = case[:-4] + ".result"
+    assert os.path.exists(golden_path), \
+        f"missing golden {golden_path}; run tools/bvt_record.py {case}"
+    with open(golden_path) as f:
+        golden = f.read()
+    s = Session()
+    try:
+        got = bvt.run_case(s, text)
+    finally:
+        s.close()
+    if got != golden:
+        diff = "\n".join(difflib.unified_diff(
+            golden.splitlines(), got.splitlines(),
+            "golden", "actual", lineterm=""))
+        raise AssertionError(f"BVT mismatch for {_rel(case)}:\n{diff}")
+
+
+def test_corpus_size():
+    """The harness only counts if the corpus is real (directive: >=100
+    green case files)."""
+    assert len(CASES) >= 100, f"only {len(CASES)} BVT cases"

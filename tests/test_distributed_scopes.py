@@ -249,5 +249,12 @@ def test_tpch_q3_q18_across_two_cn_processes(dist_cluster, qnum):
     _cols, dist = c.query(sql)
     ran = sum(_frag_stats(p) for p in frag_ports) - before
     assert dist == local, f"Q{qnum}: distributed != local over the wire"
+    if ran < 2:
+        # a cold peer under machine load can time one fragment out and
+        # fall back to local (by design); the warm retry must fan out
+        before = sum(_frag_stats(p) for p in frag_ports)
+        _cols, dist = c.query(sql)
+        ran = sum(_frag_stats(p) for p in frag_ports) - before
+        assert dist == local, f"Q{qnum}: warm retry != local"
     assert ran >= 2, f"Q{qnum} did not fan out across CN processes"
     assert len(local) > 0, f"Q{qnum} returned no rows (weak corpus)"

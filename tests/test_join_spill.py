@@ -1,0 +1,94 @@
+"""Join build-side Grace spill (VERDICT r3 directive 2): a build side
+larger than the device budget hash-partitions both sides to host disk
+and joins partition-by-partition — exact for every keyed join kind.
+
+Reference analogue: pkg/sql/colexec/spillutil/join_spill.go +
+spill_threshold.go.
+"""
+
+import pytest
+
+from matrixone_tpu.frontend import Session
+from matrixone_tpu.utils import metrics as M
+from matrixone_tpu.utils import tpch_full as T
+
+
+@pytest.fixture(scope="module")
+def rig():
+    s = Session()
+    s.execute("create table f (id bigint primary key, k bigint,"
+              " tag varchar(8), v bigint)")
+    # duplicates on k, NULL keys, strings — every join hazard at once
+    rows = []
+    for i in range(3000):
+        k = "NULL" if i % 11 == 7 else str(i % 40)
+        rows.append(f"({i},{k},'t{i % 5}',{i % 100})")
+    s.execute(f"insert into f values {', '.join(rows)}")
+    s.execute("create table d (k bigint, name varchar(8), w bigint)")
+    rows = []
+    for i in range(600):
+        k = "NULL" if i % 13 == 5 else str(i % 55)
+        rows.append(f"({k},'n{i % 7}',{i})")
+    s.execute(f"insert into d values {', '.join(rows)}")
+    return s
+
+
+QUERIES = [
+    ("inner", "select f.id, d.w from f join d on f.k = d.k"
+              " order by f.id, d.w"),
+    ("left", "select f.id, d.name from f left join d on f.k = d.k"
+             " order by f.id, d.name"),
+    ("semi", "select f.id from f where exists"
+             " (select 1 from d where d.k = f.k) order by f.id"),
+    ("anti", "select f.id from f where not exists"
+             " (select 1 from d where d.k = f.k) order by f.id"),
+    ("agg-over-join", "select d.name, sum(f.v), count(*) from f"
+                      " join d on f.k = d.k group by d.name"
+                      " order by d.name"),
+]
+
+
+@pytest.mark.parametrize("kind,sql", QUERIES, ids=[k for k, _ in QUERIES])
+def test_spilled_join_matches_in_memory(rig, kind, sql):
+    s = rig
+    s.variables.pop("join_build_budget", None)
+    expect = s.execute(sql).rows()
+    before = M.join_spills.get()
+    s.variables["join_build_budget"] = 64     # build is 600 rows
+    try:
+        got = s.execute(sql).rows()
+    finally:
+        s.variables.pop("join_build_budget", None)
+    assert M.join_spills.get() > before, "join never spilled"
+    assert got == expect
+
+
+def test_spill_survives_overflow_rerun(rig):
+    """Duplicate fan-out overflow (max_matches doubling) inside a
+    spilled partition must still re-run correctly."""
+    s = rig
+    sql = ("select f.k, count(*) from f join d on f.k = d.k"
+           " group by f.k order by f.k")
+    expect = s.execute(sql).rows()
+    s.variables["join_build_budget"] = 16
+    try:
+        got = s.execute(sql).rows()
+    finally:
+        s.variables.pop("join_build_budget", None)
+    assert got == expect
+
+
+def test_tpch_q3_with_forced_spill():
+    """Spill inside a real multi-join analytical query: Q3 with a tiny
+    build budget still matches the sqlite oracle."""
+    s = Session()
+    tables = T.load_tpch(s.catalog, sf=0.004, seed=1)
+    conn = T.to_sqlite(tables)
+    before = M.join_spills.get()
+    s.variables["join_build_budget"] = 128
+    try:
+        T.run_compare(s, conn, 3)
+    finally:
+        s.variables.pop("join_build_budget", None)
+        conn.close()
+    assert M.join_spills.get() > before, "Q3 never spilled a join"

@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""(Re)generate BVT goldens: python tools/bvt_record.py [case.sql ...]
+
+With no arguments, records every case under tests/bvt/cases. Review the
+diff before committing — the goldens pin engine behavior (reference:
+mo-tester regenerating .result files).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from matrixone_tpu.frontend import Session  # noqa: E402
+from matrixone_tpu.utils import bvt  # noqa: E402
+
+ROOT = os.path.join(os.path.dirname(__file__), "..", "tests", "bvt",
+                    "cases")
+
+
+def main() -> None:
+    cases = sys.argv[1:] or bvt.iter_cases(ROOT)
+    for path in cases:
+        bvt.record(path, Session)
+        print(f"recorded {os.path.relpath(path)}")
+
+
+if __name__ == "__main__":
+    main()
