@@ -192,12 +192,11 @@ def _decode_binary_params(body: bytes, pos: int, stmt: _PreparedStmt) -> list:
 
 
 class _Conn:
-    def __init__(self, sock: socket.socket, session: Session,
-                 users: Optional[Dict[str, bytes]], insecure: bool):
+    def __init__(self, sock: socket.socket, server: "MOServer"):
         self.sock = sock
-        self.session = session
-        self.users = users or {}
-        self.insecure = insecure
+        self.server = server
+        self.session: Optional[Session] = None
+        self.insecure = server.insecure
         self.seq = 0
         self._stmts: Dict[int, _PreparedStmt] = {}
         self._next_stmt = 1
@@ -259,11 +258,14 @@ class _Conn:
         return nonce
 
     def authenticate(self, nonce: bytes) -> bool:
-        """Parse HandshakeResponse41 and verify the scramble."""
+        """Parse HandshakeResponse41, verify the scramble, and resolve
+        the account context ('account:user' logins select the tenant —
+        reference: authenticate.go)."""
         pkt = self._recv()
         if pkt is None:
             return False
         if self.insecure:
+            self.session = self.server.make_session(None)
             return True
         try:
             caps = int.from_bytes(pkt[0:4], "little")
@@ -287,11 +289,15 @@ class _Conn:
             self.send_err("malformed handshake response", code=1043,
                           state="08S01")
             return False
-        stage2 = self.users.get(user)
-        if stage2 is None or not verify_native_password(stage2, nonce, auth):
+        resolved = self.server.auth_mgr.resolve_login(user)
+        if resolved is None or not verify_native_password(
+                resolved[2], nonce, auth):
             self.send_err(f"Access denied for user '{user}'",
                           code=1045, state="28000")
             return False
+        account, uname, _stage2 = resolved
+        ctx = self.server.auth_mgr.context_for(account, uname)
+        self.session = self.server.make_session(ctx)
         return True
 
     def send_ok(self, affected: int = 0, info: str = ""):
@@ -458,7 +464,8 @@ class _Conn:
         except (OSError, ConnectionError):
             return   # client went away mid-exchange; nothing to clean up
         finally:
-            self.session.close()   # release the processlist slot
+            if self.session is not None:
+                self.session.close()   # release the processlist slot
             try:
                 self.sock.close()
             except OSError:
@@ -486,11 +493,23 @@ class MOServer:
         self.users = {u: (password_stage2(p) if p else b"")
                       for u, p in users.items()}
         self.insecure = insecure
+        self.auth_mgr = None
         self._sock: Optional[socket.socket] = None
         self._thread: Optional[threading.Thread] = None
         self._stopping = threading.Event()
 
+    def make_session(self, ctx) -> Session:
+        return Session(catalog=self.engine, auth=ctx,
+                       auth_manager=self.auth_mgr)
+
     def start(self):
+        if not self.insecure:
+            # accounts/users/roles live in engine tables and replicate
+            # through the logtail; the seeded users land in the sys
+            # account (frontend/auth.py)
+            from matrixone_tpu.frontend.auth import AccountManager
+            self.auth_mgr = AccountManager(self.engine,
+                                           seed_users=dict(self.users))
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((self.host, self.port))
@@ -506,8 +525,7 @@ class MOServer:
                 sock, _ = self._sock.accept()
             except OSError:
                 return
-            session = Session(catalog=self.engine)
-            conn = _Conn(sock, session, self.users, self.insecure)
+            conn = _Conn(sock, self)
             threading.Thread(target=conn.run, daemon=True).start()
 
     def stop(self):

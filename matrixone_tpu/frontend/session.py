@@ -53,14 +53,24 @@ class Session:
     and (later) transaction state hang off this object."""
 
     def __init__(self, catalog: Optional[Engine] = None, fs=None,
-                 user: str = "root"):
+                 user: str = "root", auth=None, auth_manager=None):
         from matrixone_tpu.queryservice import registry_for
         self.catalog = catalog if catalog is not None else Engine(fs)
+        #: AuthContext of the logged-in user (None = trusted embedded
+        #: session, unrestricted); non-sys accounts see a tenant-scoped
+        #: catalog (frontend/auth.py, reference: authenticate.go)
+        self.auth = auth
+        self.auth_mgr = auth_manager
+        if auth is not None and auth.account != "sys":
+            from matrixone_tpu.frontend.auth import ScopedCatalog
+            self.catalog = ScopedCatalog(self.catalog, auth.account)
         self.txn_client = TxnClient(self.catalog)
         self.txn = None                 # active explicit transaction
         self.variables = {"gpu_mode": 1, "batch_rows": 1 << 20}
         self._procs = registry_for(self.catalog)
-        self.conn_id = self._procs.register(user)
+        self.conn_id = self._procs.register(user if auth is None
+                                            else f"{auth.account}:"
+                                                 f"{auth.user}")
 
     def close(self) -> None:
         """Release the session's process-registry slot (the wire server
@@ -86,8 +96,11 @@ class Session:
         import time as _time
         from matrixone_tpu.utils import metrics as M
         from matrixone_tpu.utils.trace import STMT_TABLE, StatementRecorder
-        if not hasattr(self.catalog, "stmt_recorder"):
-            self.catalog.stmt_recorder = StatementRecorder(self.catalog)
+        # statement tracing is engine-global (one system table), never
+        # tenant-scoped — always hang it off the inner engine
+        rec_host = getattr(self.catalog, "_inner", self.catalog)
+        if not hasattr(rec_host, "stmt_recorder"):
+            rec_host.stmt_recorder = StatementRecorder(rec_host)
         if STMT_TABLE in sql:
             self.catalog.stmt_recorder.flush()
         stmts = parse(sql)
@@ -118,7 +131,63 @@ class Session:
             results.append(r)
         return results[-1] if results else Result()
 
+    # ------------------------------------------------------ privileges
+    def _mgr(self):
+        """The engine's AccountManager (shared; lazily bootstrapped so
+        embedded sessions can manage accounts too)."""
+        if self.auth_mgr is not None:
+            return self.auth_mgr
+        inner = getattr(self.catalog, "_inner", self.catalog)
+        mgr = getattr(inner, "_auth_mgr", None)
+        if mgr is None:
+            from matrixone_tpu.frontend.auth import AccountManager
+            mgr = AccountManager(inner)
+            inner._auth_mgr = mgr
+        self.auth_mgr = mgr
+        return mgr
+
+    def _acct(self) -> str:
+        return self.auth.account if self.auth is not None else "sys"
+
+    def _check(self, priv: str, obj: str = "*") -> None:
+        if self.auth is None or self.auth.is_admin:
+            return
+        self._mgr().check(self.auth, priv, obj)
+
+    def _check_admin(self) -> None:
+        if self.auth is not None and not self.auth.is_admin:
+            from matrixone_tpu.frontend.auth import AuthError
+            raise AuthError(
+                f"access denied: {self.auth.user!r} is not an account "
+                f"administrator")
+
+    def _enforce(self, stmt: ast.Node) -> None:
+        """Per-statement privilege gate (reference: authenticate.go
+        determinePrivilege + privilege check before execution)."""
+        if self.auth is None or self.auth.is_admin:
+            return
+        if isinstance(stmt, ast.Insert):
+            self._check("insert", stmt.table)
+        elif isinstance(stmt, ast.Update):
+            self._check("update", stmt.table)
+        elif isinstance(stmt, ast.Delete):
+            self._check("delete", stmt.table)
+        elif isinstance(stmt, ast.LoadData):
+            self._check("insert", stmt.table)
+        elif isinstance(stmt, ast.DropTable):
+            self._check("drop", stmt.name)
+        elif isinstance(stmt, (ast.CreateTable, ast.CreateIndex,
+                               ast.CreateExternalTable, ast.CreateSource,
+                               ast.CreateDynamicTable, ast.CreateStage,
+                               ast.CreateSnapshot, ast.CreatePublication,
+                               ast.AlterPartition, ast.RestoreTable)):
+            self._check("create")
+
     def _execute_stmt(self, stmt: ast.Node) -> Result:
+        self._enforce(stmt)
+        acc = self._account_stmt(stmt)
+        if acc is not None:
+            return acc
         if isinstance(stmt, (ast.Select, ast.Union)):
             return self._select(stmt)
         if isinstance(stmt, ast.CreateTable):
@@ -571,6 +640,9 @@ class Session:
         node = apply_indices(node, self.catalog,
                              nprobe=int(self.variables.get("ivf_nprobe", 8)),
                              skip_tables=self._index_skip_tables())
+        if self.auth is not None and not self.auth.is_admin:
+            for tname in _plan_tables(node):
+                self._check("select", tname)
         ctx = self._ctx()
         node = self._maybe_distribute(node, ctx)
         op = compile_plan(node, ctx)
@@ -593,6 +665,75 @@ class Session:
                 vals.extend(b.columns[n].to_pylist())
             cols[n] = Vector.from_values(vals, d)
         return Result(batch=Batch(cols))
+
+    def _account_stmt(self, stmt: ast.Node) -> Optional[Result]:
+        """CREATE ACCOUNT/USER/ROLE, GRANT/REVOKE, SHOW GRANTS
+        (reference: frontend/authenticate.go handlers)."""
+        from matrixone_tpu.frontend.auth import SYS_ACCOUNT, AuthError
+        if isinstance(stmt, ast.CreateAccount):
+            # only the sys account provisions tenants (reference rule)
+            if self.auth is not None and self._acct() != SYS_ACCOUNT:
+                raise AuthError("only the sys account can create accounts")
+            self._check_admin()
+            self._mgr().create_account(stmt.name, stmt.admin_user,
+                                       stmt.admin_password,
+                                       stmt.if_not_exists)
+            return Result()
+        if isinstance(stmt, ast.DropAccount):
+            if self.auth is not None and self._acct() != SYS_ACCOUNT:
+                raise AuthError("only the sys account can drop accounts")
+            self._check_admin()
+            self._mgr().drop_account(stmt.name)
+            return Result()
+        if isinstance(stmt, ast.CreateUser):
+            self._check_admin()
+            self._mgr().create_user(self._acct(), stmt.name,
+                                    stmt.password, stmt.if_not_exists)
+            return Result()
+        if isinstance(stmt, ast.DropUser):
+            self._check_admin()
+            self._mgr().drop_user(self._acct(), stmt.name)
+            return Result()
+        if isinstance(stmt, ast.CreateRole):
+            self._check_admin()
+            self._mgr().create_role(self._acct(), stmt.name)
+            return Result()
+        if isinstance(stmt, ast.DropRole):
+            self._check_admin()
+            self._mgr().drop_role(self._acct(), stmt.name)
+            return Result()
+        if isinstance(stmt, ast.GrantPriv):
+            self._check_admin()
+            self._mgr().grant_priv(self._acct(), stmt.privs, stmt.obj,
+                                   stmt.role)
+            return Result()
+        if isinstance(stmt, ast.RevokePriv):
+            self._check_admin()
+            self._mgr().revoke_priv(self._acct(), stmt.privs, stmt.obj,
+                                    stmt.role)
+            return Result()
+        if isinstance(stmt, ast.GrantRole):
+            self._check_admin()
+            self._mgr().grant_role(self._acct(), stmt.role, stmt.user)
+            return Result()
+        if isinstance(stmt, ast.RevokeRole):
+            self._check_admin()
+            self._mgr().revoke_role(self._acct(), stmt.role, stmt.user)
+            return Result()
+        if isinstance(stmt, ast.ShowGrants):
+            user = stmt.user or (self.auth.user if self.auth else "root")
+            if stmt.user and stmt.user != (
+                    self.auth.user if self.auth else "root"):
+                self._check_admin()
+            rows = self._mgr().grants_for(self._acct(), user)
+            b = Batch.from_pydict(
+                {"Role": [r for r, _o, _p in rows],
+                 "Object": [o for _r, o, _p in rows],
+                 "Privilege": [p for _r, _o, p in rows]},
+                {"Role": dt.VARCHAR, "Object": dt.VARCHAR,
+                 "Privilege": dt.VARCHAR})
+            return Result(batch=b)
+        return None
 
     def _maybe_distribute(self, node, ctx):
         """Distributed scopes (reference: compile decides Magic: Remote,
@@ -765,7 +906,7 @@ class Session:
                 build_fn(self.catalog, meta)
             except ValueError as e:
                 raise BindError(str(e))
-            self.catalog.indexes[stmt.name] = meta
+            self.catalog.register_index(meta)
             indexing.register_in_cache(self.catalog, meta)
             return Result()
         if algo == "fulltext":
@@ -777,7 +918,7 @@ class Session:
             meta = IndexMeta(stmt.name, stmt.table, stmt.columns,
                              "fulltext", dict(stmt.options), dirty=True)
             indexing.build_fulltext(self.catalog, meta)
-            self.catalog.indexes[stmt.name] = meta
+            self.catalog.register_index(meta)
             indexing.register_in_cache(self.catalog, meta)
             return Result()
         raise BindError(f"unsupported index algo {stmt.using!r}")
@@ -1039,6 +1180,21 @@ class Session:
         else:
             n = table.insert_batch(batch)
         return Result(affected=n)
+
+
+def _plan_tables(node) -> set:
+    """Base tables a plan reads (SELECT privilege targets)."""
+    out = set()
+    t = getattr(node, "table", None)
+    if isinstance(t, str):
+        out.add(t)
+    for attr in ("child", "left", "right"):
+        c = getattr(node, attr, None)
+        if c is not None:
+            out |= _plan_tables(c)
+    for c in getattr(node, "children", []) or []:
+        out |= _plan_tables(c)
+    return out
 
 
 def _param_literal(v) -> ast.Node:

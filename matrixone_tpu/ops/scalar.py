@@ -376,9 +376,11 @@ def truncate(a: DeviceColumn, digits: int = 0) -> DeviceColumn:
 
 def _pick2(a: DeviceColumn, b: DeviceColumn, fn) -> DeviceColumn:
     """GREATEST/LEAST pairwise step: NULL if either side is NULL
-    (MySQL semantics), decimal scales aligned first."""
-    if TypeOid.DECIMAL64 in (a.dtype.oid, b.dtype.oid) \
-            and not (a.dtype.is_float or b.dtype.is_float):
+    (MySQL semantics); decimal scales align, and a decimal mixed with a
+    float enters as its REAL value (descale), never as scaled ints."""
+    if a.dtype.is_float or b.dtype.is_float:
+        a, b = _descale_for_float(a, b)
+    elif TypeOid.DECIMAL64 in (a.dtype.oid, b.dtype.oid):
         da_, db_, s_ = _decimal_rescale(a, b)
         a = DeviceColumn(da_, a.validity, dt.decimal64(scale=s_))
         b = DeviceColumn(db_, b.validity, dt.decimal64(scale=s_))

@@ -71,6 +71,10 @@ def execute_fragment(catalog, header: dict) -> Tuple[dict, bytes]:
     consumer = getattr(catalog, "consumer", None)
     if consumer is not None and snapshot_ts is not None:
         consumer.wait_ts(snapshot_ts)   # peer must reach the snapshot
+    if header.get("account"):
+        # tenant fragment: resolve names in the tenant's namespace
+        from matrixone_tpu.frontend.auth import ScopedCatalog
+        catalog = ScopedCatalog(catalog, header["account"])
     ctx = ExecContext(catalog=catalog, frozen_ts=snapshot_ts,
                       variables={"batch_rows":
                                  int(header.get("batch_rows", 1 << 16))})
@@ -487,6 +491,7 @@ def _dist_aggregate(split: _Split, catalog, snap, peers: FragmentPeers,
             "snapshot_ts": snap,
             "batch_rows": batch_rows,
             "shard_table": split.scan_table,
+            "account": getattr(catalog, "_acct", None),
         })
     results = peers.run(headers)
     _check_sigs(results, peers.addrs)
@@ -649,6 +654,7 @@ def _dist_topk(split: _Split, catalog, snap, peers: FragmentPeers,
         "snapshot_ts": snap,
         "batch_rows": batch_rows,
         "shard_table": split.scan_table,
+        "account": getattr(catalog, "_acct", None),
     } for i in range(n)]
     results = peers.run(headers)
     _check_sigs(results, peers.addrs)

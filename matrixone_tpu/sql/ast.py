@@ -415,6 +415,73 @@ class SetVariable(Node):
     value: Node
 
 
+# ---- accounts / users / roles / privileges (frontend/authenticate.go)
+@dataclasses.dataclass
+class CreateAccount(Node):
+    name: str
+    admin_user: str
+    admin_password: str
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass
+class DropAccount(Node):
+    name: str
+
+
+@dataclasses.dataclass
+class CreateUser(Node):
+    name: str
+    password: str
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass
+class DropUser(Node):
+    name: str
+
+
+@dataclasses.dataclass
+class CreateRole(Node):
+    name: str
+
+
+@dataclasses.dataclass
+class DropRole(Node):
+    name: str
+
+
+@dataclasses.dataclass
+class GrantPriv(Node):
+    privs: list          # ["select", ...]
+    obj: str             # table name or "*"
+    role: str
+
+
+@dataclasses.dataclass
+class RevokePriv(Node):
+    privs: list
+    obj: str
+    role: str
+
+
+@dataclasses.dataclass
+class GrantRole(Node):
+    role: str
+    user: str
+
+
+@dataclasses.dataclass
+class RevokeRole(Node):
+    role: str
+    user: str
+
+
+@dataclasses.dataclass
+class ShowGrants(Node):
+    user: "str | None" = None
+
+
 @dataclasses.dataclass
 class BeginTxn(Node):
     pass

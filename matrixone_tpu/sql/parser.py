@@ -196,7 +196,73 @@ class Parser:
             return ast.CommitTxn()
         if self.accept_kw("rollback"):
             return ast.RollbackTxn()
+        if self.at_ident("grant"):
+            return self.grant()
+        if self.at_ident("revoke"):
+            return self.revoke()
         raise ParseError(f"unsupported statement near {self.peek().value!r}")
+
+    # ---------------------------------------------- accounts/privileges
+    def at_ident(self, word: str) -> bool:
+        t = self.peek()
+        return t.kind == "ident" and t.value.lower() == word
+
+    def _word(self, what: str = "name") -> str:
+        """A bare word: keyword or identifier (privilege names like
+        SELECT/DROP are keywords; user/role names are identifiers).
+        Case is preserved — privilege-name call sites lowercase."""
+        t = self.next()
+        if t.kind not in ("kw", "ident"):
+            raise ParseError(f"expected {what}, got {t.value!r}")
+        return t.value
+
+    def _expect_word(self, word: str) -> None:
+        t = self.next()
+        if t.kind not in ("kw", "ident") or t.value.lower() != word:
+            raise ParseError(f"expected {word.upper()}")
+
+    def _str_lit(self, what: str) -> str:
+        tok = self.next()
+        if tok.kind != "str":
+            raise ParseError(f"{what} must be a string literal")
+        return tok.value
+
+    def grant(self) -> ast.Node:
+        self.next()                      # GRANT
+        first = self._word("privilege or role")
+        words = [first]
+        while self.accept_op(","):
+            words.append(self._word("privilege"))
+        if len(words) == 1 and not self.at_kw("on"):
+            # GRANT role TO [USER] user — names keep their case
+            self._expect_word("to")
+            if self.at_ident("user"):
+                self.next()
+            return ast.GrantRole(first, self._word("user"))
+        self.expect_kw("on")
+        self.accept_kw("table")
+        obj = "*" if self.accept_op("*") else self.ident()
+        self._expect_word("to")
+        return ast.GrantPriv([w.lower() for w in words], obj,
+                             self._word("role"))
+
+    def revoke(self) -> ast.Node:
+        self.next()                      # REVOKE
+        first = self._word("privilege or role")
+        words = [first]
+        while self.accept_op(","):
+            words.append(self._word("privilege"))
+        if len(words) == 1 and not self.at_kw("on"):
+            self.expect_kw("from")
+            if self.at_ident("user"):
+                self.next()
+            return ast.RevokeRole(first, self._word("user"))
+        self.expect_kw("on")
+        self.accept_kw("table")
+        obj = "*" if self.accept_op("*") else self.ident()
+        self.expect_kw("from")
+        return ast.RevokePriv([w.lower() for w in words], obj,
+                              self._word("role"))
 
     def show(self) -> ast.Node:
         self.expect_kw("show")
@@ -204,6 +270,14 @@ class Parser:
             return ast.ShowTables()
         if self.accept_kw("snapshots"):
             return ast.ShowSnapshots()
+        if self.at_ident("grants"):
+            self.next()
+            user = None
+            t = self.peek()
+            if t.kind in ("kw", "ident") and t.value.lower() == "for":
+                self.next()
+                user = self.next().value
+            return ast.ShowGrants(user)
         nxt = self.peek()
         if nxt.kind == "ident" and nxt.value.lower() == "stages":
             self.next()
@@ -448,6 +522,37 @@ class Parser:
     def create(self) -> ast.Node:
         self.expect_kw("create")
         t0 = self.peek()
+        if t0.kind == "ident" and t0.value.lower() == "account":
+            # CREATE ACCOUNT [IF NOT EXISTS] name
+            #   ADMIN_NAME 'user' IDENTIFIED BY 'password'
+            self.next()
+            ine = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                ine = True
+            name = self.ident()
+            self._expect_word("admin_name")
+            admin = self._str_lit("ADMIN_NAME")
+            self._expect_word("identified")
+            self._expect_word("by")
+            return ast.CreateAccount(name, admin,
+                                     self._str_lit("password"), ine)
+        if t0.kind == "ident" and t0.value.lower() == "user":
+            # CREATE USER [IF NOT EXISTS] name IDENTIFIED BY 'password'
+            self.next()
+            ine = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                ine = True
+            name = self.ident()
+            self._expect_word("identified")
+            self._expect_word("by")
+            return ast.CreateUser(name, self._str_lit("password"), ine)
+        if t0.kind == "ident" and t0.value.lower() == "role":
+            self.next()
+            return ast.CreateRole(self.ident())
         if t0.kind == "ident" and t0.value.lower() == "stage":
             # CREATE STAGE name URL = 'url'
             self.next()
@@ -667,6 +772,15 @@ class Parser:
         if t0.kind == "ident" and t0.value.lower() == "publication":
             self.next()
             return ast.DropPublication(self.ident())
+        if t0.kind == "ident" and t0.value.lower() == "account":
+            self.next()
+            return ast.DropAccount(self.ident())
+        if t0.kind == "ident" and t0.value.lower() == "user":
+            self.next()
+            return ast.DropUser(self.ident())
+        if t0.kind == "ident" and t0.value.lower() == "role":
+            self.next()
+            return ast.DropRole(self.ident())
         self.expect_kw("table")
         if_exists = False
         if self.accept_kw("if"):

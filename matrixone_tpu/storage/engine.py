@@ -765,6 +765,11 @@ class Engine:
     def get_table_meta(self, name: str) -> TableMeta:
         return self.get_table(name).meta
 
+    def register_index(self, meta: IndexMeta) -> None:
+        """Catalog an index meta (sessions go through this rather than
+        mutating `indexes` directly, so tenant scoping can intercept)."""
+        self.indexes[meta.name] = meta
+
     def indexes_on(self, table: str) -> List[IndexMeta]:
         return [ix for ix in self.indexes.values() if ix.table == table]
 

@@ -200,9 +200,12 @@ _JSON_BAD = object()
 
 
 def _json_path(doc, path: str):
-    """$.a.b[0] subset of MySQL JSON paths; returns _JSON_BAD on miss."""
+    """$.a.b[0] subset of MySQL JSON paths; returns _JSON_BAD on miss
+    AND on any path syntax outside the subset (never a silent partial
+    parse that extracts from the wrong place)."""
     import re as _re
-    if not path.startswith("$"):
+    if not _re.fullmatch(r"\$(?:\.[A-Za-z_][A-Za-z_0-9]*|\[\d+\])*",
+                         path):
         return _JSON_BAD
     cur = doc
     for m in _re.finditer(r"\.([A-Za-z_][A-Za-z_0-9]*)|\[(\d+)\]",

@@ -173,7 +173,10 @@ class JoinOp(Operator):
                 pending_sums.append(jnp.sum(ex.mask.astype(jnp.int64)))
                 if padded <= self.build_budget:
                     continue
-                live = int(jax.device_get(sum(pending_sums)))
+                # drain the un-synced sums into the running counter: one
+                # host sync per NEW batch past the bound, never a re-sum
+                live += int(jax.device_get(sum(pending_sums)))
+                pending_sums = []
                 if live > self.build_budget:
                     overflowed = True
                     break
