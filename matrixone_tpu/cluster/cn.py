@@ -512,6 +512,12 @@ class FragmentServer:
     def stop(self) -> None:
         self._stopping.set()
         try:
+            # close() alone does not wake a thread blocked in accept();
+            # the zombie listener would keep accepting connections
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass

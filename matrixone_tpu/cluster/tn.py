@@ -167,6 +167,10 @@ class TNService:
         self._stopping.set()
         self.hub.stop()
         try:
+            self._sock.shutdown(socket.SHUT_RDWR)  # wake blocked accept
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass

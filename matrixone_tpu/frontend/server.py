@@ -532,6 +532,10 @@ class MOServer:
         self._stopping.set()
         if self._sock is not None:
             try:
+                self._sock.shutdown(socket.SHUT_RDWR)  # wake accept
+            except OSError:
+                pass
+            try:
                 self._sock.close()
             except OSError:
                 pass

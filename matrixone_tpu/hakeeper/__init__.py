@@ -38,48 +38,159 @@ class HAKeeper:
     def __init__(self, port: int = 0, down_after_s: float = 2.0,
                  tick_s: float = 0.5,
                  persist: Optional[Callable[[dict], None]] = None,
-                 restore: Optional[Callable[[], Optional[dict]]] = None):
+                 restore: Optional[Callable[[], Optional[dict]]] = None,
+                 standby_of: Optional[Tuple[str, int]] = None,
+                 takeover_after_s: float = 2.0):
         self.down_after_s = down_after_s
         self.tick_s = tick_s
         self.persist = persist
+        self._restore = restore
+        #: control-plane survival (reference: the HAKeeper Raft group
+        #: keeps running on replica loss): a standby keeper shares the
+        #: persist store with the primary, answers every state op with
+        #: {"standby": True} (clients fail over), and promotes itself
+        #: when the primary stays silent past `takeover_after_s`
+        self.standby_of = standby_of
+        self.takeover_after_s = takeover_after_s
+        self.role = "standby" if standby_of else "primary"
+        self.last_persist_error: Optional[str] = None
+        self.persist_failures = 0
+        #: generation fencing through the shared store: promote() bumps
+        #: it, and a primary that reads a HIGHER stored generation
+        #: demotes itself — so a paused-not-dead primary that resumes
+        #: after a takeover steps down instead of split-braining the
+        #: snapshot (the reference gets this from Raft terms)
+        self.keeper_gen = 1
         # sid -> record dict
         self.services: Dict[str, dict] = {}
-        if restore is not None:
-            # resume the persisted membership view (the reference keeps it
-            # in the HAKeeper Raft state machine); restored services get a
-            # fresh heartbeat grace window before the checker may expire
-            # them
-            try:
-                snap = restore() or {}
-            except Exception:
-                snap = {}
-            for sid, rec in snap.items():
-                r = dict(rec)
-                r["meta"] = dict(rec.get("meta", {}))
-                r["last_hb"] = time.monotonic()
-                self.services[sid] = r
+        if standby_of is None:
+            self._restore_services()
+            self.keeper_gen = max(self.keeper_gen, self._stored_gen())
         self.operators: List[dict] = []     # repair audit log
         self._repair: Dict[str, Callable[[dict], None]] = {}
         self._lock = threading.Lock()
         self._stopping = threading.Event()
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("127.0.0.1", port))
         self.port = self._sock.getsockname()[1]
         self._sock.listen(32)
 
+    def _restore_services(self) -> None:
+        """Resume the persisted membership view (the reference keeps it
+        in the HAKeeper Raft state machine); restored services get a
+        fresh heartbeat grace window before the checker may expire
+        them."""
+        if self._restore is None:
+            return
+        try:
+            snap = self._restore() or {}
+        except Exception:
+            snap = {}
+        for sid, rec in snap.items():
+            if sid.startswith("__"):       # reserved store keys (gen)
+                continue
+            r = dict(rec)
+            r["meta"] = dict(rec.get("meta", {}))
+            # fresh heartbeat grace, but persisted DOWN stays DOWN —
+            # resurrecting it would route traffic to a dead endpoint
+            # and re-fire its repair on the next expiry
+            r["last_hb"] = time.monotonic()
+            self.services[sid] = r
+
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "HAKeeper":
         threading.Thread(target=self._serve, daemon=True).start()
-        threading.Thread(target=self._tick_loop, daemon=True).start()
+        if self.role == "primary":
+            threading.Thread(target=self._tick_loop, daemon=True).start()
+        else:
+            threading.Thread(target=self._watch_primary,
+                             daemon=True).start()
         return self
+
+    # ------------------------------------------------------- standby mode
+    def _watch_primary(self) -> None:
+        last_seen = time.monotonic()
+        while not self._stopping.wait(min(self.tick_s, 0.25)):
+            try:
+                s = socket.create_connection(self.standby_of, timeout=1)
+                try:
+                    _send_msg(s, {"op": "status"})
+                    resp, _ = _recv_msg(s)
+                    if resp.get("role") == "primary":
+                        last_seen = time.monotonic()
+                finally:
+                    s.close()
+            except (OSError, ConnectionError):
+                pass
+            if time.monotonic() - last_seen > self.takeover_after_s:
+                self.promote()
+                return
+
+    def _stored_gen(self) -> int:
+        if self._restore is None:
+            return 0
+        try:
+            snap = self._restore() or {}
+            return int(snap.get("__keeper_gen", {}).get("gen", 0))
+        except Exception:
+            return 0
+
+    def promote(self) -> None:
+        """Standby -> primary: adopt the shared persisted state (grace
+        window restarts), bump the keeper generation (fences the old
+        primary), and begin running checkers."""
+        with self._lock:
+            if self.role == "primary":
+                return
+            self.role = "primary"
+            self._restore_services()
+            self.keeper_gen = self._stored_gen() + 1
+            self.operators.append({"op": "takeover", "at": time.time(),
+                                   "gen": self.keeper_gen})
+            self._persist_locked()
+        threading.Thread(target=self._tick_loop, daemon=True).start()
+
+    def demote(self) -> None:
+        """A fenced primary steps down: stop answering state ops (the
+        tick loop exits when role != primary)."""
+        import sys
+        with self._lock:
+            if self.role != "primary":
+                return
+            self.role = "standby"
+            self.operators.append({"op": "demoted", "at": time.time()})
+        print("[hakeeper] demoted: a newer keeper generation owns the "
+              "store", file=sys.stderr, flush=True)
 
     def stop(self) -> None:
         self._stopping.set()
         try:
+            # close() alone does not wake a thread blocked in accept();
+            # the zombie listener would keep accepting connections
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass
+        # a stopped keeper must look dead to CONNECTED clients too, so
+        # their heartbeats fail over to the standby instead of landing
+        # on a zombie's accepted sockets
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)   # interrupts blocked recv
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def on_down(self, kind: str, fn: Callable[[dict], None]) -> None:
         """Register a repair hook for a service kind (checkers analogue):
@@ -139,14 +250,29 @@ class HAKeeper:
             return
         snap = {sid: {k: v for k, v in rec.items() if k != "last_hb"}
                 for sid, rec in self.services.items()}
+        snap["__keeper_gen"] = {"gen": self.keeper_gen}
         try:
             self.persist(snap)
-        except Exception:
-            pass                         # persistence is best-effort
+            self.last_persist_error = None
+        except Exception as e:           # noqa: BLE001
+            # LOUD: a keeper that silently loses its snapshot hands the
+            # next takeover an empty cluster view
+            import sys
+            self.persist_failures += 1
+            self.last_persist_error = f"{type(e).__name__}: {e}"
+            print(f"[hakeeper] PERSIST FAILED "
+                  f"({self.persist_failures}x): "
+                  f"{self.last_persist_error}", file=sys.stderr,
+                  flush=True)
 
     # ------------------------------------------------------- failure check
     def _tick_loop(self) -> None:
         while not self._stopping.wait(self.tick_s):
+            if self.role != "primary":
+                return
+            if self._stored_gen() > self.keeper_gen:
+                self.demote()
+                return
             self.tick()
 
     def tick(self) -> None:
@@ -185,6 +311,8 @@ class HAKeeper:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(target=self._handle, args=(conn,),
                              daemon=True).start()
 
@@ -193,6 +321,17 @@ class HAKeeper:
             while True:
                 header, _ = _recv_msg(conn)
                 op = header.get("op")
+                if op == "status":
+                    _send_msg(conn, {"ok": True, "role": self.role,
+                                     "persist_failures":
+                                         self.persist_failures,
+                                     "last_persist_error":
+                                         self.last_persist_error})
+                    continue
+                if self.role != "primary":
+                    # clients fail over to the keeper that holds state
+                    _send_msg(conn, {"ok": False, "standby": True})
+                    continue
                 if op == "register":
                     self.register(header["kind"], header["sid"],
                                   header.get("addr", ""),
@@ -213,6 +352,8 @@ class HAKeeper:
         except (ConnectionError, OSError):
             pass
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
@@ -222,13 +363,20 @@ class HAKeeper:
 class HAClient:
     """Service-side agent: registers once and heartbeats on a thread
     (the reference's per-service heartbeat senders, cnservice/tnservice
-    heartbeat.go)."""
+    heartbeat.go). `addr` may be a single (host, port) or a LIST of
+    keeper endpoints — on silence or a standby answer the client rotates
+    to the next keeper (routing recovery after a takeover)."""
 
-    def __init__(self, addr: Tuple[str, int], kind: str, sid: str,
+    def __init__(self, addr, kind: str, sid: str,
                  service_addr: str = "", meta: Optional[dict] = None,
                  interval_s: float = 0.5,
                  stats_fn: Optional[Callable[[], dict]] = None):
-        self.addr = addr
+        if isinstance(addr, tuple) or (isinstance(addr, list)
+                                       and len(addr) == 2
+                                       and isinstance(addr[1], int)):
+            addr = [tuple(addr)]
+        self.addrs = [tuple(a) for a in addr]
+        self._cur = 0
         self.kind = kind
         self.sid = sid
         self.service_addr = service_addr
@@ -241,24 +389,39 @@ class HAClient:
         # an in-flight heartbeat on the shared socket
         self._call_lock = threading.Lock()
 
+    def _call_one(self, header: dict) -> Optional[dict]:
+        try:
+            if self._sock is None:
+                self._sock = socket.create_connection(
+                    self.addrs[self._cur], timeout=2)
+                self._sock.settimeout(2)
+            _send_msg(self._sock, header)
+            resp, _ = _recv_msg(self._sock)
+            return resp
+        except (OSError, ConnectionError):
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+            self._sock = None
+            return None
+
     def _call(self, header: dict) -> Optional[dict]:
         with self._call_lock:
-            try:
-                if self._sock is None:
-                    self._sock = socket.create_connection(self.addr,
-                                                          timeout=2)
-                    self._sock.settimeout(2)
-                _send_msg(self._sock, header)
-                resp, _ = _recv_msg(self._sock)
-                return resp
-            except (OSError, ConnectionError):
+            for _ in range(len(self.addrs)):
+                resp = self._call_one(header)
+                if resp is not None and not resp.get("standby"):
+                    return resp
+                # dead or standby keeper: rotate and retry
+                self._cur = (self._cur + 1) % len(self.addrs)
                 if self._sock is not None:
                     try:
                         self._sock.close()
                     except OSError:
                         pass
                 self._sock = None
-                return None
+            return None
 
     def start(self) -> "HAClient":
         self._call({"op": "register", "kind": self.kind, "sid": self.sid,
@@ -292,13 +455,28 @@ class HAClient:
                 pass
 
 
-def details_via_tcp(addr: Tuple[str, int],
-                    kind: Optional[str] = None) -> List[dict]:
-    """One-shot clusterservice query against a keeper."""
-    s = socket.create_connection(addr, timeout=2)
-    try:
-        _send_msg(s, {"op": "details", "kind": kind})
-        resp, _ = _recv_msg(s)
-        return resp.get("services", [])
-    finally:
-        s.close()
+def details_via_tcp(addr, kind: Optional[str] = None) -> List[dict]:
+    """One-shot clusterservice query; `addr` may be one endpoint (tuple
+    OR ['host', port] list, e.g. from JSON) or a list of endpoints
+    (first primary keeper answers)."""
+    if isinstance(addr, tuple) or (isinstance(addr, list)
+                                   and len(addr) == 2
+                                   and isinstance(addr[1], int)):
+        addrs = [tuple(addr)]
+    else:
+        addrs = [tuple(a) for a in addr]
+    last: Exception = ConnectionError("no keeper reachable")
+    for a in addrs:
+        try:
+            s = socket.create_connection(a, timeout=2)
+            try:
+                _send_msg(s, {"op": "details", "kind": kind})
+                resp, _ = _recv_msg(s)
+                if resp.get("standby"):
+                    continue
+                return resp.get("services", [])
+            finally:
+                s.close()
+        except (OSError, ConnectionError) as e:
+            last = e
+    raise last

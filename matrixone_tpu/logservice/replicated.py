@@ -67,6 +67,10 @@ class LogReplica:
         self.path = os.path.join(data_dir, "replica.log")
         self.meta_path = os.path.join(data_dir, "replica.meta")
         self.epoch = 0
+        #: low watermark: entries at or below this seq were truncated by
+        #: a checkpoint — a rejoining laggard's stale copies of them must
+        #: never resurrect (repair/replay honor max watermark)
+        self.truncated_upto = 0
         self.entries: Dict[int, Tuple[int, bytes]] = {}   # seq -> (epoch, payload)
         self._load()
         self._lock = threading.Lock()
@@ -76,11 +80,15 @@ class LogReplica:
         self.port = self._sock.getsockname()[1]
         self._sock.listen(16)
         self._stopping = threading.Event()
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
 
     def _load(self) -> None:
         if os.path.exists(self.meta_path):
             with open(self.meta_path) as f:
-                self.epoch = int(f.read().strip() or 0)
+                parts = (f.read().strip() or "0").split()
+            self.epoch = int(parts[0])
+            self.truncated_upto = int(parts[1]) if len(parts) > 1 else 0
         if not os.path.exists(self.path):
             return
         with open(self.path, "rb") as f:
@@ -96,7 +104,7 @@ class LogReplica:
 
     def _persist_epoch(self) -> None:
         with open(self.meta_path, "w") as f:
-            f.write(str(self.epoch))
+            f.write(f"{self.epoch} {self.truncated_upto}")
             f.flush()
             os.fsync(f.fileno())
 
@@ -120,6 +128,8 @@ class LogReplica:
                 return {"ok": False, "err": "stale epoch"}
             self.entries = {s: v for s, v in self.entries.items()
                             if s > upto}
+            self.truncated_upto = max(self.truncated_upto, upto)
+            self._persist_epoch()
             tmp = self.path + ".tmp"
             with open(tmp, "wb") as f:
                 for s in sorted(self.entries):
@@ -136,6 +146,8 @@ class LogReplica:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(target=self._handle, args=(conn,),
                              daemon=True).start()
 
@@ -146,9 +158,28 @@ class LogReplica:
     def stop(self) -> None:
         self._stopping.set()
         try:
+            # close() alone does not wake a thread blocked in accept();
+            # the zombie listener would keep accepting connections
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass
+        # a stopped replica must look DEAD to connected writers, like a
+        # killed process would — close the accepted connections too
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)   # interrupts blocked recv
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def _handle(self, conn: socket.socket) -> None:
         try:
@@ -166,6 +197,7 @@ class LogReplica:
                                       len(self.entries[s][1]))
                             + self.entries[s][1] for s in seqs)
                     _send_msg(conn, {"ok": True, "epoch": self.epoch,
+                                     "upto": self.truncated_upto,
                                      "n": len(seqs)}, out)
                 elif op == "hello":
                     with self._lock:
@@ -186,6 +218,8 @@ class LogReplica:
         except (ConnectionError, OSError):
             pass
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
@@ -217,10 +251,31 @@ class ReplicatedLog:
         self.epoch = max(epochs) + 1
         for i in range(len(self.addrs)):
             self._call(i, {"op": "hello", "epoch": self.epoch})
-        # resume seq past anything already logged
-        for _, entries in self._read_majority():
-            if entries:
-                self.seq = max(self.seq, max(s for s, _ in entries))
+        # resume seq past anything already logged, and REPAIR divergent
+        # replicas: a replica that missed appends while down rejoins by
+        # receiving the union's missing entries under the new epoch (the
+        # log-repair half of a Raft leader bringing a follower up to
+        # date). The truncation watermark guards the other divergence
+        # direction: a laggard that missed a checkpoint truncate must
+        # have its stale pre-checkpoint entries dropped, never pushed
+        # back onto healthy replicas.
+        reads = self._read_majority()
+        upto = max((u for _i, u, _e in reads), default=0)
+        merged: Dict[int, bytes] = {}
+        for _i, _u, entries in reads:
+            for s, payload in entries:
+                if s > upto:
+                    merged[s] = payload
+        self.seq = max(merged) if merged else upto
+        for i, rep_upto, entries in reads:
+            have = {s for s, _ in entries}
+            for s in sorted(set(merged) - have):
+                self._call(i, {"op": "append", "epoch": self.epoch,
+                               "seq": s}, merged[s])
+            if rep_upto < upto:
+                # propagate the checkpoint truncation the laggard missed
+                self._call(i, {"op": "truncate", "epoch": self.epoch,
+                               "upto": upto})
 
     # ---- transport
     def _sock_for(self, i: int) -> Optional[socket.socket]:
@@ -276,7 +331,8 @@ class ReplicatedLog:
                            "upto": self.seq})
 
     def _read_majority(self):
-        """[(replica_idx, [(seq, payload)])] from >= quorum replicas."""
+        """[(replica_idx, truncated_upto, [(seq, payload)])] from >=
+        quorum replicas."""
         out = []
         for i in range(len(self.addrs)):
             r = self._call(i, {"op": "read"})
@@ -289,19 +345,24 @@ class ReplicatedLog:
                 entries.append((seq, blob[off + _REC.size:
                                           off + _REC.size + plen]))
                 off += _REC.size + plen
-            out.append((i, entries))
+            out.append((i, r[0].get("upto", 0), entries))
         if len(out) < self.quorum:
             raise ConnectionError(
                 f"{len(out)} replicas readable < quorum {self.quorum}")
         return out
 
     def replay(self) -> Iterator[Tuple[dict, bytes]]:
-        """Union of a majority's entries, seq-ordered (single-writer:
-        union is conflict-free; contains every majority-acked entry)."""
+        """Union of a majority's entries past the highest truncation
+        watermark, seq-ordered (single-writer: union is conflict-free;
+        contains every majority-acked entry; never resurrects
+        checkpoint-truncated ones)."""
+        reads = self._read_majority()
+        upto = max((u for _i, u, _e in reads), default=0)
         merged: Dict[int, bytes] = {}
-        for _, entries in self._read_majority():
+        for _i, _u, entries in reads:
             for seq, payload in entries:
-                merged[seq] = payload
+                if seq > upto:
+                    merged[seq] = payload
         for seq in sorted(merged):
             payload = merged[seq]
             (hlen,) = struct.unpack_from("<I", payload, 0)
