@@ -1,0 +1,88 @@
+"""Compressed (roaring-style) bitmap (VERDICT r3 directive 9; reference:
+cgo/croaring.c + CRoaring). Acceptance: bit-identical to the dense
+bitset on random sets, <10% of dense memory at 0.1% density — and it is
+the engine's live tombstone filter, so scan correctness rides on it.
+"""
+
+import numpy as np
+import pytest
+
+from matrixone_tpu import native
+from matrixone_tpu.frontend import Session
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(7)
+
+
+def test_bit_identical_to_dense_on_random_sets(rng):
+    domain = 1 << 20
+    for density in (0.0005, 0.01, 0.3):
+        ids = np.unique(rng.integers(0, domain,
+                                     int(domain * density)))
+        dense = native.Bitset(domain)
+        dense.set_ids(ids)
+        rbm = native.RoaringBitmap(ids)
+        assert rbm.count() == dense.count() == len(ids)
+        probes = rng.integers(0, domain, 5000)
+        np.testing.assert_array_equal(rbm.test(probes),
+                                      dense.test_ids(probes))
+        # contiguous-range form matches per-id membership
+        lo = int(rng.integers(0, domain - 70000))
+        want = rbm.test(np.arange(lo, lo + 70000))
+        np.testing.assert_array_equal(rbm.test_range(lo, lo + 70000),
+                                      want)
+        np.testing.assert_array_equal(rbm.to_array(), np.sort(ids))
+
+
+def test_set_operations_match_numpy(rng):
+    a_ids = np.unique(rng.integers(0, 1 << 18, 4000))
+    b_ids = np.unique(rng.integers(0, 1 << 18, 150000))  # dense containers
+    a = native.RoaringBitmap(a_ids)
+    b = native.RoaringBitmap(b_ids)
+    a.and_(b)
+    np.testing.assert_array_equal(a.to_array(),
+                                  np.intersect1d(a_ids, b_ids))
+    c = native.RoaringBitmap(a_ids)
+    c.or_(b)
+    np.testing.assert_array_equal(c.to_array(), np.union1d(a_ids, b_ids))
+    assert c.count() == len(np.union1d(a_ids, b_ids))
+
+
+def test_duplicates_and_negatives(rng):
+    rbm = native.RoaringBitmap([5, 5, 5, -1, -99, 70000, 70000])
+    assert rbm.count() == 2
+    assert rbm.test([5, -1, 70000, 6]).tolist() == [True, False, True,
+                                                    False]
+
+
+def test_memory_under_10pct_of_dense_at_low_density(rng):
+    domain = 10_000_000
+    ids = np.unique(rng.integers(0, domain, int(domain * 0.001)))
+    rbm = native.RoaringBitmap(ids)
+    dense_bytes = domain // 8
+    ratio = rbm.nbytes() / dense_bytes
+    assert ratio < 0.10, f"roaring used {ratio:.1%} of dense memory"
+    # sanity: clustered dense runs convert to bitmap containers and stay
+    # bounded (never worse than ~dense for a full container)
+    packed = native.RoaringBitmap(np.arange(100_000))
+    assert packed.nbytes() <= 2 * (100_000 // 8) + 4096
+
+
+def test_engine_tombstone_scan_uses_roaring_correctly():
+    """Deletes at scale through the SQL surface: the roaring tombstone
+    filter must reproduce exact scan results (it IS the scan path)."""
+    s = Session()
+    s.execute("create table t (id bigint primary key, v bigint)")
+    vals = ",".join(f"({i},{i % 97})" for i in range(30000))
+    s.execute(f"insert into t values {vals}")
+    s.execute("delete from t where v % 7 = 3")      # scattered tombstones
+    s.execute("delete from t where id >= 29990")    # tail run
+    expect_ids = [i for i in range(30000)
+                  if (i % 97) % 7 != 3 and i < 29990]
+    r = s.execute("select count(*), sum(id) from t").rows()[0]
+    assert (int(r[0]), int(r[1])) == (len(expect_ids), sum(expect_ids))
+    r = s.execute("select count(*) from t where id between 100 and 200"
+                  ).rows()[0]
+    assert int(r[0]) == sum(1 for i in expect_ids if 100 <= i <= 200)
