@@ -1,15 +1,22 @@
 """Stream sources and dynamic tables.
 
 Reference analogue: MatrixOne's `CREATE SOURCE` (Kafka connector-fed
-append-only tables, pkg/stream/connector) and `CREATE DYNAMIC TABLE ...
-AS SELECT` (continuously refreshed materializations driven by the task
-framework). Redesign:
+append-only tables, pkg/stream/connector + colexec/source) and `CREATE
+DYNAMIC TABLE ... AS SELECT` (continuously refreshed materializations
+driven by the task framework). Redesign:
 
   * a SOURCE is an append-only engine table (no PK) plus a SourceWriter
     — the connector seam: external feeders (a Kafka consumer loop, a
     log tailer) push dict-rows; the writer micro-batches them into
     commits on a flush interval, which is exactly the shape of the
     reference's connector pipeline (buffer -> batch -> insert);
+  * the PROCESS-boundary half (the reference's external Kafka
+    connector) is `python -m matrixone_tpu.stream`: a standalone
+    producer process that tails a JSONL/CSV file (following appends,
+    like a topic) and feeds the SOURCE over the MySQL wire through a
+    CN's normal commit path — so streamed rows replicate to every CN
+    via the logtail, and an optional `--refresh` re-materializes a
+    dynamic table after each flushed batch;
   * a DYNAMIC TABLE stores its defining SELECT in the catalog and
     re-materializes on demand (`REFRESH DYNAMIC TABLE`) or on a
     taskservice interval. Refresh is transactional-per-statement:
@@ -21,7 +28,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, Iterator, List, Optional
 
 
 class SourceWriter:
@@ -55,15 +62,176 @@ class SourceWriter:
             self._last_flush = time.monotonic()
         if not rows:
             return 0
-        from matrixone_tpu.cdc import sql_literal
         t = self.session.catalog.get_table(self.source)
         cols = [c for c, _ in t.meta.schema]
-        values = ["(" + ", ".join(sql_literal(r.get(c)) for c in cols) + ")"
-                  for r in rows]
-        self.session.execute(
-            f"insert into {self.source} ({', '.join(cols)}) values "
-            + ", ".join(values))
+        self.session.execute(build_insert_sql(self.source, cols, rows))
         return len(rows)
+
+
+def build_insert_sql(table: str, columns: List[str],
+                     rows: List[dict]) -> str:
+    """One INSERT statement for a batch of dict-rows (shared by the
+    in-process and wire connectors so literal rendering cannot drift)."""
+    from matrixone_tpu.cdc import sql_literal
+    values = ["(" + ", ".join(sql_literal(r.get(c)) for c in columns)
+              + ")" for r in rows]
+    return (f"insert into {table} ({', '.join(columns)}) values "
+            + ", ".join(values))
+
+
+class FileTailer:
+    """Follow a JSONL or CSV file like a topic: yield new rows as they
+    are appended; stop after `idle_timeout_s` without growth (the
+    connector's graceful drain)."""
+
+    def __init__(self, path: str, fmt: str = "jsonl",
+                 idle_timeout_s: float = 3.0, poll_s: float = 0.1):
+        self.path = path
+        self.fmt = fmt
+        self.idle_timeout_s = idle_timeout_s
+        self.poll_s = poll_s
+        self._csv_header: Optional[List[str]] = None
+
+    def _parse(self, line: str) -> Optional[dict]:
+        line = line.strip()
+        if not line:
+            return None
+        if self.fmt == "jsonl":
+            import json
+            return json.loads(line)
+        import csv
+        cells = next(csv.reader([line]))     # quoted commas survive
+        if self._csv_header is None:
+            self._csv_header = cells
+            return None
+        return dict(zip(self._csv_header, cells))
+
+    def rows(self, heartbeat_s: Optional[float] = None) -> Iterator:
+        """Yield parsed rows; with `heartbeat_s`, also yield None at
+        that cadence while idle-polling, so the consumer can run
+        time-based flushes without a second thread."""
+        with open(self.path) as f:
+            at_eof_since: Optional[float] = None
+            last_beat = time.monotonic()
+            buf = ""
+            while True:
+                chunk = f.readline()
+                if chunk:
+                    at_eof_since = None
+                    buf += chunk
+                    if not buf.endswith("\n"):
+                        continue        # torn line: wait for the rest
+                    row = self._parse(buf)
+                    buf = ""
+                    if row is not None:
+                        yield row
+                    continue
+                # idle = consecutive time AT EOF, measured only while
+                # actually polling — time the consumer spends processing
+                # a yielded row (flush/refresh) must not count, or a slow
+                # downstream would truncate the stream
+                now = time.monotonic()
+                if at_eof_since is None:
+                    at_eof_since = now
+                elif now - at_eof_since > self.idle_timeout_s:
+                    break
+                if heartbeat_s is not None \
+                        and now - last_beat >= heartbeat_s:
+                    last_beat = now
+                    yield None
+                time.sleep(self.poll_s)
+            # drain: a final line without its newline is still a record
+            # (a producer may stop mid-flush)
+            row = self._parse(buf) if buf else None
+            if row is not None:
+                yield row
+
+
+class WireSourceWriter:
+    """The producer process' writer: batches rows into INSERTs over the
+    MySQL wire — every flush is one commit through the CN's normal
+    write path (CN workspace -> TN commit -> logtail to every CN)."""
+
+    def __init__(self, conn, source: str, columns: List[str],
+                 flush_rows: int = 1024,
+                 flush_interval_s: float = 1.0,
+                 refresh: Optional[str] = None):
+        self.conn = conn
+        self.source = source
+        self.columns = columns
+        self.flush_rows = flush_rows
+        self.flush_interval_s = flush_interval_s
+        self.refresh = refresh
+        self.rows_written = 0
+        self.flushes = 0
+        self._buf: List[dict] = []
+        self._last_flush = time.monotonic()
+
+    def write(self, row: dict) -> None:
+        self._buf.append(row)
+        if len(self._buf) >= self.flush_rows:
+            self.flush()
+
+    def maybe_flush(self) -> int:
+        """Time-based flush (heartbeat path): a slow trickle must still
+        commit within flush_interval_s, not buffer forever."""
+        if self._buf and time.monotonic() - self._last_flush \
+                >= self.flush_interval_s:
+            return self.flush()
+        return 0
+
+    def flush(self) -> int:
+        rows, self._buf = self._buf, []
+        self._last_flush = time.monotonic()
+        if not rows:
+            return 0
+        self.conn.execute(build_insert_sql(self.source, self.columns,
+                                           rows))
+        self.rows_written += len(rows)
+        self.flushes += 1
+        if self.refresh:
+            self.conn.execute(f"refresh dynamic table {self.refresh}")
+        return len(rows)
+
+
+def connector_main(argv: Optional[List[str]] = None) -> dict:
+    """`python -m matrixone_tpu.stream` — the out-of-process connector
+    (reference: the Kafka consumer feeding pkg/stream sources)."""
+    import argparse
+    from matrixone_tpu import client
+    ap = argparse.ArgumentParser(prog="matrixone_tpu.stream")
+    ap.add_argument("--server", required=True, help="CN host:port")
+    ap.add_argument("--source", required=True, help="SOURCE table name")
+    ap.add_argument("--file", required=True, help="JSONL/CSV to tail")
+    ap.add_argument("--format", default="jsonl",
+                    choices=("jsonl", "csv"))
+    ap.add_argument("--follow", type=float, default=3.0,
+                    help="stop after this many idle seconds")
+    ap.add_argument("--flush-rows", type=int, default=1024)
+    ap.add_argument("--flush-interval", type=float, default=1.0)
+    ap.add_argument("--refresh", default=None,
+                    help="dynamic table to refresh after each flush")
+    ap.add_argument("--user", default="root")
+    ap.add_argument("--password", default="")
+    args = ap.parse_args(argv)
+    host, port = args.server.rsplit(":", 1)
+    conn = client.connect(host=host, port=int(port), user=args.user,
+                          password=args.password, timeout=120)
+    _cols, crows = conn.query(f"describe {args.source}")
+    columns = [r[0] for r in crows]
+    w = WireSourceWriter(conn, args.source, columns,
+                         flush_rows=args.flush_rows,
+                         flush_interval_s=args.flush_interval,
+                         refresh=args.refresh)
+    tail = FileTailer(args.file, fmt=args.format,
+                      idle_timeout_s=args.follow)
+    for row in tail.rows(heartbeat_s=args.flush_interval / 2):
+        if row is None:
+            w.maybe_flush()
+        else:
+            w.write(row)
+    w.flush()
+    return {"rows": w.rows_written, "flushes": w.flushes}
 
 
 def refresh_dynamic_table(session, name: str) -> int:
@@ -96,3 +264,10 @@ def refresh_dynamic_table(session, name: str) -> int:
         session.execute("rollback")
         raise
     return n
+
+
+if __name__ == "__main__":
+    import json as _json
+    import sys as _sys
+    print(_json.dumps(connector_main()), flush=True)
+    _sys.exit(0)

@@ -1,0 +1,123 @@
+"""Streaming source with a process boundary (VERDICT r3 directive 8):
+an out-of-process producer (file tailer, `python -m matrixone_tpu.stream`)
+feeds a SOURCE table over the MySQL wire through a CN's commit path and
+drives dynamic-table refresh — the reference's external Kafka connector
+shape (pkg/stream + colexec/source).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+from matrixone_tpu import client
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn(mod_args, wait_port=True):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.Popen([sys.executable, "-m"] + mod_args,
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.DEVNULL, env=env, text=True)
+    if not wait_port:
+        return p, None
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        line = p.stdout.readline()
+        if line.startswith("PORT "):
+            return p, int(line.split()[1])
+    raise AssertionError("no PORT line")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    d = tempfile.mkdtemp(prefix="mo_stream_")
+    tn, tn_port = _spawn(["matrixone_tpu.cluster.tn", "--dir", d,
+                          "--port", "0"])
+    cns = [_spawn(["matrixone_tpu.cluster.cn", "--tn",
+                   f"127.0.0.1:{tn_port}", "--dir", d, "--port", "0"])
+           for _ in range(2)]
+    yield d, cns
+    for p, _ in cns + [(tn, tn_port)]:
+        if p.poll() is None:
+            p.kill()
+
+
+def test_producer_process_feeds_source_and_dynamic_table(cluster):
+    d, cns = cluster
+    c1 = client.connect(port=cns[0][1], timeout=120)
+    c1.execute("create source events (user_id bigint, amount bigint,"
+               " region varchar(16))")
+    c1.execute("create dynamic table spend as select region,"
+               " sum(amount) as total, count(*) as n from events"
+               " group by region")
+
+    feed = os.path.join(d, "events.jsonl")
+    regions = ["emea", "apac", "amer"]
+    with open(feed, "w") as f:
+        for i in range(500):
+            f.write(json.dumps({"user_id": i, "amount": i % 50,
+                                "region": regions[i % 3]}) + "\n")
+
+    producer, _ = _spawn(
+        ["matrixone_tpu.stream", "--server", f"127.0.0.1:{cns[0][1]}",
+         "--source", "events", "--file", feed, "--follow", "4",
+         "--flush-rows", "128", "--refresh", "spend"],
+        wait_port=False)
+
+    # the tail-follow proof: append MORE rows while the producer runs
+    # (trigger on the SECOND flush landing — the producer is mid-stream,
+    # well before its idle window can start)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        _c, rows = c1.query("select count(*) from events")
+        if int(rows[0][0]) >= 256:
+            break
+        time.sleep(0.2)
+    with open(feed, "a") as f:
+        for i in range(500, 700):
+            f.write(json.dumps({"user_id": i, "amount": i % 50,
+                                "region": regions[i % 3]}) + "\n")
+
+    out, _ = producer.communicate(timeout=120)
+    stats = json.loads(out.strip().splitlines()[-1])
+    assert producer.returncode == 0
+    assert stats["rows"] == 700
+    assert stats["flushes"] >= 2, "micro-batching never engaged"
+
+    # every streamed row is committed and replicated to the OTHER CN
+    c2 = client.connect(port=cns[1][1], timeout=120)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        _c, rows = c2.query("select count(*), sum(amount) from events")
+        if int(rows[0][0]) == 700:
+            break
+        time.sleep(0.2)
+    expect_sum = sum(i % 50 for i in range(700))
+    assert (int(rows[0][0]), int(rows[0][1])) == (700, expect_sum)
+
+    # the dynamic table was refreshed by the producer's flushes and
+    # reflects the full stream (the final refresh commit replicates to
+    # CN2 slightly after the events rows — poll for convergence)
+    expect = {}
+    for i in range(700):
+        t, n = expect.get(regions[i % 3], (0, 0))
+        expect[regions[i % 3]] = (t + i % 50, n + 1)
+    deadline = time.time() + 30
+    got = None
+    while time.time() < deadline:
+        _c, rows = c2.query("select region, total, n from spend"
+                            " order by region")
+        got = {r[0]: (int(r[1]), int(r[2])) for r in rows}
+        if got == expect:
+            break
+        time.sleep(0.2)
+    assert got == expect
