@@ -71,14 +71,16 @@ def make_data(key, n, d, n_centers=2048):
     return data, queries
 
 
-def bench_q1():
+def bench_q1(n: int = None) -> dict:
     """TPC-H Q1 rows/sec through the full SQL engine (BASELINE config #1).
 
     The reference publishes no first-party Q1 throughput (BASELINE.md), so
     vs_baseline is null; the number itself is the tracked metric."""
     from matrixone_tpu.frontend import Session
     from matrixone_tpu.utils import tpch
-    n = int(os.environ.get("MO_BENCH_N", 100_000 if SMOKE else 6_001_215))
+    if n is None:
+        n = int(os.environ.get("MO_BENCH_N",
+                               100_000 if SMOKE else 6_001_215))
     s = Session()
     t0 = time.time()
     arrays = tpch.load_lineitem(s.catalog, n)
@@ -91,7 +93,7 @@ def bench_q1():
         t0 = time.time()
         s.execute(tpch.Q1_SQL)
         best = max(best, n / (time.time() - t0))
-    print(json.dumps({
+    return {
         "metric": f"tpch_q1_rows_per_sec_{n}",
         "value": round(best, 1),
         "unit": "rows/s",
@@ -99,7 +101,7 @@ def bench_q1():
         "exact_vs_oracle": exact,
         "load_seconds": round(t_load, 2),
         "backend": jax.default_backend(),
-    }))
+    }
 
 
 PREFLIGHT_S = float(os.environ.get("MO_BENCH_PREFLIGHT_S", 120))
@@ -160,7 +162,7 @@ def _cpu_fallback():
         else:
             env.setdefault("MO_BENCH_N", "1000000")
     r = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                       env=env, timeout=3600)
+                       env=env, timeout=4500)
     return r.returncode
 
 
@@ -185,7 +187,7 @@ def main():
         # _exit (not exit) skips jax's hanging atexit sync
         os._exit(rc)
     if METRIC == "q1":
-        bench_q1()
+        print(json.dumps(bench_q1()))
         return
     key = jax.random.PRNGKey(1234)
     t0 = time.time()
@@ -268,7 +270,40 @@ def main():
         "backend": jax.default_backend(),
         "batch": BATCH,
     }
+    # second trend line (VERDICT r3 #7: the scoreboard must trend with
+    # >=2 comparable metrics): TPC-H Q1 rows/s rides in the SAME JSON
+    # line so the one-line driver contract holds.  The already-measured
+    # IVF number must survive a mid-Q1 tunnel wedge (a hang, not an
+    # exception), so Q1 runs under a watchdog thread with a deadline —
+    # on timeout the combined line still prints with an error entry.
+    if os.environ.get("MO_BENCH_NO_Q1") != "1":
+        # free the index/query HBM before loading lineitem: the chip has
+        # ~16 GB and a resident 1M x 768 index + 6M-row table can OOM
+        del index, outs, queries, truth, found
+        q1_n = (50_000 if SMOKE else
+                1_000_000 if jax.default_backend() == "cpu"
+                else 6_001_215)
+        box = []
+
+        def _q1():
+            try:
+                box.append(bench_q1(q1_n))
+            except Exception as e:           # noqa: BLE001
+                box.append({
+                    "metric": "tpch_q1_rows_per_sec", "value": 0,
+                    "unit": "error", "vs_baseline": None,
+                    "error": f"{type(e).__name__}: {e}"})
+        t = threading.Thread(target=_q1, daemon=True)
+        t.start()
+        t.join(float(os.environ.get("MO_BENCH_Q1_TIMEOUT_S", 1200)))
+        result["extra_metrics"] = [box[0] if box else {
+            "metric": "tpch_q1_rows_per_sec", "value": 0,
+            "unit": "error", "vs_baseline": None,
+            "error": "q1 timed out (device wedge?)"}]
     print(json.dumps(result))
+    sys.stdout.flush()
+    if os.environ.get("MO_BENCH_NO_Q1") != "1" and not box:
+        os._exit(0)       # q1 thread is wedged on the device: don't hang
 
 
 if __name__ == "__main__":
