@@ -609,10 +609,23 @@ def main() -> None:
     ap.add_argument("--peers", default="",
                     help="comma-separated fragment endpoints of ALL "
                          "CNs (including this one) for distributed scopes")
+    ap.add_argument("--keeper", default="",
+                    help="comma-separated keeper endpoints to register "
+                         "with and heartbeat (HAKeeper)")
+    ap.add_argument("--insecure", type=int, default=1,
+                    help="1 = accept any login (test default); 0 = "
+                         "account/password auth via mo_user")
     args = ap.parse_args()
     peers = [p for p in args.peers.split(",") if p]
     cn = CNService(args.tn, data_dir=args.dir, port=args.port,
-                   frag_port=args.frag_port, peers=peers).start()
+                   frag_port=args.frag_port, peers=peers,
+                   insecure=bool(args.insecure)).start()
+    if args.keeper:
+        from matrixone_tpu.cluster.rpc import parse_addr
+        from matrixone_tpu.hakeeper import HAClient
+        HAClient([parse_addr(a) for a in args.keeper.split(",") if a],
+                 "cn", f"cn-{cn.port}",
+                 service_addr=f"127.0.0.1:{cn.port}").start()
     print(f"PORT {cn.port}", flush=True)
     print(f"FRAGPORT {cn.frag_port}", flush=True)
     sys.stdout.flush()

@@ -399,8 +399,27 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--dir", required=True)
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--log-replicas", default="",
+                    help="comma-separated host:port log replica "
+                         "endpoints; the TN then journals through the "
+                         "quorum WAL instead of a local file")
+    ap.add_argument("--keeper", default="",
+                    help="comma-separated keeper endpoints to register "
+                         "with and heartbeat (HAKeeper)")
     args = ap.parse_args()
-    tn = TNService(data_dir=args.dir, port=args.port)
+    wal = None
+    if args.log_replicas:
+        from matrixone_tpu.cluster.rpc import parse_addr
+        from matrixone_tpu.logservice.replicated import ReplicatedLog
+        wal = ReplicatedLog([parse_addr(a) for a
+                             in args.log_replicas.split(",") if a])
+    tn = TNService(data_dir=args.dir, port=args.port, wal=wal)
+    if args.keeper:
+        from matrixone_tpu.cluster.rpc import parse_addr
+        from matrixone_tpu.hakeeper import HAClient
+        HAClient([parse_addr(a) for a in args.keeper.split(",") if a],
+                 "tn", f"tn-{tn.port}",
+                 service_addr=f"127.0.0.1:{tn.port}").start()
     print(f"PORT {tn.port}", flush=True)
     sys.stdout.flush()
     tn.serve_forever()
