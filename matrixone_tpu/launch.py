@@ -46,12 +46,20 @@ from typing import Dict, List, Optional
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    p = s.getsockname()[1]
-    s.close()
-    return p
+def _free_ports(n: int) -> List[int]:
+    """n distinct free ports: every probe socket stays open until all
+    are allocated, or the kernel may hand a just-released port out
+    twice."""
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
 
 
 class Launcher:
@@ -119,7 +127,7 @@ class Launcher:
     def start(self) -> "Launcher":
         try:
             return self._start()
-        except Exception:
+        except BaseException:       # incl. KeyboardInterrupt mid-launch
             # a half-started cluster must not leak orphans holding the
             # ports and the data dir
             self.stop()
@@ -188,7 +196,7 @@ class Launcher:
         cn_cfg = self.cfg.get("cn", {})
         n_cn = int(cn_cfg.get("count", 1))
         insecure = "1" if cn_cfg.get("insecure", True) else "0"
-        frag_ports = [_free_port() for _ in range(n_cn)]
+        frag_ports = _free_ports(n_cn)
         peers = ",".join(f"127.0.0.1:{p}" for p in frag_ports)
         cn_procs = [
             self._launch(

@@ -282,7 +282,14 @@ class WorkerCore:
                         nlist=max(1, min(nlist // n_shards or 1, hi - lo)),
                         metric=metric, storage_dtype=jnp.bfloat16)
                 parts.append((idx, lo))
-            entry = {"mode": "sharded", "parts": parts, "n": len(data)}
+            # keep the host copy for exact re-ranking of the cross-shard
+            # merge: ranking the union on bf16 approximate distances
+            # measurably loses recall vs a single index (near-tie noise
+            # at every shard boundary); the reference's cuvs worker keeps
+            # the dataset for refine the same way
+            entry = {"mode": "sharded", "parts": parts, "n": len(data),
+                     "data": np.asarray(data, np.float32),
+                     "metric": metric}
         elif mode == "replicated":
             idx = ivf_flat.build(jnp.asarray(data),
                                  nlist=max(1, min(nlist, len(data))),
@@ -326,9 +333,10 @@ class WorkerCore:
         if pad:
             q = np.concatenate([q, np.zeros((pad, q.shape[1]), q.dtype)])
 
-        def dispatch(idx):
+        def dispatch(idx, overfetch: int = 0):
             np_ = min(nprobe, idx.nlist)
-            kk = min(k, idx.n, np_ * idx.max_cluster_size) or 1
+            kk = min(k + overfetch, idx.n,
+                     np_ * idx.max_cluster_size) or 1
             return ivf_flat.search(idx, jnp.asarray(q), k=kk,
                                    nprobe=np_, query_chunk=chunk)
 
@@ -340,13 +348,42 @@ class WorkerCore:
         if entry["mode"] == "sharded":
             # dispatch every shard before materializing any: the device
             # calls are async, so shards overlap instead of serializing on
-            # the first shard's np.asarray
-            lazy = [(dispatch(idx), off) for idx, off in entry["parts"]]
+            # the first shard's np.asarray.  Shards OVERFETCH (k + margin):
+            # a shard's local-k cutoff sits inside bf16 near-tie noise, and
+            # truncating at exactly k per shard measurably drops union
+            # recall (~6pp at small shards); the global merge cuts back
+            # to k
+            lazy = [(dispatch(idx, overfetch=k + 8), off)
+                    for idx, off in entry["parts"]]
             ds = [np.asarray(d)[:n] for (d, _i), _ in lazy]
             ids = [np.asarray(i)[:n].astype(np.int64) + off
                    for (_d, i), off in lazy]
             all_d = np.concatenate(ds, axis=1)
             all_i = np.concatenate(ids, axis=1)
+            data = entry.get("data")
+            if data is not None:
+                # exact f32 re-rank of the union candidates (tiny: n
+                # queries x shards*(2k+8) rows) — restores single-index
+                # recall that approximate cross-shard ranking loses
+                qr = np.asarray(q[:n], np.float32)
+                cand = data[all_i]                 # [n, M, d]
+                metric_ = entry.get("metric", "l2")
+                if metric_ == "cosine":
+                    cn = cand / np.maximum(
+                        np.linalg.norm(cand, axis=2, keepdims=True),
+                        1e-30)
+                    qn = qr / np.maximum(
+                        np.linalg.norm(qr, axis=1, keepdims=True), 1e-30)
+                    d_ex = 1.0 - np.einsum("nmd,nd->nm", cn, qn)
+                elif metric_ == "ip":
+                    d_ex = -np.einsum("nmd,nd->nm", cand, qr)
+                else:
+                    diff = cand - qr[:, None, :]
+                    d_ex = np.einsum("nmd,nmd->nm", diff, diff)
+                # padded candidate lanes carry inf distances and CLAMPED
+                # (duplicate) ids — they must stay infinitely far after
+                # the re-rank or they re-enter the top-k as duplicates
+                all_d = np.where(np.isfinite(all_d), d_ex, np.inf)
             order = np.argsort(all_d, axis=1)[:, :k]
             return (np.take_along_axis(all_d, order, axis=1),
                     np.take_along_axis(all_i, order, axis=1))

@@ -152,3 +152,41 @@ def test_external_zonemap_prune(s, tmp_path):
     scanned = M.rows_scanned.get(table="big") - before
     # only the first row group is read: metadata stats skip the other 9
     assert scanned == 10000, scanned
+
+
+def test_external_cache_hits_and_invalidation(tmp_path):
+    """VERDICT r3 weak #10: external tables re-read files per query.
+    Repeat queries of an unchanged local file must serve from the
+    decoded cache (no re-open); modifying the file must invalidate."""
+    import time
+    from matrixone_tpu.storage import external as ext
+    from matrixone_tpu.frontend import Session
+    p = tmp_path / "ev.csv"
+    p.write_text("id,v\n1,10\n2,20\n")
+    s = Session()
+    s.execute(f"create external table ec (id bigint, v bigint)"
+              f" location '{p}' format csv")
+    opens = {"n": 0}
+    orig = ext.open_location
+
+    def counted(engine, url):
+        opens["n"] += 1
+        return orig(engine, url)
+    ext.open_location = counted
+    try:
+        assert [tuple(map(int, r)) for r in
+                s.execute("select id, v from ec order by id").rows()] \
+            == [(1, 10), (2, 20)]
+        first = opens["n"]
+        assert first >= 1
+        for _ in range(3):
+            s.execute("select sum(v) from ec")
+        assert opens["n"] == first, "cached scan re-opened the file"
+        # file change invalidates (mtime/size fingerprint)
+        time.sleep(0.02)
+        p.write_text("id,v\n1,10\n2,20\n3,30\n")
+        rows = s.execute("select count(*), sum(v) from ec").rows()
+        assert (int(rows[0][0]), int(rows[0][1])) == (3, 60)
+        assert opens["n"] > first
+    finally:
+        ext.open_location = orig

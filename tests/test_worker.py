@@ -128,3 +128,35 @@ def test_sharded_and_replicated_modes(worker):
         assert r["mode"] == mode
         d, ids = worker.search_index(f"ix_{mode}", q, k=3, nprobe=8)
         assert int(ids[0][0]) == 17, (mode, ids[0])
+
+
+def test_sharded_mode_recall_parity(worker):
+    """VERDICT r3 weak #9: sharded mode splits nlist arithmetically and
+    recall at small shards was never measured. Clustered data, recall@10
+    vs exact brute force: sharded must stay within 0.05 of single-index
+    recall at the same nprobe budget."""
+    rng = np.random.default_rng(11)
+    centers = rng.normal(size=(64, 16)).astype(np.float32) * 3.0
+    labels = rng.integers(0, 64, 6000)
+    data = (centers[labels]
+            + rng.normal(size=(6000, 16)).astype(np.float32) * 0.4)
+    queries = (centers[rng.integers(0, 64, 100)]
+               + rng.normal(size=(100, 16)).astype(np.float32) * 0.4)
+    # exact truth
+    d2 = ((queries[:, None, :] - data[None, :, :]) ** 2).sum(-1)
+    truth = np.argsort(d2, axis=1)[:, :10]
+
+    def recall(name):
+        _d, ids = worker.search_index(name, queries, k=10, nprobe=8)
+        hit = sum(len(set(ids[i].tolist()) & set(truth[i].tolist()))
+                  for i in range(len(queries)))
+        return hit / truth.size
+
+    worker.load_index("rp_single", data, nlist=32, mode="single")
+    worker.load_index("rp_shard", data, nlist=32, mode="sharded")
+    r_single = recall("rp_single")
+    r_shard = recall("rp_shard")
+    assert r_single > 0.8, r_single
+    # sharded overfetches per shard and exact-reranks the merged union,
+    # so it must MATCH OR BEAT the single index at the same nprobe
+    assert r_shard >= r_single - 0.01, (r_shard, r_single)
