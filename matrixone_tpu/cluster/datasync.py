@@ -1,0 +1,174 @@
+"""Datasync: standby-cluster WAL shipping.
+
+Reference analogue: `pkg/datasync` — a standby cluster consumes the
+primary's log shard and re-applies it, so the standby can take over
+after the primary site is lost. Redesign on this engine's shape: the
+TN's logtail stream IS its WAL, so a StandbyAgent subscribes exactly
+like a CN replica but with durability: every received record is
+appended VERBATIM to the standby's own local WAL before it is applied,
+and periodic checkpoints compact the standby's state into its own
+manifest/objects. Promotion is then just opening the standby's data dir
+as a TN (`TNService(data_dir=standby_dir)`) — the normal restart replay
+(checkpoint + WAL tail) reconstructs everything the primary had acked
+to the stream.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Optional
+
+from matrixone_tpu.cluster.rpc import parse_addr
+from matrixone_tpu.logservice.replicated import _recv_msg, _send_msg
+from matrixone_tpu.storage import wal as walmod
+from matrixone_tpu.storage.engine import Engine, WalApplier
+from matrixone_tpu.storage.fileservice import FileService, LocalFS
+
+
+class StandbyAgent:
+    """Consume a primary TN's logtail into a durable local standby.
+
+    Unlike a CN replica (in-memory state over a shared checkpoint), the
+    standby owns its own storage: records are journaled to ITS WAL
+    before applying, so a standby crash replays locally and a primary
+    loss promotes the standby dir into a full TN."""
+
+    def __init__(self, tn_addr, fs: Optional[FileService] = None,
+                 data_dir: Optional[str] = None,
+                 checkpoint_every: int = 256):
+        if fs is None:
+            fs = LocalFS(data_dir)
+        self.fs = fs
+        self.addr = parse_addr(tn_addr)
+        # restart path: resume from our own checkpoint + WAL tail
+        self.engine = Engine.open(fs)
+        self.checkpoint_every = checkpoint_every
+        # resume position = DURABLE progress only (ckpt + highest WAL
+        # record ts) — engine.committed_ts is wall-clock seeded and
+        # would skip the primary's earlier records on a fresh standby
+        last = self.engine._ckpt_ts
+        for h, _b in self.engine.wal.replay():
+            last = max(last, h.get("ts", 0))
+        self.applied_ts = last
+        self.records_since_ckpt = 0
+        self.last_error: Optional[str] = None
+        self._group: list = []
+        self._caught_up = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self, timeout: float = 60.0) -> "StandbyAgent":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        if not self._caught_up.wait(timeout):
+            # no half-dead agent: the consumer must stop before a caller
+            # retries, or two engines would append to the same WAL
+            self.stop()
+            raise TimeoutError("standby never caught up with the primary")
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # --------------------------------------------------------------- sync
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._consume_once()
+            except (OSError, ConnectionError):
+                # primary down: hold position; promotion is the
+                # operator's call (we ARE the recovery path)
+                time.sleep(0.25)
+            except Exception as e:            # noqa: BLE001
+                import sys
+                self.last_error = repr(e)
+                print(f"[datasync] apply error, resubscribing: {e!r}",
+                      file=sys.stderr, flush=True)
+                time.sleep(1.0)
+
+    def _consume_once(self) -> None:
+        sock = socket.create_connection(self.addr, timeout=30.0)
+        sock.settimeout(1.0)
+        try:
+            _send_msg(sock, {"op": "subscribe",
+                             "from_ts": self.applied_ts})
+            applier = WalApplier(self.engine, skip_ts=self.applied_ts)
+            # journal at COMMIT boundaries only: a resubscribe mid-group
+            # makes the primary resend the group's frames, and frames
+            # already journaled individually would duplicate in our WAL
+            # (duplicate rows after promotion) — so the group buffers
+            # here and lands atomically with its commit record
+            self._group = []
+            while not self._stop.is_set():
+                try:
+                    h, b = _recv_msg(sock)
+                except socket.timeout:
+                    continue
+                self._apply(applier, h, b)
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _apply(self, applier: WalApplier, h: dict, b: bytes) -> None:
+        op = h.get("op")
+        if op == "__caught_up__":
+            self._caught_up.set()
+            return
+        if op == "__resync__":
+            # our position predates the primary's checkpoint: rebuild
+            # from the primary's manifest is impossible here (separate
+            # storage) — but the primary's stream starts at its ckpt, so
+            # a standby that was down across a primary checkpoint must
+            # re-seed. Re-seeding = full state copy; v1 surfaces it.
+            raise RuntimeError(
+                "standby lagged across a primary checkpoint; re-seed "
+                "the standby from a fresh backup")
+        if op == "merge_table":
+            # the primary rewrote gids; mirror the compaction locally
+            # from our OWN state (bit-equal row set, locally owned gids)
+            with self.engine._commit_lock:
+                self.engine.merge_table(h["name"], min_segments=1,
+                                        checkpoint=True)
+            self._advance(h.get("ts", 0))
+            return
+        hts = h.get("ts", 0)
+        already = hts and hts <= self.applied_ts
+        if op in ("insert", "delete"):
+            if not already:
+                self._group.append((h, b))   # journal with its commit
+        elif op == "commit":
+            if not already:
+                # WAL the whole group + commit BEFORE applying (the
+                # primary's WAL-first rule); applied_ts then advances
+                # past this ts, so a redelivery is skipped entirely
+                for gh, gb in self._group:
+                    self.engine.wal.append(gh, gb)
+                self.engine.wal.append(h, b)
+                self.records_since_ckpt += len(self._group) + 1
+            self._group = []
+        elif not already:
+            # catalog records apply (and advance) immediately
+            self.engine.wal.append(h, b)
+            self.records_since_ckpt += 1
+        with self.engine._commit_lock:
+            ts = applier.apply(h, b)
+        if ts is not None:
+            self._advance(ts)
+        elif op not in ("insert", "delete") and hts:
+            self._advance(hts)
+        if self.records_since_ckpt >= self.checkpoint_every:
+            self.engine.checkpoint()
+            self.records_since_ckpt = 0
+
+    def _advance(self, ts: int) -> None:
+        if ts > self.engine.committed_ts:
+            self.engine.committed_ts = ts
+        self.engine.hlc.update(ts)
+        self.applied_ts = max(self.applied_ts, ts)

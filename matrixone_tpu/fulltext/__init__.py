@@ -28,18 +28,18 @@ _CJK_RUN_RE = re.compile(r"[\u3040-\u30FF\u3400-\u9FFF]+")
 
 
 def tokenize(text: str) -> List[str]:
-    """Lowercased word tokens; each CONTIGUOUS CJK run becomes character
-    bigrams (bigrams never span non-adjacent characters)."""
+    """Lowercased word tokens; each CONTIGUOUS CJK run is segmented by
+    the dictionary-driven monlp segmenter (reference: pkg/monlp jieba
+    tokenizer), with character bigrams as the out-of-vocabulary
+    fallback so unknown text stays searchable."""
+    from matrixone_tpu import monlp
     out: List[str] = []
     if not text:
         return out
     for m in _WORD_RE.finditer(text):
         out.append(m.group(0).lower())
     for m in _CJK_RUN_RE.finditer(text):
-        run = m.group(0)
-        if len(run) == 1:
-            out.append(run)
-        out.extend(run[i:i + 2] for i in range(len(run) - 1))
+        out.extend(monlp.tokenize_cjk_run(m.group(0)))
     return out
 
 

@@ -181,7 +181,7 @@ class Binder:
         node, scope = self._bind_from(sel.from_)
 
         if sel.where is not None:
-            pred = self.bind_expr(sel.where, scope)
+            pred = _coerce_bool(self.bind_expr(sel.where, scope))
             _require_bool(pred, "WHERE")
             node = plan.Filter(node, pred, node.schema)
 
@@ -563,7 +563,8 @@ class Binder:
 
         out = agg_node
         if sel.having is not None:
-            pred = self._bind_post_agg(sel.having, new_scope, agg_sub)
+            pred = _coerce_bool(
+                self._bind_post_agg(sel.having, new_scope, agg_sub))
             _require_bool(pred, "HAVING")
             out = plan.Filter(out, pred, out.schema)
         return out, new_scope, agg_sub
@@ -712,6 +713,7 @@ class Binder:
         if isinstance(e, ast.UnaryOp):
             a = rec(e.operand)
             if e.op == "not":
+                a = _coerce_bool(a)
                 _require_bool(a, "NOT")
                 return BoundFunc("not", [a], dt.BOOL)
             return BoundFunc("neg", [a], a.dtype)
@@ -994,6 +996,11 @@ def _coerce_bool(e: BoundExpr) -> BoundExpr:
             return BoundLiteral(None, dt.BOOL)
         if isinstance(e.value, int):
             return BoundLiteral(bool(e.value), dt.BOOL)
+    if isinstance(e, BoundFunc) and e.op == "match_against":
+        # MySQL: MATCH ... AGAINST in a boolean context is truthy when
+        # the relevance score is positive
+        return BoundFunc("gt", [e, BoundLiteral(0.0, dt.FLOAT64)],
+                         dt.BOOL)
     return e
 
 

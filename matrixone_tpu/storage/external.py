@@ -205,8 +205,14 @@ class ExternalTable:
         return out
 
     # --------------------------------------------------------- file cache
-    _CACHE_BUDGET = int(os.environ.get("MO_EXTERNAL_CACHE_MB",
-                                       "256")) << 20
+    #: PROCESS-WIDE decoded-bytes budget across every external table
+    #: (read at call time so the env var works whenever it is set)
+    _cache_used = 0
+    _cache_acct_lock = threading.Lock()
+
+    @staticmethod
+    def _cache_budget() -> int:
+        return int(os.environ.get("MO_EXTERNAL_CACHE_MB", "256")) << 20
 
     def _stat_sig(self):
         """(mtime_ns, size) of the backing LOCAL file, or None when the
@@ -233,31 +239,44 @@ class ExternalTable:
         gates cold materialization: only an unfiltered scan pays the
         full read (a selective first query keeps row-group pruning)."""
         sig = self._stat_sig()
-        if sig is None or sig[1] > self._CACHE_BUDGET:
+        budget = self._cache_budget()
+        if sig is None or sig[1] > budget:
             return None
+        # the populate itself runs under _cache_lock: two concurrent
+        # cold queries must not each decode the whole file
         with self._cache_lock:
             if self._cache is not None and self._cache[0] == sig:
                 return self._cache if self._cache[1] is not None else None
-        if not populate:
-            return None
-        cols = [c for c, _ in self.meta.schema]
-        chunks = []
-        decoded = 0
-        for arrays, validity, _d, n in self._iter_stream(cols, 1 << 20,
-                                                         None, {}):
-            decoded += sum(a.nbytes for a in arrays.values()) \
-                + sum(v.nbytes for v in validity.values())
-            if decoded > self._CACHE_BUDGET:
-                # decoded form over budget: remember NOT to retry every
-                # query (sig, None) and stream instead
-                with self._cache_lock:
-                    self._cache = (sig, None)
+            if not populate:
                 return None
-            chunks.append((arrays, validity, n))
-        entry = (sig, chunks)
-        with self._cache_lock:
-            self._cache = entry
-        return entry
+            self._drop_cache_locked()
+            cols = [c for c, _ in self.meta.schema]
+            chunks = []
+            decoded = 0
+            for arrays, validity, _d, n in self._iter_stream(
+                    cols, 1 << 20, None, {}):
+                decoded += sum(a.nbytes for a in arrays.values()) \
+                    + sum(v.nbytes for v in validity.values())
+                with ExternalTable._cache_acct_lock:
+                    over = (ExternalTable._cache_used + decoded
+                            > budget)
+                if over:
+                    # decoded form over the PROCESS-WIDE budget:
+                    # remember NOT to retry every query and stream
+                    self._cache = (sig, None, 0)
+                    return None
+                chunks.append((arrays, validity, n))
+            with ExternalTable._cache_acct_lock:
+                ExternalTable._cache_used += decoded
+            self._cache = (sig, chunks, decoded)
+            return self._cache
+
+    def _drop_cache_locked(self) -> None:
+        """Release the old entry's global accounting (file changed)."""
+        if self._cache is not None and self._cache[1] is not None:
+            with ExternalTable._cache_acct_lock:
+                ExternalTable._cache_used -= self._cache[2]
+        self._cache = None
 
     # ----------------------------------------------------------- read path
     def iter_chunks(self, columns: List[str], batch_rows: int,
@@ -271,7 +290,7 @@ class ExternalTable:
         qmap = dict(zip(qualified_names or columns, columns))
         cached = self._cached_full(populate=not filters)
         if cached is not None:
-            _sig, chunks = cached
+            chunks = cached[1]
             base = 0
             for call, vall, cn in chunks:
                 # honor the caller's chunk size (session batch_rows):

@@ -204,11 +204,14 @@ def search(index: IvfFlatIndex, queries: jnp.ndarray, k: int, nprobe: int,
 
 
 def rerank_exact(dataset: jnp.ndarray, queries: jnp.ndarray,
-                 ids: jnp.ndarray, metric: str = METRIC_L2
+                 ids: jnp.ndarray, metric: str = METRIC_L2,
+                 valid: Optional[jnp.ndarray] = None
                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Re-score candidate ids with the f64 sequential-order rowwise kernel
     and re-sort — final (distances, ids) are bit-identical to the CPU scalar
-    path (`l2_distance` SQL function) applied to the same candidates."""
+    path (`l2_distance` SQL function) applied to the same candidates.
+    `valid` masks padded candidate lanes (their ids are CLAMPED
+    duplicates): invalid lanes keep inf distance and sort last."""
     b, k = ids.shape
     cand = dataset[ids.reshape(-1)].reshape(b, k, -1)
     qe = jnp.repeat(queries[:, None, :], k, axis=1)
@@ -221,6 +224,8 @@ def rerank_exact(dataset: jnp.ndarray, queries: jnp.ndarray,
     else:
         dist = -D.inner_product_rowwise(cand.reshape(b * k, -1),
                                         qe.reshape(b * k, -1)).reshape(b, k)
+    if valid is not None:
+        dist = jnp.where(valid, dist, jnp.inf)
     order = jnp.argsort(dist, axis=1)
     return (jnp.take_along_axis(dist, order, axis=1),
             jnp.take_along_axis(ids, order, axis=1))

@@ -150,7 +150,12 @@ _STRING_FUNCS = {"upper", "lower", "length", "reverse", "trim", "ltrim",
                  "regexp_like", "regexp_instr", "regexp_substr",
                  "regexp_replace",
                  "json_extract", "json_unquote", "json_valid",
-                 "json_length", "json_type", "json_keys"}
+                 "json_length", "json_type", "json_keys",
+                 # index-less MATCH AGAINST fallback (WHERE truthiness /
+                 # un-indexed scans): tf of query terms per dictionary
+                 # entry — the BM25-ranked path is the fulltext INDEX
+                 # rewrite (vm/fulltext_scan.py)
+                 "match_against"}
 
 
 def _string_arg_info(e, ex, want_col: bool = True):
@@ -416,6 +421,13 @@ def _apply_string_func(op, s, lits):
     if op == "regexp_replace":
         a = args()
         return _re.sub(str(a[0]), str(a[1]), s)
+    if op == "match_against":
+        from matrixone_tpu.fulltext import tokenize as _ft_tokenize
+        terms = set(_ft_tokenize(str(args()[0])))
+        if not terms:
+            return 0.0
+        toks = _ft_tokenize(s)
+        return float(sum(1 for t in toks if t in terms))
     if op.startswith("json_"):
         import json as _json
         doc = _json_parse(s)

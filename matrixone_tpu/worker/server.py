@@ -362,28 +362,19 @@ class WorkerCore:
             all_i = np.concatenate(ids, axis=1)
             data = entry.get("data")
             if data is not None:
-                # exact f32 re-rank of the union candidates (tiny: n
-                # queries x shards*(2k+8) rows) — restores single-index
-                # recall that approximate cross-shard ranking loses
-                qr = np.asarray(q[:n], np.float32)
-                cand = data[all_i]                 # [n, M, d]
-                metric_ = entry.get("metric", "l2")
-                if metric_ == "cosine":
-                    cn = cand / np.maximum(
-                        np.linalg.norm(cand, axis=2, keepdims=True),
-                        1e-30)
-                    qn = qr / np.maximum(
-                        np.linalg.norm(qr, axis=1, keepdims=True), 1e-30)
-                    d_ex = 1.0 - np.einsum("nmd,nd->nm", cn, qn)
-                elif metric_ == "ip":
-                    d_ex = -np.einsum("nmd,nd->nm", cand, qr)
-                else:
-                    diff = cand - qr[:, None, :]
-                    d_ex = np.einsum("nmd,nmd->nm", diff, diff)
-                # padded candidate lanes carry inf distances and CLAMPED
-                # (duplicate) ids — they must stay infinitely far after
-                # the re-rank or they re-enter the top-k as duplicates
-                all_d = np.where(np.isfinite(all_d), d_ex, np.inf)
+                # exact re-rank of the union candidates (tiny: n queries
+                # x shards*(2k+8) rows) via the SAME rerank_exact kernel
+                # every other exact path uses — restores the recall that
+                # approximate cross-shard ranking loses; padded lanes
+                # (inf distance, clamped duplicate ids) stay masked
+                d_r, i_r = ivf_flat.rerank_exact(
+                    jnp.asarray(data), jnp.asarray(q[:n], np.float32),
+                    jnp.asarray(all_i),
+                    metric=entry.get("metric", "l2"),
+                    valid=jnp.asarray(np.isfinite(all_d)))
+                all_d = np.asarray(d_r)
+                all_i = np.asarray(i_r)
+                return all_d[:, :k], all_i[:, :k]
             order = np.argsort(all_d, axis=1)[:, :k]
             return (np.take_along_axis(all_d, order, axis=1),
                     np.take_along_axis(all_i, order, axis=1))

@@ -1,0 +1,130 @@
+"""Datasync standby-cluster WAL shipping (§2.6 gap; reference:
+pkg/datasync — consume the primary's log, re-apply on a standby, and
+promote the standby after primary-site loss).
+"""
+
+import os
+import tempfile
+import time
+
+import pytest
+
+from matrixone_tpu.cluster import RemoteCatalog, TNService
+from matrixone_tpu.cluster.datasync import StandbyAgent
+from matrixone_tpu.frontend import Session
+
+
+def _wait(fn, timeout=20):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_standby_replicates_and_promotes():
+    primary_dir = tempfile.mkdtemp(prefix="mo_ds_primary_")
+    standby_dir = tempfile.mkdtemp(prefix="mo_ds_standby_")
+    tn = TNService(data_dir=primary_dir).start()
+    cat = RemoteCatalog(("127.0.0.1", tn.port), data_dir=primary_dir)
+    s = Session(catalog=cat)
+    s.execute("create table acct (id bigint primary key, bal bigint,"
+              " owner varchar(16))")
+    s.execute("insert into acct values (1, 100, 'ann'), (2, 250, 'bo')")
+
+    agent = StandbyAgent(("127.0.0.1", tn.port),
+                         data_dir=standby_dir).start()
+    try:
+        # writes AFTER the standby attached also ship
+        s.execute("update acct set bal = bal - 40 where id = 1")
+        s.execute("insert into acct values (3, 75, 'cy')")
+        s.execute("delete from acct where id = 2")
+        assert _wait(lambda: agent.applied_ts >= cat.committed_ts)
+
+        # the standby's own storage is durable: its WAL holds the tail
+        assert os.path.exists(os.path.join(standby_dir, "wal",
+                                           "wal.log"))
+
+        # primary site lost
+        cat.close()
+        tn.stop()
+        agent.stop()
+
+        # PROMOTE: the standby dir opens as a full TN (normal restart
+        # replay: its checkpoint + its WAL tail)
+        tn2 = TNService(data_dir=standby_dir).start()
+        cat2 = RemoteCatalog(("127.0.0.1", tn2.port),
+                             data_dir=standby_dir)
+        s2 = Session(catalog=cat2)
+        rows = s2.execute("select id, bal, owner from acct"
+                          " order by id").rows()
+        assert [(int(a), int(b), c) for a, b, c in rows] == \
+            [(1, 60, "ann"), (3, 75, "cy")]
+        # and the promoted cluster takes writes
+        s2.execute("insert into acct values (4, 10, 'di')")
+        assert len(s2.execute("select * from acct").rows()) == 3
+        cat2.close()
+        tn2.stop()
+    finally:
+        agent.stop()
+
+
+def test_standby_survives_own_restart():
+    primary_dir = tempfile.mkdtemp(prefix="mo_ds2_p_")
+    standby_dir = tempfile.mkdtemp(prefix="mo_ds2_s_")
+    tn = TNService(data_dir=primary_dir).start()
+    cat = RemoteCatalog(("127.0.0.1", tn.port), data_dir=primary_dir)
+    s = Session(catalog=cat)
+    s.execute("create table t (id bigint primary key, v bigint)")
+    s.execute("insert into t values (1, 1)")
+    agent = StandbyAgent(("127.0.0.1", tn.port),
+                         data_dir=standby_dir).start()
+    assert _wait(lambda: agent.applied_ts >= cat.committed_ts)
+    agent.stop()                      # standby goes down
+    s.execute("insert into t values (2, 2)")
+    # restart: local replay + resubscribe picks up what it missed
+    agent2 = StandbyAgent(("127.0.0.1", tn.port),
+                          data_dir=standby_dir).start()
+    assert _wait(lambda: agent2.applied_ts >= cat.committed_ts)
+    agent2.stop()
+    cat.close()
+    tn.stop()
+    tn2 = TNService(data_dir=standby_dir).start()
+    cat2 = RemoteCatalog(("127.0.0.1", tn2.port), data_dir=standby_dir)
+    s2 = Session(catalog=cat2)
+    assert sorted(int(r[0]) for r in
+                  s2.execute("select id from t").rows()) == [1, 2]
+    cat2.close()
+    tn2.stop()
+
+
+def test_standby_mirrors_merges():
+    primary_dir = tempfile.mkdtemp(prefix="mo_ds3_p_")
+    standby_dir = tempfile.mkdtemp(prefix="mo_ds3_s_")
+    tn = TNService(data_dir=primary_dir).start()
+    cat = RemoteCatalog(("127.0.0.1", tn.port), data_dir=primary_dir)
+    s = Session(catalog=cat)
+    s.execute("create table m (id bigint primary key, v bigint)")
+    agent = StandbyAgent(("127.0.0.1", tn.port),
+                         data_dir=standby_dir).start()
+    s.execute("insert into m values (1, 1)")
+    s.execute("insert into m values (2, 2)")
+    s.execute("delete from m where id = 1")
+    assert _wait(lambda: agent.applied_ts >= cat.committed_ts)
+    assert cat.merge_table("m") == 1
+    assert _wait(lambda: len(agent.engine.get_table("m").segments) == 1)
+    # post-merge writes keep flowing (gid spaces stayed aligned)
+    s.execute("insert into m values (5, 5)")
+    s.execute("delete from m where id = 2")
+    assert _wait(lambda: agent.applied_ts >= cat.committed_ts)
+    agent.stop()
+    cat.close()
+    tn.stop()
+    tn2 = TNService(data_dir=standby_dir).start()
+    cat2 = RemoteCatalog(("127.0.0.1", tn2.port), data_dir=standby_dir)
+    s2 = Session(catalog=cat2)
+    assert sorted(int(r[0]) for r in
+                  s2.execute("select id from m").rows()) == [5]
+    cat2.close()
+    tn2.stop()

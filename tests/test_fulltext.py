@@ -11,7 +11,11 @@ def test_tokenizer():
     assert FT.tokenize("Hello, World_2!") == ["hello", "world_2"]
     assert FT.tokenize("") == []
     toks = FT.tokenize("数据库系统")
-    assert "数据" in toks and "据库" in toks   # CJK bigrams
+    # dictionary segmentation (monlp): whole words, not bigrams
+    assert toks == ["数据库", "系统"]
+    # out-of-vocabulary CJK still falls back to bigrams
+    oov = FT.tokenize("魑魅魍魉")
+    assert oov == ["魑魅", "魅魍", "魍魉"]
 
 
 def test_bm25_ranking_vs_reference_formula():
@@ -60,13 +64,21 @@ def test_fulltext_sql_end_to_end():
     assert rows[0][0] not in {r[0] for r in rows2}
 
 
-def test_fulltext_index_required_error():
+def test_fulltext_without_index_uses_tf_fallback():
     s = Session()
     s.execute("create table d2 (id bigint, body text)")
-    s.execute("insert into d2 values (1, 'x')")
-    with pytest.raises(Exception):
-        # no fulltext index and no rewrite -> eval has no kernel for it
-        s.execute("select match(body) against ('x') from d2")
+    s.execute("insert into d2 values (1, 'alpha beta'), (2, 'gamma'),"
+              " (3, 'beta beta')")
+    # no index: the dictionary-level tf fallback scores query terms, so
+    # WHERE truthiness and plain selects still work (the BM25-ranked
+    # path needs the fulltext index rewrite)
+    rows = s.execute("select id from d2 where match(body)"
+                     " against('beta') order by id").rows()
+    assert [int(r[0]) for r in rows] == [1, 3]
+    rows = s.execute("select id, match(body) against('beta') from d2"
+                     " order by id").rows()
+    assert [(int(a), float(b)) for a, b in rows] == [(1, 1.0), (2, 0.0),
+                                                     (3, 2.0)]
 
 
 def test_fulltext_offset_and_zero_score_fill():

@@ -1,0 +1,69 @@
+"""CJK dictionary segmentation (VERDICT r3 missing #9; reference:
+pkg/monlp/tokenizer/jieba.go): bidirectional maximum matching over a
+lexicon with bigram fallback, feeding fulltext search.
+"""
+
+import pytest
+
+from matrixone_tpu import monlp
+from matrixone_tpu.frontend import Session
+from matrixone_tpu.fulltext import tokenize
+
+
+def test_dictionary_words_segment_whole():
+    assert monlp.cut("我们喜欢分布式数据库") == ["我们", "喜欢",
+                                               "分布式", "数据库"]
+    assert monlp.cut("今天天气非常好") == ["今天", "天气", "非常", "好"]
+
+
+def test_bidirectional_disambiguation():
+    # overlap ambiguity: FMM and BMM can disagree; fewer-words wins,
+    # and the result must cover the input exactly
+    for text in ("中国人民银行", "数据库索引优化", "上海高可用集群"):
+        cut = monlp.cut(text)
+        assert "".join(cut) == text
+        assert all(len(w) >= 1 for w in cut)
+
+
+def test_unknown_text_falls_back_to_bigrams():
+    toks = tokenize("魑魅魍魉")          # OOV run -> bigrams
+    assert toks == ["魑魅", "魅魍", "魍魉"]
+    # mixed: known words tokenize as words, OOV spans as bigrams
+    toks = tokenize("数据库魑魅")
+    assert "数据库" in toks and "魑魅" in toks
+
+
+def test_user_dict_extension(tmp_path):
+    seg = monlp.Segmenter()
+    assert "量子纠缠" not in seg.words
+    p = tmp_path / "user.dict"
+    p.write_text("量子纠缠 100 n\n超导材料 50\n", encoding="utf-8")
+    assert seg.load_dict(str(p)) == 2
+    assert seg.cut("量子纠缠超导材料") == ["量子纠缠", "超导材料"]
+
+
+def test_mixed_latin_cjk_tokens():
+    toks = tokenize("JAX 加速分布式计算 on TPU")
+    assert "jax" in toks and "tpu" in toks
+    assert "分布式" in toks and "计算" in toks
+
+
+def test_fulltext_search_with_cjk_words():
+    """End to end: MATCH AGAINST over Chinese documents ranks the
+    dictionary-word hit, and indexing/query tokenization agree."""
+    s = Session()
+    s.execute("create table docs (id bigint primary key, body text)")
+    s.execute("insert into docs values "
+              "(1, '我们的分布式数据库支持向量索引'), "
+              "(2, '今天天气非常好我们去跑步'), "
+              "(3, '高可用集群需要检查点和副本')")
+    s.execute("create index ft using fulltext on docs (body)")
+    rows = s.execute("select id from docs where match(body)"
+                     " against('数据库') order by id").rows()
+    assert [int(r[0]) for r in rows] == [1]
+    rows = s.execute("select id from docs where match(body)"
+                     " against('检查点') order by id").rows()
+    assert [int(r[0]) for r in rows] == [3]
+    rows = s.execute("select id from docs where match(body)"
+                     " against('跑步') order by id").rows()
+    assert [int(r[0]) for r in rows] == [2]
