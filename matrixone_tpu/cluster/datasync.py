@@ -45,13 +45,7 @@ class StandbyAgent:
         # restart path: resume from our own checkpoint + WAL tail
         self.engine = Engine.open(fs)
         self.checkpoint_every = checkpoint_every
-        # resume position = DURABLE progress only (ckpt + highest WAL
-        # record ts) — engine.committed_ts is wall-clock seeded and
-        # would skip the primary's earlier records on a fresh standby
-        last = self.engine._ckpt_ts
-        for h, _b in self.engine.wal.replay():
-            last = max(last, h.get("ts", 0))
-        self.applied_ts = last
+        self.applied_ts = self._durable_position()
         self.records_since_ckpt = 0
         self.last_error: Optional[str] = None
         self._group: list = []
@@ -75,6 +69,34 @@ class StandbyAgent:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
+    # -------------------------------------------------------- positioning
+    def _durable_position(self) -> int:
+        """Highest PRIMARY ts covered by durable standby state: the
+        position file written at each checkpoint (our local ckpt_ts is a
+        WALL-CLOCK stamp — trusting it would skip primary records under
+        clock skew) plus the WAL tail's record ts (all primary ts)."""
+        import json
+        last = 0
+        if self.fs.exists("meta/datasync_pos.json"):
+            try:
+                last = int(json.loads(
+                    self.fs.read("meta/datasync_pos.json")))
+            except (ValueError, TypeError):
+                last = 0
+        for h, _b in self.engine.wal.replay():
+            last = max(last, h.get("ts", 0))
+        return last
+
+    def _checkpoint(self) -> None:
+        """Checkpoint + persist the primary position it covers (written
+        BEFORE the truncation so a crash between the two replays the
+        tail instead of skipping it)."""
+        import json
+        self.fs.write("meta/datasync_pos.json",
+                      json.dumps(self.applied_ts).encode())
+        self.engine.checkpoint()
+        self.records_since_ckpt = 0
+
     # --------------------------------------------------------------- sync
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -87,8 +109,18 @@ class StandbyAgent:
             except Exception as e:            # noqa: BLE001
                 import sys
                 self.last_error = repr(e)
-                print(f"[datasync] apply error, resubscribing: {e!r}",
-                      file=sys.stderr, flush=True)
+                print(f"[datasync] apply error, recovering from local "
+                      f"WAL and resubscribing: {e!r}", file=sys.stderr,
+                      flush=True)
+                # an error between journaling a group and applying it
+                # leaves memory behind the WAL; rebuild in-memory state
+                # from our durable truth so the journaled group is never
+                # re-received (duplicate frames) nor lost
+                try:
+                    self.engine = Engine.open(self.fs)
+                    self.applied_ts = self._durable_position()
+                except Exception as e2:       # noqa: BLE001
+                    self.last_error = repr(e2)
                 time.sleep(1.0)
 
     def _consume_once(self) -> None:
@@ -164,8 +196,7 @@ class StandbyAgent:
         elif op not in ("insert", "delete") and hts:
             self._advance(hts)
         if self.records_since_ckpt >= self.checkpoint_every:
-            self.engine.checkpoint()
-            self.records_since_ckpt = 0
+            self._checkpoint()
 
     def _advance(self, ts: int) -> None:
         if ts > self.engine.committed_ts:

@@ -1165,6 +1165,16 @@ _SCALAR_FUNCS = {
     "regexp_instr": ("regexp_instr", lambda ts: dt.INT64),
     "regexp_substr": ("regexp_substr", lambda ts: dt.VARCHAR),
     "regexp_replace": ("regexp_replace", lambda ts: dt.VARCHAR),
+    # ---- geo family (WKT, planar — pkg/geo role)
+    "st_geomfromtext": ("st_geomfromtext", lambda ts: dt.VARCHAR),
+    "st_astext": ("st_astext", lambda ts: dt.VARCHAR),
+    "st_x": ("st_x", lambda ts: dt.FLOAT64),
+    "st_y": ("st_y", lambda ts: dt.FLOAT64),
+    "st_distance": ("st_distance", lambda ts: dt.FLOAT64),
+    "st_within": ("st_within", lambda ts: dt.BOOL),
+    "st_contains": ("st_contains", lambda ts: dt.BOOL),
+    "st_area": ("st_area", lambda ts: dt.FLOAT64),
+    "st_geohash": ("st_geohash", lambda ts: dt.VARCHAR),
     # ---- JSON family
     "json_extract": ("json_extract", lambda ts: dt.VARCHAR),
     "json_unquote": ("json_unquote", lambda ts: dt.VARCHAR),

@@ -657,6 +657,10 @@ class Engine:
             if if_exists:
                 return
             raise ValueError(f"no such table {name}")
+        t = self.tables[name]
+        release = getattr(t, "release_cache", None)
+        if release is not None:       # external tables free their cache
+            release()
         del self.tables[name]
         self.sources.discard(name)
         self.dynamic_tables.pop(name, None)

@@ -150,6 +150,7 @@ class ExternalTable:
         # budget, invalidated by mtime/size
         self._cache: Optional[tuple] = None
         self._cache_lock = threading.Lock()
+        self._populate_lock = threading.Lock()
 
     # ------------------------------------------------------------- plumbing
     @property
@@ -240,16 +241,24 @@ class ExternalTable:
         full read (a selective first query keeps row-group pruning)."""
         sig = self._stat_sig()
         budget = self._cache_budget()
-        if sig is None or sig[1] > budget:
-            return None
-        # the populate itself runs under _cache_lock: two concurrent
-        # cold queries must not each decode the whole file
-        with self._cache_lock:
+        with self._cache_lock:              # brief: hit/negative check
+            if self._cache is not None and self._cache[0] != sig:
+                self._drop_cache_locked()   # file changed: free budget
+            if sig is None or sig[1] > budget:
+                return None
             if self._cache is not None and self._cache[0] == sig:
                 return self._cache if self._cache[1] is not None else None
             if not populate:
+                # streaming readers must never wait on a cold decode
                 return None
-            self._drop_cache_locked()
+        # cold populate serialized on its OWN lock so concurrent first
+        # queries don't each decode the file — and filtered readers
+        # above never block on it
+        with self._populate_lock:
+            with self._cache_lock:
+                if self._cache is not None and self._cache[0] == sig:
+                    return (self._cache if self._cache[1] is not None
+                            else None)
             cols = [c for c, _ in self.meta.schema]
             chunks = []
             decoded = 0
@@ -263,20 +272,31 @@ class ExternalTable:
                 if over:
                     # decoded form over the PROCESS-WIDE budget:
                     # remember NOT to retry every query and stream
-                    self._cache = (sig, None, 0)
+                    with self._cache_lock:
+                        self._drop_cache_locked()
+                        self._cache = (sig, None, 0)
                     return None
                 chunks.append((arrays, validity, n))
             with ExternalTable._cache_acct_lock:
                 ExternalTable._cache_used += decoded
-            self._cache = (sig, chunks, decoded)
-            return self._cache
+            with self._cache_lock:
+                self._drop_cache_locked()
+                self._cache = (sig, chunks, decoded)
+                return self._cache
 
     def _drop_cache_locked(self) -> None:
-        """Release the old entry's global accounting (file changed)."""
+        """Release the old entry's global accounting (file changed /
+        table dropped)."""
         if self._cache is not None and self._cache[1] is not None:
             with ExternalTable._cache_acct_lock:
                 ExternalTable._cache_used -= self._cache[2]
         self._cache = None
+
+    def release_cache(self) -> None:
+        """DROP TABLE hook: give the decoded bytes back to the
+        process-wide budget."""
+        with self._cache_lock:
+            self._drop_cache_locked()
 
     # ----------------------------------------------------------- read path
     def iter_chunks(self, columns: List[str], batch_rows: int,

@@ -155,7 +155,12 @@ _STRING_FUNCS = {"upper", "lower", "length", "reverse", "trim", "ltrim",
                  # un-indexed scans): tf of query terms per dictionary
                  # entry — the BM25-ranked path is the fulltext INDEX
                  # rewrite (vm/fulltext_scan.py)
-                 "match_against"}
+                 "match_against",
+                 # geo over WKT strings (reference: pkg/geo) — planar
+                 # semantics evaluated on the dictionary (matrixone_tpu.geo)
+                 "st_geomfromtext", "st_astext", "st_x", "st_y",
+                 "st_distance", "st_within", "st_contains", "st_area",
+                 "st_geohash"}
 
 
 def _string_arg_info(e, ex, want_col: bool = True):
@@ -421,6 +426,36 @@ def _apply_string_func(op, s, lits):
     if op == "regexp_replace":
         a = args()
         return _re.sub(str(a[0]), str(a[1]), s)
+    if op.startswith("st_"):
+        from matrixone_tpu import geo as G
+        if op == "st_geohash":
+            g = G.parse_wkt(s)
+            if g is None or g.kind != "POINT":
+                return None
+            prec = int(args()[0]) if args() else 12
+            return G.geohash(g.coords[0][0], g.coords[0][1],
+                             max(1, min(prec, 12)))
+        if op in ("st_distance", "st_within", "st_contains"):
+            g1 = G.parse_wkt(str(at(0, "")))
+            g2 = G.parse_wkt(str(at(1, "")))
+            if g1 is None or g2 is None:
+                return None
+            if op == "st_distance":
+                return G.distance(g1, g2)
+            if op == "st_within":
+                return G.contains(g2, g1)
+            return G.contains(g1, g2)
+        g = G.parse_wkt(s)
+        if g is None:
+            return None
+        if op in ("st_geomfromtext", "st_astext"):
+            return g.wkt()
+        if op == "st_x":
+            return g.coords[0][0] if g.kind == "POINT" else None
+        if op == "st_y":
+            return g.coords[0][1] if g.kind == "POINT" else None
+        if op == "st_area":
+            return G.area(g)
     if op == "match_against":
         from matrixone_tpu.fulltext import tokenize as _ft_tokenize
         terms = set(_ft_tokenize(str(args()[0])))

@@ -362,18 +362,25 @@ class WorkerCore:
             all_i = np.concatenate(ids, axis=1)
             data = entry.get("data")
             if data is not None:
-                # exact re-rank of the union candidates (tiny: n queries
-                # x shards*(2k+8) rows) via the SAME rerank_exact kernel
-                # every other exact path uses — restores the recall that
-                # approximate cross-shard ranking loses; padded lanes
-                # (inf distance, clamped duplicate ids) stay masked
-                d_r, i_r = ivf_flat.rerank_exact(
-                    jnp.asarray(data), jnp.asarray(q[:n], np.float32),
-                    jnp.asarray(all_i),
+                # exact re-rank of the union candidates via the SAME
+                # rerank_exact kernel every other exact path uses —
+                # restores the recall that approximate cross-shard
+                # ranking loses. The candidates are GATHERED host-side
+                # first (n x shards*(2k+8) rows): shipping the whole
+                # dataset to the device per search batch would be a
+                # gigabyte-scale transfer at real index sizes.
+                n_q, m = all_i.shape
+                cand = data[all_i.reshape(-1)]         # [n*M, d] host
+                local_ids = np.arange(n_q * m,
+                                      dtype=np.int64).reshape(n_q, m)
+                d_r, loc = ivf_flat.rerank_exact(
+                    jnp.asarray(cand), jnp.asarray(q[:n], np.float32),
+                    jnp.asarray(local_ids),
                     metric=entry.get("metric", "l2"),
                     valid=jnp.asarray(np.isfinite(all_d)))
+                loc = np.asarray(loc)
                 all_d = np.asarray(d_r)
-                all_i = np.asarray(i_r)
+                all_i = all_i.reshape(-1)[loc]
                 return all_d[:, :k], all_i[:, :k]
             order = np.argsort(all_d, axis=1)[:, :k]
             return (np.take_along_axis(all_d, order, axis=1),

@@ -1,0 +1,71 @@
+"""Geo functions over WKT (pkg/geo role): planar ST_* family evaluated
+at the dictionary level, end-to-end through SQL.
+"""
+
+import math
+
+import pytest
+
+from matrixone_tpu import geo
+from matrixone_tpu.frontend import Session
+
+
+def test_wkt_parse_and_normalize():
+    g = geo.parse_wkt("point( 1.5  -2 )")
+    assert g.kind == "POINT" and g.coords == [(1.5, -2.0)]
+    assert geo.parse_wkt("POINT(1)") is None
+    assert geo.parse_wkt("POLYGON((0 0, 1 0, 1 1))") is None  # not closed
+    assert geo.parse_wkt("garbage") is None
+    ring = geo.parse_wkt("POLYGON((0 0, 4 0, 4 4, 0 4, 0 0))")
+    assert ring.kind == "POLYGON" and len(ring.coords) == 5
+
+
+def test_distance_and_contains():
+    p = geo.parse_wkt("POINT(0 0)")
+    q = geo.parse_wkt("POINT(3 4)")
+    assert geo.distance(p, q) == 5.0
+    line = geo.parse_wkt("LINESTRING(0 2, 10 2)")
+    assert abs(geo.distance(p, line) - 2.0) < 1e-12
+    poly = geo.parse_wkt("POLYGON((0 0, 4 0, 4 4, 0 4, 0 0))")
+    inside = geo.parse_wkt("POINT(2 2)")
+    outside = geo.parse_wkt("POINT(9 9)")
+    assert geo.contains(poly, inside)
+    assert not geo.contains(poly, outside)
+    assert geo.distance(inside, poly) == 0.0
+    assert abs(geo.area(poly) - 16.0) < 1e-12
+
+
+def test_geohash_known_value():
+    # well-known reference point: geohash of (lon=-5.6, lat=42.6) region
+    assert geo.geohash(-5.60302734375, 42.60498046875, 5) == "ezs42"
+
+
+def test_geo_sql_end_to_end():
+    s = Session()
+    s.execute("create table places (id bigint primary key,"
+              " loc varchar(64))")
+    s.execute("insert into places values "
+              "(1, 'POINT(1 1)'), (2, 'POINT(5 5)'),"
+              " (3, 'POINT(2.5 3)'), (4, NULL), (5, 'not wkt')")
+    rows = s.execute("select id, st_x(loc), st_y(loc) from places"
+                     " order by id").rows()
+    assert rows[0][1:] == (1.0, 1.0)
+    assert rows[3][1:] == (None, None)      # NULL in
+    assert rows[4][1:] == (None, None)      # malformed WKT -> NULL
+    # distance to a constant point, and a polygon containment filter
+    rows = s.execute(
+        "select id from places where st_within(loc,"
+        " 'POLYGON((0 0, 4 0, 4 4, 0 4, 0 0))') order by id").rows()
+    assert [int(r[0]) for r in rows] == [1, 3]
+    rows = s.execute(
+        "select id, round(st_distance(loc, 'POINT(0 0)'), 6)"
+        " from places where loc is not null and st_x(loc) is not null"
+        " order by id").rows()
+    assert abs(rows[0][1] - math.sqrt(2)) < 1e-5
+    # geohash + normalization round-trip
+    rows = s.execute("select st_geohash(st_geomfromtext(loc), 6)"
+                     " from places where id = 1").rows()
+    assert isinstance(rows[0][0], str) and len(rows[0][0]) == 6
+    r = s.execute("select st_area('POLYGON((0 0, 2 0, 2 3, 0 3, 0 0))')"
+                  ).rows()
+    assert abs(r[0][0] - 6.0) < 1e-12
