@@ -1,0 +1,3 @@
+create table sd (g bigint, v double);
+insert into sd values (1, 1.0), (1, 2.0), (1, 3.0), (2, 10.0), (2, 10.0);
+select g, round(stddev_pop(v), 9), round(var_samp(v), 9) from sd group by g order by g;

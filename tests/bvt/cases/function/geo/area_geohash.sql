@@ -1,0 +1,4 @@
+select st_area('POLYGON((0 0, 2 0, 2 3, 0 3, 0 0))');
+select st_area('POINT(1 1)');
+select st_geohash('POINT(-5.60302734375 42.60498046875)', 5);
+select st_geomfromtext('point( 2  3 )');

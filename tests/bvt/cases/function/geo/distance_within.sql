@@ -1,0 +1,5 @@
+create table pl (id bigint primary key, g varchar(64));
+insert into pl values (1, 'POINT(1 1)'), (2, 'POINT(5 5)'), (3, 'POINT(3 0)');
+select id, round(st_distance(g, 'POINT(0 0)'), 6) from pl order by id;
+select id from pl where st_within(g, 'POLYGON((0 0, 4 0, 4 4, 0 4, 0 0))') order by id;
+select st_contains('POLYGON((0 0, 10 0, 10 10, 0 10, 0 0))', 'POINT(5 5)');

@@ -1,0 +1,4 @@
+create table pts (id bigint primary key, g varchar(64));
+insert into pts values (1, 'POINT(1.5 -2)'), (2, 'POINT(0 0)'), (3, NULL), (4, 'bogus');
+select id, st_x(g), st_y(g) from pts order by id;
+select st_x('POINT(7 9)'), st_y('POINT(7 9)');

@@ -1,0 +1,4 @@
+create table cn (id bigint primary key, body text);
+insert into cn values (1, '分布式数据库支持向量索引'), (2, '今天天气非常好');
+select id from cn where match(body) against('数据库') order by id;
+select id from cn where match(body) against('天气') order by id;

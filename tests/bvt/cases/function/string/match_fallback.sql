@@ -1,0 +1,4 @@
+create table ft (id bigint primary key, body text);
+insert into ft values (1, 'alpha beta gamma'), (2, 'delta delta'), (3, 'beta beta beta');
+select id, match(body) against('beta') from ft order by id;
+select id from ft where match(body) against('delta') order by id;
