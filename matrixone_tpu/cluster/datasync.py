@@ -87,13 +87,22 @@ class StandbyAgent:
             last = max(last, h.get("ts", 0))
         return last
 
+    def _persist_pos(self) -> None:
+        """Write the durable position file. Must precede ANY operation
+        that truncates the standby WAL (checkpoint, merge-triggered
+        checkpoint): a crash between truncation and the next pos write
+        would otherwise regress _durable_position() to a stale file with
+        no WAL tail to make up the difference, resubscribe from an old
+        ts, and re-apply records already baked into the checkpoint."""
+        import json
+        self.fs.write("meta/datasync_pos.json",
+                      json.dumps(self.applied_ts).encode())
+
     def _checkpoint(self) -> None:
         """Checkpoint + persist the primary position it covers (written
         BEFORE the truncation so a crash between the two replays the
         tail instead of skipping it)."""
-        import json
-        self.fs.write("meta/datasync_pos.json",
-                      json.dumps(self.applied_ts).encode())
+        self._persist_pos()
         self.engine.checkpoint()
         self.records_since_ckpt = 0
 
@@ -164,10 +173,14 @@ class StandbyAgent:
                 "the standby from a fresh backup")
         if op == "merge_table":
             # the primary rewrote gids; mirror the compaction locally
-            # from our OWN state (bit-equal row set, locally owned gids)
+            # from our OWN state (bit-equal row set, locally owned gids).
+            # checkpoint=True truncates our WAL inside merge_table, so
+            # the pos file must land first (see _persist_pos)
+            self._persist_pos()
             with self.engine._commit_lock:
                 self.engine.merge_table(h["name"], min_segments=1,
                                         checkpoint=True)
+            self.records_since_ckpt = 0
             self._advance(h.get("ts", 0))
             return
         hts = h.get("ts", 0)

@@ -149,6 +149,13 @@ class Session:
     def _acct(self) -> str:
         return self.auth.account if self.auth is not None else "sys"
 
+    def _visible_account(self) -> Optional[str]:
+        """Process-registry visibility scope: None = cluster-wide (sys
+        tenant / embedded sessions), else restricted to this account."""
+        if self.auth is None or self.auth.account == "sys":
+            return None
+        return self.auth.account
+
     def _check(self, priv: str, obj: str = "*") -> None:
         if self.auth is None or self.auth.is_admin:
             return
@@ -265,7 +272,15 @@ class Session:
                 TableMeta(stmt.name, schema, []), stmt.location, fmt)
             return Result()
         if isinstance(stmt, ast.ShowProcesslist):
+            # tenant isolation (reference: authenticate.go account
+            # scoping): the registry is engine-global, but a non-sys
+            # session must not see other tenants' connections — their
+            # SQL text can carry data
+            from matrixone_tpu.queryservice import account_of
             pl = self._procs.processlist()
+            scope = self._visible_account()
+            if scope is not None:
+                pl = [p for p in pl if account_of(p["User"]) == scope]
             b = Batch.from_pydict(
                 {"Id": [p["Id"] for p in pl],
                  "User": [p["User"] for p in pl],
@@ -276,6 +291,18 @@ class Session:
                  "Time": dt.FLOAT64, "Query": dt.TEXT})
             return Result(batch=b)
         if isinstance(stmt, ast.Kill):
+            scope = self._visible_account()
+            owner = self._procs.owner_account(stmt.conn_id)
+            if scope is not None and owner != scope:
+                # cross-tenant KILL is a DoS vector; deny with ONE
+                # indistinguishable error whether the conn is another
+                # tenant's or nonexistent (no conn-id existence oracle)
+                from matrixone_tpu.frontend.auth import AuthError
+                raise AuthError(
+                    f"access denied: connection {stmt.conn_id} does not "
+                    f"belong to account {scope!r}")
+            if owner is None:
+                raise BindError(f"no connection {stmt.conn_id}")
             if not self._procs.kill(stmt.conn_id,
                                     query_only=stmt.query_only):
                 raise BindError(f"no connection {stmt.conn_id}")

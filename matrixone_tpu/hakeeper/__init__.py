@@ -248,6 +248,23 @@ class HAKeeper:
     def _persist_locked(self) -> None:
         if self.persist is None:
             return
+        # Generation fencing on the WRITE path too: after a standby
+        # takeover bumps the stored gen to N+1, the old not-yet-demoted
+        # primary still serves register/deregister until its next tick —
+        # one unconditional persist would roll the store back to N and
+        # unfence BOTH keepers (persistent split-brain). Refuse the
+        # write and step down inline (the lock is already held, so
+        # demote() would deadlock).
+        stored = self._stored_gen()
+        if stored > self.keeper_gen:
+            if self.role == "primary":
+                import sys
+                self.role = "standby"
+                self.operators.append({"op": "demoted", "at": time.time()})
+                print("[hakeeper] demoted: a newer keeper generation "
+                      "owns the store; persist refused", file=sys.stderr,
+                      flush=True)
+            return
         snap = {sid: {k: v for k, v in rec.items() if k != "last_hb"}
                 for sid, rec in self.services.items()}
         snap["__keeper_gen"] = {"gen": self.keeper_gen}
@@ -273,6 +290,16 @@ class HAKeeper:
             if self._stored_gen() > self.keeper_gen:
                 self.demote()
                 return
+            if self._stored_gen() < self.keeper_gen:
+                # the store regressed below our generation: a stale
+                # primary's check-then-write raced our takeover persist
+                # (the store is a plain file, no CAS — the reference
+                # gets atomicity from Raft). Re-assert our generation;
+                # the stale keeper then demotes at ITS next persist or
+                # tick, so any split-brain window is bounded by one
+                # tick interval instead of lasting indefinitely.
+                with self._lock:
+                    self._persist_locked()
             self.tick()
 
     def tick(self) -> None:

@@ -20,6 +20,13 @@ class QueryKilled(RuntimeError):
     pass
 
 
+def account_of(user: str) -> str:
+    """Tenant account of a registered user label. Sessions register as
+    "account:user" (frontend/session.py); bare labels are engine-internal
+    (embed, tests) and belong to the sys tenant."""
+    return user.split(":", 1)[0] if ":" in user else "sys"
+
+
 class ProcessRegistry:
     def __init__(self):
         self._lock = threading.Lock()
@@ -73,6 +80,12 @@ class ProcessRegistry:
             killed = rec is not None and (rec["killed"] or rec["terminated"])
         if killed:
             raise QueryKilled(f"query of connection {cid} was killed")
+
+    def owner_account(self, cid: int) -> Optional[str]:
+        """Tenant account owning a connection; None if no such conn."""
+        with self._lock:
+            rec = self._procs.get(cid)
+            return None if rec is None else account_of(rec["user"])
 
     def is_terminated(self, cid: int) -> bool:
         with self._lock:

@@ -261,24 +261,38 @@ class ExternalTable:
                             else None)
             cols = [c for c, _ in self.meta.schema]
             chunks = []
-            decoded = 0
-            for arrays, validity, _d, n in self._iter_stream(
-                    cols, 1 << 20, None, {}):
-                decoded += sum(a.nbytes for a in arrays.values()) \
-                    + sum(v.nbytes for v in validity.values())
+            # reserve into the PROCESS-WIDE budget chunk by chunk (not
+            # check-then-add-at-the-end): populate is serialized per
+            # table, so two tables populating concurrently would each
+            # see the other's usage as zero and jointly overshoot the
+            # budget by ~2x if reservation waited for the end
+            decoded = 0                     # bytes THIS populate holds
+            try:
+                for arrays, validity, _d, n in self._iter_stream(
+                        cols, 1 << 20, None, {}):
+                    step = sum(a.nbytes for a in arrays.values()) \
+                        + sum(v.nbytes for v in validity.values())
+                    with ExternalTable._cache_acct_lock:
+                        over = (ExternalTable._cache_used + step > budget)
+                        if not over:
+                            ExternalTable._cache_used += step
+                    if over:
+                        # decoded form over the budget: roll back our
+                        # reservation, remember NOT to retry every
+                        # query, and stream
+                        with ExternalTable._cache_acct_lock:
+                            ExternalTable._cache_used -= decoded
+                        decoded = 0
+                        with self._cache_lock:
+                            self._drop_cache_locked()
+                            self._cache = (sig, None, 0)
+                        return None
+                    decoded += step
+                    chunks.append((arrays, validity, n))
+            except BaseException:
                 with ExternalTable._cache_acct_lock:
-                    over = (ExternalTable._cache_used + decoded
-                            > budget)
-                if over:
-                    # decoded form over the PROCESS-WIDE budget:
-                    # remember NOT to retry every query and stream
-                    with self._cache_lock:
-                        self._drop_cache_locked()
-                        self._cache = (sig, None, 0)
-                    return None
-                chunks.append((arrays, validity, n))
-            with ExternalTable._cache_acct_lock:
-                ExternalTable._cache_used += decoded
+                    ExternalTable._cache_used -= decoded
+                raise
             with self._cache_lock:
                 self._drop_cache_locked()
                 self._cache = (sig, chunks, decoded)

@@ -207,3 +207,43 @@ def test_auth_state_replicates_to_cn():
         cat1.close()
         cat2.close()
         tn.stop()
+
+
+# --------------------------------------------- processlist/KILL isolation
+def test_processlist_and_kill_tenant_scoped():
+    """A non-sys tenant must not see other tenants' connections in SHOW
+    PROCESSLIST (their SQL text can carry data) nor KILL them
+    (cross-tenant DoS). Reference: authenticate.go account scoping."""
+    eng = Engine()
+    mgr = AccountManager(eng)
+    mgr.create_account("a1", "adm", "p")
+    mgr.create_account("a2", "adm", "p")
+    s_sys = Session(catalog=eng)
+    s1 = Session(catalog=eng, auth=mgr.context_for("a1", "adm"),
+                 auth_manager=mgr)
+    s2 = Session(catalog=eng, auth=mgr.context_for("a2", "adm"),
+                 auth_manager=mgr)
+    # tenant sees only its own account's connections
+    users = {r[1] for r in s1.execute("show processlist").rows()}
+    assert users == {"a1:adm"}
+    users2 = {r[1] for r in s2.execute("show processlist").rows()}
+    assert users2 == {"a2:adm"}
+    # sys sees everything
+    users_sys = {r[1] for r in s_sys.execute("show processlist").rows()}
+    assert {"a1:adm", "a2:adm"} <= users_sys
+    # cross-tenant KILL denied (and does not confirm existence)
+    with pytest.raises(AuthError):
+        s1.execute(f"kill {s2.conn_id}")
+    with pytest.raises(AuthError):
+        s1.execute(f"kill {s_sys.conn_id}")
+    assert not eng._queryservice.is_terminated(s2.conn_id)
+    # same-account KILL still works
+    s1b = Session(catalog=eng, auth=mgr.context_for("a1", "adm"),
+                  auth_manager=mgr)
+    s1.execute(f"kill {s1b.conn_id}")
+    assert eng._queryservice.is_terminated(s1b.conn_id)
+    # sys can kill anyone
+    s_sys.execute(f"kill {s2.conn_id}")
+    assert eng._queryservice.is_terminated(s2.conn_id)
+    for s in (s_sys, s1, s2, s1b):
+        s.close()

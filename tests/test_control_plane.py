@@ -233,3 +233,28 @@ def test_quorum_loss_rejected(replicas):
     with pytest.raises(ConnectionError):
         w.append({"op": "b", "ts": 2})
     w.close()
+
+
+def test_stale_primary_persist_cannot_erase_fencing():
+    """ADVICE r4: after a takeover bumps the stored generation, the old
+    not-yet-demoted primary still serves register/deregister; its
+    persist must NOT roll the stored gen back (which would unfence both
+    keepers — persistent split-brain). The write is refused and the
+    stale primary demotes inline."""
+    state = os.path.join(tempfile.mkdtemp(prefix="mo_ha3_"), "state.json")
+    persist, restore = _file_store(state)
+    stale = HAKeeper(down_after_s=30, tick_s=30, persist=persist,
+                     restore=restore)     # NOT started: no tick demotion
+    stale.role = "primary"
+    stale.register("cn", "cn-1")
+    assert restore()["__keeper_gen"]["gen"] == stale.keeper_gen
+    # a takeover elsewhere bumps the stored generation
+    snap = restore()
+    snap["__keeper_gen"] = {"gen": stale.keeper_gen + 1}
+    persist(snap)
+    # the stale primary handles one more state op before its next tick
+    stale.register("cn", "cn-2")
+    # the store kept the NEW generation, and the stale keeper stepped down
+    assert restore()["__keeper_gen"]["gen"] == stale.keeper_gen + 1
+    assert stale.role == "standby"
+    assert any(op["op"] == "demoted" for op in stale.operators)

@@ -128,3 +128,49 @@ def test_standby_mirrors_merges():
                   s2.execute("select id from m").rows()) == [5]
     cat2.close()
     tn2.stop()
+
+
+def test_merge_checkpoint_persists_pos_first():
+    """ADVICE r4: a merge-triggered checkpoint truncates the standby's
+    WAL; the durable position file must be written FIRST, or a crash
+    before the next periodic checkpoint regresses _durable_position()
+    to a stale pos with no WAL tail and re-applies baked records
+    (duplicate rows after promotion)."""
+    import json
+    primary_dir = tempfile.mkdtemp(prefix="mo_ds4_p_")
+    standby_dir = tempfile.mkdtemp(prefix="mo_ds4_s_")
+    tn = TNService(data_dir=primary_dir).start()
+    cat = RemoteCatalog(("127.0.0.1", tn.port), data_dir=primary_dir)
+    s = Session(catalog=cat)
+    s.execute("create table m (id bigint primary key, v bigint)")
+    agent = StandbyAgent(("127.0.0.1", tn.port),
+                         data_dir=standby_dir).start()
+    s.execute("insert into m values (1, 1)")
+    s.execute("insert into m values (2, 2)")
+    assert _wait(lambda: agent.applied_ts >= cat.committed_ts)
+    pre_merge_ts = agent.applied_ts
+    assert cat.merge_table("m") >= 1
+    assert _wait(lambda: len(agent.engine.get_table("m").segments) == 1)
+    # the pos file covers the pre-merge stream (written before the WAL
+    # truncation), so a "crash now" restart resumes at/after it
+    pos_path = os.path.join(standby_dir, "meta", "datasync_pos.json")
+    assert os.path.exists(pos_path)
+    with open(pos_path) as f:
+        pos = int(json.load(f))
+    assert pos >= pre_merge_ts
+    agent.stop()
+    # simulate crash-after-merge: reopen and verify no duplicates
+    agent2 = StandbyAgent(("127.0.0.1", tn.port),
+                          data_dir=standby_dir).start()
+    s.execute("insert into m values (9, 9)")
+    assert _wait(lambda: agent2.applied_ts >= cat.committed_ts)
+    agent2.stop()
+    cat.close()
+    tn.stop()
+    tn2 = TNService(data_dir=standby_dir).start()
+    cat2 = RemoteCatalog(("127.0.0.1", tn2.port), data_dir=standby_dir)
+    s2 = Session(catalog=cat2)
+    assert sorted(int(r[0]) for r in
+                  s2.execute("select id from m").rows()) == [1, 2, 9]
+    cat2.close()
+    tn2.stop()
