@@ -43,16 +43,19 @@ def _matmul_xqT(x: jnp.ndarray, q: jnp.ndarray, compute_dtype) -> jnp.ndarray:
         preferred_element_type=jnp.float32, precision=precision)
 
 
-@partial(jax.jit, static_argnames=("compute_dtype",))
+@partial(jax.jit, static_argnames=("compute_dtype", "use_pallas"))
 def l2_distance_sq(x: jnp.ndarray, q: jnp.ndarray,
-                   compute_dtype=None) -> jnp.ndarray:
+                   compute_dtype=None, use_pallas=None) -> jnp.ndarray:
     """Squared L2 distances [n, b] between rows of x [n,d] and q [b,d].
 
-    With MO_USE_PALLAS=1 and tile-aligned shapes, the exact-f32 path runs
-    the hand-tiled Pallas kernel (ops/pallas_kernels.py) instead of the
-    XLA default — same math, explicit VMEM staging."""
+    With use_pallas (session `SET use_pallas = 1`, or the MO_USE_PALLAS
+    env default when the kwarg is None) and tile-aligned shapes, the
+    exact-f32 path runs the hand-tiled Pallas kernel
+    (ops/pallas_kernels.py) instead of the XLA default — same math,
+    explicit VMEM staging."""
     from matrixone_tpu.ops import pallas_kernels as PK
-    if PK.use_pallas() and compute_dtype is None and x.shape[0] % 1024 == 0:
+    enabled = PK.use_pallas() if use_pallas is None else use_pallas
+    if enabled and compute_dtype is None and x.shape[0] % 1024 == 0:
         return PK.l2_distance_sq_pallas(x, q, tile_m=1024)
     xq = _matmul_xqT(x, q, compute_dtype)
     x2 = jnp.sum(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)

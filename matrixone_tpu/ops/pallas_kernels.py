@@ -1,17 +1,28 @@
 """Hand-tiled Pallas TPU kernels for the hottest inner loops.
 
 Reference analogue: the hand-written SIMD/CUDA kernels (`cgo/arith.c`,
-`cgo/cuda/mocl.cu`) — here Pallas grid kernels that keep the MXU fed from
-VMEM explicitly instead of relying on XLA's default tiling.
+`cgo/cuda/mocl.cu`, `cgo/cuvs/ivf_pq_c.cpp` ADC scoring) — here Pallas
+grid kernels that keep the MXU fed from VMEM explicitly instead of
+relying on XLA's default tiling.
 
-`l2_distance_sq_pallas`: one grid step loads a [TM, D] tile of the
-collection and the full query block [B, D] into VMEM, runs the
-[TM, D] @ [D, B] matmul on the MXU, and fuses the ||x||^2 row-norm
-computation + (x2 + q2 - 2xq) epilogue into the same kernel — the
-epilogue never round-trips through HBM. Falls back to interpret mode off
-TPU (tests run on the CPU mesh), and callers opt in via
-MO_USE_PALLAS=1 (ops.distance keeps the XLA path as default until the
-kernel is profiled on hardware).
+Kernels:
+  * `l2_distance_sq_pallas`     — tiled pairwise L2 with the norm
+    epilogue fused (never round-trips through HBM);
+  * `l2_distance_sq_masked_pallas` — same with a fused validity mask
+    (masked rows score +inf), the filtered-search shape
+    (`cgo/cuvs/filter.hpp` bitset prefilter analogue);
+  * `segment_sum_pallas`        — one-hot-matmul GROUP BY segment sum:
+    the hash-table-free TPU formulation of `colexec/group` partial
+    aggregation, riding the MXU instead of scatter units;
+  * `adc_score_pallas`          — IVF-PQ asymmetric-distance scoring
+    sum_m LUT[g, m, code] as a one-hot matmul per candidate tile
+    (`cgo/cuvs` ivf_pq ADC kernel analogue).
+
+All kernels fall back to interpret mode off TPU (tests run on the CPU
+mesh) and are opt-in: sessions enable them with `SET use_pallas = 1`
+(reference: `pkg/util/gpumode/gpu_mode.go:37 EffectiveGpuMode` — session
+value wins, else the MO_USE_PALLAS env default), because until profiled
+on real hardware the XLA default fusion is the trusted path.
 """
 
 from __future__ import annotations
@@ -24,7 +35,33 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
-def _kernel(x_ref, q_ref, q2_ref, out_ref):
+# --------------------------------------------------------------- gating
+def use_pallas() -> bool:
+    """Process default (env). Kept for back-compat; prefer
+    effective_use_pallas(session_value)."""
+    return os.environ.get("MO_USE_PALLAS") == "1"
+
+
+def effective_use_pallas(session_value=None) -> bool:
+    """gpu_mode.go:37 EffectiveGpuMode analogue: an explicit session
+    `SET use_pallas = 0|1` wins; otherwise the MO_USE_PALLAS env var
+    (the build-tag default of the reference)."""
+    if session_value is not None:
+        try:
+            return bool(int(session_value))
+        except (TypeError, ValueError):
+            return False
+    return use_pallas()
+
+
+def _interpret(flag):
+    if flag is None:
+        return jax.default_backend() != "tpu"
+    return flag
+
+
+# ------------------------------------------------- pairwise L2 (fused)
+def _l2_kernel(x_ref, q_ref, q2_ref, out_ref):
     x = x_ref[:]                                   # [TM, D] f32
     q = q_ref[:]                                   # [B, D]  f32
     xq = jax.lax.dot_general(
@@ -42,14 +79,13 @@ def l2_distance_sq_pallas(x: jnp.ndarray, q: jnp.ndarray,
     n, d = x.shape
     b = q.shape[0]
     assert n % tile_m == 0, f"n={n} must be a multiple of tile_m={tile_m}"
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = _interpret(interpret)
     xf = x.astype(jnp.float32)
     qf = q.astype(jnp.float32)
     q2 = jnp.sum(qf * qf, axis=1)[None, :]          # [1, b]
     grid = (n // tile_m,)
     return pl.pallas_call(
-        _kernel,
+        _l2_kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((tile_m, d), lambda i: (i, 0)),
@@ -62,5 +98,160 @@ def l2_distance_sq_pallas(x: jnp.ndarray, q: jnp.ndarray,
     )(xf, qf, q2)
 
 
-def use_pallas() -> bool:
-    return os.environ.get("MO_USE_PALLAS") == "1"
+# -------------------------------------- pairwise L2 with fused prefilter
+def _l2_masked_kernel(x_ref, q_ref, q2_ref, m_ref, out_ref):
+    x = x_ref[:]                                   # [TM, D] f32
+    q = q_ref[:]                                   # [B, D]  f32
+    xq = jax.lax.dot_general(
+        x, q, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)
+    dist = jnp.maximum(x2 + q2_ref[:] - 2.0 * xq, 0.0)
+    # fused doc-filter: excluded rows never leave the kernel as
+    # candidates (top-k downstream sorts them last)
+    keep = m_ref[:] > 0                            # [TM, 1] int32
+    out_ref[:] = jnp.where(keep, dist, jnp.inf)
+
+
+@functools.partial(jax.jit, static_argnames=("tile_m", "interpret"))
+def l2_distance_sq_masked_pallas(x: jnp.ndarray, q: jnp.ndarray,
+                                 mask: jnp.ndarray,
+                                 tile_m: int = 1024,
+                                 interpret: bool | None = None
+                                 ) -> jnp.ndarray:
+    """Filtered pairwise squared L2 [n, b]: rows with mask=False score
+    +inf. The mask rides into the same VMEM tile as the vectors, so the
+    filter costs no extra HBM pass (the reference pre-filters with a
+    bitset handed to cuVS — cgo/cuvs/filter.hpp)."""
+    n, d = x.shape
+    b = q.shape[0]
+    assert n % tile_m == 0, f"n={n} must be a multiple of tile_m={tile_m}"
+    interpret = _interpret(interpret)
+    xf = x.astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    q2 = jnp.sum(qf * qf, axis=1)[None, :]
+    m2 = mask.astype(jnp.int32)[:, None]            # [n, 1]
+    return pl.pallas_call(
+        _l2_masked_kernel,
+        grid=(n // tile_m,),
+        in_specs=[
+            pl.BlockSpec((tile_m, d), lambda i: (i, 0)),
+            pl.BlockSpec((b, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, b), lambda i: (0, 0)),
+            pl.BlockSpec((tile_m, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile_m, b), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, b), jnp.float32),
+        interpret=interpret,
+    )(xf, qf, q2, m2)
+
+
+# ------------------------------------------------ GROUP BY segment sum
+def _segsum_kernel(v_ref, g_ref, out_ref):
+    i = pl.program_id(0)
+    v = v_ref[:]                                    # [1, TN] f32
+    g = g_ref[:]                                    # [1, TN] int32
+    num_segments = out_ref.shape[1]
+    # one-hot [TN, G] on the fly in VMEM; the segment reduction becomes
+    # a [1, TN] @ [TN, G] matmul on the MXU — no scatter, no hash table
+    onehot = (g[0][:, None] ==
+              jax.lax.broadcasted_iota(jnp.int32, (1, num_segments), 1)
+              ).astype(jnp.float32)
+    partial = jax.lax.dot_general(
+        v, onehot, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)         # [1, G]
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    out_ref[:] += partial                           # grid is sequential
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_segments", "tile_n", "interpret"))
+def segment_sum_pallas(values: jnp.ndarray, gids: jnp.ndarray,
+                       mask: jnp.ndarray, num_segments: int,
+                       tile_n: int = 2048,
+                       interpret: bool | None = None) -> jnp.ndarray:
+    """Masked float32 segment sum over [n] values into [num_segments].
+
+    TPU formulation of `colexec/group` partial aggregation: instead of a
+    hash-table scatter, each row tile builds its one-hot group matrix in
+    VMEM and reduces with a single MXU matmul; the sequential TPU grid
+    accumulates partials in the output block, which stays resident.
+    n must be a multiple of tile_n (callers pad with mask=False);
+    num_segments bounded by VMEM (tile_n * num_segments * 4B ≲ 8 MB).
+
+    NOTE float32 only: exact int64/decimal sums must stay on the XLA
+    `segment_sum` scatter path (MXU accumulation is float).
+    """
+    n = values.shape[0]
+    assert n % tile_n == 0, f"n={n} not a multiple of tile_n={tile_n}"
+    interpret = _interpret(interpret)
+    v = jnp.where(mask, values.astype(jnp.float32), 0.0)[None, :]  # [1, n]
+    # masked rows also get an out-of-range id so a gid collision with a
+    # real group cannot resurrect them (id G sums into nothing: the iota
+    # comparison never matches because iota < G)
+    g = jnp.where(mask, gids.astype(jnp.int32), num_segments)[None, :]
+    out = pl.pallas_call(
+        _segsum_kernel,
+        grid=(n // tile_n,),
+        in_specs=[
+            pl.BlockSpec((1, tile_n), lambda i: (0, i)),
+            pl.BlockSpec((1, tile_n), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, num_segments), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, num_segments), jnp.float32),
+        interpret=interpret,
+    )(v, g)
+    return out[0]
+
+
+# ------------------------------------------------- IVF-PQ ADC scoring
+def _adc_kernel(codes_ref, lut_ref, out_ref):
+    codes = codes_ref[:][0]                         # [TC, M] int32
+    lut = lut_ref[:][0]                             # [M, 256] f32
+    tc, m = codes.shape
+    # scores[c] = sum_m lut[m, codes[c, m]] — expressed as a one-hot
+    # [TC, M*256] @ [M*256, 1] matmul so the gather runs on the MXU
+    # (the reference's cuVS ADC kernel does warp-local LUT gathers;
+    # TPUs have no per-lane gather, but the one-hot contraction is
+    # exactly what the systolic array is good at)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, 256), 1)  # [1, 256]
+    onehot = (codes[:, :, None] == iota[None, :, :]).astype(jnp.float32)
+    onehot = onehot.reshape(tc, m * 256)
+    lut_flat = lut.reshape(m * 256, 1)
+    out_ref[:] = jax.lax.dot_general(
+        onehot, lut_flat, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).reshape(1, tc)
+
+
+@functools.partial(jax.jit, static_argnames=("tile_c", "interpret"))
+def adc_score_pallas(codes: jnp.ndarray, lut: jnp.ndarray,
+                     tile_c: int = 256,
+                     interpret: bool | None = None) -> jnp.ndarray:
+    """Batched ADC scoring: codes [G, P, M] uint8/int32 (G query-probe
+    groups, P candidates each), lut [G, M, 256] f32 -> scores [G, P]
+    with scores[g, p] = sum_m lut[g, m, codes[g, p, m]].
+
+    P must be a multiple of tile_c. VMEM per step: the one-hot tile
+    (tile_c * M * 256 * 4B — 4 MB at tile_c=256, M=16) plus one LUT.
+    """
+    g, p, m = codes.shape
+    assert p % tile_c == 0, f"P={p} not a multiple of tile_c={tile_c}"
+    assert lut.shape == (g, m, 256), lut.shape
+    interpret = _interpret(interpret)
+    c32 = codes.astype(jnp.int32)
+    out = pl.pallas_call(
+        _adc_kernel,
+        grid=(g, p // tile_c),
+        in_specs=[
+            pl.BlockSpec((1, tile_c, m), lambda gi, ci: (gi, ci, 0)),
+            pl.BlockSpec((1, m, 256), lambda gi, ci: (gi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, tile_c), lambda gi, ci: (gi, ci)),
+        out_shape=jax.ShapeDtypeStruct((g, p), jnp.float32),
+        interpret=interpret,
+    )(c32, lut.astype(jnp.float32))
+    return out

@@ -77,7 +77,8 @@ def execute_fragment(catalog, header: dict) -> Tuple[dict, bytes]:
         catalog = ScopedCatalog(catalog, header["account"])
     ctx = ExecContext(catalog=catalog, frozen_ts=snapshot_ts,
                       variables={"batch_rows":
-                                 int(header.get("batch_rows", 1 << 16))})
+                                 int(header.get("batch_rows", 1 << 16)),
+                                 **header.get("session_vars", {})})
     plan = plan_from_json(header["plan"])
     child_op = compile_plan(plan, ctx)
     sig = (table_signature(catalog, header["shard_table"], snapshot_ts)
@@ -450,10 +451,16 @@ def try_distribute(node, catalog, ctx, peers: FragmentPeers,
         opened = True
         snap = max(ctx.snapshot_ts or 0,
                    getattr(catalog, "committed_ts", 0)) or None
+        # forward session execution knobs so SET use_pallas behaves the
+        # same distributed as local (no silent local/remote divergence)
+        sess_vars = {k: v for k, v in (ctx.variables or {}).items()
+                     if k in ("use_pallas",)}
         if split.kind == "agg":
-            mat = _dist_aggregate(split, catalog, snap, peers, batch_rows)
+            mat = _dist_aggregate(split, catalog, snap, peers, batch_rows,
+                                  sess_vars)
         else:
-            mat = _dist_topk(split, catalog, snap, peers, batch_rows)
+            mat = _dist_topk(split, catalog, snap, peers, batch_rows,
+                             sess_vars)
     except Exception as e:     # noqa: BLE001 — fall back to local
         import sys
         print(f"[dist] fragment execution failed, running locally: "
@@ -477,7 +484,7 @@ def _check_sigs(results, addrs) -> None:
 
 
 def _dist_aggregate(split: _Split, catalog, snap, peers: FragmentPeers,
-                    batch_rows: int) -> P.Materialized:
+                    batch_rows: int, sess_vars=None) -> P.Materialized:
     agg: P.Aggregate = split.split
     n = len(peers.addrs)
     child_json = plan_to_json(agg.child)
@@ -490,6 +497,7 @@ def _dist_aggregate(split: _Split, catalog, snap, peers: FragmentPeers,
             "aggs": [agg_to_json(a) for a in agg.aggs],
             "snapshot_ts": snap,
             "batch_rows": batch_rows,
+            "session_vars": sess_vars or {},
             "shard_table": split.scan_table,
             "account": getattr(catalog, "_acct", None),
         })
@@ -639,7 +647,7 @@ def _merge_scalar(agg: P.Aggregate, results) -> P.Materialized:
 
 
 def _dist_topk(split: _Split, catalog, snap, peers: FragmentPeers,
-               batch_rows: int) -> P.PlanNode:
+               batch_rows: int, sess_vars=None) -> P.PlanNode:
     """Per-peer local top-(k+offset) over its shard, concatenated; the
     ORIGINAL TopK re-runs at the coordinator over the union (exact: every
     global top-k row is in its shard's local top-(k+offset))."""
@@ -653,6 +661,7 @@ def _dist_topk(split: _Split, catalog, snap, peers: FragmentPeers,
         "plan": _set_shard(tk_json, ["child"] + split.scan_path, i, n),
         "snapshot_ts": snap,
         "batch_rows": batch_rows,
+        "session_vars": sess_vars or {},
         "shard_table": split.scan_table,
         "account": getattr(catalog, "_acct", None),
     } for i in range(n)]

@@ -131,14 +131,16 @@ def build(dataset: jnp.ndarray, nlist: int, metric: str = METRIC_L2,
 
 
 @partial(jax.jit, static_argnames=("k", "nprobe", "query_chunk",
-                                   "compute_dtype"))
+                                   "compute_dtype", "use_pallas"))
 def search(index: IvfFlatIndex, queries: jnp.ndarray, k: int, nprobe: int,
-           query_chunk: int = 32,
-           compute_dtype=jnp.bfloat16) -> Tuple[jnp.ndarray, jnp.ndarray]:
+           query_chunk: int = 32, compute_dtype=jnp.bfloat16,
+           use_pallas: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Batched IVF search -> (distances [b,k], row_positions [b,k] int32).
 
     Distances are squared l2 (metric=l2) or 1-ip (cosine/ip). b must be a
-    multiple of query_chunk (pad queries host-side).
+    multiple of query_chunk (pad queries host-side). use_pallas (session
+    `SET use_pallas = 1`) runs the centroid probe through the hand-tiled
+    fused-epilogue kernel when nlist is tile-aligned.
     """
     b, d = queries.shape
     assert b % query_chunk == 0, (
@@ -150,7 +152,12 @@ def search(index: IvfFlatIndex, queries: jnp.ndarray, k: int, nprobe: int,
     # 1) probe centroids: [b, nlist] -> top-nprobe clusters per query.
     # full f32 precision: these scores re-enter the candidate distances
     if index.metric == METRIC_L2:
-        cdist = D.l2_distance_sq(q, index.centroids)   # [b, nlist]
+        # orient the tiled axis along nlist (the large dim) and let the
+        # shared gate in ops/distance.py decide pallas-vs-XLA — one
+        # dispatch point, and an explicit use_pallas=False here really
+        # disables the kernel even when the env default is on
+        cdist = D.l2_distance_sq(index.centroids, q,
+                                 use_pallas=use_pallas).T   # [b, nlist]
     else:
         cdist = -D.inner_product(q, index.centroids)
     cprobe_scores, probes = jax.lax.top_k(-cdist, nprobe)  # [b, nprobe]

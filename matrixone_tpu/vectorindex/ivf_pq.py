@@ -130,11 +130,16 @@ def build(dataset: jnp.ndarray, nlist: int, n_subspaces: int = 16,
 
 
 @partial(jax.jit, static_argnames=("k", "nprobe", "query_chunk",
-                                   "compute_dtype"))
+                                   "compute_dtype", "use_pallas"))
 def search(index: IvfPqIndex, queries: jnp.ndarray, k: int, nprobe: int,
-           query_chunk: int = 32,
-           compute_dtype=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Batched ADC search -> (approx distances [b,k], row positions [b,k])."""
+           query_chunk: int = 32, compute_dtype=None,
+           use_pallas: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched ADC search -> (approx distances [b,k], row positions [b,k]).
+
+    use_pallas (session `SET use_pallas = 1`) scores candidates through
+    the hand-tiled one-hot-matmul ADC kernel (ops/pallas_kernels.py)
+    instead of the XLA take_along_axis gather when the cluster pad is
+    tile-aligned."""
     b, d = queries.shape
     assert b % query_chunk == 0
     M = index.n_subspaces
@@ -177,11 +182,19 @@ def search(index: IvfPqIndex, queries: jnp.ndarray, k: int, nprobe: int,
         cand = jnp.where(valid, cand, 0)             # [qc, nprobe, pad]
         cand_codes = index.codes[cand]               # [qc, nprobe, pad, M]
         # dist = sum_m LUT[..., m, code_m]
-        gathered = jnp.take_along_axis(
-            lut[:, :, None, :, :],                   # [qc,np,1,M,256]
-            cand_codes[..., None].astype(jnp.int32),  # [qc,np,pad,M,1]
-            axis=4)[..., 0]                          # [qc,np,pad,M]
-        dist = jnp.sum(gathered, axis=-1)            # [qc, nprobe, pad]
+        if use_pallas and pad % 128 == 0:
+            from matrixone_tpu.ops import pallas_kernels as PK
+            g = query_chunk * nprobe
+            dist = PK.adc_score_pallas(
+                cand_codes.reshape(g, pad, M),
+                lut.reshape(g, M, 256),
+                tile_c=128).reshape(query_chunk, nprobe, pad)
+        else:
+            gathered = jnp.take_along_axis(
+                lut[:, :, None, :, :],                   # [qc,np,1,M,256]
+                cand_codes[..., None].astype(jnp.int32),  # [qc,np,pad,M,1]
+                axis=4)[..., 0]                          # [qc,np,pad,M]
+            dist = jnp.sum(gathered, axis=-1)            # [qc, nprobe, pad]
         dist = jnp.where(valid, dist, jnp.inf)
         m_tot = nprobe * pad
         dist_flat = dist.reshape(query_chunk, m_tot)

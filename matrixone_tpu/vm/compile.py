@@ -25,7 +25,10 @@ def compile_plan(node: P.PlanNode, ctx) -> ops.Operator:
     if isinstance(node, P.Project):
         return ops.ProjectOp(node, compile_plan(node.child, ctx))
     if isinstance(node, P.Aggregate):
-        return ops.AggOp(node, compile_plan(node.child, ctx))
+        from matrixone_tpu.ops import pallas_kernels as PK
+        return ops.AggOp(node, compile_plan(node.child, ctx),
+                         use_pallas=PK.effective_use_pallas(
+                             (ctx.variables or {}).get("use_pallas")))
     if isinstance(node, P.Sort):
         return ops.SortOp(node, compile_plan(node.child, ctx))
     if isinstance(node, P.TopK):

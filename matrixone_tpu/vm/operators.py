@@ -373,13 +373,15 @@ class AggOp(Operator):
     def __init__(self, node: P.Aggregate, child: Operator,
                  max_groups: int = 4096,
                  max_device_groups: int = 1 << 21,
-                 spill_partitions: int = 16):
+                 spill_partitions: int = 16,
+                 use_pallas: bool = False):
         self.node = node
         self.child = child
         self.schema = node.schema
         self.max_groups = max_groups
         self.max_device_groups = max(max_groups, max_device_groups)
         self.spill_partitions = spill_partitions
+        self.use_pallas = use_pallas
         self._spill: Optional[_AggSpill] = None
 
     def _grow(self, needed: int, allow_spill: bool) -> None:
@@ -511,7 +513,8 @@ class AggOp(Operator):
         present = jnp.arange(mg, dtype=jnp.int32) < gi.num_groups
         partials = []
         for a, v in zip(self.node.aggs, values):
-            partials.append(_grouped_step(a, gi, v, mask, mg))
+            partials.append(_grouped_step(a, gi, v, mask, mg,
+                                          use_pallas=self.use_pallas))
         return {"keys": rep_k, "kvalid": rep_v, "present": present,
                 "partials": partials, "n": gi.num_groups}
 
@@ -650,7 +653,7 @@ def _host_bit_reduce(func: str, data, gids, mask, mg: int):
 
 
 def _grouped_step(a: AggCall, gi, col: Optional[DeviceColumn],
-                  row_mask, mg: int):
+                  row_mask, mg: int, use_pallas: bool = False):
     """Per-batch partial for one aggregate over PRE-EVALUATED values
     (col = _agg_value(...) or a revived spill chunk; None for count(*))."""
     if a.func == "count" and a.arg is None:
@@ -659,7 +662,8 @@ def _grouped_step(a: AggCall, gi, col: Optional[DeviceColumn],
     if a.func == "count":
         return {"count": A.seg_count(gi.gids, m, mg)}
     if a.func == "sum":
-        return {"sum": A.seg_sum(col.data, gi.gids, m, mg),
+        return {"sum": A.seg_sum(col.data, gi.gids, m, mg,
+                                 use_pallas=use_pallas),
                 "count": A.seg_count(gi.gids, m, mg)}
     if a.func == "avg":
         return {"sum": A.seg_sum(col.data.astype(jnp.float64)

@@ -78,8 +78,14 @@ class VectorTopKOp(Operator):
             k = min(self.node.k, index.n, pool) or 1
             search_fn = (ivf_pq.search if ix.algo == "ivfpq"
                          else ivf_flat.search)
+            # session SET use_pallas = 1 routes the probe/ADC kernels
+            # through the hand-tiled Pallas paths (gpu_mode analogue)
+            from matrixone_tpu.ops import pallas_kernels as PK
+            up = PK.effective_use_pallas(
+                (self.ctx.variables or {}).get("use_pallas"))
             dists, pos = search_fn(index, jnp.asarray(q), k=k,
-                                   nprobe=nprobe, query_chunk=1)
+                                   nprobe=nprobe, query_chunk=1,
+                                   use_pallas=up)
             main_d = np.asarray(dists)[0]
             pos = np.asarray(pos)[0]
             keep = pos >= 0

@@ -1,27 +1,27 @@
 #!/bin/bash
 # Probe the TPU tunnel; run the full bench the moment it answers.
-# Writes the JSON line to bench_r4_result.json on success.  A CPU-backend
+# Writes the JSON line to bench_r5_result.json on success.  A CPU-backend
 # fallback result is recorded but does NOT stop the loop — the script
 # exists to capture the on-chip number.
 cd /root/repo
 for i in $(seq 1 400); do
   if timeout 90 python -c "import jax, jax.numpy as jnp; jnp.ones(8).sum().block_until_ready()" >/dev/null 2>&1; then
     echo "$(date -u +%T) probe ok, running bench (attempt $i)" >> bench_watch.log
-    if timeout 4800 python bench.py > bench_r4_result.json 2> bench_r4_stderr.log; then
-      if grep -q '"backend": "cpu"' bench_r4_result.json; then
+    if timeout 4800 python bench.py > bench_r5_result.json 2> bench_r5_stderr.log; then
+      if grep -q '"backend": "cpu"' bench_r5_result.json; then
         # tunnel wedged between probe and preflight: the CPU fallback
         # answered — keep waiting for the chip
-        echo "$(date -u +%T) got cpu fallback only, keep probing: $(cat bench_r4_result.json)" >> bench_watch.log
+        echo "$(date -u +%T) got cpu fallback only, keep probing: $(cat bench_r5_result.json)" >> bench_watch.log
       else
-        echo "$(date -u +%T) bench done: $(cat bench_r4_result.json)" >> bench_watch.log
+        echo "$(date -u +%T) bench done: $(cat bench_r5_result.json)" >> bench_watch.log
         # also profile pallas vs xla distance kernel while the chip answers
-        timeout 1200 python tools/profile_pallas.py > pallas_profile.json 2>> bench_r4_stderr.log \
+        timeout 1200 python tools/profile_pallas.py > pallas_profile.json 2>> bench_r5_stderr.log \
           && echo "$(date -u +%T) pallas profile: $(cat pallas_profile.json)" >> bench_watch.log
         exit 0
       fi
     else
       rc=$?
-      echo "$(date -u +%T) bench failed rc=$rc (see bench_r4_stderr.log)" >> bench_watch.log
+      echo "$(date -u +%T) bench failed rc=$rc (see bench_r5_stderr.log)" >> bench_watch.log
     fi
   else
     echo "$(date -u +%T) probe failed (attempt $i)" >> bench_watch.log
