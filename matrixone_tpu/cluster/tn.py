@@ -406,13 +406,22 @@ def main() -> None:
     ap.add_argument("--keeper", default="",
                     help="comma-separated keeper endpoints to register "
                          "with and heartbeat (HAKeeper)")
+    ap.add_argument("--campaign", action="store_true",
+                    help="acquire the quorum WAL via leader election "
+                         "(waits for any live writer's lease to lapse) "
+                         "instead of unconditional epoch fencing")
     args = ap.parse_args()
     wal = None
     if args.log_replicas:
         from matrixone_tpu.cluster.rpc import parse_addr
         from matrixone_tpu.logservice.replicated import ReplicatedLog
-        wal = ReplicatedLog([parse_addr(a) for a
-                             in args.log_replicas.split(",") if a])
+        addrs = [parse_addr(a) for a
+                 in args.log_replicas.split(",") if a]
+        if args.campaign:
+            wal = ReplicatedLog.campaign_until_elected(addrs,
+                                                       timeout=120.0)
+        else:
+            wal = ReplicatedLog(addrs)
     tn = TNService(data_dir=args.dir, port=args.port, wal=wal)
     if args.keeper:
         from matrixone_tpu.cluster.rpc import parse_addr

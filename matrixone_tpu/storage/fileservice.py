@@ -23,6 +23,12 @@ class FileService:
     def read(self, path: str) -> bytes:
         raise NotImplementedError
 
+    def read_range(self, path: str, offset: int, length: int) -> bytes:
+        """Ranged read (the out-of-core column-fetch path — reference:
+        fileservice IOVector entries / S3 Range GETs). Default slices a
+        full read; backends with cheaper partial reads override."""
+        return self.read(path)[offset:offset + length]
+
     def exists(self, path: str) -> bool:
         raise NotImplementedError
 
@@ -93,6 +99,11 @@ class LocalFS(FileService):
     def read(self, path):
         with open(os.path.join(self.root, path), "rb") as f:
             return f.read()
+
+    def read_range(self, path, offset, length):
+        with open(os.path.join(self.root, path), "rb") as f:
+            f.seek(offset)
+            return f.read(length)
 
     def exists(self, path):
         return os.path.exists(os.path.join(self.root, path))

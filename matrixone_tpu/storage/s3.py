@@ -100,12 +100,15 @@ class S3FS(FileService):
         url = f"{self.endpoint}/{self.bucket}/" + urllib.parse.quote(key)
         return url + ("?" + query if query else "")
 
-    def _request(self, method: str, url: str, payload: bytes = b""):
+    def _request(self, method: str, url: str, payload: bytes = b"",
+                 extra_headers: Optional[dict] = None):
         headers = {}
         if self.access_key:
             headers = sigv4_headers(method, url, self.region,
                                     self.access_key, self.secret_key,
                                     payload)
+        if extra_headers:
+            headers.update(extra_headers)
         req = urllib.request.Request(url, data=payload or None,
                                      method=method, headers=headers)
         return urllib.request.urlopen(req, timeout=60)
@@ -131,6 +134,20 @@ class S3FS(FileService):
         except urllib.error.HTTPError as e:
             if e.code == 404:
                 raise FileNotFoundError(path) from None
+            raise
+
+    def read_range(self, path, offset, length):
+        """S3 Range GET — the real out-of-core fetch path (one column
+        block per request, not the whole object)."""
+        rng = {"Range": f"bytes={offset}-{offset + length - 1}"}
+        try:
+            return self._request("GET", self._url(path),
+                                 extra_headers=rng).read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise FileNotFoundError(path) from None
+            if e.code == 416:          # range past EOF: empty tail
+                return b""
             raise
 
     def exists(self, path):
@@ -248,6 +265,16 @@ class MemCacheFS(FileService):
                 return True
         return self.base.exists(path)
 
+    def read_range(self, path, offset, length):
+        # a fully-cached object serves the slice; otherwise pass the
+        # range straight through (no partial-range caching — the decoded
+        # BlockCache above this layer is the dedup point)
+        with self._lock:
+            v = self.cache.get(path)
+        if v is not None:
+            return v[offset:offset + length]
+        return self.base.read_range(path, offset, length)
+
     def list(self, prefix):
         return self.base.list(prefix)
 
@@ -337,6 +364,21 @@ class DiskCacheFS(FileService):
                 return True
         return self.base.exists(path)
 
+    def read_range(self, path, offset, length):
+        cp = self._cpath(path)
+        with self._lock:
+            if path in self._lru:
+                self._lru.move_to_end(path)
+                try:
+                    with open(cp, "rb") as f:
+                        f.seek(offset)
+                        self.hits += 1
+                        return f.read(length)
+                except FileNotFoundError:
+                    self._used -= self._lru.pop(path)
+        self.misses += 1
+        return self.base.read_range(path, offset, length)
+
     def list(self, prefix):
         return self.base.list(prefix)
 
@@ -396,6 +438,25 @@ class FakeS3Server:
                 if body is None:
                     self.send_response(404)
                     self.end_headers()
+                    return
+                rng = self.headers.get("Range")
+                if rng and rng.startswith("bytes="):
+                    # Range GET (the out-of-core column fetch path)
+                    lo, hi = rng[len("bytes="):].split("-", 1)
+                    lo = int(lo)
+                    hi = int(hi) if hi else len(body) - 1
+                    if lo >= len(body):
+                        self.send_response(416)
+                        self.end_headers()
+                        return
+                    part = body[lo:hi + 1]
+                    self.send_response(206)
+                    self.send_header("Content-Length", str(len(part)))
+                    self.send_header(
+                        "Content-Range",
+                        f"bytes {lo}-{lo + len(part) - 1}/{len(body)}")
+                    self.end_headers()
+                    self.wfile.write(part)
                     return
                 self.send_response(200)
                 self.send_header("Content-Length", str(len(body)))

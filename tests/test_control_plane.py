@@ -258,3 +258,129 @@ def test_stale_primary_persist_cannot_erase_fencing():
     assert restore()["__keeper_gen"]["gen"] == stale.keeper_gen + 1
     assert stale.role == "standby"
     assert any(op["op"] == "demoted" for op in stale.operators)
+
+
+# ------------------------------------------------ WAL leader election
+def test_lease_blocks_rival_campaign():
+    """A standby campaigning against a HEALTHY renewing primary must
+    lose — leases close the 'any new writer instantly fences a live
+    one' hole of raw epoch fencing (VERDICT r4 Missing #3)."""
+    import tempfile as tf
+    from matrixone_tpu.logservice.replicated import NotLeader
+    reps = [LogReplica(tf.mkdtemp(prefix="mo_el_")).start()
+            for _ in range(3)]
+    addrs = [("127.0.0.1", r.port) for r in reps]
+    try:
+        primary = ReplicatedLog(addrs, campaign=True, lease_s=1.5,
+                                writer_id="primary")
+        primary.append({"op": "x", "ts": 1})
+        with pytest.raises(NotLeader):
+            ReplicatedLog(addrs, campaign=True, lease_s=1.5,
+                          writer_id="rival")
+        # primary unaffected
+        primary.append({"op": "x", "ts": 2})
+        primary.close()
+    finally:
+        for r in reps:
+            r.stop()
+
+
+def test_writer_death_elects_successor_no_acked_loss():
+    """The drill (VERDICT r4 Next #3): kill the WAL writer mid-commit-
+    stream; the standby campaigns, wins after the lease lapses, replays
+    the union, and every acked entry is present; writes resume."""
+    import tempfile as tf
+    reps = [LogReplica(tf.mkdtemp(prefix="mo_el2_")).start()
+            for _ in range(3)]
+    addrs = [("127.0.0.1", r.port) for r in reps]
+    try:
+        w1 = ReplicatedLog(addrs, campaign=True, lease_s=1.0,
+                           writer_id="tn-a")
+        acked = []
+        for i in range(25):
+            w1.append({"op": "commit", "ts": i + 1})   # quorum-acked
+            acked.append(i + 1)
+        # writer dies mid-stream: no clean close, renewals just stop
+        w1._renew_stop.set()
+        for s in w1._socks.values():
+            if s is not None:
+                s.close()
+
+        w2 = ReplicatedLog.campaign_until_elected(
+            addrs, timeout=30.0, lease_s=1.0, writer_id="tn-b")
+        assert w2.epoch > w1.epoch
+        got = [h["ts"] for h, _b in w2.replay() if h.get("op") == "commit"]
+        assert got == acked, f"lost acked entries: {set(acked) - set(got)}"
+        # the old writer is fenced out
+        with pytest.raises(ConnectionError):
+            w1.append({"op": "commit", "ts": 99})
+        # the new leader's stream continues
+        w2.append({"op": "commit", "ts": 100})
+        got2 = [h["ts"] for h, _b in w2.replay()
+                if h.get("op") == "commit"]
+        assert got2[-1] == 100 and got2[:-1] == acked
+        w2.close()
+    finally:
+        for r in reps:
+            r.stop()
+
+
+def test_tn_process_campaign_flag():
+    """End-to-end through real processes: a TN acquires the quorum WAL
+    with --campaign, commits flow, and after kill -9 a second TN with
+    --campaign takes over and serves every acked row."""
+    import signal
+    import subprocess
+    import sys
+    import tempfile as tf
+    from matrixone_tpu.cluster import RemoteCatalog
+    from matrixone_tpu.frontend import Session
+
+    def spawn(args):
+        p = subprocess.Popen([sys.executable, "-m", *args],
+                             stdout=subprocess.PIPE, text=True)
+        port = int(p.stdout.readline().split()[1])
+        return p, port
+
+    log_ps = []
+    try:
+        log_addrs = []
+        for _ in range(3):
+            p, port = spawn(["matrixone_tpu.logservice.replicated",
+                             "--dir", tf.mkdtemp(prefix="mo_elp_")])
+            log_ps.append(p)
+            log_addrs.append(f"127.0.0.1:{port}")
+        shared = tf.mkdtemp(prefix="mo_eltn_")
+        tn1, tn1_port = spawn(["matrixone_tpu.cluster.tn",
+                               "--dir", shared,
+                               "--log-replicas", ",".join(log_addrs),
+                               "--campaign"])
+        log_ps.append(tn1)
+        cat = RemoteCatalog(("127.0.0.1", tn1_port), data_dir=shared)
+        s = Session(catalog=cat)
+        s.execute("create table d (id bigint primary key, v bigint)")
+        for i in range(10):
+            s.execute(f"insert into d values ({i}, {i * 10})")
+        cat.close()
+        tn1.send_signal(signal.SIGKILL)    # mid-stream death
+        tn1.wait(timeout=10)
+
+        tn2, tn2_port = spawn(["matrixone_tpu.cluster.tn",
+                               "--dir", shared,
+                               "--log-replicas", ",".join(log_addrs),
+                               "--campaign"])
+        log_ps.append(tn2)
+        cat2 = RemoteCatalog(("127.0.0.1", tn2_port), data_dir=shared)
+        s2 = Session(catalog=cat2)
+        rows = sorted((int(a), int(b)) for a, b in
+                      s2.execute("select id, v from d").rows())
+        assert rows == [(i, i * 10) for i in range(10)], rows
+        s2.execute("insert into d values (100, 1000)")   # writes resume
+        assert len(s2.execute("select * from d").rows()) == 11
+        cat2.close()
+    finally:
+        for p in log_ps:
+            try:
+                p.kill()
+            except OSError:
+                pass
