@@ -9,6 +9,7 @@ host Batch.
 
 from __future__ import annotations
 
+import contextvars
 import dataclasses
 import datetime
 from typing import List, Optional
@@ -28,6 +29,16 @@ from matrixone_tpu.storage.engine import ROWID
 from matrixone_tpu.txn.client import TxnClient, TxnState
 from matrixone_tpu.vm.compile import compile_plan
 from matrixone_tpu.vm.process import ExecContext
+
+#: the session currently executing a statement on this thread — info
+#: functions (connection_id()/user()/last_insert_id()/...) resolve
+#: against it at bind time (reference: frontend session variables)
+_CURRENT_SESSION: contextvars.ContextVar = contextvars.ContextVar(
+    "mo_current_session", default=None)
+
+
+def current_session():
+    return _CURRENT_SESSION.get()
 
 
 @dataclasses.dataclass
@@ -66,6 +77,7 @@ class Session:
             self.catalog = ScopedCatalog(self.catalog, auth.account)
         self.txn_client = TxnClient(self.catalog)
         self.txn = None                 # active explicit transaction
+        self.last_insert_id = 0         # MySQL LAST_INSERT_ID()
         self.variables = {"gpu_mode": 1, "batch_rows": 1 << 20}
         self._procs = registry_for(self.catalog)
         self.conn_id = self._procs.register(user if auth is None
@@ -106,6 +118,15 @@ class Session:
         stmts = parse(sql)
         if params is not None:
             stmts = [_substitute_params(st, params) for st in stmts]
+        _tok = _CURRENT_SESSION.set(self)
+        try:
+            return self._execute_stmts(stmts, sql)
+        finally:
+            _CURRENT_SESSION.reset(_tok)
+
+    def _execute_stmts(self, stmts, sql: str) -> Result:
+        import time as _time
+        from matrixone_tpu.utils import metrics as M
         results = []
         for st in stmts:
             if self._procs.is_terminated(self.conn_id):
@@ -114,6 +135,7 @@ class Session:
                     f"connection {self.conn_id} was killed")
             t0 = _time.perf_counter()
             self._procs.start_query(self.conn_id, sql)
+            self._liid_set = False     # last_insert_id(): per statement
             try:
                 r = self._execute_stmt(st)
             except Exception as e:
@@ -1190,6 +1212,11 @@ class Session:
                 for i, v in enumerate(vals):
                     if v is None:
                         vals[i] = int(table.allocate_auto(1)[0])
+                        # MySQL last_insert_id(): FIRST generated id
+                        # of the statement
+                        if not getattr(self, "_liid_set", False):
+                            self.last_insert_id = vals[i]
+                            self._liid_set = True
                     else:
                         table.observe_auto(np.asarray([v], np.int64))
             if d.oid == TypeOid.DATE:

@@ -58,6 +58,10 @@ class UnaryOp(Node):
 class WindowSpec(Node):
     partition_by: List[Node] = dataclasses.field(default_factory=list)
     order_by: List["OrderItem"] = dataclasses.field(default_factory=list)
+    #: explicit frame: ("rows", lo, hi) with bounds
+    #: ("unbounded_preceding"|"unbounded_following"|"current"|
+    #:  "preceding"|"following", k_or_None); None = SQL default frame
+    frame: Optional[tuple] = None
 
 
 @dataclasses.dataclass

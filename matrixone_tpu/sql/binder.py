@@ -34,7 +34,13 @@ from matrixone_tpu.sql.parser import (AGG_FUNCS, BASIC_AGGS, BIT_AGGS,
 # SAMPLE seeds: each bound Sample node (and each re-bind of the same
 # query) draws an independent random stream
 _sample_seed = itertools.count(1)
-WINDOW_ONLY_FUNCS = {"row_number", "rank", "dense_rank"}
+WINDOW_ONLY_FUNCS = {"row_number", "rank", "dense_rank", "ntile",
+                     "lag", "lead", "first_value", "last_value",
+                     "nth_value"}
+#: rank family: no arguments, ignores frames
+_RANK_FUNCS = {"row_number", "rank", "dense_rank"}
+#: value functions: first arg is the value expression
+_VALUE_FUNCS = {"lag", "lead", "first_value", "last_value", "nth_value"}
 
 _TYPE_NAMES = {
     "bool": lambda a: dt.BOOL, "boolean": lambda a: dt.BOOL,
@@ -637,9 +643,10 @@ class Binder:
                     f"{fn}(DISTINCT ...) OVER (...) is not supported yet")
             if fc.star and fn != "count":
                 raise BindError(f"{fn}(*) is not valid")
-            if fn in WINDOW_ONLY_FUNCS and (fc.args or fc.star):
+            if fn in _RANK_FUNCS and (fc.args or fc.star):
                 raise BindError(f"{fn}() takes no arguments")
             arg = None
+            extra = {"frame": fc.window.frame}
             if fn in BASIC_AGGS and not fc.star:
                 if not fc.args:
                     raise BindError(f"{fn}() needs an argument")
@@ -648,16 +655,70 @@ class Binder:
                     raise BindError(
                         f"{fn}() over strings in windows is not "
                         f"supported yet")
+            elif fn == "ntile":
+                if len(fc.args) != 1 or not isinstance(
+                        fc.args[0], ast.Literal):
+                    raise BindError("ntile(N) needs one integer literal")
+                extra["n"] = int(fc.args[0].value)
+                if extra["n"] < 1:
+                    raise BindError("ntile(N): N must be >= 1")
+            elif fn in ("lag", "lead"):
+                if not 1 <= len(fc.args) <= 3:
+                    raise BindError(
+                        f"{fn}(expr [, offset [, default]]) takes 1-3 "
+                        f"arguments")
+                arg = bind(fc.args[0])
+                extra["offset"] = 1
+                if len(fc.args) >= 2:
+                    if not isinstance(fc.args[1], ast.Literal):
+                        raise BindError(
+                            f"{fn}() offset must be an integer literal")
+                    extra["offset"] = int(fc.args[1].value)
+                    if extra["offset"] < 0:
+                        raise BindError(f"{fn}() offset must be >= 0")
+                if len(fc.args) == 3:
+                    dflt = bind(fc.args[2])
+                    if not isinstance(dflt, BoundLiteral):
+                        raise BindError(
+                            f"{fn}() default must be a literal")
+                    if dflt.value is None:
+                        pass          # NULL default == no default
+                    elif arg.dtype.is_varlen:
+                        raise BindError(
+                            f"{fn}() over strings supports only NULL "
+                            f"default")
+                    else:
+                        extra["default"] = dflt
+            elif fn in ("first_value", "last_value"):
+                if len(fc.args) != 1:
+                    raise BindError(f"{fn}(expr) takes one argument")
+                arg = bind(fc.args[0])
+            elif fn == "nth_value":
+                if len(fc.args) != 2 or not isinstance(
+                        fc.args[1], ast.Literal):
+                    raise BindError(
+                        "nth_value(expr, N) needs an integer literal N")
+                arg = bind(fc.args[0])
+                extra["n"] = int(fc.args[1].value)
+                if extra["n"] < 1:
+                    raise BindError("nth_value(expr, N): N must be >= 1")
+            if extra["frame"] is not None and \
+                    fn in _RANK_FUNCS | {"ntile", "lag", "lead"}:
+                raise BindError(
+                    f"{fn}() does not accept a frame clause")
             part = [bind(p) for p in fc.window.partition_by]
             okeys = [bind(o.expr) for o in fc.window.order_by]
             odescs = [o.descending for o in fc.window.order_by]
             if fn in BASIC_AGGS:
                 out_t = _agg_result_type(fn, arg.dtype) if arg is not None \
                     else dt.INT64
+            elif fn in _VALUE_FUNCS:
+                out_t = arg.dtype
             else:
                 out_t = dt.INT64
             out_name = f"_w{i}"
-            entries.append((fn, arg, part, okeys, odescs, out_name))
+            entries.append((fn, arg, part, okeys, odescs, out_name,
+                            extra))
             win_map[id(fc)] = BoundCol(out_name, out_t)
             schema.append((out_name, out_t))
         wnode = plan.Window(node, entries, schema)
@@ -804,6 +865,15 @@ class Binder:
     def _bind_func(self, e: ast.FuncCall, rec) -> BoundExpr:
         if e.name in AGG_FUNCS:
             raise BindError(f"aggregate {e.name}() not allowed here")
+        # date_add/date_sub take an INTERVAL argument that is not an
+        # expression (function_id.go DATE_ADD/DATE_SUB family)
+        if e.name in ("date_add", "adddate", "date_sub", "subdate") \
+                and len(e.args) == 2 \
+                and isinstance(e.args[1], ast.IntervalLiteral):
+            iv = e.args[1]
+            sign = 1 if e.name in ("date_add", "adddate") else -1
+            return _bind_date_add_unit(rec(e.args[0]),
+                                       sign * iv.value, iv.unit)
         args = [rec(a) for a in e.args]
         if e.name == "load_file":
             # datalink resolution (reference: load_file over the datalink
@@ -1206,7 +1276,54 @@ _SCALAR_FUNCS = {
     "cosine_distance": ("cosine_distance", lambda ts: dt.FLOAT64),
     "inner_product": ("inner_product", lambda ts: dt.FLOAT64),
     "cosine_similarity": ("cosine_similarity", lambda ts: dt.FLOAT64),
+    # ---- r5 long tail: string family (dictionary-level eval)
+    "left": ("left", lambda ts: dt.VARCHAR),
+    "right": ("right", lambda ts: dt.VARCHAR),
+    "mid": ("substring", lambda ts: dt.VARCHAR),
+    "ord": ("ord", lambda ts: dt.INT64),
+    "insert": ("insert_str", lambda ts: dt.VARCHAR),
+    "elt": ("elt", lambda ts: dt.VARCHAR),
+    "concat_ws": ("concat_ws", lambda ts: dt.VARCHAR),
+    "split_part": ("split_part", lambda ts: dt.VARCHAR),
+    "octet_length": ("octet_length", lambda ts: dt.INT64),
+    "inet_aton": ("inet_aton", lambda ts: dt.INT64),
+    # ---- r5: numeric -> string presentation (unique-value LUT)
+    "inet_ntoa": ("inet_ntoa", lambda ts: dt.VARCHAR),
+    "format": ("format_num", lambda ts: dt.VARCHAR),
+    "sec_to_time": ("sec_to_time", lambda ts: dt.VARCHAR),
+    "date_format": ("date_format", lambda ts: dt.VARCHAR),
+    # ---- r5: date/time long tail
+    "str_to_date": ("str_to_date", lambda ts: dt.DATE),
+    "time_to_sec": ("time_to_sec", lambda ts: dt.INT64),
+    "microsecond": ("microsecond", lambda ts: dt.INT32),
+    "yearweek": ("yearweek", lambda ts: dt.INT64),
+    "makedate": ("makedate", lambda ts: dt.DATE),
+    "period_add": ("period_add", lambda ts: dt.INT64),
+    "period_diff": ("period_diff", lambda ts: dt.INT64),
+    "timestampdiff": ("timestampdiff", lambda ts: dt.INT64),
+    "timestampadd": ("timestampadd", lambda ts: dt.DATETIME),
+    "datetime": ("to_datetime", lambda ts: dt.DATETIME),
+    # ---- r5: misc
+    "bit_count": ("bit_count", lambda ts: dt.INT64),
+    "uuid": ("uuid", lambda ts: dt.VARCHAR),
+    "rand": ("rand", lambda ts: dt.FLOAT64),
 }
+
+
+_TIME_UNITS = {"microsecond", "second", "minute", "hour"}
+_DATE_UNITS = {"day", "week", "month", "quarter", "year"}
+
+
+def _bind_date_add_unit(base: BoundExpr, n: int, unit: str) -> BoundExpr:
+    unit = unit.lower().rstrip("s")
+    if unit not in _TIME_UNITS | _DATE_UNITS:
+        raise BindError(f"unsupported interval unit {unit!r}")
+    out_t = (dt.DATETIME if unit in _TIME_UNITS
+             or base.dtype.oid in (TypeOid.DATETIME, TypeOid.TIMESTAMP)
+             else dt.DATE)
+    return BoundFunc("date_add_unit",
+                     [base, BoundLiteral(int(n), dt.INT64),
+                      BoundLiteral(unit, dt.VARCHAR)], out_t)
 
 
 def _common_numeric(ts: List[DType]) -> DType:
@@ -1225,11 +1342,79 @@ def _common_numeric(ts: List[DType]) -> DType:
     return out
 
 
+def _session_info(name: str):
+    """Info functions resolve against the EXECUTING session (the way the
+    reference reads them from the frontend session): frontend/session.py
+    publishes the current session in a contextvar during execute()."""
+    from matrixone_tpu.frontend.session import current_session
+    s = current_session()
+    if name == "connection_id":
+        return BoundLiteral(int(getattr(s, "conn_id", 0) or 0), dt.INT64)
+    if name == "last_insert_id":
+        return BoundLiteral(int(getattr(s, "last_insert_id", 0) or 0),
+                            dt.INT64)
+    if name in ("user", "current_user", "session_user", "system_user"):
+        auth = getattr(s, "auth", None)
+        u = ("root" if auth is None
+             else f"{auth.account}:{auth.user}")
+        return BoundLiteral(u + "@localhost", dt.VARCHAR)
+    if name == "database":
+        return BoundLiteral("mo_catalog", dt.VARCHAR)
+    return None
+
+
 def bind_scalar_function(name: str, args: List[BoundExpr]) -> BoundExpr:
+    import datetime as _dtm
     import math
     # sugar rewrites (reference: many of the 554 ids are compositions)
     if name == "pi" and not args:
         return BoundLiteral(math.pi, dt.FLOAT64)
+    if name == "version" and not args:
+        return BoundLiteral("8.0.30-matrixone-tpu", dt.VARCHAR)
+    if name in ("connection_id", "last_insert_id", "user", "current_user",
+                "session_user", "system_user", "database", "schema") \
+            and not args:
+        r = _session_info("database" if name == "schema" else name)
+        if r is not None:
+            return r
+    # statement-time clock literals (MySQL: fixed per statement)
+    if name in ("now", "current_timestamp", "sysdate",
+                "localtimestamp") and not args:
+        now = _dtm.datetime.now()
+        us = int((now - _dtm.datetime(1970, 1, 1)).total_seconds() * 1e6)
+        return BoundLiteral(us, dt.DATETIME)
+    if name in ("utc_timestamp",) and not args:
+        now = _dtm.datetime.now(_dtm.timezone.utc).replace(tzinfo=None)
+        us = int((now - _dtm.datetime(1970, 1, 1)).total_seconds() * 1e6)
+        return BoundLiteral(us, dt.DATETIME)
+    if name in ("curdate", "current_date") and not args:
+        d = (_dtm.date.today() - _dtm.date(1970, 1, 1)).days
+        return BoundLiteral(d, dt.DATE)
+    if name in ("utc_date",) and not args:
+        d = (_dtm.datetime.now(_dtm.timezone.utc).date()
+             - _dtm.date(1970, 1, 1)).days
+        return BoundLiteral(d, dt.DATE)
+    if name in ("curtime", "current_time") and not args:
+        now = _dtm.datetime.now()
+        return BoundLiteral(now.strftime("%H:%M:%S"), dt.VARCHAR)
+    if name == "log" and len(args) == 2:
+        # log(b, x) = ln(x) / ln(b)
+        lnx = BoundFunc("ln", [args[1]], dt.FLOAT64)
+        lnb = BoundFunc("ln", [args[0]], dt.FLOAT64)
+        return BoundFunc("div", [lnx, lnb], dt.FLOAT64)
+    if name in ("timestampadd", "timestampdiff"):
+        if len(args) != 3 or not isinstance(args[0], BoundLiteral):
+            raise BindError(f"{name}(unit, a, b) takes a unit keyword "
+                            f"and two arguments")
+        unit = str(args[0].value).lower().rstrip("s")
+        if unit not in _TIME_UNITS | _DATE_UNITS:
+            raise BindError(f"unsupported {name} unit {unit!r}")
+        if name == "timestampadd" and not (
+                isinstance(args[1], BoundLiteral)
+                and isinstance(args[1].value, int)):
+            raise BindError(
+                "timestampadd() count must be an integer literal "
+                "(per-row counts are not supported yet)")
     if name == "if" and len(args) == 3:
         _require_bool(args[0], "if()")
         vt = (args[1].dtype if not (isinstance(args[1], BoundLiteral)

@@ -61,6 +61,21 @@ class Parser:
         t = self.peek()
         return t.kind == "op" and t.value in ops
 
+    def accept_soft_kw(self, word: str) -> bool:
+        """Accept a NON-RESERVED keyword (lexed as ident): window-frame
+        words like ROWS/PRECEDING stay usable as column names."""
+        t = self.peek()
+        if t.kind == "ident" and t.value.lower() == word:
+            self.next()
+            return True
+        return False
+
+    def expect_soft_kw(self, word: str) -> None:
+        if not self.accept_soft_kw(word):
+            raise ParseError(f"expected {word!r} near "
+                             f"{self.peek().value!r} "
+                             f"(pos {self.peek().pos})")
+
     def accept_kw(self, *kws: str) -> bool:
         if self.at_kw(*kws):
             self.next()
@@ -1043,9 +1058,53 @@ class Parser:
             spec.order_by.append(self.order_item())
             while self.accept_op(","):
                 spec.order_by.append(self.order_item())
+        # inside OVER(...) nothing else can start with these idents, so
+        # soft keywords are unambiguous here
+        if self.accept_soft_kw("rows"):
+            spec.frame = ("rows",) + self._frame_bounds()
+        elif self.accept_soft_kw("range"):
+            # only the two frames equivalent to defaults are accepted
+            # (numeric RANGE needs typed interval arithmetic)
+            lo, hi = self._frame_bounds()
+            if lo != ("unbounded_preceding", None) or \
+                    hi not in (("current", None),
+                               ("unbounded_following", None)):
+                raise ParseError(
+                    "only RANGE BETWEEN UNBOUNDED PRECEDING AND "
+                    "CURRENT ROW / UNBOUNDED FOLLOWING are supported")
+            if hi == ("unbounded_following", None):
+                spec.frame = ("rows", lo, hi)    # whole partition
         self.expect_op(")")
         fc.window = spec
         return fc
+
+    def _frame_bounds(self):
+        """BETWEEN <bound> AND <bound> | <bound> (hi = CURRENT ROW)."""
+        if self.accept_kw("between"):
+            lo = self._frame_bound()
+            self.expect_kw("and")
+            return lo, self._frame_bound()
+        return self._frame_bound(), ("current", None)
+
+    def _frame_bound(self):
+        if self.accept_soft_kw("unbounded"):
+            if self.accept_soft_kw("preceding"):
+                return ("unbounded_preceding", None)
+            self.expect_soft_kw("following")
+            return ("unbounded_following", None)
+        if self.accept_soft_kw("current"):
+            self.expect_soft_kw("row")
+            return ("current", None)
+        t = self.peek()
+        if t.kind == "int":
+            self.next()
+            k = int(t.value)
+            if self.accept_soft_kw("preceding"):
+                return ("preceding", k)
+            self.expect_soft_kw("following")
+            return ("following", k)
+        raise ParseError(
+            f"expected frame bound near {t.value!r} (pos {t.pos})")
 
     def func_or_column(self) -> ast.Node:
         name = self.ident()
@@ -1064,6 +1123,35 @@ class Parser:
                 self.expect_op(")")
                 return ast.FuncCall("match_against", cols + [q])
             return ast.FuncCall("match", cols)
+        if name.lower() in ("timestampadd", "timestampdiff") \
+                and self.at_op("("):
+            # MySQL: the first argument is a bare interval-unit keyword
+            # (MINUTE, DAY, ...), not an expression
+            self.expect_op("(")
+            unit = self.ident().lower()
+            self.expect_op(",")
+            a1 = self.expr()
+            self.expect_op(",")
+            a2 = self.expr()
+            self.expect_op(")")
+            return ast.FuncCall(name.lower(),
+                                [ast.Literal(unit, "str"), a1, a2])
+        if name.lower() == "convert" and self.at_op("("):
+            # CONVERT(expr, type) = CAST(expr AS type)
+            save = self.i
+            self.expect_op("(")
+            inner = self.expr()
+            if self.accept_op(","):
+                tname = self.ident().lower()
+                targs = []
+                if self.accept_op("("):
+                    while not self.at_op(")"):
+                        targs.append(int(self.next().value))
+                        self.accept_op(",")
+                    self.expect_op(")")
+                self.expect_op(")")
+                return ast.Cast(inner, tname, targs)
+            self.i = save          # CONVERT(x USING ...) etc: fall through
         if self.accept_op("("):
             if self.accept_op("*"):
                 self.expect_op(")")

@@ -20,6 +20,7 @@ import fnmatch
 import re
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -73,6 +74,10 @@ def _dict_of(e: BoundExpr, ex: ExecBatch) -> Optional[List[str]]:
     if isinstance(e, BoundFunc) and e.dtype.is_varlen \
             and e.op in _STRING_FUNCS:
         return string_func_final_dict(e, ex)
+    if isinstance(e, BoundFunc) and e.op in _NUM2STR_FUNCS:
+        return num2str_final_dict(e, ex)
+    if isinstance(e, BoundFunc) and e.op == "uuid":
+        return uuid_dict(ex)
     return None
 
 
@@ -160,7 +165,22 @@ _STRING_FUNCS = {"upper", "lower", "length", "reverse", "trim", "ltrim",
                  # semantics evaluated on the dictionary (matrixone_tpu.geo)
                  "st_geomfromtext", "st_astext", "st_x", "st_y",
                  "st_distance", "st_within", "st_contains", "st_area",
-                 "st_geohash"}
+                 "st_geohash",
+                 # r5 long tail (function_id.go families)
+                 "left", "right", "ord", "insert_str", "elt",
+                 "concat_ws", "split_part", "octet_length", "inet_aton",
+                 "str_to_date", "time_to_sec"}
+
+#: numeric input -> string output: evaluated over the column's UNIQUE
+#: values host-side (O(distinct)), gathered on device — the same
+#: cost model as the dictionary-level string functions
+_NUM2STR_FUNCS = {"date_format", "sec_to_time", "inet_ntoa",
+                  "format_num"}
+
+
+#: marks the COLUMN's position in a string call's literal list — distinct
+#: from None, which is a genuine NULL literal argument
+_COLPOS = object()
 
 
 def _string_arg_info(e, ex, want_col: bool = True):
@@ -186,13 +206,16 @@ def _string_arg_info(e, ex, want_col: bool = True):
                 f"string function {e.op} over two columns not supported yet")
         col_ast = a
         d = src
-        lits.append(None)          # placeholder for the column position
+        lits.append(_COLPOS)       # placeholder for the column position
     if col_ast is None:
-        # all-literal call: first literal is the subject string
+        # all-literal call: the first literal is the subject string. A
+        # NULL subject stays None in lits so the NULL-propagation rule
+        # fires (left(NULL, 2) is NULL, not '')
         if not lits:
             raise EvalError(f"string function {e.op} needs arguments")
-        d = [str(lits[0])]
-        lits[0] = None
+        d = [str(lits[0]) if lits[0] is not None else ""]
+        if lits[0] is not None:
+            lits[0] = _COLPOS
     elif want_col:
         col = eval_expr(col_ast, ex)
     return col, d, lits
@@ -260,14 +283,20 @@ def _apply_string_func(op, s, lits):
     import zlib
 
     def args():
-        return [x for x in lits if x is not None]
+        return [x for x in lits if x is not _COLPOS]
 
     def at(i, default=None):
         """Positional arg: the dictionary entry if the column sits at
         position i, else the literal there."""
         if i >= len(lits):
             return default
-        return s if lits[i] is None else lits[i]
+        return s if lits[i] is _COLPOS else lits[i]
+
+    # MySQL: a NULL argument yields NULL — except functions with
+    # explicit NULL semantics (concat_ws skips NULLs; elt/coalesce
+    # handle them positionally)
+    if op not in ("concat_ws", "elt") and any(x is None for x in lits):
+        return None
 
     if op == "upper":
         return s.upper()
@@ -288,7 +317,7 @@ def _apply_string_func(op, s, lits):
     if op == "rtrim":
         return s.rstrip()
     if op == "concat":
-        return "".join(s if x is None else str(x) for x in lits)
+        return "".join(s if x is _COLPOS else str(x) for x in lits)
     if op == "substring":
         a = args()
         start = int(a[0])
@@ -322,6 +351,82 @@ def _apply_string_func(op, s, lits):
     if op == "repeat":
         n = int(args()[0])
         return s * max(n, 0)
+    if op == "left":
+        return s[:max(int(args()[0]), 0)]
+    if op == "right":
+        n = max(int(args()[0]), 0)
+        return s[max(len(s) - n, 0):] if n else ""
+    if op == "ord":
+        # MySQL ORD: leftmost character's byte sequence as an int
+        if not s:
+            return 0
+        out = 0
+        for byte in s[0].encode():
+            out = out * 256 + byte
+        return out
+    if op == "octet_length":
+        return len(s.encode())
+    if op == "insert_str":
+        a = args()
+        pos, ln, news = int(a[0]), int(a[1]), str(a[2])
+        if pos < 1 or pos > len(s):
+            return s
+        return s[:pos - 1] + news + s[pos - 1 + max(ln, 0):]
+    if op == "elt":
+        idx = at(0)
+        if idx is None:
+            return None
+        i = int(idx)
+        options = [s if x is _COLPOS else
+                   (None if x is None else str(x)) for x in lits[1:]]
+        if i < 1 or i > len(options):
+            return None
+        return options[i - 1]
+    if op == "concat_ws":
+        sep = at(0)
+        if sep is None:
+            return None                   # NULL separator -> NULL
+        parts = [s if x is _COLPOS else str(x)
+                 for x in lits[1:] if x is not None]   # NULLs skipped
+        return str(sep).join(parts)
+    if op == "split_part":
+        a = args()
+        parts = s.split(str(a[0]))
+        i = int(a[1])
+        if i < 1 or i > len(parts):
+            return None
+        return parts[i - 1]
+    if op == "inet_aton":
+        try:
+            p = s.split(".")
+            if len(p) != 4 or any(not x.isdigit() or int(x) > 255
+                                  for x in p):
+                return None
+            return (int(p[0]) << 24 | int(p[1]) << 16
+                    | int(p[2]) << 8 | int(p[3]))
+        except ValueError:
+            return None
+    if op == "str_to_date":
+        import datetime as _dtm
+        fmt = str(args()[0])
+        pyfmt = (fmt.replace("%i", "%M").replace("%s", "%S")
+                 .replace("%e", "%d").replace("%c", "%m"))
+        try:
+            d0 = _dtm.datetime.strptime(s, pyfmt).date()
+            return (d0 - _dtm.date(1970, 1, 1)).days
+        except ValueError:
+            return None
+    if op == "time_to_sec":
+        try:
+            t = s.strip()
+            neg = t.startswith("-")
+            if neg:
+                t = t[1:]
+            hh, mm, ss = (t.split(":") + ["0", "0"])[:3]
+            total = int(hh) * 3600 + int(mm) * 60 + int(float(ss))
+            return -total if neg else total
+        except ValueError:
+            return None
     if op == "space":
         return " " * max(int(s), 0)
     if op == "instr":
@@ -344,7 +449,7 @@ def _apply_string_func(op, s, lits):
     if op == "field":
         # the column may sit at ANY position: substitute the dictionary
         # entry at its placeholder before comparing
-        full = [s if x is None else str(x) for x in lits]
+        full = [s if x is _COLPOS else str(x) for x in lits]
         try:
             return full[1:].index(full[0]) + 1
         except ValueError:
@@ -516,6 +621,108 @@ def string_func_output_dict(e: BoundFunc, ex: ExecBatch):
     return [_apply_string_func(e.op, s, lits) for s in d]
 
 
+#: MySQL date_format codes -> strftime (%e/%c handled inline: no-pad
+#: forms are platform-dependent in strftime)
+_MYSQL_FMT = {
+    "%Y": "%Y", "%y": "%y", "%m": "%m", "%d": "%d", "%H": "%H",
+    "%h": "%I", "%i": "%M", "%s": "%S", "%f": "%f", "%M": "%B",
+    "%b": "%b", "%W": "%A", "%a": "%a", "%j": "%j", "%p": "%p",
+    "%T": "%H:%M:%S", "%r": "%I:%M:%S %p", "%%": "%%",
+}
+
+
+def _num2str_value(op, v, lits, dtype) -> "Optional[str]":
+    """One unique input value -> output string (None = SQL NULL)."""
+    import datetime as _dtm
+    if op == "inet_ntoa":
+        n = int(v)
+        if n < 0 or n > 0xFFFFFFFF:
+            return None
+        return ".".join(str((n >> s) & 0xFF) for s in (24, 16, 8, 0))
+    if op == "sec_to_time":
+        n = int(v)
+        sign = "-" if n < 0 else ""
+        n = abs(n)
+        return f"{sign}{n // 3600:02d}:{n % 3600 // 60:02d}:{n % 60:02d}"
+    if op == "format_num":
+        nd = int(lits[1]) if len(lits) > 1 and lits[1] is not None else 0
+        x = float(v)
+        if dtype is not None and dtype.oid == dt.TypeOid.DECIMAL64:
+            x = x / 10 ** dtype.scale      # stored scaled (exact int)
+        return f"{x:,.{max(nd, 0)}f}"
+    if op == "date_format":
+        fmt = str(lits[1]) if len(lits) > 1 else "%Y-%m-%d"
+        if dtype is not None and dtype.oid in (dt.TypeOid.DATETIME,
+                                               dt.TypeOid.TIMESTAMP):
+            base = _dtm.datetime(1970, 1, 1) \
+                + _dtm.timedelta(microseconds=int(v))
+        else:
+            base = _dtm.datetime(1970, 1, 1) + _dtm.timedelta(days=int(v))
+        out = []
+        i = 0
+        while i < len(fmt):
+            if fmt[i] == "%" and i + 1 < len(fmt):
+                code = fmt[i:i + 2]
+                i += 2
+                if code == "%e":
+                    out.append(str(base.day))
+                elif code == "%c":
+                    out.append(str(base.month))
+                elif code in _MYSQL_FMT:
+                    out.append(base.strftime(_MYSQL_FMT[code]))
+                else:
+                    out.append(code[1])
+            else:
+                out.append(fmt[i])
+                i += 1
+        return "".join(out)
+    raise EvalError(op)
+
+
+def _num2str_parts(e: BoundFunc, ex: ExecBatch):
+    """(col, unique_vals, inverse_codes, formatted) for a numeric->string
+    function — shared by eval and dictionary derivation so codes and
+    dict entries always line up. Cached per (expression, batch): the
+    projection asks for the dict AND the values, and the unique+format
+    pass must not run twice (same motivation as uuid_dict's cache)."""
+    cache = getattr(ex, "_num2str_cache", None)
+    if cache is None:
+        cache = {}
+        ex._num2str_cache = cache
+    key = id(e)
+    if key in cache:
+        return cache[key]
+    col = eval_expr(e.args[0], ex)
+    vals = np.asarray(jax.device_get(col.data))
+    uniq, inv = np.unique(vals, return_inverse=True)
+    strs = [_num2str_value(e.op, u, [None] + [
+        a.value if isinstance(a, BoundLiteral) else None
+        for a in e.args[1:]], e.args[0].dtype) for u in uniq]
+    cache[key] = (col, uniq, inv, strs)
+    return cache[key]
+
+
+def num2str_final_dict(e: BoundFunc, ex: ExecBatch):
+    _col, _u, _inv, strs = _num2str_parts(e, ex)
+    uniq = {}
+    for v in strs:
+        uniq.setdefault("" if v is None else str(v), len(uniq))
+    return list(uniq)
+
+
+def _eval_num2str(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
+    col, _u, inv, strs = _num2str_parts(e, ex)
+    uniq = {}
+    remap = np.empty(len(strs), np.int32)
+    nulls = np.empty(len(strs), np.bool_)
+    for i, v in enumerate(strs):
+        remap[i] = uniq.setdefault("" if v is None else str(v), len(uniq))
+        nulls[i] = v is None
+    codes = jnp.asarray(remap[inv].astype(np.int32))
+    validity = col.validity & ~jnp.asarray(nulls[inv])
+    return DeviceColumn(codes, validity, e.dtype)
+
+
 def _eval_string_func(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
     col, d, lits = _string_arg_info(e, ex)
     if col is None:
@@ -647,10 +854,168 @@ def _eval_func(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
         return _eval_distance(e, ex)
     if op in _STRING_FUNCS:
         return _eval_string_func(e, ex)
+    if op in _NUM2STR_FUNCS:
+        return _eval_num2str(e, ex)
+    if op == "date_add_unit":
+        return _eval_date_add_unit(e, ex)
+    if op in ("timestampadd", "timestampdiff"):
+        return _eval_timestamp_fn(e, ex)
+    if op in ("makedate", "period_add", "period_diff"):
+        return _eval_period_fn(e, ex)
+    if op == "to_datetime":
+        a = eval_expr(e.args[0], ex)
+        data = a.data.astype(jnp.int64)
+        if a.dtype.oid == dt.TypeOid.DATE:
+            data = data * _US_PER_DAY
+        return DeviceColumn(data, a.validity, dt.DATETIME)
+    if op == "bit_count":
+        a = eval_expr(e.args[0], ex)
+        x = a.data.astype(jnp.uint64)
+        # Hacker's Delight popcount, 64-bit, fully vectorized
+        m1 = jnp.uint64(0x5555555555555555)
+        m2 = jnp.uint64(0x3333333333333333)
+        m4 = jnp.uint64(0x0F0F0F0F0F0F0F0F)
+        h01 = jnp.uint64(0x0101010101010101)
+        x = x - ((x >> jnp.uint64(1)) & m1)
+        x = (x & m2) + ((x >> jnp.uint64(2)) & m2)
+        x = (x + (x >> jnp.uint64(4))) & m4
+        x = (x * h01) >> jnp.uint64(56)
+        return DeviceColumn(x.astype(jnp.int64), a.validity, dt.INT64)
+    if op == "rand":
+        n = ex.padded_len
+        seed = (int(e.args[0].value) if e.args
+                and isinstance(e.args[0], BoundLiteral) else None)
+        rng = np.random.default_rng(seed)
+        vals = jnp.asarray(rng.random(n))
+        return DeviceColumn(vals, jnp.ones((n,), jnp.bool_), dt.FLOAT64)
+    if op == "uuid":
+        n = ex.padded_len
+        codes = jnp.arange(n, dtype=jnp.int32)
+        return DeviceColumn(codes, jnp.ones((n,), jnp.bool_), e.dtype)
     if op in _SIMPLE:
         args = [eval_expr(a, ex) for a in e.args]
         return _SIMPLE[op](*args)
     raise EvalError(f"unsupported function {op}")
+
+
+def uuid_dict(ex: ExecBatch):
+    """uuid() dictionary: one fresh v4 uuid per row position. Cached on
+    the batch so eval codes and the projection's dict agree."""
+    import uuid as _uuid
+    cache = getattr(ex, "_uuid_dict", None)
+    if cache is None or len(cache) != ex.padded_len:
+        cache = [str(_uuid.uuid4()) for _ in range(ex.padded_len)]
+        try:
+            object.__setattr__(ex, "_uuid_dict", cache)
+        except Exception:          # noqa: BLE001 — plain attribute works
+            ex._uuid_dict = cache
+    return cache
+
+
+def _eval_date_add_unit(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
+    """date_add/date_sub with any interval unit. Calendar units go
+    through civil decomposition with MySQL day clamping (Jan 31 + 1
+    month = Feb 28); time units ride microseconds."""
+    a = eval_expr(e.args[0], ex)
+    n = int(e.args[1].value)
+    unit = str(e.args[2].value)
+    is_dt_in = a.dtype.oid in (dt.TypeOid.DATETIME, dt.TypeOid.TIMESTAMP)
+    micros = a.data.astype(jnp.int64) * (1 if is_dt_in else _US_PER_DAY)
+    if unit in ("microsecond", "second", "minute", "hour"):
+        mult = {"microsecond": 1, "second": 1_000_000,
+                "minute": 60_000_000, "hour": 3_600_000_000}[unit]
+        out = micros + n * mult
+        return DeviceColumn(out, a.validity, dt.DATETIME)
+    days = jnp.floor_divide(micros, _US_PER_DAY)
+    tod = micros - days * _US_PER_DAY
+    if unit in ("day", "week"):
+        nd = days + n * (7 if unit == "week" else 1)
+    else:
+        months = {"month": n, "quarter": 3 * n, "year": 12 * n}[unit]
+        y, m, d = _civil_from_days(days)
+        tot = y * 12 + (m - 1) + months
+        ny, nm = tot // 12, tot % 12 + 1
+        # clamp to the target month's length (MySQL semantics)
+        mlen = _days_from_civil(ny + (nm == 12), jnp.where(nm == 12, 1,
+                                                          nm + 1), 1) \
+            - _days_from_civil(ny, nm, 1)
+        nd2 = jnp.minimum(d, mlen)
+        nd = _days_from_civil(ny, nm, nd2)
+    if e.dtype.oid == dt.TypeOid.DATETIME:
+        return DeviceColumn(nd * _US_PER_DAY + tod, a.validity,
+                            dt.DATETIME)
+    return DeviceColumn(nd.astype(jnp.int32), a.validity, dt.DATE)
+
+
+def _eval_timestamp_fn(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
+    unit = str(e.args[0].value).lower().rstrip("s")
+    if e.op == "timestampadd":
+        from matrixone_tpu.sql.expr import BoundLiteral as _BL
+        n = int(e.args[1].value)
+        inner = BoundFunc("date_add_unit",
+                          [e.args[2], _BL(n, dt.INT64),
+                           _BL(unit, dt.VARCHAR)], dt.DATETIME)
+        return _eval_date_add_unit(inner, ex)
+    # timestampdiff(unit, a, b) = (b - a) in unit, truncated
+    a = eval_expr(e.args[1], ex)
+    b = eval_expr(e.args[2], ex)
+    da, db, valid = S._broadcast2(a, b)
+    ua = da.astype(jnp.int64) * (1 if a.dtype.oid in
+                                 (dt.TypeOid.DATETIME,
+                                  dt.TypeOid.TIMESTAMP) else _US_PER_DAY)
+    ub = db.astype(jnp.int64) * (1 if b.dtype.oid in
+                                 (dt.TypeOid.DATETIME,
+                                  dt.TypeOid.TIMESTAMP) else _US_PER_DAY)
+    diff = ub - ua
+    if unit in ("microsecond", "second", "minute", "hour", "day", "week"):
+        div = {"microsecond": 1, "second": 1_000_000,
+               "minute": 60_000_000, "hour": 3_600_000_000,
+               "day": _US_PER_DAY, "week": 7 * _US_PER_DAY}[unit]
+        out = jnp.sign(diff) * (jnp.abs(diff) // div)
+        return DeviceColumn(out.astype(jnp.int64), valid, dt.INT64)
+    days_a = jnp.floor_divide(ua, _US_PER_DAY)
+    days_b = jnp.floor_divide(ub, _US_PER_DAY)
+    ya, ma, dda = _civil_from_days(days_a)
+    yb, mb, ddb = _civil_from_days(days_b)
+    months = (yb * 12 + mb) - (ya * 12 + ma)
+    # partial month does not count (MySQL truncation) — compare
+    # (day-of-month, time-of-day) lexicographically, not just the day
+    toa = ua - days_a * _US_PER_DAY
+    tob = ub - days_b * _US_PER_DAY
+    b_before_a = (ddb < dda) | ((ddb == dda) & (tob < toa))
+    a_before_b = (ddb > dda) | ((ddb == dda) & (tob > toa))
+    months = months - jnp.where((months > 0) & b_before_a, 1, 0) \
+        + jnp.where((months < 0) & a_before_b, 1, 0)
+    div = {"month": 1, "quarter": 3, "year": 12}.get(unit)
+    if div is None:
+        raise EvalError(f"unsupported timestampdiff unit {unit!r}")
+    out = jnp.sign(months) * (jnp.abs(months) // div)
+    return DeviceColumn(out.astype(jnp.int64), valid, dt.INT64)
+
+
+def _eval_period_fn(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
+    if e.op == "makedate":
+        y = eval_expr(e.args[0], ex)
+        doy = eval_expr(e.args[1], ex)
+        dy, dd, valid = S._broadcast2(y, doy)
+        jan1 = _days_from_civil(dy.astype(jnp.int64), jnp.int64(1),
+                                jnp.int64(1))
+        out = (jan1 + dd.astype(jnp.int64) - 1).astype(jnp.int32)
+        valid = valid & (dd.astype(jnp.int64) >= 1)
+        return DeviceColumn(out, valid, dt.DATE)
+    a = eval_expr(e.args[0], ex)
+    b = eval_expr(e.args[1], ex)
+    da, db, valid = S._broadcast2(a, b)
+    pa = da.astype(jnp.int64)
+    mo_a = (pa // 100) * 12 + pa % 100 - 1
+
+    if e.op == "period_add":
+        tot = mo_a + db.astype(jnp.int64)
+        out = (tot // 12) * 100 + tot % 12 + 1
+        return DeviceColumn(out, valid, dt.INT64)
+    pb = db.astype(jnp.int64)
+    mo_b = (pb // 100) * 12 + pb % 100 - 1
+    return DeviceColumn(mo_a - mo_b, valid, dt.INT64)
 
 
 def _eval_compare(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
@@ -729,7 +1094,8 @@ _DAY_NAMES = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
 _DATE_FUNCS = {"weekday", "dayofweek", "dayofyear", "quarter", "week",
                "last_day", "to_days", "from_days", "datediff", "hour",
                "minute", "second", "date", "unix_timestamp",
-               "from_unixtime", "monthname", "dayname"}
+               "from_unixtime", "monthname", "dayname",
+               "microsecond", "yearweek"}
 
 _US_PER_DAY = 86_400_000_000
 
@@ -818,6 +1184,28 @@ def _eval_date_func(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
         nm = jnp.where(m == 12, 1, m + 1)
         out = _days_from_civil(ny, nm, jnp.ones_like(d)) - 1
         return DeviceColumn(out.astype(jnp.int32), a.validity, dt.DATE)
+    if op == "microsecond":
+        if a.dtype.oid in (dt.TypeOid.DATETIME, dt.TypeOid.TIMESTAMP):
+            us = a.data.astype(jnp.int64) % 1_000_000
+        else:
+            us = jnp.zeros_like(a.data, jnp.int64)
+        return DeviceColumn(us.astype(jnp.int32), a.validity, dt.INT32)
+    if op == "yearweek":       # mode 0: YYYYWW, week-0 days belong to
+        # the previous year's last week (MySQL yearweek semantics)
+        jan1 = _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+        doy = days - jan1 + 1
+        jan1_dow_sun0 = (jan1 + 4) % 7
+        first_sunday_doy = 1 + (7 - jan1_dow_sun0) % 7
+        wk = jnp.where(doy < first_sunday_doy, 0,
+                       (doy - first_sunday_doy) // 7 + 1)
+        # week 0: recompute as last week of the PREVIOUS year
+        pj = _days_from_civil(y - 1, jnp.ones_like(m), jnp.ones_like(d))
+        pdoy = days - pj + 1
+        pdow = (pj + 4) % 7
+        pfirst = 1 + (7 - pdow) % 7
+        pwk = jnp.where(pdoy < pfirst, 0, (pdoy - pfirst) // 7 + 1)
+        out = jnp.where(wk > 0, y * 100 + wk, (y - 1) * 100 + pwk)
+        return DeviceColumn(out.astype(jnp.int64), a.validity, dt.INT64)
     raise EvalError(op)
 
 

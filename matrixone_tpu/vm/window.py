@@ -62,23 +62,33 @@ class WindowOp(Operator):
         self.max_partitions = max_partitions
 
     def execute(self) -> Iterator[ExecBatch]:
+        from matrixone_tpu.vm.operators import _expr_dict
         batches = list(self.child.execute())
         if not batches:
             return
         ex = _concat_batches(batches, self.node.child.schema)
         out_cols = dict(ex.batch.columns)
+        out_dicts = dict(ex.dicts)
         # entries sharing one OVER spec share the sort/segment machinery
         spec_cache = {}
-        for (fn, arg, part, okeys, odescs, out_name) in self.node.entries:
+        for entry in self.node.entries:
+            (fn, arg, part, okeys, odescs, out_name) = entry[:6]
+            extra = entry[6] if len(entry) > 6 else {}
             from matrixone_tpu.sql.serde import expr_to_json
             key = (tuple(repr(expr_to_json(p)) for p in part),
                    tuple(repr(expr_to_json(k)) for k in okeys),
                    tuple(odescs))
             if key not in spec_cache:
                 spec_cache[key] = self._spec(part, okeys, odescs, ex)
-            out_cols[out_name] = self._compute(fn, arg, spec_cache[key], ex)
+            out_cols[out_name] = self._compute(fn, arg, spec_cache[key],
+                                               ex, extra)
+            # value functions over varchar carry their source dictionary
+            if arg is not None and arg.dtype.is_varlen:
+                d = _expr_dict(arg, ex)
+                if d is not None:
+                    out_dicts[out_name] = d
         db = DeviceBatch(columns=out_cols, n_rows=ex.batch.n_rows)
-        yield ExecBatch(batch=db, dicts=ex.dicts, mask=ex.mask)
+        yield ExecBatch(batch=db, dicts=out_dicts, mask=ex.mask)
 
     # ------------------------------------------------------------ kernels
     def _spec(self, part, okeys, odescs, ex):
@@ -139,9 +149,10 @@ class WindowOp(Operator):
         return {"order": order, "seg": seg, "first": first, "pos": pos,
                 "new_peer": new_peer, "peer_end": peer_end,
                 "part_end": part_end, "mask_s": mask_s,
-                "has_order": bool(ocols)}
+                "start_idx": start_idx, "has_order": bool(ocols)}
 
-    def _compute(self, fn, arg, spec, ex) -> DeviceColumn:
+    def _compute(self, fn, arg, spec, ex, extra=None) -> DeviceColumn:
+        extra = extra or {}
         n = ex.padded_len
         order = spec["order"]
         seg = spec["seg"]
@@ -158,11 +169,27 @@ class WindowOp(Operator):
         elif fn == "dense_rank":
             vals_s = _seg_scan(new_peer.astype(jnp.int64), seg, jnp.add)
             out_t = dt.INT64
+        elif fn == "ntile":
+            vals_s = self._ntile(extra["n"], spec)
+            out_t = dt.INT64
+        elif fn in ("lag", "lead", "first_value", "last_value",
+                    "nth_value"):
+            vals_s, valid_out, out_t = self._value_window(
+                fn, arg, ex, spec, extra)
+            out = jnp.zeros((n,), vals_s.dtype).at[order].set(vals_s)
+            valid = jnp.zeros((n,), jnp.bool_).at[order].set(
+                mask_s & valid_out)
+            return DeviceColumn(out, valid, out_t)
         else:
-            take_at = spec["peer_end"] if spec["has_order"] \
-                else spec["part_end"]
-            vals_s, frame_valid, out_t = self._agg_window(
-                fn, arg, ex, order, seg, mask_s, take_at)
+            frame = extra.get("frame")
+            if frame is not None:
+                vals_s, frame_valid, out_t = self._framed_agg(
+                    fn, arg, ex, spec, frame)
+            else:
+                take_at = spec["peer_end"] if spec["has_order"] \
+                    else spec["part_end"]
+                vals_s, frame_valid, out_t = self._agg_window(
+                    fn, arg, ex, order, seg, mask_s, take_at)
             out = jnp.zeros((n,), vals_s.dtype).at[order].set(vals_s)
             valid = jnp.zeros((n,), jnp.bool_).at[order].set(
                 mask_s & frame_valid)
@@ -171,6 +198,175 @@ class WindowOp(Operator):
         out = jnp.zeros((n,), vals_s.dtype).at[order].set(vals_s)
         valid = jnp.zeros((n,), jnp.bool_).at[order].set(mask_s)
         return DeviceColumn(out, valid, out_t)
+
+    def _ntile(self, nt: int, spec):
+        """MySQL ntile: first (count % nt) buckets get one extra row;
+        when count < nt every row is its own bucket."""
+        pos = spec["pos"]
+        count = spec["part_end"] - spec["start_idx"] + 1
+        size = count // nt
+        rem = count % nt
+        big_span = rem * (size + 1)
+        in_big = pos < big_span
+        bucket_small = jnp.where(size > 0,
+                                 rem + (pos - big_span)
+                                 // jnp.maximum(size, 1),
+                                 pos)
+        bucket = jnp.where(in_big, pos // jnp.maximum(size + 1, 1),
+                           bucket_small)
+        return bucket + 1
+
+    # ---------------------------------------------------- value functions
+    def _value_window(self, fn, arg, ex, spec, extra):
+        n = ex.padded_len
+        order = spec["order"]
+        idx = jnp.arange(n, dtype=jnp.int64)
+        start = spec["start_idx"]
+        pend = spec["part_end"]
+        col = _broadcast_full(eval_expr(arg, ex), n)
+        v_s = col.data[order]
+        cval_s = col.validity[order]
+
+        if fn in ("lag", "lead"):
+            off = extra.get("offset", 1)
+            src = idx - off if fn == "lag" else idx + off
+            in_part = (src >= start) & (src <= pend)
+            srcc = jnp.clip(src, 0, n - 1)
+            vals = jnp.take(v_s, srcc, axis=0)
+            valid = in_part & jnp.take(cval_s, srcc)
+            dflt = extra.get("default")
+            if dflt is not None:
+                dv = jnp.asarray(dflt.value).astype(v_s.dtype)
+                vals = jnp.where(in_part, vals, dv)
+                valid = valid | ~in_part
+            return vals, valid, arg.dtype
+        if fn == "first_value":
+            src = self._frame_lo(spec, extra.get("frame"))
+        elif fn == "last_value":
+            src = self._frame_hi(spec, extra.get("frame"))
+        else:                                  # nth_value
+            src = self._frame_lo(spec, extra.get("frame")) \
+                + extra["n"] - 1
+        hi = self._frame_hi(spec, extra.get("frame"))
+        lo = self._frame_lo(spec, extra.get("frame"))
+        in_frame = (src >= lo) & (src <= hi) & (lo <= hi)
+        srcc = jnp.clip(src, 0, n - 1)
+        vals = jnp.take(v_s, srcc, axis=0)
+        valid = in_frame & jnp.take(cval_s, srcc)
+        return vals, valid, arg.dtype
+
+    # ------------------------------------------------------------- frames
+    def _frame_lo(self, spec, frame):
+        idx = jnp.arange(len(spec["pos"]), dtype=jnp.int64)
+        start = spec["start_idx"]
+        if frame is None:
+            return start                        # default: RANGE UNB..CUR
+        kind, k = frame[1]
+        if kind == "unbounded_preceding":
+            raw = start
+        elif kind == "current":
+            raw = idx
+        elif kind == "preceding":
+            raw = idx - k
+        else:                                   # following
+            raw = idx + k
+        return jnp.maximum(raw, start)
+
+    def _frame_hi(self, spec, frame):
+        idx = jnp.arange(len(spec["pos"]), dtype=jnp.int64)
+        pend = spec["part_end"]
+        if frame is None:
+            return spec["peer_end"] if spec["has_order"] else pend
+        kind, k = frame[2]
+        if kind == "unbounded_following":
+            raw = pend
+        elif kind == "current":
+            raw = idx
+        elif kind == "following":
+            raw = idx + k
+        else:                                   # preceding
+            raw = idx - k
+        return jnp.minimum(raw, pend)
+
+    def _framed_agg(self, fn, arg, ex, spec, frame):
+        """ROWS-frame aggregate: sum/count/avg by inclusive-prefix
+        difference; min/max by a sparse table (log-levels of shifted
+        combines) queried per row — O(n log n), fully vectorized, no
+        per-partition host loop."""
+        n = ex.padded_len
+        order = spec["order"]
+        seg = spec["seg"]
+        mask_s = spec["mask_s"]
+        start = spec["start_idx"]
+        lo = self._frame_lo(spec, frame)
+        hi = self._frame_hi(spec, frame)
+        nonempty = lo <= hi
+        loc = jnp.clip(lo, 0, n - 1)
+        hic = jnp.clip(hi, 0, n - 1)
+
+        if arg is not None:
+            col = _broadcast_full(eval_expr(arg, ex), n)
+            v_s = col.data[order]
+            valid_s = col.validity[order] & mask_s
+        else:
+            v_s = jnp.ones((n,), jnp.int64)
+            valid_s = mask_s
+
+        cnt_pre = _seg_scan(valid_s.astype(jnp.int64), seg, jnp.add)
+        cnt = jnp.where(nonempty,
+                        jnp.take(cnt_pre, hic)
+                        - jnp.where(lo > start,
+                                    jnp.take(cnt_pre,
+                                             jnp.clip(lo - 1, 0, n - 1)),
+                                    0),
+                        0)
+        if fn == "count":
+            return cnt, jnp.ones_like(cnt, jnp.bool_), dt.INT64
+        frame_valid = (cnt > 0) & nonempty
+        if fn in ("sum", "avg"):
+            x = jnp.where(valid_s, v_s, 0)
+            csum = _seg_scan(x, seg, jnp.add)
+            s = jnp.where(nonempty,
+                          jnp.take(csum, hic)
+                          - jnp.where(lo > start,
+                                      jnp.take(csum,
+                                               jnp.clip(lo - 1, 0,
+                                                        n - 1)),
+                                      0),
+                          0)
+            if fn == "avg":
+                cs = s.astype(jnp.float64)
+                if arg is not None and \
+                        arg.dtype.oid == dt.TypeOid.DECIMAL64:
+                    cs = cs / (10.0 ** arg.dtype.scale)
+                return cs / jnp.maximum(cnt, 1), frame_valid, dt.FLOAT64
+            out_t = (arg.dtype if arg.dtype.oid == dt.TypeOid.DECIMAL64
+                     else dt.INT64 if arg.dtype.is_integer
+                     else dt.FLOAT64)
+            return s.astype(out_t.jnp_dtype), frame_valid, out_t
+        # min / max over arbitrary in-partition ranges: sparse table
+        fill = jnp.asarray(A._reduce_fill(v_s.dtype, fn == "min"),
+                           v_s.dtype)
+        comb = jnp.minimum if fn == "min" else jnp.maximum
+        x = jnp.where(valid_s, v_s, fill)
+        levels = [x]
+        span = 1
+        while span * 2 <= n:
+            prev = levels[-1]
+            shifted = jnp.concatenate(
+                [prev[span:], jnp.full((span,), fill, x.dtype)])
+            levels.append(comb(prev, shifted))
+            span *= 2
+        st = jnp.stack(levels)                  # [L, n]
+        length = jnp.maximum(hi - lo + 1, 1)
+        # k = floor(log2(length)), exact via comparisons
+        k = jnp.zeros_like(length)
+        for j in range(1, len(levels)):
+            k = k + (length >= (1 << j)).astype(length.dtype)
+        right = jnp.clip(hi - ((jnp.int64(1) << k) - 1), 0, n - 1)
+        vals = comb(st[k, loc], st[k, right])
+        return jnp.where(frame_valid, vals, fill), frame_valid, \
+            (arg.dtype if arg is not None else dt.INT64)
 
     def _agg_window(self, fn, arg, ex, order, seg, mask_s, take_at):
         n = ex.padded_len

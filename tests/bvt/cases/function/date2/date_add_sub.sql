@@ -1,0 +1,6 @@
+create table d (id bigint primary key, dte date);
+insert into d values (1, date '2023-01-31'), (2, date '2024-02-29'), (3, NULL);
+select id, date_add(dte, interval 1 month), date_sub(dte, interval 1 month) from d order by id;
+select id, date_add(dte, interval 1 year), date_add(dte, interval 2 quarter) from d order by id;
+select id, adddate(dte, interval 10 day), subdate(dte, interval 1 week) from d order by id;
+select date_add(date '2023-06-15', interval 25 hour);

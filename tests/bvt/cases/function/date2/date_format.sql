@@ -1,0 +1,6 @@
+create table d (id bigint primary key, dte date);
+insert into d values (1, date '2023-01-05'), (2, date '2024-11-30');
+select id, date_format(dte, '%Y-%m-%d') from d order by id;
+select id, date_format(dte, '%M %D %W') from d order by id;
+select id, date_format(dte, '%y/%c/%e %j') from d order by id;
+select date_format(dte, '%Y') , count(*) from d group by date_format(dte, '%Y') order by 1;

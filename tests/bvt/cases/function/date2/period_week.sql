@@ -1,0 +1,4 @@
+select period_add(202311, 3), period_diff(202402, 202311);
+select yearweek(date '2023-01-01'), yearweek(date '2024-12-31');
+select makedate(2023, 32), makedate(2024, 366);
+select microsecond(date '2023-01-01');

@@ -1,0 +1,3 @@
+select str_to_date('2023-04-05', '%Y-%m-%d');
+select str_to_date('05/04/2023', '%d/%m/%Y');
+select str_to_date('garbage', '%Y-%m-%d');

@@ -1,0 +1,5 @@
+select timestampdiff(day, date '2023-01-01', date '2023-03-01');
+select timestampdiff(month, date '2023-01-31', date '2023-03-30');
+select timestampdiff(year, date '2020-06-15', date '2023-06-14');
+select timestampadd(hour, 26, date '2023-01-01');
+select timestampadd(month, 1, date '2023-01-31');

@@ -1,0 +1,4 @@
+create table c (id bigint primary key, n bigint, f double);
+insert into c values (1, 42, 3.7), (2, -5, -2.2);
+select id, convert(n, float), convert(f, bigint) from c order by id;
+select cast('123' as bigint) + 1;

@@ -1,0 +1,3 @@
+select format(1234567.891, 2), format(1234567.891, 0), format(3, 4);
+select bit_count(7), bit_count(0), bit_count(-1), bit_count(255);
+select sec_to_time(3661), sec_to_time(0), time_to_sec('02:30:15');

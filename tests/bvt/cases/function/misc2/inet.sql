@@ -1,0 +1,2 @@
+select inet_aton('192.168.0.1'), inet_aton('255.255.255.255'), inet_aton('bad.ip');
+select inet_ntoa(3232235521), inet_ntoa(0), inet_ntoa(4294967295);

@@ -1,0 +1,3 @@
+select version(), database();
+select rand(42) > 0, rand(42) < 1;
+select log(2, 8), log(10, 1000);

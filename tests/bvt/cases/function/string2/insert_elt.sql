@@ -1,0 +1,3 @@
+select insert('abcdef', 2, 3, 'XY'), insert('abc', 0, 1, 'Z'), insert('abc', 9, 1, 'Z');
+select elt(1, 'a', 'b'), elt(2, 'a', 'b'), elt(3, 'a', 'b');
+select concat_ws('-', 'x', 'y', 'z'), concat_ws('', 'a', 'b');

@@ -1,0 +1,5 @@
+create table s (id bigint primary key, t varchar(32));
+insert into s values (1, 'hello world'), (2, 'ab'), (3, NULL);
+select id, left(t, 5), right(t, 5) from s order by id;
+select id, left(t, 0), right(t, 99) from s order by id;
+select ord('A'), ord(''), ord('€');

@@ -1,0 +1,4 @@
+create table s (id bigint primary key, t varchar(32));
+insert into s values (1, 'a:b:c'), (2, 'one'), (3, 'x:y');
+select id, split_part(t, ':', 1), split_part(t, ':', 2), split_part(t, ':', 9) from s order by id;
+select octet_length('abc'), octet_length('héllo');

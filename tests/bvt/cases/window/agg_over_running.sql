@@ -1,0 +1,4 @@
+create table s (id bigint primary key, v bigint);
+insert into s values (1,5),(2,10),(3,15),(4,20);
+select id, sum(v) over (order by id), avg(v) over (order by id) from s order by id;
+select id, sum(v) over () from s order by id;

@@ -1,0 +1,5 @@
+create table e (id bigint primary key, dept varchar(8), sal bigint);
+insert into e values (1,'eng',100),(2,'eng',200),(3,'eng',150),(4,'ops',50),(5,'ops',80);
+select id, first_value(sal) over (partition by dept order by sal) from e order by id;
+select id, last_value(sal) over (partition by dept order by sal rows between unbounded preceding and unbounded following) from e order by id;
+select id, nth_value(sal, 2) over (partition by dept order by sal) from e order by id;

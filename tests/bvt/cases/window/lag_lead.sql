@@ -1,0 +1,5 @@
+create table e (id bigint primary key, dept varchar(8), sal bigint);
+insert into e values (1,'eng',100),(2,'eng',200),(3,'eng',150),(4,'ops',50),(5,'ops',80);
+select id, lag(sal) over (partition by dept order by id), lead(sal) over (partition by dept order by id) from e order by id;
+select id, lag(sal, 2, 0) over (partition by dept order by id) from e order by id;
+select id, lag(dept) over (order by id) from e order by id;

@@ -1,0 +1,4 @@
+create table t (id bigint primary key);
+insert into t values (1),(2),(3),(4),(5),(6),(7);
+select id, ntile(3) over (order by id) from t order by id;
+select id, ntile(10) over (order by id) from t order by id;

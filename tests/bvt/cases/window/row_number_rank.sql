@@ -1,0 +1,4 @@
+create table e (id bigint primary key, dept varchar(8), sal bigint);
+insert into e values (1,'eng',100),(2,'eng',200),(3,'eng',200),(4,'ops',50),(5,'ops',80),(6,'hr',90);
+select id, row_number() over (partition by dept order by sal desc) from e order by id;
+select id, rank() over (partition by dept order by sal desc), dense_rank() over (partition by dept order by sal desc) from e order by id;

@@ -1,0 +1,6 @@
+create table m (id bigint primary key, g bigint, v bigint);
+insert into m values (1,1,10),(2,1,30),(3,1,20),(4,2,5),(5,2,15),(6,2,25);
+select id, sum(v) over (partition by g order by id rows between 1 preceding and current row) from m order by id;
+select id, min(v) over (partition by g order by id rows between 1 preceding and 1 following), max(v) over (partition by g order by id rows between 1 preceding and 1 following) from m order by id;
+select id, count(*) over (order by id rows between 2 preceding and current row) from m order by id;
+select id, avg(v) over (partition by g order by id rows between unbounded preceding and current row) from m order by id;

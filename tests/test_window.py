@@ -136,3 +136,132 @@ def test_window_invalid_forms():
         s.execute("select count(distinct v) over (partition by g) from t")
     with pytest.raises(Exception, match="strings"):
         s.execute("select min(g) over (partition by v) from t")
+
+
+# ------------------------------------- r5: value functions + ROWS frames
+def _win_fixture():
+    s = Session()
+    s.execute("create table w (id bigint primary key, g bigint,"
+              " v bigint, nm varchar(8))")
+    rows = [(1, 1, 10, 'a'), (2, 1, 30, 'b'), (3, 1, 20, 'c'),
+            (4, 2, 5, 'd'), (5, 2, 15, 'e'), (6, 3, 7, 'f')]
+    s.execute("insert into w values " +
+              ",".join(f"({a},{b},{c},'{d}')" for a, b, c, d in rows))
+    return s
+
+
+def test_lag_lead():
+    s = _win_fixture()
+    got = s.execute(
+        "select id, lag(v) over (partition by g order by id),"
+        " lead(v) over (partition by g order by id),"
+        " lag(v, 2, -1) over (partition by g order by id)"
+        " from w order by id").rows()
+    assert got == [(1, None, 30, -1), (2, 10, 20, -1), (3, 30, None, 10),
+                   (4, None, 15, -1), (5, 5, None, -1),
+                   (6, None, None, -1)]
+
+
+def test_lag_over_strings():
+    s = _win_fixture()
+    got = s.execute(
+        "select id, lag(nm) over (partition by g order by id)"
+        " from w order by id").rows()
+    assert got == [(1, None), (2, 'a'), (3, 'b'), (4, None), (5, 'd'),
+                   (6, None)]
+
+
+def test_first_last_nth_value():
+    s = _win_fixture()
+    got = s.execute(
+        "select id, first_value(v) over (partition by g order by v),"
+        " last_value(v) over (partition by g order by v"
+        "   rows between unbounded preceding and unbounded following),"
+        " nth_value(v, 2) over (partition by g order by v)"
+        " from w order by id").rows()
+    # partition 1 ordered by v: 10,20,30; partition 2: 5,15; part 3: 7
+    assert got == [(1, 10, 30, None), (2, 10, 30, 20), (3, 10, 30, 20),
+                   (4, 5, 15, None), (5, 5, 15, 15), (6, 7, 7, None)]
+
+
+def test_ntile():
+    s = _win_fixture()
+    got = s.execute(
+        "select id, ntile(2) over (order by id) from w"
+        " order by id").rows()
+    # 6 rows, 2 buckets of 3
+    assert [r[1] for r in got] == [1, 1, 1, 2, 2, 2]
+    got3 = s.execute(
+        "select id, ntile(4) over (order by id) from w"
+        " order by id").rows()
+    # 6 rows, 4 buckets: sizes 2,2,1,1
+    assert [r[1] for r in got3] == [1, 1, 2, 2, 3, 4]
+
+
+def test_rows_frame_sum_avg_count():
+    s = _win_fixture()
+    got = s.execute(
+        "select id, sum(v) over (partition by g order by id"
+        "   rows between 1 preceding and current row),"
+        " count(*) over (order by id rows between 1 preceding"
+        "   and 1 following)"
+        " from w order by id").rows()
+    assert got == [(1, 10, 2), (2, 40, 3), (3, 50, 3),
+                   (4, 5, 3), (5, 20, 3), (6, 7, 2)]
+
+
+def test_rows_frame_min_max():
+    s = _win_fixture()
+    got = s.execute(
+        "select id, min(v) over (order by id rows between 2 preceding"
+        "   and current row),"
+        " max(v) over (order by id rows between current row"
+        "   and 2 following)"
+        " from w order by id").rows()
+    # v by id: 10,30,20,5,15,7 (no PARTITION BY: one global partition)
+    assert got == [(1, 10, 30), (2, 10, 30), (3, 10, 20),
+                   (4, 5, 15), (5, 5, 15), (6, 5, 7)]
+
+
+def test_rows_frame_vs_pandas_random():
+    import pandas as pd
+    s = Session()
+    s.execute("create table r (id bigint primary key, g bigint,"
+              " v double)")
+    rng = np.random.default_rng(11)
+    n = 500
+    gs = rng.integers(0, 7, n)
+    vs = np.round(rng.normal(size=n), 6)
+    s.execute("insert into r values " +
+              ",".join(f"({i},{gs[i]},{vs[i]})" for i in range(n)))
+    got = s.execute(
+        "select id, sum(v) over (partition by g order by id"
+        "   rows between 3 preceding and 1 following),"
+        " min(v) over (partition by g order by id"
+        "   rows between 2 preceding and 2 following)"
+        " from r order by id").rows()
+    # python-loop oracle (explicit frame semantics, partition-aware)
+    import collections
+    by_g = collections.defaultdict(list)
+    for i in range(n):
+        by_g[gs[i]].append(i)
+    exp = {}
+    for g, ids in by_g.items():
+        for j, i in enumerate(ids):
+            w5 = [vs[ids[t]] for t in range(max(0, j - 3),
+                                            min(len(ids), j + 2))]
+            w_min = [vs[ids[t]] for t in range(max(0, j - 2),
+                                               min(len(ids), j + 3))]
+            exp[i] = (sum(w5), min(w_min))
+    for (i, sm, mn) in got:
+        es, em = exp[int(i)]
+        assert abs(float(sm) - es) < 1e-9, (i, sm, es)
+        assert abs(float(mn) - em) < 1e-12, (i, mn, em)
+
+
+def test_frame_rejected_for_rank_funcs():
+    s = _win_fixture()
+    import pytest as _pt
+    with _pt.raises(Exception):
+        s.execute("select rank() over (order by id rows between"
+                  " 1 preceding and current row) from w")
