@@ -532,10 +532,13 @@ class FragmentServer:
                              daemon=True).start()
 
     def _handle(self, conn: socket.socket) -> None:
-        from matrixone_tpu.parallel.fragments import execute_fragment
+        from matrixone_tpu.parallel.fragments import (execute_fragment,
+                                                      run_shuffle_join,
+                                                      run_shuffle_scan,
+                                                      shuffle_store_for)
         try:
             while True:
-                header, _blob = _recv_msg(conn)
+                header, blob = _recv_msg(conn)
                 op = header.get("op")
                 if op == "ping":
                     _send_msg(conn, {"ok": True})
@@ -544,11 +547,34 @@ class FragmentServer:
                     _send_msg(conn, {"ok": True,
                                      "frags_run": self.frags_run})
                     continue
+                if op == "shuffle_put":
+                    # a peer pushing its bucket of a repartitioned side
+                    # (colexec/dispatch analogue)
+                    shuffle_store_for(self.catalog).put(
+                        str(header["shuffle_id"]), header["side"],
+                        int(header["from"]), int(header["to"]), blob)
+                    _send_msg(conn, {"ok": True})
+                    continue
+                if op == "shuffle_drop":
+                    # coordinator-ordered cleanup of a failed shuffle
+                    shuffle_store_for(self.catalog).drop_sid(
+                        str(header["shuffle_id"]))
+                    _send_msg(conn, {"ok": True})
+                    continue
                 if op != "run_fragment":
                     _send_msg(conn, {"ok": False, "err": f"bad op {op}"})
                     continue
                 try:
-                    resp, rblob = execute_fragment(self.catalog, header)
+                    kind = header.get("kind")
+                    if kind == "shuffle_scan":
+                        resp, rblob = run_shuffle_scan(self.catalog,
+                                                       header)
+                    elif kind == "shuffle_join":
+                        resp, rblob = run_shuffle_join(self.catalog,
+                                                       header)
+                    else:
+                        resp, rblob = execute_fragment(self.catalog,
+                                                       header)
                     self.frags_run += 1
                 except Exception as e:           # noqa: BLE001
                     resp, rblob = {"ok": False,

@@ -107,9 +107,10 @@ def execute_fragment(catalog, header: dict) -> Tuple[dict, bytes]:
     return resp, blob
 
 
-def _run_collect(op, schema) -> Tuple[dict, bytes]:
-    """Materialize the fragment's output rows: numpy columns, strings
-    decoded through each batch's dictionary (peer dicts never leave)."""
+def _collect_arrays(op, schema):
+    """Materialize the fragment's output rows as HOST arrays (strings
+    decoded through each batch's dictionary — peer dicts never leave).
+    -> (arrays, valid, n_total); empty dicts when no rows."""
     parts: List[dict] = []
     vparts: List[dict] = []
     n_total = 0
@@ -130,7 +131,7 @@ def _run_collect(op, schema) -> Tuple[dict, bytes]:
         parts.append(arrays)
         vparts.append(valid)
     if not parts:
-        return {"ok": True, "n": 0}, b""
+        return {}, {}, 0
     arrays = {}
     valid = {}
     for name, dtype in schema:
@@ -142,6 +143,13 @@ def _run_collect(op, schema) -> Tuple[dict, bytes]:
         else:
             arrays[name] = np.concatenate([p[name] for p in parts])
         valid[name] = np.concatenate([v[name] for v in vparts])
+    return arrays, valid, n_total
+
+
+def _run_collect(op, schema) -> Tuple[dict, bytes]:
+    arrays, valid, n_total = _collect_arrays(op, schema)
+    if n_total == 0:
+        return {"ok": True, "n": 0}, b""
     return ({"ok": True, "n": n_total},
             arrowio.arrays_to_ipc(arrays, valid))
 
@@ -248,11 +256,14 @@ _UPPER = (P.Project, P.TopK, P.Sort, P.Limit, P.Filter, P.Distinct)
 
 @dataclasses.dataclass
 class _Split:
-    kind: str                    # "agg" | "topk"
+    kind: str                    # "agg" | "topk" | "join"
     uppers: List[P.PlanNode]     # nodes above the split, root first
-    split: P.PlanNode            # the Aggregate / TopK at the split
+    split: P.PlanNode            # the Aggregate / TopK / Join at the split
     scan_path: List[str]         # attr path from fragment child to scan
     scan_table: str
+    # shuffle join only: the build (right) side's own sharded scan
+    right_path: Optional[List[str]] = None
+    right_table: Optional[str] = None
 
 
 def _find_scan_path(node) -> Optional[Tuple[List[str], str]]:
@@ -340,7 +351,47 @@ def plan_split(node, catalog, min_rows: int = 0) -> Optional[_Split]:
         except TypeError:
             return None
         return _Split("topk", uppers[:topk_at], tk, path, table)
+    # shuffle join (reference: plan/shuffle.go + colexec/shuffle): BOTH
+    # sides big — a broadcast/replica-resident build would be the wrong
+    # shape, so hash-repartition both sides across the peers by join key
+    # and join each bucket locally
+    if isinstance(cur, P.Join) and cur.kind == "inner" \
+            and cur.left_keys and not cur.residual:
+        from matrixone_tpu.sql.expr import BoundCol
+        if not all(isinstance(k, BoundCol)
+                   for k in cur.left_keys + cur.right_keys):
+            return None
+        lf = _scan_only_path(cur.left)
+        rf = _scan_only_path(cur.right)
+        if lf is None or rf is None:
+            return None
+        (lpath, ltab), (rpath, rtab) = lf, rf
+        if not (_table_big_enough(catalog, ltab, min_rows)
+                and _table_big_enough(catalog, rtab, min_rows)):
+            return None
+        try:
+            plan_to_json(cur.left)
+            plan_to_json(cur.right)
+        except TypeError:
+            return None
+        return _Split("join", uppers, cur, lpath, ltab,
+                      right_path=rpath, right_table=rtab)
     return None
+
+
+def _scan_only_path(node) -> Optional[Tuple[List[str], str]]:
+    """Scan path through Filter/Project ONLY (no joins below): each
+    shuffle side must be a single sharded table scan subtree."""
+    path: List[str] = []
+    cur = node
+    while True:
+        if isinstance(cur, P.Scan):
+            return path, cur.table
+        if isinstance(cur, (P.Filter, P.Project)):
+            path.append("child")
+            cur = cur.child
+            continue
+        return None
 
 
 def _table_big_enough(catalog, table: str, min_rows: int) -> bool:
@@ -349,6 +400,24 @@ def _table_big_enough(catalog, table: str, min_rows: int) -> bool:
         return t.n_rows >= min_rows
     except Exception:          # noqa: BLE001  (e.g. external table)
         return False
+
+
+def shard_of_peer(addrs, table: str) -> Dict[int, int]:
+    """Stable shard ownership (reference: pkg/shardservice
+    types.go:67 — table shards placed on CN subsets, reads routed to
+    owners). The peer membership comes from the keeper (launch.py wires
+    --peers from registered CNs); on top of it, each table's shards map
+    to peers by a deterministic hash permutation — so the SAME peer
+    always scans the SAME shard of a table across queries and
+    coordinators, keeping that shard's blocks warm in exactly one CN's
+    block cache (cache-sharded data placement: storage holds one copy
+    in the object store; ownership shards the CACHE, not the truth)."""
+    import hashlib
+    n = len(addrs)
+    perm = sorted(range(n), key=lambda i: hashlib.sha1(
+        f"{addrs[i]}|{table}".encode()).digest())
+    # perm[s] = peer owning shard s  ->  invert to peer -> shard
+    return {perm[s]: s for s in range(n)}
 
 
 def _set_shard(plan_json: dict, path: List[str], i: int, n: int) -> dict:
@@ -392,23 +461,29 @@ class FragmentPeers:
     on its first query, and a premature timeout silently downgrades the
     cluster to local execution."""
 
+    LANES = 2     # concurrent fragments per peer (shuffle L/R overlap)
+
     def __init__(self, addrs, timeout: float = 180.0):
         from matrixone_tpu.cluster.rpc import RpcClient
         self.addrs = list(addrs)
-        self.clients = [RpcClient(a, timeout=timeout)
+        self.clients = [[RpcClient(a, timeout=timeout)
+                         for _ in range(self.LANES)]
                         for a in self.addrs]
 
     def close(self) -> None:
-        for c in self.clients:
-            c.close()
+        for lanes in self.clients:
+            for c in lanes:
+                c.close()
 
     def run(self, headers: List[dict]) -> List[Tuple[dict, bytes]]:
+        n = len(self.addrs)
+
         def one(i):
-            c = self.clients[i % len(self.clients)]
+            c = self.clients[i % n][(i // n) % self.LANES]
             resp, blob = c.call({"op": "run_fragment", **headers[i]})
             if not resp.get("ok"):
                 raise RuntimeError(
-                    f"fragment on {self.addrs[i % len(self.addrs)]}: "
+                    f"fragment on {self.addrs[i % n]}: "
                     f"{resp.get('err')}")
             return resp, blob
         with futures.ThreadPoolExecutor(
@@ -449,8 +524,18 @@ def try_distribute(node, catalog, ctx, peers: FragmentPeers,
         # _dist_* catches one already in flight
         catalog.txn_opened(did)
         opened = True
-        snap = max(ctx.snapshot_ts or 0,
-                   getattr(catalog, "committed_ts", 0)) or None
+        consumer = getattr(catalog, "consumer", None)
+        if consumer is not None:
+            # coordinator is a CN replica: its committed_ts includes
+            # LOCAL-only commits (statement tracing writes into the
+            # replica's system tables) that never ride the logtail — a
+            # peer can never reach that ts. The replicated frontier is
+            # the consumer's applied position; everything the
+            # coordinator has seen of the SHARED tables is <= it.
+            snap = consumer.applied_ts or None
+        else:
+            snap = max(ctx.snapshot_ts or 0,
+                       getattr(catalog, "committed_ts", 0)) or None
         # forward session execution knobs so SET use_pallas behaves the
         # same distributed as local (no silent local/remote divergence)
         sess_vars = {k: v for k, v in (ctx.variables or {}).items()
@@ -458,6 +543,9 @@ def try_distribute(node, catalog, ctx, peers: FragmentPeers,
         if split.kind == "agg":
             mat = _dist_aggregate(split, catalog, snap, peers, batch_rows,
                                   sess_vars)
+        elif split.kind == "join":
+            mat = _dist_shuffle_join(split, catalog, snap, peers,
+                                     batch_rows, sess_vars)
         else:
             mat = _dist_topk(split, catalog, snap, peers, batch_rows,
                              sess_vars)
@@ -488,11 +576,13 @@ def _dist_aggregate(split: _Split, catalog, snap, peers: FragmentPeers,
     agg: P.Aggregate = split.split
     n = len(peers.addrs)
     child_json = plan_to_json(agg.child)
+    owners = shard_of_peer(peers.addrs, split.scan_table)
     headers = []
     for i in range(n):
         headers.append({
             "kind": "partial_agg",
-            "plan": _set_shard(child_json, split.scan_path, i, n),
+            "plan": _set_shard(child_json, split.scan_path,
+                               owners[i], n),
             "group_keys": [expr_to_json(k) for k in agg.group_keys],
             "aggs": [agg_to_json(a) for a in agg.aggs],
             "snapshot_ts": snap,
@@ -655,10 +745,12 @@ def _dist_topk(split: _Split, catalog, snap, peers: FragmentPeers,
     local = dataclasses.replace(tk, k=tk.k + tk.offset, offset=0)
     n = len(peers.addrs)
     tk_json = plan_to_json(local)
+    owners = shard_of_peer(peers.addrs, split.scan_table)
     # the sharded scan sits below the TopK: path starts at tk.child
     headers = [{
         "kind": "collect",
-        "plan": _set_shard(tk_json, ["child"] + split.scan_path, i, n),
+        "plan": _set_shard(tk_json, ["child"] + split.scan_path,
+                           owners[i], n),
         "snapshot_ts": snap,
         "batch_rows": batch_rows,
         "session_vars": sess_vars or {},
@@ -697,3 +789,347 @@ def _dist_topk(split: _Split, catalog, snap, peers: FragmentPeers,
             [np.asarray(v[name], bool) for _, v in parts])
     mat = P.Materialized(arrays, valid, tk.schema)
     return dataclasses.replace(tk, child=mat)
+
+
+# =====================================================================
+# shuffle join (reference: plan/shuffle.go determineShuffleMethod +
+# colexec/shuffle + dispatch): hash-repartition BOTH sides across the
+# peers by join key, each peer joins its bucket locally, the
+# coordinator concatenates. Exact for inner equi-joins: equal keys land
+# in the same bucket on both sides.
+# =====================================================================
+
+
+
+def _stable_row_hash(cols: List[object]) -> np.ndarray:
+    """Deterministic cross-process row hash of the join key columns
+    (strings included) — pandas' siphash with its fixed key, combined
+    across columns with an odd multiplier."""
+    import pandas as pd
+    out = None
+    for c in cols:
+        if isinstance(c, list):
+            arr = np.asarray(c, dtype=object)
+        else:
+            arr = np.asarray(c)
+            # width-normalize: hash_array(int32(-1)) != hash_array(
+            # int64(-1)) (pandas zero-extends small ints) — an
+            # int32-vs-bigint equi-join would silently drop matches
+            if arr.dtype.kind in ("i", "u", "b"):
+                arr = arr.astype(np.int64)
+            elif arr.dtype.kind == "f":
+                arr = arr.astype(np.float64)
+        h = pd.util.hash_array(arr, categorize=False)
+        out = h if out is None else (out * np.uint64(0x9E3779B1)) ^ h
+    return out
+
+
+class ShuffleStore:
+    """Peer-side mailbox for in-flight shuffle buckets, keyed by
+    (shuffle_id, side, to): receives pushes from every peer (including
+    the local short-circuit) and hands the join phase a complete set.
+    The destination index rides in the key so engines SHARED by several
+    in-process fragment servers (tests, embed clusters) keep each
+    recipient's buckets separate."""
+
+    def __init__(self):
+        import threading as _t
+        self._lock = _t.Lock()
+        self._cond = _t.Condition(self._lock)
+        self._buckets: Dict[tuple, Dict[int, bytes]] = {}
+        self._born: Dict[tuple, float] = {}
+
+    #: stale-mailbox TTL: buckets orphaned by a failed phase 1 (the
+    #: coordinator also sends an explicit shuffle_drop, but a dead
+    #: coordinator can't) are evicted on later traffic
+    TTL_S = 600.0
+
+    def put(self, shuffle_id, side: str, frm: int, to: int,
+            blob: bytes) -> None:
+        import time as _time
+        now = _time.monotonic()
+        with self._cond:
+            self._prune_locked(now)
+            self._buckets.setdefault(
+                (shuffle_id, side, to), {})[frm] = blob
+            self._born.setdefault((shuffle_id, side, to), now)
+            self._cond.notify_all()
+
+    def _prune_locked(self, now: float) -> None:
+        for k in [k for k, t0 in self._born.items()
+                  if now - t0 > self.TTL_S]:
+            self._buckets.pop(k, None)
+            self._born.pop(k, None)
+
+    def wait_all(self, shuffle_id, side: str, to: int, expect: int,
+                 timeout: float = 120.0) -> Dict[int, bytes]:
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        with self._cond:
+            while True:
+                got = self._buckets.get((shuffle_id, side, to), {})
+                if len(got) >= expect:
+                    return dict(got)
+                left = deadline - _time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"shuffle {shuffle_id}/{side}->{to}: "
+                        f"{len(got)}/{expect} buckets after timeout")
+                self._cond.wait(left)
+
+    def drop(self, shuffle_id, to: int) -> None:
+        with self._cond:
+            for k in [k for k in self._buckets
+                      if k[0] == shuffle_id and k[2] == to]:
+                del self._buckets[k]
+                self._born.pop(k, None)
+
+    def drop_sid(self, shuffle_id) -> None:
+        """Coordinator-ordered cleanup after a failed shuffle: every
+        bucket of the id, all destinations."""
+        with self._cond:
+            for k in [k for k in self._buckets if k[0] == shuffle_id]:
+                del self._buckets[k]
+                self._born.pop(k, None)
+
+
+def shuffle_store_for(catalog) -> ShuffleStore:
+    st = getattr(catalog, "_shuffle_store", None)
+    if st is None:
+        st = ShuffleStore()
+        catalog._shuffle_store = st
+    return st
+
+
+def _schema_to_json(schema) -> list:
+    from matrixone_tpu.storage.engine import schema_to_json
+    return schema_to_json(schema)
+
+
+def _schema_from_json(rows):
+    from matrixone_tpu.storage.engine import schema_from_json
+    return schema_from_json(rows)
+
+
+def run_shuffle_scan(catalog, header: dict) -> Tuple[dict, bytes]:
+    """Phase 1 (peer side): execute the sharded scan subtree, hash rows
+    into n buckets by join key, push each bucket to its owner peer
+    (direct CN->CN, not through the coordinator), keep own bucket."""
+    from matrixone_tpu.cluster.rpc import RpcClient
+    from matrixone_tpu.vm.compile import compile_plan
+    snapshot_ts = header.get("snapshot_ts")
+    consumer = getattr(catalog, "consumer", None)
+    if consumer is not None and snapshot_ts is not None:
+        consumer.wait_ts(snapshot_ts)
+    # the mailbox lives on the BASE catalog — the same object the
+    # fragment server uses for incoming shuffle_put pushes (a
+    # tenant-scoped wrapper would orphan the local bucket)
+    store = shuffle_store_for(catalog)
+    if header.get("account"):
+        from matrixone_tpu.frontend.auth import ScopedCatalog
+        catalog = ScopedCatalog(catalog, header["account"])
+    ctx = ExecContext(catalog=catalog, frozen_ts=snapshot_ts,
+                      variables={"batch_rows":
+                                 int(header.get("batch_rows", 1 << 16)),
+                                 **header.get("session_vars", {})})
+    plan = plan_from_json(header["plan"])
+    op = compile_plan(plan, ctx)
+    schema = plan.schema
+    key_names = header["key_names"]
+    n = int(header["n_buckets"])
+    me = int(header["my_index"])
+    sid = str(header["shuffle_id"])
+    side = header["side"]
+    sig = (table_signature(catalog, header["shard_table"], snapshot_ts)
+           if header.get("shard_table") else None)
+    # materialize the shard's rows host-side (strings decoded) —
+    # directly as arrays: only the per-destination BUCKETS serialize
+    arrays, valid, n_rows = _collect_arrays(op, schema)
+    if n_rows == 0:
+        arrays = {nm: ([] if d.is_varlen else np.zeros(0, d.np_dtype))
+                  for nm, d in schema}
+        valid = {nm: np.zeros(0, np.bool_) for nm, _ in schema}
+    if n_rows:
+        hashes = _stable_row_hash([arrays[k] for k in key_names])
+        buckets = (hashes % np.uint64(n)).astype(np.int64)
+    else:
+        buckets = np.zeros(0, np.int64)
+    sent = 0
+    for j in range(n):
+        rowsel = np.nonzero(buckets == j)[0]
+        ba = {}
+        bv = {}
+        for nm, d in schema:
+            if d.is_varlen:
+                src = arrays[nm]
+                ba[nm] = [src[int(r)] for r in rowsel]
+            else:
+                ba[nm] = np.asarray(arrays[nm])[rowsel]
+            bv[nm] = np.asarray(valid[nm])[rowsel]
+        bblob = arrowio.arrays_to_ipc(ba, bv)
+        if j == me:
+            store.put(sid, side, me, me, bblob)
+        else:
+            c = RpcClient(tuple(header["peer_addrs"][j]), timeout=60.0)
+            try:
+                r, _ = c.call({"op": "shuffle_put", "shuffle_id": sid,
+                               "side": side, "from": me, "to": j},
+                              bblob)
+                if not r.get("ok"):
+                    raise RuntimeError(r.get("err"))
+            finally:
+                c.close()
+            sent += len(rowsel)
+    out = {"ok": True, "n": n_rows, "pushed": sent}
+    if sig is not None:
+        after = table_signature(catalog, header["shard_table"],
+                                snapshot_ts)
+        if after != sig:
+            raise RuntimeError("table layout changed during shuffle "
+                               "scan (merge resync)")
+        out["table_sig"] = sig
+    return out, b""
+
+
+def run_shuffle_join(catalog, header: dict) -> Tuple[dict, bytes]:
+    """Phase 2 (peer side): assemble this peer's buckets of both sides,
+    run the join locally, return the joined rows."""
+    from matrixone_tpu.sql.serde import expr_from_json
+    from matrixone_tpu.vm.compile import compile_plan
+    store = shuffle_store_for(catalog)   # base catalog: same mailbox
+    # as the fragment server's shuffle_put handler
+    sid = str(header["shuffle_id"])
+    expect = int(header["n_buckets"])
+    me = int(header["my_index"])
+    lschema = _schema_from_json(header["left_schema"])
+    rschema = _schema_from_json(header["right_schema"])
+    try:
+        lparts = store.wait_all(sid, "L", me, expect)
+        rparts = store.wait_all(sid, "R", me, expect)
+        lmat = _concat_ipc_parts(lparts, lschema)
+        rmat = _concat_ipc_parts(rparts, rschema)
+    finally:
+        store.drop(sid, me)
+    join = P.Join(
+        kind="inner",
+        left=P.Materialized(lmat[0], lmat[1], lschema),
+        right=P.Materialized(rmat[0], rmat[1], rschema),
+        left_keys=[expr_from_json(k) for k in header["left_keys"]],
+        right_keys=[expr_from_json(k) for k in header["right_keys"]],
+        residual=None,
+        schema=_schema_from_json(header["out_schema"]))
+    ctx = ExecContext(catalog=catalog,
+                      variables={"batch_rows":
+                                 int(header.get("batch_rows", 1 << 16)),
+                                 **header.get("session_vars", {})})
+    op = compile_plan(join, ctx)
+    return _run_collect(op, join.schema)
+
+
+def _concat_ipc_parts(parts: Dict[int, bytes], schema):
+    arrays_l: Dict[str, list] = {nm: [] for nm, _ in schema}
+    valid_l: Dict[str, list] = {nm: [] for nm, _ in schema}
+    for frm in sorted(parts):
+        a, v = arrowio.ipc_to_arrays(parts[frm])
+        if not v:
+            continue
+        for nm, d in schema:
+            arrays_l[nm].append(a[nm])
+            valid_l[nm].append(np.asarray(v[nm]))
+    arrays = {}
+    valid = {}
+    for nm, d in schema:
+        if d.is_varlen:
+            merged: list = []
+            for p in arrays_l[nm]:
+                merged.extend(p)
+            arrays[nm] = merged
+        else:
+            arrays[nm] = (np.concatenate(arrays_l[nm]) if arrays_l[nm]
+                          else np.zeros(0, d.np_dtype))
+        valid[nm] = (np.concatenate(valid_l[nm]) if valid_l[nm]
+                     else np.zeros(0, np.bool_))
+    return arrays, valid
+
+
+def _shuffle_cleanup(peers: "FragmentPeers", sid) -> None:
+    """Best-effort mailbox cleanup after a failed shuffle: peers with
+    delivered buckets must not hold them until TTL (leak under repeated
+    failing queries)."""
+    from matrixone_tpu.cluster.rpc import RpcClient, parse_addr
+    for a in peers.addrs:
+        try:
+            c = RpcClient(parse_addr(a), timeout=5.0)
+            try:
+                c.call({"op": "shuffle_drop", "shuffle_id": sid})
+            finally:
+                c.close()
+        except Exception:      # noqa: BLE001 — cleanup is best-effort
+            pass
+
+
+def _dist_shuffle_join(split: _Split, catalog, snap,
+                       peers: FragmentPeers, batch_rows: int,
+                       sess_vars=None) -> P.Materialized:
+    from matrixone_tpu.cluster.rpc import parse_addr
+    import uuid as _uuid
+    join: P.Join = split.split
+    n = len(peers.addrs)
+    # globally unique: several CN coordinators may shuffle concurrently
+    # through the same peers — a per-process counter would mix their
+    # mailboxes
+    sid = _uuid.uuid4().hex
+    peer_addrs = [list(parse_addr(a)) for a in peers.addrs]
+    lkeys = [k.name for k in join.left_keys]
+    rkeys = [k.name for k in join.right_keys]
+    ljson = plan_to_json(join.left)
+    rjson = plan_to_json(join.right)
+    common = {
+        "snapshot_ts": snap, "batch_rows": batch_rows,
+        "session_vars": sess_vars or {},
+        "account": getattr(catalog, "_acct", None),
+        "shuffle_id": sid, "n_buckets": n, "peer_addrs": peer_addrs,
+    }
+    # phase 1: both sides scatter concurrently (all 2n fragments in one
+    # pool run — the left side's buckets stream while the right scans)
+    lowners = shard_of_peer(peers.addrs, split.scan_table)
+    rowners = shard_of_peer(peers.addrs, split.right_table)
+    headers = []
+    for i in range(n):
+        headers.append({**common, "kind": "shuffle_scan",
+                        "plan": _set_shard(ljson, split.scan_path,
+                                           lowners[i], n),
+                        "side": "L", "my_index": i,
+                        "key_names": lkeys,
+                        "shard_table": split.scan_table})
+    for i in range(n):
+        headers.append({**common, "kind": "shuffle_scan",
+                        "plan": _set_shard(rjson, split.right_path,
+                                           rowners[i], n),
+                        "side": "R", "my_index": i,
+                        "key_names": rkeys,
+                        "shard_table": split.right_table})
+    try:
+        results = peers.run(headers)
+        _check_sigs(results[:n], peers.addrs)
+        _check_sigs(results[n:], peers.addrs)
+    except Exception:
+        _shuffle_cleanup(peers, sid)
+        raise
+    # phase 2: every peer joins its bucket
+    jheaders = [{**common, "kind": "shuffle_join", "my_index": i,
+                 "left_schema": _schema_to_json(join.left.schema),
+                 "right_schema": _schema_to_json(join.right.schema),
+                 "out_schema": _schema_to_json(join.schema),
+                 "left_keys": [expr_to_json(k) for k in join.left_keys],
+                 "right_keys": [expr_to_json(k) for k in join.right_keys]}
+                for i in range(n)]
+    try:
+        jres = peers.run(jheaders)
+    except Exception:
+        _shuffle_cleanup(peers, sid)
+        raise
+    parts = {i: blob for i, (resp, blob) in enumerate(jres)
+             if resp.get("n", 0) > 0}
+    arrays, valid = _concat_ipc_parts(parts, join.schema)
+    return P.Materialized(arrays, valid, join.schema)
