@@ -427,10 +427,12 @@ class RemoteCatalog:
                    "if_exists": if_exists})
 
     def create_external(self, meta, location: str, fmt: str, log=True,
-                        if_not_exists: bool = False) -> None:
+                        if_not_exists: bool = False,
+                        snapshot=None) -> None:
         self._ddl({"op": "create_external", "name": meta.name,
                    "schema": schema_to_json(meta.schema),
                    "location": location, "fmt": fmt,
+                   "snapshot": snapshot,
                    "if_not_exists": if_not_exists})
 
     def create_publication(self, name, tables, log=True) -> None:

@@ -334,7 +334,8 @@ class TNService:
                 TableMeta(rec["name"], schema_from_json(rec["schema"]),
                           []),
                 rec["location"], rec["fmt"],
-                if_not_exists=rec.get("if_not_exists", False))
+                if_not_exists=rec.get("if_not_exists", False),
+                snapshot=rec.get("snapshot"))
         elif op == "create_stage":
             eng.create_stage(rec["name"], rec["url"])
         elif op == "drop_stage":

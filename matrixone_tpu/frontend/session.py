@@ -290,8 +290,11 @@ class Session:
             schema = [(c.name, type_from_name(c.type_name, c.type_args))
                       for c in stmt.columns]
             fmt = _resolve_format(stmt.fmt, stmt.location)
+            if stmt.snapshot is not None and fmt != "iceberg":
+                raise BindError("SNAPSHOT applies to FORMAT iceberg only")
             self.catalog.create_external(
-                TableMeta(stmt.name, schema, []), stmt.location, fmt)
+                TableMeta(stmt.name, schema, []), stmt.location, fmt,
+                snapshot=stmt.snapshot)
             return Result()
         if isinstance(stmt, ast.ShowProcesslist):
             # tenant isolation (reference: authenticate.go account
@@ -991,6 +994,10 @@ class Session:
         import pyarrow.parquet as papq
         from matrixone_tpu.storage.external import open_location
         fmt = _resolve_format(stmt.fmt, stmt.path)
+        if fmt == "iceberg":
+            raise BindError(
+                "LOAD DATA does not support FORMAT iceberg; create an "
+                "external table over it and INSERT ... SELECT instead")
         src = open_location(self.catalog, stmt.path)
         tbl = (papq.read_table(src) if fmt == "parquet"
                else pacsv.read_csv(src))
@@ -1272,7 +1279,7 @@ def _resolve_format(fmt: str, location: str) -> str:
     the two DDL paths cannot drift; always a BindError on bad input)."""
     if not fmt:
         fmt = "parquet" if location.endswith(".parquet") else "csv"
-    if fmt not in ("csv", "parquet"):
+    if fmt not in ("csv", "parquet", "iceberg"):
         raise BindError(f"unsupported external format {fmt!r}")
     return fmt
 

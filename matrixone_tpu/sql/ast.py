@@ -376,6 +376,7 @@ class CreateExternalTable(Node):
     columns: List["ColumnDef"]
     location: str
     fmt: str
+    snapshot: Optional[int] = None   # iceberg time travel
 
 
 @dataclasses.dataclass

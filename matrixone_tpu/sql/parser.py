@@ -630,11 +630,20 @@ class Parser:
                 raise ParseError("LOCATION must be a string")
             location = tok.value
             fmt = ""
+            snap = None
             t = self.peek()
             if t.kind == "ident" and t.value.lower() == "format":
                 self.next()
                 fmt = self.ident().lower()
-            return ast.CreateExternalTable(name, cols, location, fmt)
+            if self.at_kw("snapshot"):
+                # iceberg time travel: ... FORMAT iceberg SNAPSHOT <id>
+                self.next()
+                tok = self.next()
+                if tok.kind != "int":
+                    raise ParseError("SNAPSHOT requires an integer id")
+                snap = int(tok.value)
+            return ast.CreateExternalTable(name, cols, location, fmt,
+                                           snapshot=snap)
         if self.accept_kw("table"):
             if_not = False
             if self.accept_kw("if"):

@@ -1,0 +1,272 @@
+"""Minimal Apache Avro object-container codec (generic, schema-driven).
+
+Iceberg's manifest-list and manifest files are Avro object containers
+(`/root/reference/pkg/iceberg/` reads them through goavro); this image
+ships no Avro library, so the subset the Iceberg read path needs is
+implemented natively: the container framing (magic, metadata map, sync
+markers, deflate/null codecs) and the generic binary encoding for
+records, unions, arrays, maps and all primitives. Decoding is driven by
+the WRITER schema embedded in the file header, so any spec-compliant
+producer (pyiceberg, Java, our own fixture writer) round-trips.
+
+Spec: https://avro.apache.org/docs/current/specification/ (format is
+public; implementation is from the spec, not from any codebase).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+_MAGIC = b"Obj\x01"
+
+
+class AvroError(ValueError):
+    pass
+
+
+# ------------------------------------------------------------ primitives
+def _read_long(buf: io.BytesIO) -> int:
+    """Zigzag varint."""
+    shift = 0
+    acc = 0
+    while True:
+        b = buf.read(1)
+        if not b:
+            raise AvroError("truncated varint")
+        byte = b[0]
+        acc |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1)
+
+
+def _write_long(out: io.BytesIO, v: int) -> None:
+    v = (v << 1) ^ (v >> 63)
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.write(bytes([b | 0x80]))
+        else:
+            out.write(bytes([b]))
+            break
+
+
+def _read_bytes(buf: io.BytesIO) -> bytes:
+    n = _read_long(buf)
+    data = buf.read(n)
+    if len(data) != n:
+        raise AvroError("truncated bytes")
+    return data
+
+
+def _write_bytes(out: io.BytesIO, b: bytes) -> None:
+    _write_long(out, len(b))
+    out.write(b)
+
+
+# ------------------------------------------------------- schema decoding
+def _decode(schema, buf: io.BytesIO):
+    """Generic value decode per the (JSON-decoded) writer schema."""
+    if isinstance(schema, str):
+        t = schema
+        if t == "null":
+            return None
+        if t == "boolean":
+            return buf.read(1) == b"\x01"
+        if t in ("int", "long"):
+            return _read_long(buf)
+        if t == "float":
+            return struct.unpack("<f", buf.read(4))[0]
+        if t == "double":
+            return struct.unpack("<d", buf.read(8))[0]
+        if t == "bytes":
+            return _read_bytes(buf)
+        if t == "string":
+            return _read_bytes(buf).decode()
+        raise AvroError(f"unknown primitive {t!r}")
+    if isinstance(schema, list):                  # union
+        idx = _read_long(buf)
+        if not 0 <= idx < len(schema):
+            raise AvroError(f"bad union index {idx}")
+        return _decode(schema[idx], buf)
+    t = schema["type"]
+    if t == "record":
+        return {f["name"]: _decode(f["type"], buf)
+                for f in schema["fields"]}
+    if t == "array":
+        out = []
+        while True:
+            n = _read_long(buf)
+            if n == 0:
+                break
+            if n < 0:                             # block size present
+                _read_long(buf)
+                n = -n
+            for _ in range(n):
+                out.append(_decode(schema["items"], buf))
+        return out
+    if t == "map":
+        out = {}
+        while True:
+            n = _read_long(buf)
+            if n == 0:
+                break
+            if n < 0:
+                _read_long(buf)
+                n = -n
+            for _ in range(n):
+                k = _read_bytes(buf).decode()
+                out[k] = _decode(schema["values"], buf)
+        return out
+    if t == "enum":
+        return schema["symbols"][_read_long(buf)]
+    if t == "fixed":
+        return buf.read(schema["size"])
+    return _decode(t, buf)                        # {"type": "string"} etc.
+
+
+def _encode(schema, v, out: io.BytesIO) -> None:
+    if isinstance(schema, str):
+        t = schema
+        if t == "null":
+            return
+        if t == "boolean":
+            out.write(b"\x01" if v else b"\x00")
+            return
+        if t in ("int", "long"):
+            _write_long(out, int(v))
+            return
+        if t == "float":
+            out.write(struct.pack("<f", float(v)))
+            return
+        if t == "double":
+            out.write(struct.pack("<d", float(v)))
+            return
+        if t == "bytes":
+            _write_bytes(out, bytes(v))
+            return
+        if t == "string":
+            _write_bytes(out, str(v).encode())
+            return
+        raise AvroError(f"unknown primitive {t!r}")
+    if isinstance(schema, list):                  # union: match by value
+        for i, branch in enumerate(schema):
+            if _matches(branch, v):
+                _write_long(out, i)
+                _encode(branch, v, out)
+                return
+        raise AvroError(f"no union branch for {v!r} in {schema}")
+    t = schema["type"]
+    if t == "record":
+        for f in schema["fields"]:
+            _encode(f["type"], (v or {}).get(f["name"]), out)
+        return
+    if t == "array":
+        if v:
+            _write_long(out, len(v))
+            for item in v:
+                _encode(schema["items"], item, out)
+        _write_long(out, 0)
+        return
+    if t == "map":
+        if v:
+            _write_long(out, len(v))
+            for k, val in v.items():
+                _write_bytes(out, str(k).encode())
+                _encode(schema["values"], val, out)
+        _write_long(out, 0)
+        return
+    if t == "enum":
+        _write_long(out, schema["symbols"].index(v))
+        return
+    if t == "fixed":
+        out.write(bytes(v))
+        return
+    _encode(t, v, out)
+
+
+def _matches(branch, v) -> bool:
+    if branch == "null" or (isinstance(branch, dict)
+                            and branch.get("type") == "null"):
+        return v is None
+    if v is None:
+        return False
+    if isinstance(branch, str):
+        types = {"boolean": bool, "int": int, "long": int,
+                 "float": (float, int), "double": (float, int),
+                 "bytes": (bytes, bytearray), "string": str}
+        py = types.get(branch)
+        return py is not None and isinstance(v, py)
+    t = branch.get("type")
+    if t == "record":
+        return isinstance(v, dict)
+    if t == "array":
+        return isinstance(v, list)
+    if t == "map":
+        return isinstance(v, dict)
+    if t in ("enum",):
+        return isinstance(v, str)
+    if t == "fixed":
+        return isinstance(v, (bytes, bytearray))
+    return True
+
+
+# ---------------------------------------------------------- file framing
+def read_container(blob: bytes) -> Tuple[dict, List[Any]]:
+    """-> (writer schema, records) of one Avro object container."""
+    buf = io.BytesIO(blob)
+    if buf.read(4) != _MAGIC:
+        raise AvroError("bad avro magic")
+    meta = _decode({"type": "map", "values": "bytes"}, buf)
+    schema = json.loads(meta["avro.schema"].decode())
+    codec = meta.get("avro.codec", b"null").decode()
+    sync = buf.read(16)
+    records: List[Any] = []
+    while True:
+        head = buf.read(1)
+        if not head:
+            break
+        buf.seek(-1, io.SEEK_CUR)
+        n = _read_long(buf)
+        size = _read_long(buf)
+        data = buf.read(size)
+        if codec == "deflate":
+            data = zlib.decompress(data, -15)
+        elif codec != "null":
+            raise AvroError(f"unsupported codec {codec!r}")
+        block = io.BytesIO(data)
+        for _ in range(n):
+            records.append(_decode(schema, block))
+        if buf.read(16) != sync:
+            raise AvroError("sync marker mismatch")
+    return schema, records
+
+
+def write_container(schema: dict, records: List[Any],
+                    codec: str = "deflate") -> bytes:
+    out = io.BytesIO()
+    out.write(_MAGIC)
+    meta = {"avro.schema": json.dumps(schema).encode(),
+            "avro.codec": codec.encode()}
+    _encode({"type": "map", "values": "bytes"}, meta, out)
+    sync = os.urandom(16)
+    out.write(sync)
+    body = io.BytesIO()
+    for r in records:
+        _encode(schema, r, body)
+    data = body.getvalue()
+    if codec == "deflate":
+        comp = zlib.compressobj(wbits=-15)
+        data = comp.compress(data) + comp.flush()
+    _write_long(out, len(records))
+    _write_long(out, len(data))
+    out.write(data)
+    out.write(sync)
+    return out.getvalue()

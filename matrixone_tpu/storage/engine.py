@@ -746,19 +746,21 @@ class Engine:
                              "ts": self.hlc.now()})
 
     def create_external(self, meta: TableMeta, location: str, fmt: str,
-                        log: bool = True, if_not_exists: bool = False):
+                        log: bool = True, if_not_exists: bool = False,
+                        snapshot=None):
         """Register an external (scan-in-place, read-only) table —
-        colexec/external role; see storage/external.py."""
+        colexec/external + iceberg roles; see storage/external.py."""
         from matrixone_tpu.storage.external import ExternalTable
         if meta.name in self.tables:
             if if_not_exists:
                 return
             raise ValueError(f"table {meta.name} already exists")
-        t = ExternalTable(meta, location, fmt, engine=self)
+        t = ExternalTable(meta, location, fmt, engine=self,
+                          snapshot=snapshot)
         self.tables[meta.name] = t
         if log:
             self.wal.append({"op": "create_external", "name": meta.name,
-                             "ts": self.hlc.now(),
+                             "ts": self.hlc.now(), "snapshot": snapshot,
                              "location": location, "fmt": fmt,
                              "schema": schema_to_json(meta.schema)})
 
@@ -1134,6 +1136,7 @@ class Engine:
             if getattr(t, "is_external", False):
                 manifest["externals"][name] = {
                     "location": t.location, "fmt": t.fmt,
+                    "snapshot": getattr(t, "snapshot", None),
                     "schema": schema_to_json(t.meta.schema)}
                 continue
             objs = []
@@ -1230,7 +1233,8 @@ class Engine:
         for name, ex in manifest.get("externals", {}).items():
             schema = schema_from_json(ex["schema"])
             self.create_external(TableMeta(name, schema, []),
-                                 ex["location"], ex["fmt"], log=False)
+                                 ex["location"], ex["fmt"], log=False,
+                                 snapshot=ex.get("snapshot"))
         for name, tm in manifest["tables"].items():
             self._load_manifest_table(name, tm)
 
@@ -1358,7 +1362,8 @@ class WalApplier:
             schema = schema_from_json(header["schema"])
             eng.create_external(TableMeta(header["name"], schema, []),
                                 header["location"], header["fmt"],
-                                log=False, if_not_exists=True)
+                                log=False, if_not_exists=True,
+                                snapshot=header.get("snapshot"))
         elif op == "create_stage":
             eng.stages[header["name"]] = header["url"]
         elif op == "drop_stage":

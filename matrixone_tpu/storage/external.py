@@ -122,12 +122,14 @@ class ExternalTable:
     is_external = True
 
     def __init__(self, meta: TableMeta, location: str, fmt: str,
-                 engine=None):
-        if fmt not in ("parquet", "csv"):
+                 engine=None, snapshot=None):
+        if fmt not in ("parquet", "csv", "iceberg"):
             raise ExternalError(f"unsupported external format {fmt!r}")
         self.meta = meta
         self.location = location
         self.fmt = fmt
+        #: iceberg time travel: pinned snapshot id (None = current)
+        self.snapshot = snapshot
         self.engine = engine
         self.dicts: Dict[str, List[str]] = {
             c: [] for c, d in meta.schema if d.is_varlen}
@@ -175,8 +177,25 @@ class ExternalTable:
         reference's parquet predicate pushdown (external.go + readutil)."""
         import pyarrow.csv as pacsv
         import pyarrow.parquet as papq
-        src = self._open()
         want = [c for c in columns if c != "__rowid"]
+        if self.fmt == "iceberg":
+            # iceberg table dir: snapshot -> manifests -> live parquet
+            # files, partition-pruned BEFORE any file is opened
+            from matrixone_tpu.storage import iceberg as ib
+            meta = ib.load_table(self._iceberg_root())
+            files = ib.data_files(meta, self.snapshot)
+            files = ib.prune_files(files, filters, qmap)
+            for df in files:
+                pf = papq.ParquetFile(df.path)
+                for rg in range(pf.metadata.num_row_groups):
+                    if filters and _rg_excluded(
+                            pf.metadata.row_group(rg),
+                            pf.schema_arrow.names, filters, qmap):
+                        continue
+                    tbl = pf.read_row_group(rg, columns=want)
+                    yield from tbl.to_batches(max_chunksize=batch_rows)
+            return
+        src = self._open()
         if self.fmt == "parquet":
             pf = papq.ParquetFile(src)
             for rg in range(pf.metadata.num_row_groups):
@@ -215,9 +234,27 @@ class ExternalTable:
     def _cache_budget() -> int:
         return int(os.environ.get("MO_EXTERNAL_CACHE_MB", "256")) << 20
 
+    def _iceberg_root(self) -> str:
+        url = resolve_location(self.location,
+                               getattr(self.engine, "stages", {})
+                               if self.engine is not None else {})
+        if url.startswith("file://"):
+            url = url[len("file://"):]
+        return url
+
     def _stat_sig(self):
         """(mtime_ns, size) of the backing LOCAL file, or None when the
-        location is not statable (fs://, stage->fs) — those stream."""
+        location is not statable (fs://, stage->fs) — those stream.
+        Iceberg tables key on the metadata json (a commit writes a new
+        one)."""
+        if self.fmt == "iceberg":
+            try:
+                from matrixone_tpu.storage import iceberg as ib
+                meta = ib.load_table(self._iceberg_root())
+                st = os.stat(meta.metadata_path)
+                return (st.st_mtime_ns, st.st_size, self.snapshot)
+            except Exception:          # noqa: BLE001
+                return None
         try:
             url = resolve_location(self.location,
                                    getattr(self.engine, "stages", {})

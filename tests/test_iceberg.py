@@ -1,0 +1,284 @@
+"""Iceberg read path (VERDICT r4 Next #7; reference: pkg/iceberg 44k +
+pkg/sql/iceberg 22k — the read-only first slice).
+
+pyiceberg is not in this image, so the fixture is written by a
+spec-following generator in this file (real Avro object containers via
+storage/avro.py, real parquet via pyarrow, v2 metadata JSON). The Avro
+layer round-trips the GENERIC encoding, so a table written by any
+compliant producer parses the same way.
+"""
+
+import json
+import os
+import uuid
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pytest
+
+from matrixone_tpu.frontend import Session
+from matrixone_tpu.storage import avro as avrolib, iceberg as ib
+
+
+# ------------------------------------------------------- fixture writer
+_MANIFEST_SCHEMA = {
+    "type": "record", "name": "manifest_entry", "fields": [
+        {"name": "status", "type": "int"},
+        {"name": "snapshot_id", "type": ["null", "long"]},
+        {"name": "data_file", "type": {
+            "type": "record", "name": "r2", "fields": [
+                {"name": "file_path", "type": "string"},
+                {"name": "file_format", "type": "string"},
+                {"name": "partition", "type": {
+                    "type": "record", "name": "r102", "fields": [
+                        {"name": "region", "type": ["null", "string"]},
+                    ]}},
+                {"name": "record_count", "type": "long"},
+                {"name": "file_size_in_bytes", "type": "long"},
+            ]}},
+    ]}
+
+_MANIFEST_LIST_SCHEMA = {
+    "type": "record", "name": "manifest_file", "fields": [
+        {"name": "manifest_path", "type": "string"},
+        {"name": "manifest_length", "type": "long"},
+        {"name": "partition_spec_id", "type": "int"},
+        {"name": "added_snapshot_id", "type": ["null", "long"]},
+    ]}
+
+
+def _write_iceberg_table(root: str, with_second_snapshot: bool = True):
+    """A partitioned (identity on `region`) two-snapshot table."""
+    os.makedirs(os.path.join(root, "metadata"), exist_ok=True)
+    os.makedirs(os.path.join(root, "data"), exist_ok=True)
+
+    def data_file(name, ids, vals, region):
+        path = os.path.join(root, "data", name)
+        t = pa.table({"id": pa.array(ids, pa.int64()),
+                      "val": pa.array(vals, pa.int64()),
+                      "region": pa.array([region] * len(ids))})
+        papq.write_table(t, path)
+        return path, len(ids)
+
+    f1, n1 = data_file("r_east_1.parquet", [1, 2, 3], [10, 20, 30],
+                       "east")
+    f2, n2 = data_file("r_west_1.parquet", [4, 5], [40, 50], "west")
+
+    def manifest(name, entries):
+        path = os.path.join(root, "metadata", name)
+        with open(path, "wb") as f:
+            f.write(avrolib.write_container(_MANIFEST_SCHEMA, entries))
+        return path
+
+    def mlist(name, manifests):
+        path = os.path.join(root, "metadata", name)
+        recs = [{"manifest_path": m, "manifest_length": os.path.getsize(m),
+                 "partition_spec_id": 0, "added_snapshot_id": 1}
+                for m in manifests]
+        with open(path, "wb") as f:
+            f.write(avrolib.write_container(_MANIFEST_LIST_SCHEMA, recs))
+        return path
+
+    def entry(path, n, region, status=1):
+        return {"status": status, "snapshot_id": 1,
+                "data_file": {"file_path": path,
+                              "file_format": "PARQUET",
+                              "partition": {"region": region},
+                              "record_count": n,
+                              "file_size_in_bytes": os.path.getsize(path)}}
+
+    m1 = manifest("m1.avro", [entry(f1, n1, "east"),
+                              entry(f2, n2, "west")])
+    ml1 = mlist("snap-1.avro", [m1])
+
+    snapshots = [{"snapshot-id": 1, "timestamp-ms": 1000,
+                  "manifest-list": ml1}]
+    current = 1
+    if with_second_snapshot:
+        f3, n3 = data_file("r_east_2.parquet", [6, 7], [60, 70], "east")
+        m2 = manifest("m2.avro", [entry(f1, n1, "east", status=0),
+                                  entry(f2, n2, "west", status=0),
+                                  entry(f3, n3, "east")])
+        ml2 = mlist("snap-2.avro", [m2])
+        snapshots.append({"snapshot-id": 2, "timestamp-ms": 2000,
+                          "manifest-list": ml2})
+        current = 2
+
+    md = {
+        "format-version": 2,
+        "table-uuid": str(uuid.uuid4()),
+        "location": root,
+        "current-snapshot-id": current,
+        "snapshots": snapshots,
+        "current-schema-id": 0,
+        "schemas": [{"schema-id": 0, "type": "struct", "fields": [
+            {"id": 1, "name": "id", "required": True, "type": "long"},
+            {"id": 2, "name": "val", "required": False, "type": "long"},
+            {"id": 3, "name": "region", "required": False,
+             "type": "string"},
+        ]}],
+        "default-spec-id": 0,
+        "partition-specs": [{"spec-id": 0, "fields": [
+            {"name": "region", "transform": "identity", "source-id": 3,
+             "field-id": 1000}]}],
+    }
+    with open(os.path.join(root, "metadata", "v2.metadata.json"),
+              "w") as f:
+        json.dump(md, f)
+    with open(os.path.join(root, "metadata", "version-hint.text"),
+              "w") as f:
+        f.write("2")
+    return root
+
+
+# ---------------------------------------------------------------- tests
+def test_avro_roundtrip():
+    schema = {"type": "record", "name": "t", "fields": [
+        {"name": "a", "type": "long"},
+        {"name": "s", "type": ["null", "string"]},
+        {"name": "xs", "type": {"type": "array", "items": "int"}},
+        {"name": "m", "type": {"type": "map", "values": "double"}},
+        {"name": "b", "type": "boolean"},
+    ]}
+    recs = [{"a": -12345678901, "s": "héllo", "xs": [1, -2, 3],
+             "m": {"x": 1.5}, "b": True},
+            {"a": 0, "s": None, "xs": [], "m": {}, "b": False}]
+    for codec in ("null", "deflate"):
+        blob = avrolib.write_container(schema, recs, codec=codec)
+        s2, got = avrolib.read_container(blob)
+        assert got == recs
+        assert s2["name"] == "t"
+
+
+def test_metadata_and_snapshots(tmp_path):
+    root = _write_iceberg_table(str(tmp_path / "tbl"))
+    meta = ib.load_table(root)
+    assert meta.current_snapshot_id == 2
+    assert set(meta.snapshots) == {1, 2}
+    assert meta.partition_fields == [("region", "identity")]
+    files = ib.data_files(meta)
+    assert len(files) == 3
+    files1 = ib.data_files(meta, snapshot_id=1)
+    assert len(files1) == 2
+
+
+def test_sql_end_to_end(tmp_path):
+    root = _write_iceberg_table(str(tmp_path / "tbl"))
+    s = Session()
+    s.execute(f"create external table ice (id bigint, val bigint,"
+              f" region varchar(16)) location '{root}' format iceberg")
+    rows = s.execute("select id, val, region from ice order by id").rows()
+    assert rows == [(1, 10, "east"), (2, 20, "east"), (3, 30, "east"),
+                    (4, 40, "west"), (5, 50, "west"), (6, 60, "east"),
+                    (7, 70, "east")]
+    # aggregates + joins work like any table
+    assert s.execute("select region, sum(val) from ice group by region"
+                     " order by region").rows() == \
+        [("east", 190), ("west", 90)]
+
+
+def test_time_travel_snapshot(tmp_path):
+    root = _write_iceberg_table(str(tmp_path / "tbl"))
+    s = Session()
+    s.execute(f"create external table ice_v1 (id bigint, val bigint,"
+              f" region varchar(16)) location '{root}' format iceberg"
+              f" snapshot 1")
+    rows = s.execute("select id from ice_v1 order by id").rows()
+    assert [int(r[0]) for r in rows] == [1, 2, 3, 4, 5]
+
+
+def test_partition_pruning_skips_files(tmp_path, monkeypatch):
+    root = _write_iceberg_table(str(tmp_path / "tbl"))
+    meta = ib.load_table(root)
+    files = ib.data_files(meta)
+    from matrixone_tpu.sql.expr import BoundCol, BoundFunc, BoundLiteral
+    from matrixone_tpu.container import dtypes as dt
+    flt = [BoundFunc("eq", [BoundCol("region", dt.VARCHAR),
+                            BoundLiteral("west", dt.VARCHAR)], dt.BOOL)]
+    kept = ib.prune_files(files, flt, {"region": "region"})
+    assert len(kept) == 1 and kept[0].partition["region"] == "west"
+    # and through SQL: only matching rows come back
+    s = Session()
+    s.execute(f"create external table ice (id bigint, val bigint,"
+              f" region varchar(16)) location '{root}' format iceberg")
+    rows = s.execute("select id from ice where region = 'west'"
+                     " order by id").rows()
+    assert [int(r[0]) for r in rows] == [4, 5]
+
+
+def test_deleted_entries_dropped(tmp_path):
+    """A status=2 (deleted) manifest entry must not be scanned."""
+    root = str(tmp_path / "tbl")
+    _write_iceberg_table(root, with_second_snapshot=False)
+    # rewrite the manifest marking the west file deleted
+    m1 = os.path.join(root, "metadata", "m1.avro")
+    with open(m1, "rb") as f:
+        schema, entries = avrolib.read_container(f.read())
+    for e in entries:
+        if "west" in e["data_file"]["file_path"]:
+            e["status"] = 2
+    with open(m1, "wb") as f:
+        f.write(avrolib.write_container(schema, entries))
+    meta = ib.load_table(root)
+    files = ib.data_files(meta)
+    assert len(files) == 1 and "east" in files[0].path
+
+
+def test_survives_restart(tmp_path):
+    """External iceberg tables persist through WAL + checkpoint."""
+    import tempfile
+
+    from matrixone_tpu.storage.engine import Engine
+    from matrixone_tpu.storage.fileservice import LocalFS
+    root = _write_iceberg_table(str(tmp_path / "tbl"))
+    d = tempfile.mkdtemp(prefix="mo_ice_")
+    eng = Engine(LocalFS(d))
+    s = Session(catalog=eng)
+    s.execute(f"create external table ice (id bigint, val bigint,"
+              f" region varchar(16)) location '{root}' format iceberg"
+              f" snapshot 1")
+    eng.checkpoint()
+    eng2 = Engine.open(LocalFS(d))
+    s2 = Session(catalog=eng2)
+    assert len(s2.execute("select * from ice").rows()) == 5
+    assert eng2.get_table("ice").snapshot == 1
+
+
+def test_cluster_mode_external_and_snapshot(tmp_path):
+    """code-review r5: CREATE EXTERNAL TABLE (incl. pinned iceberg
+    snapshot) must work through the CN->TN DDL path, not just the
+    single-node engine."""
+    from matrixone_tpu.cluster import RemoteCatalog, TNService
+    root = _write_iceberg_table(str(tmp_path / "tbl"))
+    shared = str(tmp_path / "store")
+    tn = TNService(data_dir=shared).start()
+    cat = RemoteCatalog(("127.0.0.1", tn.port), data_dir=shared)
+    try:
+        s = Session(catalog=cat)
+        s.execute(f"create external table ice (id bigint, val bigint,"
+                  f" region varchar(16)) location '{root}'"
+                  f" format iceberg snapshot 1")
+        rows = s.execute("select id from ice order by id").rows()
+        assert [int(r[0]) for r in rows] == [1, 2, 3, 4, 5]
+        # plain csv/parquet externals too (regression: TypeError)
+        import pyarrow as _pa
+        import pyarrow.parquet as _papq
+        pq = str(tmp_path / "plain.parquet")
+        _papq.write_table(_pa.table({"x": _pa.array([1, 2],
+                                                    _pa.int64())}), pq)
+        s.execute(f"create external table plain (x bigint)"
+                  f" location '{pq}'")
+        assert len(s.execute("select * from plain").rows()) == 2
+    finally:
+        cat.close()
+        tn.stop()
+
+
+def test_load_data_rejects_iceberg(tmp_path):
+    root = _write_iceberg_table(str(tmp_path / "tbl"))
+    s = Session()
+    s.execute("create table t (id bigint primary key)")
+    with pytest.raises(Exception, match="iceberg"):
+        s.execute(f"load data infile '{root}' into table t"
+                  f" format iceberg")
