@@ -191,6 +191,41 @@ class Launcher:
                                  tn_args + keeper_opt, "tn")
         self.ports["tn"] = tn_port
 
+        # --- TN failover (VERDICT r4 Next #9; reference:
+        # hakeeper/checkers/tnservice): when the keeper marks the TN
+        # DOWN, its repair hook respawns a TN over the same storage ON
+        # THE SAME PORT — CN RPC clients and logtail consumers
+        # reconnect by themselves, so nothing needs repointing. With
+        # log replicas, the successor acquires the quorum WAL via
+        # ELECTION (--campaign): it only proceeds once the dead
+        # writer's lease lapses, and the replay of the quorum log
+        # guarantees no acked commit is lost.
+        if self.keepers and self.cfg.get("tn", {}).get(
+                "auto_restart", True):
+            respawn_args = (["--dir", self.data_dir,
+                             "--port", str(tn_port)]
+                            + (["--log-replicas", ",".join(log_addrs),
+                                "--campaign"] if log_addrs else [])
+                            + keeper_opt)
+
+            def _respawn(_args=respawn_args):
+                try:
+                    p_ = self._launch("matrixone_tpu.cluster.tn",
+                                      _args, "tn-respawn")
+                    self._collect_ports(p_, "tn respawn", 1)
+                except Exception as e:     # noqa: BLE001 — repair is
+                    import sys as _sys     # best-effort; keeper records
+                    print(f"[launch] TN respawn failed: {e}",
+                          file=_sys.stderr, flush=True)
+
+            def tn_repair(rec):
+                # detach: the hook runs on the keeper's tick thread —
+                # a slow respawn (port contention, quiet child) must
+                # not stall failure detection for every other service
+                threading.Thread(target=_respawn, daemon=True).start()
+            for k in self.keepers:
+                k.on_down("tn", tn_repair)
+
         # --- CNs (fragment endpoints pre-allocated so every CN knows
         # the full peer set at spawn time; spawned in parallel)
         cn_cfg = self.cfg.get("cn", {})

@@ -98,3 +98,73 @@ def test_launch_registers_heartbeats(cluster):
         time.sleep(0.3)
     assert len(kinds.get("cn", [])) == 2 and len(kinds.get("tn", [])) == 1
     assert all(st == "up" for sts in kinds.values() for st in sts)
+
+
+def test_tn_kill9_failover_no_acked_loss():
+    """VERDICT r4 Next #9 drill: kill -9 the TN in a launched cluster;
+    the keeper's repair hook respawns a TN on the same port, which wins
+    the quorum-WAL election once the dead writer's lease lapses and
+    replays every acked commit; CN sessions resume writing."""
+    import signal
+    import subprocess
+
+    d = tempfile.mkdtemp(prefix="mo_launch_fo_")
+    cfg = os.path.join(d, "cluster.toml")
+    with open(cfg, "w") as f:
+        f.write(f"""
+[cluster]
+data_dir = "{d}/data"
+[log]
+replicas = 3
+[tn]
+port = 0
+[cn]
+count = 1
+insecure = true
+[keeper]
+enabled = true
+""")
+    launcher = Launcher(cfg).start()
+    try:
+        cn_port = launcher.ports["cn"][0]
+        c = client.connect(port=cn_port, timeout=240.0)
+        c.execute("create table acc (id bigint primary key, v bigint)")
+        for i in range(12):
+            c.execute(f"insert into acc values ({i}, {i * 10})")
+
+        # find the TN child and kill -9 it mid-stream
+        tn_proc = None
+        for p in launcher.procs:
+            if "matrixone_tpu.cluster.tn" in " ".join(p.args):
+                tn_proc = p
+        assert tn_proc is not None
+        tn_proc.send_signal(signal.SIGKILL)
+        tn_proc.wait(timeout=10)
+
+        # keeper detects + respawns; writes resume through the SAME CN
+        deadline = time.time() + 120
+        resumed = False
+        while time.time() < deadline:
+            try:
+                c.execute("insert into acc values (100, 1000)")
+                resumed = True
+                break
+            except Exception:
+                time.sleep(1.0)
+                try:
+                    c.close()
+                except Exception:
+                    pass
+                c = client.connect(port=cn_port, timeout=240.0)
+        assert resumed, "writes never resumed after TN kill -9"
+        _, rows = c.query("select count(*), sum(v) from acc")
+        n, sv = int(rows[0][0]), int(rows[0][1])
+        # every acked pre-kill commit survived + the post-failover row
+        assert n == 13 and sv == sum(i * 10 for i in range(12)) + 1000
+        # keeper recorded the repair
+        ops = [o for k in launcher.keepers for o in k.operators
+               if o.get("kind") == "tn"]
+        assert any(o.get("repair") == "dispatched" for o in ops), ops
+        c.close()
+    finally:
+        launcher.stop()

@@ -78,3 +78,126 @@ def test_proxy_skips_dead_backend():
     finally:
         proxy.stop()
         cn.stop()
+
+
+# ---------------------------------------- live connection migration (r5)
+def test_live_migration_under_client_loop():
+    """VERDICT r4 Next #8 acceptance: drain a CN while a client loops
+    queries + prepared statements through the SessionProxy — ZERO client
+    errors, the session lands on the other backend, session vars and
+    prepared statements survive."""
+    from matrixone_tpu import client
+    from matrixone_tpu.frontend.proxy import SessionProxy
+    from matrixone_tpu.frontend.server import MOServer
+    from matrixone_tpu.storage.engine import Engine
+
+    eng = Engine()
+    s1 = MOServer(engine=eng, port=0, insecure=True).start()
+    s2 = MOServer(engine=eng, port=0, insecure=True).start()
+    px = SessionProxy([("127.0.0.1", s1.port),
+                       ("127.0.0.1", s2.port)]).start()
+    try:
+        c = client.connect(port=px.port, timeout=60.0)
+        c.execute("create table m (id bigint primary key, v bigint)")
+        c.execute("insert into m values (1, 10), (2, 20)")
+        c.execute("set ivf_nprobe = 4")            # replayable state
+        ps = c.prepare("select v from m where id = ?")
+        assert ps.execute(1)[1] == [("10",)]
+
+        # which backend serves this conn? drain it
+        active = {f"127.0.0.1:{s1.port}": s1, f"127.0.0.1:{s2.port}": s2}
+        stats = px.stats()
+        (serving, _), = [(k, v) for k, v in stats.items() if v > 0]
+        host, port = serving.split(":")
+        px.drain(host, int(port))
+
+        # keep querying: the NEXT command triggers the migration
+        for i in range(10):
+            _, rows = c.query("select count(*) from m")
+            assert rows == [("2",)]
+            assert ps.execute(2)[1] == [("20",)]  # stmt survives
+        # the drained backend quiesced; the other carries the session
+        assert px.drained(host, int(port))
+        other = [k for k in stats if k != serving][0]
+        assert px.stats()[other] == 1
+        # new connections avoid the drained backend
+        c2 = client.connect(port=px.port, timeout=30.0)
+        assert c2.query("select 1")[1] == [("1",)]
+        c2.close()
+        c.close()
+    finally:
+        px.stop()
+        s1.stop()
+        s2.stop()
+
+
+def test_migration_waits_for_txn_end():
+    """A session inside BEGIN..COMMIT must NOT migrate mid-transaction;
+    it moves at the first idle point after COMMIT."""
+    from matrixone_tpu import client
+    from matrixone_tpu.frontend.proxy import SessionProxy
+    from matrixone_tpu.frontend.server import MOServer
+    from matrixone_tpu.storage.engine import Engine
+
+    eng = Engine()
+    s1 = MOServer(engine=eng, port=0, insecure=True).start()
+    s2 = MOServer(engine=eng, port=0, insecure=True).start()
+    px = SessionProxy([("127.0.0.1", s1.port),
+                       ("127.0.0.1", s2.port)]).start()
+    try:
+        c = client.connect(port=px.port, timeout=60.0)
+        c.execute("create table t (id bigint primary key)")
+        c.execute("begin")
+        c.execute("insert into t values (1)")
+        serving = [k for k, v in px.stats().items() if v > 0][0]
+        host, port = serving.split(":")
+        px.drain(host, int(port))
+        # still in the txn: commands keep flowing to the OLD backend
+        c.execute("insert into t values (2)")
+        assert not px.drained(host, int(port))
+        c.execute("commit")
+        # after commit the next command migrates
+        _, rows = c.query("select count(*) from t")
+        assert rows == [("2",)]
+        assert px.drained(host, int(port))
+        c.close()
+    finally:
+        px.stop()
+        s1.stop()
+        s2.stop()
+
+
+def test_migrated_session_accounting_on_close():
+    """code-review r5: after a migration, closing the client must
+    decrement the NEW backend (not the old one again) — otherwise
+    drained() flips back to False and stats skew forever."""
+    from matrixone_tpu import client
+    from matrixone_tpu.frontend.proxy import SessionProxy
+    from matrixone_tpu.frontend.server import MOServer
+    from matrixone_tpu.storage.engine import Engine
+
+    eng = Engine()
+    s1 = MOServer(engine=eng, port=0, insecure=True).start()
+    s2 = MOServer(engine=eng, port=0, insecure=True).start()
+    px = SessionProxy([("127.0.0.1", s1.port),
+                       ("127.0.0.1", s2.port)]).start()
+    try:
+        c = client.connect(port=px.port, timeout=60.0)
+        c.query("select 1")
+        serving = [k for k, v in px.stats().items() if v > 0][0]
+        h, p = serving.split(":")
+        px.drain(h, int(p))
+        c.query("select 1")            # triggers migration
+        assert px.drained(h, int(p))
+        c.close()
+        import time as _t
+        deadline = _t.time() + 5
+        while _t.time() < deadline and any(px.stats().values()):
+            _t.sleep(0.05)
+        # every count back to exactly zero — no -1, no leak
+        assert all(v == 0 for v in px.stats().values()), px.stats()
+        assert px.drained(h, int(p))
+    finally:
+        px.stop()
+        s1.stop()
+        s2.stop()
