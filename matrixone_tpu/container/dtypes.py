@@ -198,3 +198,31 @@ def promote(a: DType, b: DType) -> DType:
     if TypeOid.DECIMAL64 in (a.oid, b.oid) and hi.oid != TypeOid.DECIMAL64:
         return FLOAT64  # decimal + float -> float64
     return hi
+
+
+# ---------------------------------------------------------------- epochs
+# ONE conversion for date/datetime <-> epoch integers (binder literal
+# coercion, INSERT coercion, and clock functions all share it; exact
+# integer arithmetic — float total_seconds() truncates ~1% of
+# microsecond values by 1us)
+import datetime as _dtm
+
+_EPOCH_D = _dtm.date(1970, 1, 1)
+_EPOCH_DT = _dtm.datetime(1970, 1, 1)
+_US = _dtm.timedelta(microseconds=1)
+
+
+def epoch_days(d: "_dtm.date") -> int:
+    return (d - _EPOCH_D).days
+
+
+def epoch_micros(dtv: "_dtm.datetime") -> int:
+    return (dtv - _EPOCH_DT) // _US
+
+
+def epoch_days_from_iso(s: str) -> int:
+    return epoch_days(_dtm.date.fromisoformat(s.strip()))
+
+
+def epoch_micros_from_iso(s: str) -> int:
+    return epoch_micros(_dtm.datetime.fromisoformat(s.strip()))

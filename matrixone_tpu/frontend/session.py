@@ -772,6 +772,19 @@ class Session:
             self._check_admin()
             self._mgr().revoke_role(self._acct(), stmt.role, stmt.user)
             return Result()
+        if isinstance(stmt, ast.ShowAccounts):
+            from matrixone_tpu.frontend.auth import SYS_ACCOUNT, AuthError
+            if self.auth is not None and self._acct() != SYS_ACCOUNT:
+                raise AuthError(
+                    "only the sys account can list accounts")
+            m = self._mgr()._m()
+            names = sorted(m["accounts"])
+            b = Batch.from_pydict(
+                {"Account": names,
+                 "AdminName": [m["accounts"][n].get("admin_user", "")
+                               for n in names]},
+                {"Account": dt.VARCHAR, "AdminName": dt.VARCHAR})
+            return Result(batch=b)
         if isinstance(stmt, ast.ShowGrants):
             user = stmt.user or (self.auth.user if self.auth else "root")
             if stmt.user and stmt.user != (
@@ -1227,12 +1240,19 @@ class Session:
                     else:
                         table.observe_auto(np.asarray([v], np.int64))
             if d.oid == TypeOid.DATE:
-                vals = [(datetime.date.fromisoformat(v)
-                         - datetime.date(1970, 1, 1)).days
+                vals = [dt.epoch_days_from_iso(v)
+                        if isinstance(v, str) else v for v in vals]
+            elif d.oid in (TypeOid.DATETIME, TypeOid.TIMESTAMP):
+                vals = [dt.epoch_micros_from_iso(v)
                         if isinstance(v, str) else v for v in vals]
             elif d.is_vector:
                 vals = [[float(x) for x in v.strip()[1:-1].split(",")]
                         if isinstance(v, str) else v for v in vals]
+                for v in vals:
+                    if v is not None and len(v) != d.dim:
+                        raise BindError(
+                            f"vector literal has {len(v)} dimensions, "
+                            f"column {c!r} expects {d.dim}")
             full[c] = vals
         batch = Batch.from_pydict(full, {c: d for c, d in schema})
         if self.txn is not None:

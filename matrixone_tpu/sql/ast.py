@@ -299,6 +299,11 @@ class ShowSnapshots(Node):
 
 
 @dataclasses.dataclass
+class ShowAccounts(Node):
+    pass
+
+
+@dataclasses.dataclass
 class RestoreTable(Node):
     table: str
     snapshot: str

@@ -1363,9 +1363,40 @@ def _session_info(name: str):
     return None
 
 
+_DATE_ARG_FUNCS = {
+    "date", "year", "month", "day", "dayofmonth", "dayofweek",
+    "dayofyear", "weekday", "week", "yearweek", "quarter", "last_day",
+    "to_days", "datediff", "monthname", "dayname", "hour", "minute",
+    "second", "microsecond", "unix_timestamp", "date_format",
+}
+
+
+def _coerce_date_literals(name: str, args: List[BoundExpr]) -> None:
+    """MySQL accepts date/datetime STRINGS wherever dates go
+    ('2024-01-02 10:00:00'); parse literal strings at bind so the
+    kernels only ever see typed DATE/DATETIME values."""
+    import datetime as _dtm
+    if name not in _DATE_ARG_FUNCS:
+        return
+    for i, a in enumerate(args):
+        if not (isinstance(a, BoundLiteral) and isinstance(a.value, str)):
+            continue
+        s = a.value.strip()
+        try:
+            if len(s) > 10:
+                args[i] = BoundLiteral(dt.epoch_micros_from_iso(s),
+                                       dt.DATETIME)
+            else:
+                args[i] = BoundLiteral(dt.epoch_days_from_iso(s),
+                                       dt.DATE)
+        except ValueError:
+            pass        # not a date string: leave for the kernel/error
+
+
 def bind_scalar_function(name: str, args: List[BoundExpr]) -> BoundExpr:
     import datetime as _dtm
     import math
+    _coerce_date_literals(name, args)
     # sugar rewrites (reference: many of the 554 ids are compositions)
     if name == "pi" and not args:
         return BoundLiteral(math.pi, dt.FLOAT64)
@@ -1380,13 +1411,11 @@ def bind_scalar_function(name: str, args: List[BoundExpr]) -> BoundExpr:
     # statement-time clock literals (MySQL: fixed per statement)
     if name in ("now", "current_timestamp", "sysdate",
                 "localtimestamp") and not args:
-        now = _dtm.datetime.now()
-        us = int((now - _dtm.datetime(1970, 1, 1)).total_seconds() * 1e6)
-        return BoundLiteral(us, dt.DATETIME)
+        return BoundLiteral(dt.epoch_micros(_dtm.datetime.now()),
+                            dt.DATETIME)
     if name in ("utc_timestamp",) and not args:
         now = _dtm.datetime.now(_dtm.timezone.utc).replace(tzinfo=None)
-        us = int((now - _dtm.datetime(1970, 1, 1)).total_seconds() * 1e6)
-        return BoundLiteral(us, dt.DATETIME)
+        return BoundLiteral(dt.epoch_micros(now), dt.DATETIME)
     if name in ("curdate", "current_date") and not args:
         d = (_dtm.date.today() - _dtm.date(1970, 1, 1)).days
         return BoundLiteral(d, dt.DATE)
@@ -1452,4 +1481,8 @@ def bind_scalar_function(name: str, args: List[BoundExpr]) -> BoundExpr:
                 vec = [float(x)
                        for x in a.value.strip()[1:-1].split(",") if x]
                 args[i] = BoundLiteral(vec, dt.vecf32(len(vec)))
+        dims = [a.dtype.dim for a in args if a.dtype.is_vector]
+        if len(dims) == 2 and dims[0] != dims[1]:
+            raise BindError(
+                f"{name}() dimension mismatch: {dims[0]} vs {dims[1]}")
     return BoundFunc(op, args, result([a.dtype for a in args]))

@@ -285,6 +285,9 @@ class Parser:
             return ast.ShowTables()
         if self.accept_kw("snapshots"):
             return ast.ShowSnapshots()
+        if self.at_ident("accounts"):
+            self.next()
+            return ast.ShowAccounts()
         if self.at_ident("grants"):
             self.next()
             user = None
@@ -987,6 +990,12 @@ class Parser:
             if self.accept_kw("false"):
                 return ast.Literal(False, "bool")
             if self.accept_kw("date"):
+                if self.at_op("("):
+                    # function form: DATE(expr) extracts the date part
+                    self.expect_op("(")
+                    arg = self.expr()
+                    self.expect_op(")")
+                    return ast.FuncCall("date", [arg])
                 s = self.next()
                 if s.kind != "str":
                     raise ParseError("DATE literal requires a string")

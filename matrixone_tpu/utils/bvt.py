@@ -22,12 +22,44 @@ __all__ = ["split_statements", "run_case", "record", "iter_cases"]
 
 
 def split_statements(text: str) -> Iterator[str]:
-    """Yield `;`-terminated statements; `--` comment lines are dropped.
-    A `;` only terminates at end-of-line (so string literals containing
-    semicolons mid-line survive)."""
+    """Yield `;`-terminated statements (or ('session', name, login)
+    directives); `--` comment lines are dropped EXCEPT the mo-tester
+    style session switch:
+
+        -- @session user2 acme:bob
+
+    which routes the following statements through a second session
+    named `user2` logged in as acme:bob (tenant/privilege and
+    transaction-interleaving cases need more than one session — the
+    reference's mo-tester has the same directive)."""
     buf: List[str] = []
     for line in text.splitlines():
-        if line.strip().startswith("--"):
+        ls = line.strip()
+        if ls.startswith(("-- @session", "-- @tpch")):
+            if buf and "".join(buf).strip():
+                raise ValueError(
+                    f"directive {ls.split()[1]} inside an unterminated "
+                    f"statement — directives go between statements")
+        if ls.startswith("-- @session"):
+            parts = ls.split()
+            name = parts[2] if len(parts) > 2 else "default"
+            login = parts[3] if len(parts) > 3 else None
+            yield ("session", name, login)
+            continue
+        if ls.startswith("-- @tpch"):
+            # deterministic TPC-H data at the given scale factor into
+            # the case's engine (pins the 22 queries as goldens without
+            # megabytes of INSERT text)
+            parts = ls.split()
+            try:
+                sf = float(parts[2]) if len(parts) > 2 else 0.002
+            except ValueError:
+                raise ValueError(
+                    f"bad @tpch scale factor {parts[2]!r} (a number "
+                    f"like 0.002, not key=value)")
+            yield ("tpch", sf)
+            continue
+        if ls.startswith("--"):
             continue
         buf.append(line)
         if line.rstrip().endswith(";"):
@@ -58,6 +90,8 @@ def _fmt_value(v) -> str:
 
 def _fmt_result(r) -> List[str]:
     if r.batch is None:
+        if r.text is not None:           # EXPLAIN plans are golden too
+            return r.text.splitlines()
         if r.affected:
             return [f"affected: {r.affected}"]
         return ["ok"]
@@ -68,19 +102,58 @@ def _fmt_result(r) -> List[str]:
 
 
 def run_case(session, text: str) -> str:
-    """Execute a case's statements; return the canonical output text."""
+    """Execute a case's statements; return the canonical output text.
+    `-- @session name [account:user]` directives switch between named
+    sessions sharing the first session's engine."""
     out: List[str] = []
-    for stmt in split_statements(text):
+    sessions = {"default": session}
+    cur = session
+    for item in split_statements(text):
+        if isinstance(item, tuple) and item[0] == "tpch":
+            from matrixone_tpu.utils.tpch_full import load_tpch
+            eng = getattr(session.catalog, "_inner", session.catalog)
+            load_tpch(eng, sf=item[1], seed=0)
+            out.append(f"-- @tpch {item[1]}")
+            out.append("")
+            continue
+        if isinstance(item, tuple) and item[0] == "session":
+            _k, name, login = item
+            if name not in sessions:
+                sessions[name] = _make_session(session, login)
+            cur = sessions[name]
+            out.append(f"-- @session {name}" + (f" {login}" if login
+                                                else ""))
+            out.append("")
+            continue
+        stmt = item
         echo = " ".join(stmt.split())
         out.append(f"> {echo}")
         try:
-            r = session.execute(stmt)
+            r = cur.execute(stmt)
             out.extend(_fmt_result(r))
         except Exception as e:           # noqa: BLE001 — errors are golden
             msg = " ".join(str(e).split())
             out.append(f"ERROR {type(e).__name__}: {msg}")
         out.append("")
+    for name, s in sessions.items():
+        if s is not session:
+            close = getattr(s, "close", None)
+            if close:
+                close()
     return "\n".join(out).rstrip() + "\n"
+
+
+def _make_session(base, login):
+    """A second session over the SAME engine; `login` = 'account:user'
+    resolves through the AccountManager (tenant-scoped), None = root."""
+    from matrixone_tpu.frontend.session import Session
+    eng = getattr(base.catalog, "_inner", base.catalog)
+    if login is None:
+        return Session(catalog=eng)
+    account, _, user = login.partition(":")
+    mgr = base._mgr()
+    ctx = mgr.context_for(account, user)
+    return Session(catalog=eng, auth=ctx, auth_manager=mgr)
 
 
 def iter_cases(root: str) -> List[str]:

@@ -1,0 +1,6 @@
+create table docs (id bigint primary key, body text);
+insert into docs values (1, '分布式数据库系统'), (2, '数据分析平台'), (3, 'plain english text');
+create index ft using fulltext on docs (body);
+select id from docs where match (body) against ('数据库') order by id;
+select id from docs where match (body) against ('数据') order by id;
+select id from docs where match (body) against ('english');

@@ -1,0 +1,5 @@
+create table t (id bigint primary key, s varchar(32));
+insert into t values (1, '数据库系统'), (2, 'データベース'), (3, 'mixed 中文 text');
+select id, length(s), char_length(s) from t order by id;
+select id from t where s like '%中文%';
+select upper(s) from t where id = 3;

@@ -1,0 +1,4 @@
+create table t (d date, dt datetime);
+insert into t values (date '2024-06-15', '2024-06-15 10:30:45');
+select d, dt from t;
+select year(d), hour(dt), minute(dt), second(dt) from t;

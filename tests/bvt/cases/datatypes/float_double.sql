@@ -1,0 +1,4 @@
+create table t (f float, d double);
+insert into t values (1.5, 2.25), (0.1, 0.1);
+select f * 2, d * 2 from t order by d;
+select sum(d) from t;

@@ -1,0 +1,5 @@
+create table t (a tinyint, b smallint, c int, d bigint);
+insert into t values (127, 32767, 2147483647, 9223372036854775807);
+insert into t values (-128, -32768, -2147483648, -9223372036854775808);
+select * from t order by d;
+select a + 1 from t where a = 127;

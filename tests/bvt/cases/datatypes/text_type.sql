@@ -1,0 +1,3 @@
+create table t (id bigint primary key, body text);
+insert into t values (1, 'some long body of text here');
+select length(body), upper(body) from t;

@@ -1,0 +1,3 @@
+create table t (s varchar(4));
+insert into t values ('abcd'), (''), (null);
+select s, length(s) from t order by s;

@@ -1,0 +1,6 @@
+create table t (id bigint primary key auto_increment, v bigint);
+insert into t (v) values (10), (20);
+insert into t values (100, 30);
+insert into t (v) values (40);
+select * from t order by id;
+select last_insert_id();

@@ -1,0 +1,5 @@
+create table t (id bigint primary key, f bool);
+insert into t values (1, true), (2, false), (3, null);
+select * from t order by id;
+select count(*) from t where f;
+select id from t where not f;

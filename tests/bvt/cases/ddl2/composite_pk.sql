@@ -1,0 +1,5 @@
+create table t (a bigint, b varchar(4), v bigint, primary key (a, b));
+insert into t values (1, 'x', 10), (1, 'y', 20), (2, 'x', 30);
+select * from t order by a, b;
+delete from t where a = 1 and b = 'x';
+select count(*) from t;

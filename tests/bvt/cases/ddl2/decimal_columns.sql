@@ -1,0 +1,5 @@
+create table p (id bigint primary key, price decimal(10,2), qty decimal(8,3));
+insert into p values (1, 19.99, 2.500), (2, 0.01, 1000.125);
+select * from p order by id;
+select price * 2, qty + 0.375 from p order by id;
+select sum(price), sum(qty) from p;

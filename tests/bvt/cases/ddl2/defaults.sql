@@ -1,0 +1,4 @@
+create table t (id bigint primary key, v bigint default 7, s varchar(8) default 'hi');
+insert into t (id) values (1);
+insert into t values (2, 9, 'yo');
+select * from t order by id;

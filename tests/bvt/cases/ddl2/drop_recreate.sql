@@ -1,0 +1,6 @@
+create table t (id bigint primary key);
+insert into t values (1);
+drop table t;
+create table t (id bigint primary key, v bigint);
+insert into t values (2, 20);
+select * from t;

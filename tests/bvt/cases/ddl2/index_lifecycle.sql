@@ -1,0 +1,5 @@
+create table t (id bigint primary key, emb vecf32(3));
+insert into t values (1, '[1,0,0]'), (2, '[0,1,0]');
+create index iv using ivfflat on t (emb) lists = 1 op_type = 'vector_l2_ops';
+show indexes from t;
+drop table t;

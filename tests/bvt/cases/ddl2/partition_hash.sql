@@ -1,0 +1,5 @@
+create table t (id bigint primary key, v bigint) partition by hash(id) partitions 4;
+insert into t values (1, 1), (2, 2), (3, 3), (4, 4), (5, 5);
+select count(*) from t;
+show partitions from t;
+select * from t where id = 3;

@@ -1,0 +1,5 @@
+create table ev (id bigint primary key, ts bigint) partition by range(ts) (partition p0 values less than (100), partition p1 values less than (200), partition pmax values less than (maxvalue));
+insert into ev values (1, 50), (2, 150), (3, 250);
+select count(*) from ev;
+alter table ev truncate partition p0;
+select * from ev order by id;

@@ -1,0 +1,3 @@
+create table t (id bigint primary key, v double, s varchar(16), d date);
+show columns from t;
+describe t;

@@ -1,0 +1,6 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 1), (2, 2), (3, 3), (4, 4);
+delete from t where v % 2 = 0;
+select * from t order by id;
+delete from t;
+select count(*) from t;

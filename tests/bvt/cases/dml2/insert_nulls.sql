@@ -1,0 +1,4 @@
+create table t (id bigint primary key, v bigint, s varchar(8));
+insert into t values (1, null, null), (2, 5, 'x');
+select id, v is null, s is null from t order by id;
+select coalesce(v, -1), coalesce(s, 'none') from t order by id;

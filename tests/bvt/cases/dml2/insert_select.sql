@@ -1,0 +1,5 @@
+create table src (id bigint primary key, v bigint);
+create table dst (id bigint primary key, v bigint);
+insert into src values (1, 10), (2, 20), (3, 30);
+insert into dst select id, v * 2 from src where v >= 20;
+select * from dst order by id;

@@ -1,0 +1,3 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1,1),(2,2),(3,3),(4,4),(5,5),(6,6),(7,7),(8,8),(9,9),(10,10);
+select count(*), sum(v), min(v), max(v) from t;

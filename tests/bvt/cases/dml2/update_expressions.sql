@@ -1,0 +1,6 @@
+create table t (id bigint primary key, v bigint, s varchar(8));
+insert into t values (1, 10, 'a'), (2, 20, 'b');
+update t set v = v + 5 where id = 1;
+update t set v = v * 2, s = upper(s);
+select * from t order by id;
+update t set v = 0 where id = 99;

@@ -1,0 +1,4 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 10), (2, 20);
+update t set v = 99 where id in (1, 2);
+select * from t order by id;

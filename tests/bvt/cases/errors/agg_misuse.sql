@@ -1,0 +1,4 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 10);
+select v, sum(v) from t;
+select sum(v) from t where sum(v) > 0;

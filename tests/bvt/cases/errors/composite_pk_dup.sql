@@ -1,0 +1,4 @@
+create table t (a bigint, b bigint, v bigint, primary key (a, b));
+insert into t values (1, 1, 10), (1, 2, 20);
+insert into t values (1, 1, 99);
+select * from t order by a, b;

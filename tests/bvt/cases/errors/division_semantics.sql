@@ -1,0 +1,5 @@
+select 1 / 0;
+select 10 / 4;
+select 10 % 3;
+select -7 % 3;
+select 0 / 5;

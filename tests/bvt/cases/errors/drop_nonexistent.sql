@@ -1,0 +1,4 @@
+drop table if exists ghost;
+drop table ghost;
+drop snapshot ghost;
+drop stage ghost;

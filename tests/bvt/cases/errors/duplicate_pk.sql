@@ -1,0 +1,5 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 10);
+insert into t values (1, 20);
+insert into t values (2, 20), (2, 30);
+select * from t order by id;

@@ -1,0 +1,3 @@
+create table t (id bigint primary key);
+create table t (id bigint primary key);
+create table if not exists t (id bigint primary key);

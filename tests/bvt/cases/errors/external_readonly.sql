@@ -1,0 +1,3 @@
+create external table x (a bigint) location '/nonexistent/file.csv';
+insert into x values (1);
+delete from x;

@@ -1,0 +1,3 @@
+create table t (g bigint, v bigint);
+insert into t values (1, 10);
+select g, v from t group by g;

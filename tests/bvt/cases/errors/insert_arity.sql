@@ -1,0 +1,5 @@
+create table t (a bigint primary key, b bigint);
+insert into t values (1);
+insert into t values (1, 2, 3);
+insert into t (a) values (1);
+select * from t;

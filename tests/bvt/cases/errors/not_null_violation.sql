@@ -1,0 +1,4 @@
+create table t (id bigint primary key, v bigint not null);
+insert into t values (1, null);
+insert into t values (1, 10);
+select * from t;

@@ -1,0 +1,4 @@
+create table t (a bigint primary key, b bigint);
+insert into t values (1, 2);
+select a, b from t order by 3;
+select a, b from t order by 0;

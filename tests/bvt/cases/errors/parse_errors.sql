@@ -1,0 +1,4 @@
+selec 1;
+select * frm t;
+select from;
+create table (x bigint);

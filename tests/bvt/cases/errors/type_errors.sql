@@ -1,0 +1,4 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 5);
+select v + 'abc' from t;
+select unknown_func(v) from t;

@@ -1,0 +1,5 @@
+create table t (id bigint primary key, v bigint);
+select nothere from t;
+select id from t where nothere = 1;
+update t set nothere = 1;
+insert into t (id, nothere) values (1, 2);

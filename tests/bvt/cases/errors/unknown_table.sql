@@ -1,0 +1,5 @@
+select * from nope;
+insert into nope values (1);
+delete from nope;
+update nope set x = 1;
+drop table nope;

@@ -1,0 +1,4 @@
+create table t (d date);
+insert into t values ('not-a-date');
+insert into t values ('2024-13-45');
+select cast('garbage' as date);

@@ -1,0 +1,3 @@
+create external table ice (id bigint) location '/nonexistent/iceberg' format iceberg;
+select * from ice;
+load data infile '/tmp' into table ice format iceberg;

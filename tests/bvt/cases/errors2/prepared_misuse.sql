@@ -1,0 +1,3 @@
+create table t (id bigint primary key);
+insert into t values (1), (2);
+select * from t where id = ? ;

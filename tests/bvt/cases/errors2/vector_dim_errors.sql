@@ -1,0 +1,5 @@
+create table v (id bigint primary key, emb vecf32(4));
+insert into v values (1, '[1,2,3]');
+insert into v values (1, '[1,2,3,4,5]');
+insert into v values (1, '[1,2,3,4]');
+select l2_distance(emb, '[1,2]') from v;

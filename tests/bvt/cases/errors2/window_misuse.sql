@@ -1,0 +1,6 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 1);
+select rank() over (order by v rows between 1 preceding and current row) from t;
+select upper(v) over (order by v) from t;
+select lag(v, -1) over (order by v) from t;
+select ntile(0) over (order by v) from t;

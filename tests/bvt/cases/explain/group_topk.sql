@@ -1,0 +1,4 @@
+create table t (g varchar(2), v bigint);
+insert into t values ('a', 1);
+explain select g, sum(v) from t group by g;
+explain select * from t order by v desc limit 3;

@@ -1,0 +1,5 @@
+create table big (id bigint primary key, k bigint);
+create table small (k bigint primary key);
+insert into big values (1, 1), (2, 2), (3, 1), (4, 2), (5, 1), (6, 2), (7, 1), (8, 2);
+insert into small values (1), (2);
+explain select big.id from big join small on big.k = small.k;

@@ -1,0 +1,5 @@
+create table a (id bigint primary key, k bigint, v bigint);
+create table b (k bigint primary key, w bigint);
+insert into a values (1, 1, 1);
+insert into b values (1, 1);
+explain select a.id from a, b where a.k = b.k and a.v > 5 and b.w < 3;

@@ -1,0 +1,4 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 10);
+explain select * from t where v > 5;
+explain select v from t;

@@ -1,0 +1,7 @@
+create table docs (id bigint primary key, body text);
+insert into docs values (1, 'hello world');
+create index ft using fulltext on docs (body);
+insert into docs values (2, 'hello again');
+select id from docs where match (body) against ('hello') order by id;
+delete from docs where id = 1;
+select id from docs where match (body) against ('hello') order by id;

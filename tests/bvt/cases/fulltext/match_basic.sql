@@ -1,0 +1,6 @@
+create table docs (id bigint primary key, body text);
+insert into docs values (1, 'the quick brown fox'), (2, 'lazy dogs sleep all day'), (3, 'quick thinking wins the day');
+create index ft using fulltext on docs (body);
+select id from docs where match (body) against ('quick') order by id;
+select id from docs where match (body) against ('day') order by id;
+select id from docs where match (body) against ('nothing');

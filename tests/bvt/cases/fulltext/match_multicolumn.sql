@@ -1,0 +1,5 @@
+create table art (id bigint primary key, title text, body text);
+insert into art values (1, 'rust systems', 'memory safety story'), (2, 'python data', 'pandas and numpy');
+create index ft using fulltext on art (title, body);
+select id from art order by match (title, body) against ('memory') desc limit 1;
+select id from art order by match (title, body) against ('python') desc limit 1;

@@ -1,0 +1,3 @@
+create table d (id bigint primary key, body text);
+insert into d values (1, 'alpha beta'), (2, 'beta gamma');
+select id from d where match (body) against ('beta') order by id;

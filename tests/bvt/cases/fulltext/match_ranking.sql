@@ -1,0 +1,4 @@
+create table docs (id bigint primary key, body text);
+insert into docs values (1, 'apple apple apple'), (2, 'apple banana'), (3, 'banana cherry');
+create index ft using fulltext on docs (body);
+select id from docs where match (body) against ('apple') order by match (body) against ('apple') desc limit 2;

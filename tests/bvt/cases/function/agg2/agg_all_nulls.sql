@@ -1,0 +1,3 @@
+create table t (v bigint);
+insert into t values (null), (null);
+select count(*), count(v), sum(v), min(v), avg(v) from t;

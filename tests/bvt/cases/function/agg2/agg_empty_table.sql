@@ -1,0 +1,3 @@
+create table t (v bigint);
+select count(*), sum(v), min(v), max(v), avg(v) from t;
+select count(*) from t group by v;

@@ -1,0 +1,3 @@
+create table t (g bigint, v bigint);
+insert into t values (1, 5), (1, 15), (2, 25), (3, 35);
+select g, sum(v) from t where v > 10 group by g order by g;

@@ -1,0 +1,3 @@
+create table t (g varchar(2), v bigint);
+insert into t values ('a', 5), ('a', 5), ('b', 9);
+select g, any_value(v) from t group by g order by g;

@@ -1,0 +1,3 @@
+create table t (g varchar(2), v bigint);
+insert into t values ('a', 6), ('a', 3), ('b', 12), ('b', 10);
+select g, bit_and(v), bit_or(v), bit_xor(v) from t group by g order by g;

@@ -1,0 +1,3 @@
+create table t (a varchar(2), b bigint, v bigint);
+insert into t values ('x', 1, 10), ('x', 1, 20), ('x', 2, 30), ('y', 1, 40);
+select a, b, sum(v), count(*) from t group by a, b order by a, b;

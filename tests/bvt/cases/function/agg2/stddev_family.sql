@@ -1,0 +1,4 @@
+create table t (v double);
+insert into t values (2), (4), (4), (4), (5), (5), (7), (9);
+select round(stddev_pop(v), 6), round(var_pop(v), 6) from t;
+select round(stddev_samp(v), 6), round(var_samp(v), 6) from t;

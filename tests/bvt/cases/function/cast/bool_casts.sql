@@ -1,0 +1,2 @@
+select cast(true as bigint), cast(false as bigint);
+select cast(1 as bool), cast(0 as bool);

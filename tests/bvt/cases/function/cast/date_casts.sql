@@ -1,0 +1,3 @@
+select cast('2023-05-17' as date);
+select cast(date '2023-05-17' as char);
+select date('2024-02-29 10:30:00');

@@ -1,0 +1,5 @@
+select cast(1.005 as decimal(10,2)), cast(7 as decimal(6,3));
+select cast('12.345' as decimal(8,2));
+create table t (d decimal(10,4));
+insert into t values (1.23456789);
+select * from t;

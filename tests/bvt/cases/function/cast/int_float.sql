@@ -1,0 +1,3 @@
+select cast(3.7 as bigint), cast(-3.7 as bigint);
+select cast(5 as double), cast('42' as bigint);
+select cast('3.14' as double), cast(2.999 as int);

@@ -1,0 +1,1 @@
+select cast(null as bigint), cast(null as char), cast(null as double);

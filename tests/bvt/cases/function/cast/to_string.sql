@@ -1,0 +1,2 @@
+select cast(42 as char), cast(3.5 as char);
+select concat('v=', cast(7 as char));

@@ -1,0 +1,4 @@
+select datediff(date '2024-03-01', date '2024-02-01');
+select datediff(date '2023-03-01', date '2023-02-01');
+select timestampdiff(month, date '2024-01-15', date '2024-03-14');
+select timestampdiff(week, date '2024-01-01', date '2024-01-20');

@@ -1,0 +1,4 @@
+create table ev (id bigint primary key, d date);
+insert into ev values (1, date '2024-01-05'), (2, date '2024-01-25'), (3, date '2024-02-10'), (4, date '2024-03-01');
+select month(d), count(*) from ev group by month(d) order by 1;
+select date_format(d, '%Y-%m'), count(*) from ev group by date_format(d, '%Y-%m') order by 1;

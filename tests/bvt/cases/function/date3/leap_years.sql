@@ -1,0 +1,4 @@
+select date_add(date '2024-02-28', interval 1 day);
+select date_add(date '2023-02-28', interval 1 day);
+select last_day(date '2024-02-01'), last_day(date '2023-02-01');
+select dayofyear(date '2024-12-31'), dayofyear(date '2023-12-31');

@@ -1,0 +1,4 @@
+select date_add(date '2024-01-31', interval 1 month);
+select date_add(date '2024-03-31', interval 1 month);
+select date_sub(date '2024-03-31', interval 1 month);
+select date_add(date '2024-08-31', interval 6 month);

@@ -1,0 +1,3 @@
+select week(date '2024-01-01'), weekday(date '2024-01-01'), dayofweek(date '2024-01-01');
+select yearweek(date '2024-01-01'), yearweek(date '2023-12-31');
+select week(date '2024-12-31');

@@ -1,0 +1,5 @@
+create table t (id bigint primary key, d decimal(8,2));
+insert into t values (1, 1.50), (2, 1.55), (3, 2.00);
+select id from t where d > 1.50 order by id;
+select id from t where d = 1.55;
+select id from t where d between 1.5 and 2 order by id;

@@ -1,0 +1,5 @@
+create table p (id bigint primary key, amt decimal(12,2));
+insert into p values (1, 0.10), (2, 0.20), (3, 0.30);
+select sum(amt) from p;
+select sum(amt) = 0.60 from p;
+select avg(amt) from p;

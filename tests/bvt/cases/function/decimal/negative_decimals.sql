@@ -1,0 +1,4 @@
+create table t (d decimal(10,3));
+insert into t values (-1.125), (2.250), (-3.375);
+select sum(d), min(d), max(d) from t;
+select abs(d) from t order by d;

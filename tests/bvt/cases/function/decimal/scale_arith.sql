@@ -1,0 +1,3 @@
+select 1.5 + 2.25, 1.5 * 2, 10.00 / 4;
+select 0.1 + 0.2 = 0.3;
+select round(2.675, 2), truncate(2.679, 2);

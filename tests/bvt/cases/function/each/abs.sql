@@ -1,0 +1,1 @@
+select abs(-5), abs(5), abs(0), abs(-2.5), abs(null);

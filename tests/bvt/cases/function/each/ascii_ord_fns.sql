@@ -1,0 +1,1 @@
+select ascii('A'), ascii('abc'), ascii(''), ord('A'), ord('€');

@@ -1,0 +1,1 @@
+select bit_count(0), bit_count(1), bit_count(3), bit_count(255), bit_count(-1);

@@ -1,0 +1,1 @@
+select ceil(1.1), ceil(-1.1), floor(1.9), floor(-1.9), ceil(2), floor(2);

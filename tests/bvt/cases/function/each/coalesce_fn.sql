@@ -1,0 +1,1 @@
+select coalesce(null, 1), coalesce(null, null, 'x'), coalesce(2, 1);

@@ -1,0 +1,1 @@
+select concat('a', 'b', 'c'), concat('a', null), concat_ws('-', 'a', 'b'), concat_ws('-', null, 'x');

@@ -1,0 +1,1 @@
+select date('2024-05-06 10:11:12'), date(date '2024-05-06');

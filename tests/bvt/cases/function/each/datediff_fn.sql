@@ -1,0 +1,1 @@
+select datediff(date '2024-01-10', date '2024-01-01'), datediff(date '2024-01-01', date '2024-01-10');

@@ -1,0 +1,1 @@
+select dayname(date '2024-01-01'), monthname(date '2024-01-01'), dayofweek(date '2024-01-07'), weekday(date '2024-01-01');

@@ -1,0 +1,1 @@
+select dayofmonth(date '2024-02-29'), dayofyear(date '2024-03-01'), week(date '2024-06-15');

@@ -1,0 +1,1 @@
+select elt(2, 'a', 'b', 'c'), field('c', 'a', 'b', 'c'), find_in_set('c', 'a,b,c');

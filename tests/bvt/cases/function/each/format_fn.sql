@@ -1,0 +1,1 @@
+select format(1234.5678, 2), format(1234.5678, 0), format(0.5, 3);

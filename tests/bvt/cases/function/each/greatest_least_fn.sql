@@ -1,0 +1,1 @@
+select greatest(3, 1, 2), least(3, 1, 2), greatest(1.5, 2), least(-1, 0);

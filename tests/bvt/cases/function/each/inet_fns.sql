@@ -1,0 +1,1 @@
+select inet_aton('1.2.3.4'), inet_ntoa(16909060), inet_aton('256.1.1.1');

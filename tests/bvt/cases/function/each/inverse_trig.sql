@@ -1,0 +1,1 @@
+select round(asin(1), 6), round(acos(1), 6), round(atan(1), 6), round(atan2(0, -1), 6);

@@ -1,0 +1,1 @@
+select last_day(date '2024-02-10'), last_day(date '2023-02-10'), last_day(date '2024-04-01');

@@ -1,0 +1,1 @@
+select left('hello', 2), right('hello', 2), left('hi', 99), right('hi', 0);

@@ -1,0 +1,1 @@
+select length('abc'), char_length('abc'), bit_length('ab'), octet_length('abc'), length(null);

@@ -1,0 +1,1 @@
+select round(ln(exp(2)), 6), log2(8), log10(1000), round(log(3, 27), 6);

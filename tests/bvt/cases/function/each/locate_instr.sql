@@ -1,0 +1,1 @@
+select instr('banana', 'na'), locate('na', 'banana'), locate('na', 'banana', 4);

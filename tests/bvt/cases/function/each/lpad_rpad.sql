@@ -1,0 +1,1 @@
+select lpad('5', 3, '0'), rpad('5', 3, '0'), lpad('abc', 2, 'x');

@@ -1,0 +1,1 @@
+select power(2, 10), power(9, 0.5), mod(17, 5), mod(-17, 5), 17 % 5;

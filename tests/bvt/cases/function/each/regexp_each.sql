@@ -1,0 +1,1 @@
+select regexp_like('abc', 'b'), regexp_instr('abcabc', 'c'), regexp_substr('a1b2', '[0-9]'), regexp_replace('a1b2', '[0-9]', '#');

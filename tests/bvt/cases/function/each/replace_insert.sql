@@ -1,0 +1,1 @@
+select replace('aaa', 'a', 'b'), insert('abcdef', 2, 2, 'ZZ'), insert('abc', 1, 0, 'X');

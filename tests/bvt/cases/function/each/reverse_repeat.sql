@@ -1,0 +1,1 @@
+select reverse('abc'), reverse(''), repeat('xy', 2), repeat('x', -1);

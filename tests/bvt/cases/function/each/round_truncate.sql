@@ -1,0 +1,1 @@
+select round(1.45), round(1.45, 1), truncate(1.49, 1), round(-1.45, 1), truncate(-1.49, 1);

@@ -1,0 +1,1 @@
+select sec_to_time(90061), time_to_sec('25:01:01'), sec_to_time(-60);

@@ -1,0 +1,1 @@
+select sign(-9), sign(0), sign(3), sign(-0.5);

@@ -1,0 +1,1 @@
+select split_part('x:y:z', ':', 1), split_part('x:y:z', ':', 3), split_part('xyz', ':', 1);

@@ -1,0 +1,1 @@
+select sqrt(16), sqrt(2.25), round(exp(1), 6), round(exp(0), 6);

@@ -1,0 +1,1 @@
+select starts_with('hello', 'he'), ends_with('hello', 'lo'), starts_with('hello', 'lo');

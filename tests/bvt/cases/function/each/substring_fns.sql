@@ -1,0 +1,1 @@
+select substring('hello', 2), substring('hello', 2, 2), substring('hello', -3), substr('hello', 1, 1), mid('hello', 2, 3);

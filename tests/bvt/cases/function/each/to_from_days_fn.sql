@@ -1,0 +1,1 @@
+select to_days(date '2024-01-01'), from_days(739251), to_days(date '1970-01-01');

@@ -1,0 +1,1 @@
+select round(sin(pi()/2), 6), round(cos(pi()), 6), round(tan(0), 6), round(cot(pi()/4), 6);

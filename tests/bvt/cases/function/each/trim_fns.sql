@@ -1,0 +1,1 @@
+select trim(' a '), ltrim(' a '), rtrim(' a '), trim('aa');

@@ -1,0 +1,1 @@
+select unix_timestamp(date '2024-01-01'), from_unixtime(1704067200);

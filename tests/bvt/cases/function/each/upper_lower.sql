@@ -1,0 +1,1 @@
+select upper('MiXeD'), lower('MiXeD'), ucase('ab'), lcase('AB'), upper(''), upper(null);

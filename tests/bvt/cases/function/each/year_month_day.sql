@@ -1,0 +1,1 @@
+select year(date '2024-03-15'), month(date '2024-03-15'), day(date '2024-03-15'), quarter(date '2024-03-15');

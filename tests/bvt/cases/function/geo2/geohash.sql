@@ -1,0 +1,2 @@
+select st_geohash(st_geomfromtext('POINT(-5.6 42.6)'), 5);
+select st_geohash(st_geomfromtext('POINT(0 0)'), 3);

@@ -1,0 +1,3 @@
+select st_x(st_geomfromtext('POINT(3 4)')), st_y(st_geomfromtext('POINT(3 4)'));
+select st_distance(st_geomfromtext('POINT(0 0)'), st_geomfromtext('POINT(3 4)'));
+select st_astext(st_geomfromtext('POINT(1.5 2.5)'));

@@ -1,0 +1,4 @@
+select st_within(st_geomfromtext('POINT(1 1)'), st_geomfromtext('POLYGON((0 0, 4 0, 4 4, 0 4, 0 0))'));
+select st_within(st_geomfromtext('POINT(9 9)'), st_geomfromtext('POLYGON((0 0, 4 0, 4 4, 0 4, 0 0))'));
+select st_area(st_geomfromtext('POLYGON((0 0, 4 0, 4 4, 0 4, 0 0))'));
+select st_contains(st_geomfromtext('POLYGON((0 0, 2 0, 2 2, 0 2, 0 0))'), st_geomfromtext('POINT(1 1)'));

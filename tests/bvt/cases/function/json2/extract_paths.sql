@@ -1,0 +1,7 @@
+create table j (id bigint primary key, doc text);
+insert into j values (1, '{"a": {"b": [1, 2, 3]}, "c": "x"}'), (2, '{"a": null}'), (3, 'not json');
+select id, json_valid(doc) from j order by id;
+select json_extract(doc, '$.a.b[1]') from j where id = 1;
+select json_extract(doc, '$.c') from j where id = 1;
+select json_unquote(json_extract(doc, '$.c')) from j where id = 1;
+select json_extract(doc, '$.zzz') from j where id = 1;

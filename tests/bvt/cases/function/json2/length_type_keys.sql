@@ -1,0 +1,3 @@
+select json_length('[1,2,3]'), json_length('{"a":1,"b":2}');
+select json_type('[1]'), json_type('{"x":1}'), json_type('3'), json_type('"s"');
+select json_keys('{"b":1,"a":2}');

@@ -1,0 +1,3 @@
+select sqrt(-1), ln(0), ln(-5), log10(0);
+select power(0, 0), power(2, -2);
+select mod(10, 0);

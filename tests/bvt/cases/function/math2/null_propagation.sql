@@ -1,0 +1,3 @@
+select 1 + null, null * 2, abs(null), sqrt(null);
+select greatest(1, null, 3), least(null, 2);
+select coalesce(null, null, 5);

@@ -1,0 +1,3 @@
+select round(2.5), round(3.5), round(-2.5);
+select floor(-1.5), ceil(-1.5), floor(1.5), ceil(1.5);
+select round(1234.5678, 2), round(1234.5678, -2), truncate(1234.5678, -2);

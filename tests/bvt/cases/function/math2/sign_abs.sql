@@ -1,0 +1,2 @@
+select sign(-5), sign(0), sign(7);
+select abs(-3.5), abs(0), abs(12);

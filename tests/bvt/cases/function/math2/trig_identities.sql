@@ -1,0 +1,3 @@
+select round(sin(0), 10), round(cos(0), 10);
+select round(degrees(pi()), 6), round(radians(180) - pi(), 10);
+select round(atan2(1, 1) * 4 - pi(), 10);

@@ -1,0 +1,3 @@
+select to_base64('hello'), from_base64('aGVsbG8=');
+select from_base64(to_base64('round trip ok'));
+select from_base64('!!!invalid!!!');

@@ -1,0 +1,4 @@
+select if(1 > 0, 'yes', 'no'), if(0 > 1, 'yes', 'no');
+select ifnull(null, 5), ifnull(7, 5);
+select nullif(3, 3), nullif(3, 4);
+select isnull(null), isnull(0);

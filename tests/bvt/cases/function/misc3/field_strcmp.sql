@@ -1,0 +1,3 @@
+select field('b', 'a', 'b', 'c'), field('z', 'a', 'b');
+select find_in_set('b', 'a,b,c'), find_in_set('z', 'a,b,c');
+select strcmp('a', 'b'), strcmp('b', 'a'), strcmp('a', 'a');

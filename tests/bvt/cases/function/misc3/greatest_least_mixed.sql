@@ -1,0 +1,2 @@
+select greatest(1, 2.5, 2), least(1, 2.5, 0.5);
+select greatest(-1, -2), least(-1, -2);

@@ -1,0 +1,4 @@
+select md5('abc');
+select sha1('abc');
+select sha2('abc', 256);
+select crc32('abc');

@@ -1,0 +1,4 @@
+select hex('abc'), unhex('616263');
+select conv('ff', 16, 10), conv('255', 10, 16), conv('777', 8, 10);
+select bin(10), oct(64);
+select hex(255);

@@ -1,0 +1,4 @@
+select lpad('x', 5, 'ab'), rpad('x', 5, 'ab');
+select lpad('hello', 3, '*'), rpad('hello', 0, '*');
+select repeat('ab', 3), repeat('ab', 0), space(4);
+select lpad('x', 5, '');

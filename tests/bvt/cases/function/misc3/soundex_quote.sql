@@ -1,0 +1,2 @@
+select soundex('Robert'), soundex('Rupert'), soundex('Ashcraft');
+select quote('O''Brien'), quote('plain');

@@ -1,0 +1,3 @@
+select regexp_like('abc123', '^[a-z]+[0-9]+$');
+select regexp_like('ABC', '^[a-z]+$');
+select regexp_replace('2024-01-02', '[0-9]{4}', 'YYYY');

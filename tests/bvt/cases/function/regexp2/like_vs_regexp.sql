@@ -1,0 +1,7 @@
+create table t (id bigint primary key, s varchar(20));
+insert into t values (1, 'cat'), (2, 'category'), (3, 'concat'), (4, 'dog');
+select id from t where regexp_like(s, '^cat') order by id;
+select id from t where regexp_like(s, 'cat$') order by id;
+select regexp_replace(s, 'a', '@') from t order by id;
+select regexp_substr(s, '[aeiou]+') from t order by id;
+select regexp_instr(s, 'g') from t order by id;

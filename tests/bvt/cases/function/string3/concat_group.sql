@@ -1,0 +1,4 @@
+create table t (id bigint primary key, s varchar(8));
+insert into t values (1, 'a'), (2, null), (3, 'c');
+select concat(s, '!') from t order by id;
+select count(concat(s, '!')) from t;

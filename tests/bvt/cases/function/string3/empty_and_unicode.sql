@@ -1,0 +1,3 @@
+select length(''), char_length(''), length('héllo'), char_length('héllo');
+select upper('àbc'), reverse('añb');
+select substring('héllo', 2, 3);

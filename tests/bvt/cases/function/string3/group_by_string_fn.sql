@@ -1,0 +1,4 @@
+create table t (id bigint primary key, s varchar(16));
+insert into t values (1, 'Apple'), (2, 'APPLE'), (3, 'banana');
+select lower(s), count(*) from t group by lower(s) order by 1;
+select upper(s), count(*) from t group by upper(s) order by 1;

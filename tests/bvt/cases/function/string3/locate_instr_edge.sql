@@ -1,0 +1,3 @@
+select instr('hello', ''), instr('', 'x'), instr('hello', 'l');
+select locate('l', 'hello', 4);
+select substring_index('a.b.c.d', '.', 2), substring_index('a.b.c.d', '.', -1);

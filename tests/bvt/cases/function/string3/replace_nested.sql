@@ -1,0 +1,3 @@
+select replace('aaa', 'a', 'ab');
+select replace('hello world', 'o', '0');
+select replace('x', 'nomatch', 'y');

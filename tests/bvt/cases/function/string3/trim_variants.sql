@@ -1,0 +1,2 @@
+select trim('  pad  '), ltrim('  pad  '), rtrim('  pad  ');
+select concat('[', trim('   '), ']');

@@ -1,0 +1,7 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 10), (2, 20);
+create snapshot s;
+insert into t values (3, 30);
+select sum(v) from t;
+select sum(v) from t as of snapshot 's';
+select count(*) from t as of snapshot 's' where v > 5;

@@ -1,0 +1,6 @@
+create table a (x bigint primary key);
+create table b (y bigint primary key);
+insert into a values (1), (2);
+insert into b values (10), (20);
+select x, y from a cross join b order by x, y;
+select count(*) from a, b;

@@ -1,0 +1,5 @@
+create table l (k bigint primary key, a bigint);
+create table r (k bigint primary key, b bigint);
+insert into l values (1, 10), (2, 20);
+insert into r values (2, 200), (3, 300);
+select l.k, r.k, a, b from l full join r on l.k = r.k order by coalesce(l.k, r.k);

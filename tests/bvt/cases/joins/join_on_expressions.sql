@@ -1,0 +1,5 @@
+create table a (id bigint primary key, k bigint);
+create table b (k2 bigint primary key, w bigint);
+insert into a values (1, 5), (2, 10);
+insert into b values (6, 60), (11, 110);
+select a.id, b.w from a join b on a.k + 1 = b.k2 order by a.id;

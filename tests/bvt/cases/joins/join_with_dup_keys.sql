@@ -1,0 +1,6 @@
+create table l (id bigint primary key, k bigint);
+create table r (id bigint primary key, k bigint);
+insert into l values (1, 7), (2, 7);
+insert into r values (10, 7), (11, 7);
+select l.id, r.id from l join r on l.k = r.k order by l.id, r.id;
+select count(*) from l join r on l.k = r.k;

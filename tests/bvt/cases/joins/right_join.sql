@@ -1,0 +1,5 @@
+create table l (id bigint primary key, k bigint);
+create table r (k bigint primary key, nm varchar(4));
+insert into l values (1, 10);
+insert into r values (10, 'x'), (20, 'y');
+select r.k, l.id from l right join r on l.k = r.k order by r.k;

@@ -1,0 +1,5 @@
+create table a (id bigint primary key, nm varchar(8));
+create table b (nm varchar(8) primary key, w bigint);
+insert into a values (1, 'x'), (2, 'y'), (3, 'x');
+insert into b values ('x', 100), ('z', 300);
+select a.id, b.w from a join b on a.nm = b.nm order by a.id;

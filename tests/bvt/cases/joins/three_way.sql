@@ -1,0 +1,7 @@
+create table f (id bigint primary key, ck bigint, pk bigint);
+create table c (ck bigint primary key, cn varchar(4));
+create table p (pk bigint primary key, pn varchar(4));
+insert into f values (1, 1, 1), (2, 1, 2), (3, 2, 1);
+insert into c values (1, 'c1'), (2, 'c2');
+insert into p values (1, 'p1'), (2, 'p2');
+select f.id, c.cn, p.pn from f join c on f.ck = c.ck join p on f.pk = p.pk order by f.id;

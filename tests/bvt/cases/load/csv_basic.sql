@@ -1,0 +1,4 @@
+create table people (id bigint primary key, name varchar(16), age bigint);
+load data infile 'tests/bvt/fixtures/people.csv' into table people;
+select * from people order by id;
+select count(*), sum(age) from people;

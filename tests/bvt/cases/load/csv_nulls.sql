@@ -1,0 +1,3 @@
+create table nums (a bigint primary key, b double);
+load data infile 'tests/bvt/fixtures/nums.csv' into table nums;
+select a, b, b is null from nums order by a;

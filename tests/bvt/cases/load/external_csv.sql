@@ -1,0 +1,4 @@
+create external table ppl (id bigint, name varchar(16), age bigint) location 'tests/bvt/fixtures/people.csv';
+select * from ppl order by id;
+select avg(age) from ppl;
+insert into ppl values (9, 'x', 1);

@@ -1,0 +1,3 @@
+create external table v (id bigint, v double) location 'tests/bvt/fixtures/vals.parquet';
+select sum(v) from v;
+select id from v where v > 15 order by id;

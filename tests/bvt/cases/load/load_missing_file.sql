@@ -1,0 +1,2 @@
+create table t (id bigint primary key);
+load data infile 'tests/bvt/fixtures/nope.csv' into table t;

@@ -1,0 +1,3 @@
+create table vals (id bigint primary key, v double);
+load data infile 'tests/bvt/fixtures/vals.parquet' into table vals format parquet;
+select * from vals order by id;

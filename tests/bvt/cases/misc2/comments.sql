@@ -1,0 +1,3 @@
+-- leading comment line
+select 1;
+select 2;

@@ -1,0 +1,4 @@
+select version();
+select database();
+select user() = 'root@localhost';
+select connection_id() > 0;

@@ -1,0 +1,3 @@
+create table t (id bigint primary key);
+insert into t values (1); insert into t values (2);
+select count(*) from t;

@@ -1,0 +1,4 @@
+select 1, 'two', 3.5;
+select 1 + 2 * 3, (1 + 2) * 3;
+select null is null, 1 is not null;
+select true and false, true or false, not true;

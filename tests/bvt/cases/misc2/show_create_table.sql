@@ -1,0 +1,2 @@
+create table t (id bigint primary key, v double, s varchar(16));
+show create table t;

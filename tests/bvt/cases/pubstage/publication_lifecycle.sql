@@ -1,0 +1,6 @@
+create table t (id bigint primary key);
+create publication pub1 for table t;
+show publications;
+drop publication pub1;
+show publications;
+drop publication nosuch;

@@ -1,0 +1,4 @@
+create stage fx url = 'tests/bvt/fixtures';
+create external table ppl (id bigint, name varchar(16), age bigint) location 'stage://fx/people.csv';
+select count(*) from ppl;
+select name from ppl where age > 28 order by name;

@@ -1,0 +1,4 @@
+create stage s1 url = 'tests/bvt/fixtures';
+show stages;
+drop stage s1;
+show stages;

@@ -1,0 +1,6 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 5), (2, 15), (3, 25);
+select id from t where v between 10 and 20;
+select id from t where v not between 10 and 20 order by id;
+select id from t where v in (5, 25) order by id;
+select id from t where v not in (5, 25);

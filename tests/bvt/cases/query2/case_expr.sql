@@ -1,0 +1,4 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 5), (2, 15), (3, null);
+select id, case when v > 10 then 'big' when v is not null then 'small' else 'none' end from t order by id;
+select id, case v when 5 then 'five' else 'other' end from t order by id;

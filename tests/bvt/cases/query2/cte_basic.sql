@@ -1,0 +1,4 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 10), (2, 20), (3, 30);
+with big as (select * from t where v >= 20) select count(*) from big;
+with a as (select id from t), b as (select id from t where id > 1) select count(*) from a join b on a.id = b.id;

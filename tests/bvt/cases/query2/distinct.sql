@@ -1,0 +1,5 @@
+create table t (a bigint, b varchar(4));
+insert into t values (1, 'x'), (1, 'x'), (2, 'y'), (1, 'z');
+select distinct a from t order by a;
+select distinct a, b from t order by a, b;
+select count(distinct a) from t;

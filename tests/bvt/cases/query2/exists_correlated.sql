@@ -1,0 +1,6 @@
+create table o (id bigint primary key, cid bigint);
+create table c (cid bigint primary key, nm varchar(8));
+insert into o values (1, 1), (2, 1), (3, 2);
+insert into c values (1, 'ann'), (2, 'bo'), (3, 'cy');
+select nm from c where exists (select 1 from o where o.cid = c.cid) order by nm;
+select nm from c where not exists (select 1 from o where o.cid = c.cid);

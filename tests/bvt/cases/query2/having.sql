@@ -1,0 +1,4 @@
+create table s (g varchar(2), v bigint);
+insert into s values ('a', 1), ('a', 2), ('b', 10), ('b', 20), ('c', 5);
+select g, sum(v) from s group by g having sum(v) > 5 order by g;
+select g, count(*) from s group by g having count(*) >= 2 order by g;

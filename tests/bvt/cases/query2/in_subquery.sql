@@ -1,0 +1,6 @@
+create table a (id bigint primary key, k bigint);
+create table b (k bigint primary key);
+insert into a values (1, 10), (2, 20), (3, 30);
+insert into b values (10), (30);
+select id from a where k in (select k from b) order by id;
+select id from a where k not in (select k from b) order by id;

@@ -1,0 +1,6 @@
+create table l (id bigint primary key, k bigint);
+create table r (k bigint primary key, nm varchar(4));
+insert into l values (1, 10), (2, 99);
+insert into r values (10, 'x');
+select l.id, r.nm from l left join r on l.k = r.k order by l.id;
+select l.id from l left join r on l.k = r.k where r.nm is null;

@@ -1,0 +1,5 @@
+create table t (id bigint primary key, s varchar(16));
+insert into t values (1, 'apple'), (2, 'apply'), (3, 'banana'), (4, null);
+select id from t where s like 'appl%' order by id;
+select id from t where s like '_pple';
+select id from t where s not like '%an%' order by id;

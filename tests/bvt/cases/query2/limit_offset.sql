@@ -1,0 +1,6 @@
+create table t (id bigint primary key);
+insert into t values (1), (2), (3), (4), (5);
+select id from t order by id limit 2;
+select id from t order by id limit 2 offset 2;
+select id from t order by id desc limit 1 offset 4;
+select id from t order by id limit 0;

@@ -1,0 +1,4 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 10), (2, null), (3, 5), (4, null);
+select id, v from t order by v, id;
+select id, v from t order by v desc, id;

@@ -1,0 +1,4 @@
+create table t (id bigint primary key);
+insert into t values (1), (2), (3), (4), (5), (6), (7), (8);
+select count(*) from t sample 4 rows;
+select count(*) <= 8 from t sample 50 percent;

@@ -1,0 +1,4 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 10), (2, 20);
+select (select max(v) from t);
+select id from t where v = (select max(v) from t);

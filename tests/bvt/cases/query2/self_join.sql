@@ -1,0 +1,3 @@
+create table e (id bigint primary key, mgr bigint);
+insert into e values (1, null), (2, 1), (3, 1), (4, 2);
+select a.id, b.id from e a join e b on a.mgr = b.id order by a.id;

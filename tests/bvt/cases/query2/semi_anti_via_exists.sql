@@ -1,0 +1,6 @@
+create table f (id bigint primary key, k bigint);
+create table d (k bigint primary key);
+insert into f values (1, 1), (2, 2), (3, 1), (4, 3);
+insert into d values (1), (3);
+select count(*) from f where exists (select 1 from d where d.k = f.k);
+select count(*) from f where not exists (select 1 from d where d.k = f.k);

@@ -1,0 +1,4 @@
+create table t (g varchar(2), v bigint);
+insert into t values ('a', 1), ('a', 2), ('b', 5);
+select g, s from (select g, sum(v) s from t group by g) x order by g;
+select max(s) from (select g, sum(v) s from t group by g) x;

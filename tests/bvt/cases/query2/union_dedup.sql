@@ -1,0 +1,4 @@
+create table t (id bigint primary key);
+insert into t values (1), (2), (3);
+select id from t where id <= 2 union select id from t where id >= 2 order by id;
+select id from t where id <= 2 union all select id from t where id >= 2 order by id;

@@ -1,0 +1,5 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 10), (2, 20);
+select v * 2 as dbl from t order by dbl;
+select v * 2 as dbl from t where v > 5 order by dbl desc;
+select t2.v from t t2 where t2.id = 1;

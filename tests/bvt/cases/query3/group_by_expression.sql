@@ -1,0 +1,4 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 5), (2, 15), (3, 25), (4, 35);
+select v / 10, count(*) from t group by v / 10 order by 1;
+select v % 2, sum(v) from t group by v % 2 order by 1;

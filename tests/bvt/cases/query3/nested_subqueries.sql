@@ -1,0 +1,4 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 10), (2, 20), (3, 30), (4, 40);
+select id from t where v > (select avg(v) from t) order by id;
+select count(*) from t where v < (select max(v) from t where v < (select max(v) from t));

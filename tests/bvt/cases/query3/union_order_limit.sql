@@ -1,0 +1,6 @@
+create table a (x bigint primary key);
+create table b (x bigint primary key);
+insert into a values (1), (3), (5);
+insert into b values (2), (3), (6);
+select x from a union select x from b order by x limit 4;
+select x from a union all select x from b order by x desc limit 3;

@@ -1,0 +1,5 @@
+create table t (a bigint primary key, b bigint);
+insert into t values (1, 1), (2, 2), (3, 3), (4, 4);
+select a from t where a = 1 or a = 2 and b = 99;
+select a from t where (a = 1 or a = 2) and b <= 2 order by a;
+select a from t where not a = 1 order by a;

@@ -1,0 +1,6 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 1), (2, 2), (3, 3);
+create snapshot full;
+delete from t where id <= 2;
+select * from t order by id;
+select * from t as of snapshot 'full' order by id;

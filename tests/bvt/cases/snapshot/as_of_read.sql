@@ -1,0 +1,8 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 10), (2, 20);
+create snapshot before;
+insert into t values (3, 30);
+update t set v = 99 where id = 1;
+select * from t order by id;
+select * from t as of snapshot 'before' order by id;
+select count(*) from t as of snapshot 'before';

@@ -1,0 +1,7 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 10);
+create snapshot s1;
+select count(*) from t as of snapshot 's1';
+drop snapshot s1;
+select count(*) from t as of snapshot 's1';
+drop snapshot nosuch;

@@ -1,0 +1,9 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 1);
+create snapshot v1;
+insert into t values (2, 2);
+create snapshot v2;
+insert into t values (3, 3);
+select count(*) from t as of snapshot 'v1';
+select count(*) from t as of snapshot 'v2';
+select count(*) from t;

@@ -1,0 +1,7 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 10), (2, 20);
+create snapshot keep;
+delete from t;
+select count(*) from t;
+restore table t from snapshot keep;
+select * from t order by id;

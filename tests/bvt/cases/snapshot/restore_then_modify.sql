@@ -1,0 +1,7 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 1);
+create snapshot base;
+insert into t values (2, 2);
+restore table t from snapshot base;
+insert into t values (5, 5);
+select * from t order by id;

@@ -1,0 +1,5 @@
+create table t (id bigint primary key);
+select * from t as of snapshot 'missing';
+create snapshot dup;
+create snapshot dup;
+restore table nosuch from snapshot dup;

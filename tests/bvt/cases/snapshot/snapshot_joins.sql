@@ -1,0 +1,8 @@
+create table a (id bigint primary key, k bigint);
+create table b (k bigint primary key, nm varchar(8));
+insert into a values (1, 10), (2, 20);
+insert into b values (10, 'x'), (20, 'y');
+create snapshot j1;
+insert into a values (3, 10);
+update b set nm = 'z' where k = 10;
+select a.id, b.nm from a as of snapshot 'j1' a join b as of snapshot 'j1' b on a.k = b.k order by a.id;

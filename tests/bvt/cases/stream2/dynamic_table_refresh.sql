@@ -1,0 +1,8 @@
+create table base (id bigint primary key, g varchar(2), v bigint);
+insert into base values (1, 'a', 10), (2, 'b', 20);
+create dynamic table agg as select g, sum(v) s from base group by g;
+refresh dynamic table agg;
+select * from agg order by g;
+insert into base values (3, 'a', 5);
+refresh dynamic table agg;
+select * from agg order by g;

@@ -1,0 +1,3 @@
+create source events (id bigint, kind varchar(8), val bigint);
+insert into events values (1, 'click', 5);
+select * from events;

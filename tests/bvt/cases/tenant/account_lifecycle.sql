@@ -1,0 +1,5 @@
+create account acme admin_name 'alice' identified by 'pw';
+show accounts;
+create account acme admin_name 'x' identified by 'y';
+drop account acme;
+drop account nosuch;

@@ -1,0 +1,7 @@
+create account a1 admin_name 'adm' identified by 'p';
+create account a2 admin_name 'adm' identified by 'p';
+-- @session s1 a1:adm
+create table secrets (id bigint primary key);
+-- @session s2 a2:adm
+select * from secrets;
+drop table secrets;

@@ -1,0 +1,14 @@
+create account corp admin_name 'adm' identified by 'p';
+-- @session adm corp:adm
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 10);
+create user w identified by 'wp';
+create role writer;
+grant select on table t to writer;
+grant insert on table t to writer;
+grant writer to w;
+-- @session w corp:w
+insert into t values (2, 20);
+select * from t order by id;
+update t set v = 99 where id = 1;
+delete from t where id = 1;

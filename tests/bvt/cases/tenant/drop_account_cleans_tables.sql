@@ -1,0 +1,9 @@
+create account tmp admin_name 'adm' identified by 'p';
+-- @session s tmp:adm
+create table t (id bigint primary key);
+insert into t values (1);
+-- @session default
+drop account tmp;
+create account tmp admin_name 'adm' identified by 'p';
+-- @session s2 tmp:adm
+select * from t;

@@ -1,0 +1,8 @@
+create account corp admin_name 'adm' identified by 'p';
+-- @session adm corp:adm
+create user maker identified by 'mp';
+create role builder;
+grant create on * to builder;
+grant builder to maker;
+-- @session maker corp:maker
+create table made (id bigint primary key);

@@ -1,0 +1,15 @@
+create account corp admin_name 'adm' identified by 'p';
+-- @session adm corp:adm
+create table sal (id bigint primary key, amt bigint);
+insert into sal values (1, 100), (2, 200);
+create user bob identified by 'bp';
+create role reader;
+grant select on table sal to reader;
+grant reader to bob;
+-- @session bob corp:bob
+select * from sal order by id;
+insert into sal values (3, 300);
+-- @session adm
+revoke reader from bob;
+-- @session bob
+select * from sal;

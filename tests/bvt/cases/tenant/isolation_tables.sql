@@ -1,0 +1,12 @@
+create account a1 admin_name 'adm' identified by 'p';
+create account a2 admin_name 'adm' identified by 'p';
+-- @session s1 a1:adm
+create table t (id bigint primary key, v varchar(8));
+insert into t values (1, 'one');
+-- @session s2 a2:adm
+create table t (id bigint primary key, v varchar(8));
+insert into t values (7, 'seven'), (8, 'eight');
+select count(*) from t;
+-- @session s1
+select * from t order by id;
+show tables;

@@ -1,0 +1,6 @@
+create account corp admin_name 'adm' identified by 'p';
+-- @session adm corp:adm
+create user pleb identified by 'pp';
+-- @session pleb corp:pleb
+create user another identified by 'x';
+create role r2;

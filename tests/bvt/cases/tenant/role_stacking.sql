@@ -1,0 +1,16 @@
+create account corp admin_name 'adm' identified by 'p';
+-- @session adm corp:adm
+create table a (id bigint primary key);
+create table b (id bigint primary key);
+insert into a values (1);
+insert into b values (2);
+create user u identified by 'up';
+create role ra;
+create role rb;
+grant select on table a to ra;
+grant select on table b to rb;
+grant ra to u;
+grant rb to u;
+-- @session u corp:u
+select * from a;
+select * from b;

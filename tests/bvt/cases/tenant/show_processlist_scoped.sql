@@ -1,0 +1,4 @@
+create account a1 admin_name 'adm' identified by 'p';
+-- @session t1 a1:adm
+create table x (id bigint primary key);
+select count(*) > 0 from x;

@@ -1,0 +1,8 @@
+create account corp admin_name 'adm' identified by 'p';
+-- @session adm corp:adm
+create user u identified by 'up';
+create role r;
+drop role r;
+drop user u;
+drop user ghost;
+drop role ghost;

@@ -1,0 +1,14 @@
+create account corp admin_name 'adm' identified by 'p';
+-- @session adm corp:adm
+create table t (id bigint primary key);
+insert into t values (1);
+create user u identified by 'up';
+create role r;
+grant select on table t to r;
+grant r to u;
+-- @session u corp:u
+select count(*) from t;
+-- @session adm
+revoke select on table t from r;
+-- @session u
+select count(*) from t;

@@ -1,0 +1,10 @@
+create account corp admin_name 'adm' identified by 'p';
+-- @session adm corp:adm
+create table t (id bigint primary key);
+create user u identified by 'up';
+create role r;
+grant select on table t to r;
+grant insert on table t to r;
+grant r to u;
+-- @session u corp:u
+show grants;

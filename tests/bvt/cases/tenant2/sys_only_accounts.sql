@@ -1,0 +1,5 @@
+create account corp admin_name 'adm' identified by 'p';
+-- @session adm corp:adm
+create account nested admin_name 'x' identified by 'y';
+drop account corp;
+show accounts;

@@ -1,0 +1,4 @@
+create table m (ts bigint, v double);
+insert into m values (0, 10), (30, 40);
+select time_bucket(ts, 10) b, sum(v) from m group by time_bucket(ts, 10) fill(linear) order by b;
+select time_bucket(ts, 10) b, sum(v) from m group by time_bucket(ts, 10) fill(value, -1) order by b;

@@ -1,0 +1,3 @@
+create table m (ts bigint, v bigint);
+insert into m values (0, 10), (20, 30);
+select time_bucket(ts, 10) b, sum(v) from m group by time_bucket(ts, 10) fill(prev) order by b;

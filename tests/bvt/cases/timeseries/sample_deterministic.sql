@@ -1,0 +1,4 @@
+create table m (id bigint primary key);
+insert into m values (1),(2),(3),(4),(5),(6);
+select count(*) from m sample 3 rows;
+select count(*) from m sample 100 percent;

@@ -1,0 +1,4 @@
+create table m (ts bigint, v bigint);
+insert into m values (5, 1), (15, 2), (25, 3), (35, 4), (95, 5);
+select time_bucket(ts, 10) b, sum(v) from m group by time_bucket(ts, 10) order by b;
+select time_bucket(ts, 30) b, count(*) from m group by time_bucket(ts, 30) order by b;

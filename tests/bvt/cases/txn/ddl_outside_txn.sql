@@ -1,0 +1,3 @@
+begin;
+create table inside (id bigint primary key);
+commit;

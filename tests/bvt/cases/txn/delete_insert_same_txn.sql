@@ -1,0 +1,8 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 1), (2, 2);
+begin;
+delete from t where id = 1;
+insert into t values (3, 3);
+select * from t order by id;
+rollback;
+select * from t order by id;

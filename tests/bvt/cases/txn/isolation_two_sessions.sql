@@ -1,0 +1,11 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 100);
+-- @session writer
+begin;
+update t set v = 200 where id = 1;
+-- @session default
+select v from t where id = 1;
+-- @session writer
+commit;
+-- @session default
+select v from t where id = 1;

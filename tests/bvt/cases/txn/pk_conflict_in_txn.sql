@@ -1,0 +1,6 @@
+create table t (id bigint primary key);
+insert into t values (1);
+begin;
+insert into t values (1);
+rollback;
+select count(*) from t;

@@ -1,0 +1,11 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 1);
+-- @session rdr
+begin;
+select count(*) from t;
+-- @session default
+insert into t values (2, 2);
+-- @session rdr
+select count(*) from t;
+commit;
+select count(*) from t;

@@ -1,0 +1,7 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 10);
+begin;
+update t set v = 20 where id = 1;
+select v from t where id = 1;
+rollback;
+select v from t where id = 1;

@@ -1,0 +1,13 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 100);
+-- @session a
+begin;
+update t set v = 111 where id = 1;
+-- @session b
+begin;
+update t set v = 222 where id = 1;
+-- @session a
+commit;
+-- @session b
+commit;
+select v from t where id = 1;

@@ -1,0 +1,4 @@
+create table docs (id bigint primary key, emb vecf32(3));
+insert into docs values (1, '[2,0,0]'), (2, '[0,3,0]'), (3, '[1,1,0]');
+create index cv using ivfflat on docs (emb) lists = 1 op_type = 'vector_cosine_ops';
+select id from docs order by cosine_distance(emb, '[1,0,0]') limit 2;

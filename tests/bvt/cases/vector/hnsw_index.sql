@@ -1,0 +1,4 @@
+create table h (id bigint primary key, emb vecf32(3));
+insert into h values (1, '[1,0,0]'), (2, '[0,1,0]'), (3, '[0,0,1]'), (4, '[0.8,0.2,0]');
+create index hx using hnsw on h (emb) op_type = 'vector_l2_ops';
+select id from h order by l2_distance(emb, '[1,0,0]') limit 2;

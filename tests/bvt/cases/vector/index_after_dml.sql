@@ -1,0 +1,7 @@
+create table items (id bigint primary key, emb vecf32(3));
+insert into items values (1, '[1,0,0]'), (2, '[0,1,0]'), (3, '[0,0,1]');
+create index iv using ivfflat on items (emb) lists = 1 op_type = 'vector_l2_ops';
+insert into items values (4, '[0.95,0.05,0]');
+select id from items order by l2_distance(emb, '[1,0,0]') limit 2;
+delete from items where id = 1;
+select id from items order by l2_distance(emb, '[1,0,0]') limit 1;

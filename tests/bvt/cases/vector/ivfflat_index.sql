@@ -1,0 +1,6 @@
+create table items (id bigint primary key, emb vecf32(4));
+insert into items values (1, '[1,0,0,0]'), (2, '[0.9,0.1,0,0]'), (3, '[0,1,0,0]'), (4, '[0,0.9,0.1,0]'), (5, '[0,0,1,0]'), (6, '[0,0,0.9,0.1]'), (7, '[0,0,0,1]'), (8, '[0.1,0,0,0.9]');
+create index iv using ivfflat on items (emb) lists = 2 op_type = 'vector_l2_ops';
+show indexes from items;
+select id from items order by l2_distance(emb, '[1,0,0,0]') limit 2;
+select id from items order by l2_distance(emb, '[0,0,0,1]') limit 2;

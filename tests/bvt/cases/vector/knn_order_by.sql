@@ -1,0 +1,4 @@
+create table v (id bigint primary key, emb vecf32(3));
+insert into v values (1, '[1,0,0]'), (2, '[0,1,0]'), (3, '[0,0,1]'), (4, '[0.9,0.1,0]');
+select id from v order by l2_distance(emb, '[1,0,0]') limit 2;
+select id from v order by l2_distance(emb, '[0,0,1]') limit 1;

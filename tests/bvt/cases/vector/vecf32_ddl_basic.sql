@@ -1,0 +1,5 @@
+create table v (id bigint primary key, emb vecf32(4));
+insert into v values (1, '[1,0,0,0]'), (2, '[0,1,0,0]'), (3, '[0.5,0.5,0,0]');
+select id from v order by id;
+select l2_distance(emb, '[1,0,0,0]') from v order by id;
+select cosine_similarity(emb, '[1,0,0,0]') from v order by id;

@@ -1,0 +1,4 @@
+create table v (id bigint primary key, a vecf32(3), b vecf32(3));
+insert into v values (1, '[1,2,3]', '[4,5,6]');
+select inner_product(a, b) from v;
+select l2_distance_sq(a, b) from v;

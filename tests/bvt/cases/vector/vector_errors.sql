@@ -1,0 +1,4 @@
+create table v (id bigint primary key, emb vecf32(3));
+insert into v values (1, '[1,2]');
+insert into v values (1, '[1,2,3]');
+select l2_distance(emb, '[1,2]') from v;

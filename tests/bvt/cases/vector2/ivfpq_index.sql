@@ -1,0 +1,4 @@
+create table big (id bigint primary key, emb vecf32(8));
+insert into big values (1, '[1,0,0,0,0,0,0,0]'), (2, '[0,1,0,0,0,0,0,0]'), (3, '[0,0,1,0,0,0,0,0]'), (4, '[0,0,0,1,0,0,0,0]'), (5, '[0.9,0.1,0,0,0,0,0,0]'), (6, '[0,0,0,0,1,0,0,0]'), (7, '[0,0,0,0,0,1,0,0]'), (8, '[0,0,0,0,0,0,1,0]');
+create index pq using ivfpq on big (emb) lists = 2 op_type = 'vector_l2_ops';
+select id from big order by l2_distance(emb, '[1,0,0,0,0,0,0,0]') limit 2;

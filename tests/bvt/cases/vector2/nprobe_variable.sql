@@ -1,0 +1,7 @@
+create table v (id bigint primary key, emb vecf32(3));
+insert into v values (1, '[1,0,0]'), (2, '[0,1,0]'), (3, '[0,0,1]'), (4, '[0.7,0.3,0]');
+create index iv using ivfflat on v (emb) lists = 2 op_type = 'vector_l2_ops';
+set ivf_nprobe = 1;
+select id from v order by l2_distance(emb, '[1,0,0]') limit 1;
+set ivf_nprobe = 2;
+select id from v order by l2_distance(emb, '[1,0,0]') limit 2;

@@ -1,0 +1,3 @@
+create table v (id bigint primary key, emb vecf32(3), cat varchar(4));
+insert into v values (1, '[1,0,0]', 'a'), (2, '[0,1,0]', 'b'), (3, '[0.9,0.1,0]', 'a');
+select id from v where cat = 'a' order by l2_distance(emb, '[1,0,0]') limit 2;

@@ -1,0 +1,5 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1,5),(2,10),(3,15),(4,20),(5,25);
+select id, sum(v) over (order by id rows between 1 preceding and 1 following) from t order by id;
+select id, max(v) over (order by id rows between unbounded preceding and 1 preceding) from t order by id;
+select id, count(*) over (order by id rows between current row and unbounded following) from t order by id;

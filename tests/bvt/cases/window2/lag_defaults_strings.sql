@@ -1,0 +1,4 @@
+create table t (id bigint primary key, s varchar(4));
+insert into t values (1, 'a'), (2, 'b'), (3, 'c');
+select id, lag(s) over (order by id), lead(s, 2) over (order by id) from t order by id;
+select id, lag(id, 1, -99) over (order by id) from t order by id;

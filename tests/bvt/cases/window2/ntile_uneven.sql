@@ -1,0 +1,3 @@
+create table t (id bigint primary key);
+insert into t values (1),(2),(3),(4),(5);
+select id, ntile(2) over (order by id), ntile(3) over (order by id), ntile(7) over (order by id) from t order by id;

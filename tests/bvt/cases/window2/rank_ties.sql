@@ -1,0 +1,3 @@
+create table t (id bigint primary key, v bigint);
+insert into t values (1, 10), (2, 10), (3, 20), (4, 20), (5, 30);
+select id, rank() over (order by v), dense_rank() over (order by v), row_number() over (order by v, id) from t order by id;

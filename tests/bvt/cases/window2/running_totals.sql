@@ -1,0 +1,5 @@
+create table s (id bigint primary key, g varchar(2), v bigint);
+insert into s values (1,'a',10),(2,'a',20),(3,'a',30),(4,'b',5),(5,'b',15);
+select id, sum(v) over (partition by g order by id) from s order by id;
+select id, avg(v) over (partition by g order by id) from s order by id;
+select id, min(v) over (partition by g order by id desc) from s order by id;

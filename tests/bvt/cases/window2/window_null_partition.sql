@@ -1,0 +1,4 @@
+create table t (id bigint primary key, g bigint, v bigint);
+insert into t values (1, null, 10), (2, null, 20), (3, 1, 30);
+select id, sum(v) over (partition by g) from t order by id;
+select id, row_number() over (partition by g order by id) from t order by id;

@@ -1,0 +1,5 @@
+create table a (id bigint primary key, k bigint);
+create table b (k bigint primary key, w bigint);
+insert into a values (1, 1), (2, 2), (3, 1);
+insert into b values (1, 100), (2, 200);
+select a.id, sum(b.w) over (partition by a.k) from a join b on a.k = b.k order by a.id;
