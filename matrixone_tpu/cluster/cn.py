@@ -150,7 +150,15 @@ class LogtailConsumer:
     def _apply(self, applier: WalApplier, h: dict, b: bytes) -> None:
         op = h.get("op")
         if op == "__caught_up__":
+            # the marker's ts is the TN frontier at subscribe time:
+            # every commit <= it was in the backlog just applied, so the
+            # frontier itself is applied (wait_ts targets become
+            # reachable on an idle cluster)
+            self._advance(h.get("ts", 0), commit=True)
             self._caught_up.set()
+            return
+        if op == "__frontier__":
+            self._advance(h.get("ts", 0), commit=True)
             return
         rep = self.replica
         if op == "__resync__":
@@ -365,13 +373,37 @@ class RemoteCatalog:
                 f"CN replica quarantined — logtail apply kept failing "
                 f"(last error: {self.consumer.last_error})")
 
+    def sync_frontier(self, timeout: float = 30.0) -> None:
+        """Catch the replica up to the TN's CURRENT commit frontier
+        (reference: disttae waitCanServeTableSnapshot,
+        logtail_consumer.go:389 — reads gate on the logtail reaching
+        the snapshot). Used on catalog misses: a table created through
+        ANOTHER connection must be visible once the TN has it."""
+        try:
+            resp = self._call({"op": "ping"})
+            self.consumer.wait_ts(resp["committed_ts"], timeout=timeout)
+        except (OSError, ConnectionError, ValueError):
+            pass                       # TN down: serve the local frontier
+
     def get_table(self, name: str):
         self._check_breaker()
-        return _TableProxy(self, self._replica.get_table(name))
+        try:
+            t = self._replica.get_table(name)
+        except ValueError:
+            # not here YET? close the replication gap once and retry —
+            # "no such table" must mean the CLUSTER doesn't have it,
+            # not that this replica is lagging
+            self.sync_frontier()
+            t = self._replica.get_table(name)
+        return _TableProxy(self, t)
 
     def get_table_meta(self, name: str):
         self._check_breaker()
-        return self._replica.get_table_meta(name)
+        try:
+            return self._replica.get_table_meta(name)
+        except ValueError:
+            self.sync_frontier()
+            return self._replica.get_table_meta(name)
 
     # ------------------------------------------------------------ writes
     def commit_write(self, table: str, arrays, validity) -> int:

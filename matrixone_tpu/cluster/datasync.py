@@ -162,6 +162,11 @@ class StandbyAgent:
         if op == "__caught_up__":
             self._caught_up.set()
             return
+        if op == "__frontier__":
+            # heartbeat marker: advances the in-memory position only —
+            # never journaled (there is nothing durable to replay)
+            self._advance(h.get("ts", 0))
+            return
         if op == "__resync__":
             # our position predates the primary's checkpoint: rebuild
             # from the primary's manifest is impossible here (separate

@@ -121,6 +121,20 @@ class LogtailHub:
         with self._lock:
             self._subs = [s for s in self._subs if s is not q]
 
+    def safe_frontier(self, q: queue.Queue, committed_ts: int):
+        """`committed_ts` only if every appended record has been
+        dispatched AND this subscriber's queue is drained — otherwise
+        None. Read committed_ts BEFORE calling: commit order is
+        hub.append -> committed_ts advance, so a committed ts implies
+        its record already holds an lsn, and the two checks then prove
+        it was delivered. Advertising a frontier ahead of delivery
+        would let subscribers advance applied_ts past records still in
+        flight (permanent loss on a resubscribe)."""
+        with self._lock:
+            if self._processed_lsn == self._next_lsn - 1 and q.empty():
+                return committed_ts
+            return None
+
 
 from matrixone_tpu.cluster.rpc import err_name as _err_name, unpack_blobs
 
@@ -376,12 +390,30 @@ class TNService:
                 _send_msg(conn, {"op": "__resync__", "ts": ck})
             for h, b in backlog:
                 _send_msg(conn, h, b)
+            cu_ts = self.engine.committed_ts
+            cu_safe = self.hub.safe_frontier(q, cu_ts)
             _send_msg(conn, {"op": "__caught_up__",
-                             "ts": self.engine.committed_ts})
+                             "ts": cu_safe or 0})
             while not self._stopping.is_set():
                 try:
-                    h, b = q.get(timeout=1.0)
+                    # 250ms cadence: new sessions sync to the frontier
+                    # at connect, so the idle heartbeat bounds their
+                    # connect-time stall
+                    h, b = q.get(timeout=0.25)
                 except queue.Empty:
+                    # frontier heartbeat (reference: logtail periodic
+                    # update-ts events): an idle CN's applied_ts keeps
+                    # tracking the TN frontier, so read gates
+                    # (sync_frontier / fragment snapshots) stay
+                    # reachable without fresh commits. ONLY a
+                    # delivery-safe frontier is advertised (see
+                    # safe_frontier) — never a ts ahead of records
+                    # still in the dispatch pipeline.
+                    ts = self.engine.committed_ts
+                    safe = self.hub.safe_frontier(q, ts)
+                    if safe:
+                        _send_msg(conn, {"op": "__frontier__",
+                                         "ts": safe})
                     continue
                 _send_msg(conn, h, b)
         except (ConnectionError, OSError):

@@ -75,6 +75,13 @@ class Session:
         if auth is not None and auth.account != "sys":
             from matrixone_tpu.frontend.auth import ScopedCatalog
             self.catalog = ScopedCatalog(self.catalog, auth.account)
+        # a NEW session on a CN starts at the cluster frontier (the
+        # reference's reads gate on the logtail reaching the snapshot;
+        # here one catch-up per connection keeps cross-connection
+        # read-your-writes without a per-statement RPC)
+        sync = getattr(self.catalog, "sync_frontier", None)
+        if sync is not None:
+            sync()
         self.txn_client = TxnClient(self.catalog)
         self.txn = None                 # active explicit transaction
         self.last_insert_id = 0         # MySQL LAST_INSERT_ID()
@@ -109,8 +116,16 @@ class Session:
         from matrixone_tpu.utils import metrics as M
         from matrixone_tpu.utils.trace import STMT_TABLE, StatementRecorder
         # statement tracing is engine-global (one system table), never
-        # tenant-scoped — always hang it off the inner engine
+        # tenant-scoped — always hang it off the TRUE engine: unwrap the
+        # tenant scope AND the CN's RemoteCatalog facade. Writing through
+        # the facade is how round 5's nastiest bug happened: the trace
+        # flush's `engine.committed_ts = ...` created an INSTANCE
+        # attribute on the RemoteCatalog that permanently shadowed the
+        # replica's live committed_ts behind __getattr__, freezing every
+        # later transaction's begin snapshot (stale snapshots ->
+        # spurious write-write conflicts on busy CN sessions)
         rec_host = getattr(self.catalog, "_inner", self.catalog)
+        rec_host = getattr(rec_host, "_replica", rec_host)
         if not hasattr(rec_host, "stmt_recorder"):
             rec_host.stmt_recorder = StatementRecorder(rec_host)
         if STMT_TABLE in sql:

@@ -42,6 +42,10 @@ class StatementRecorder:
         self._ensure_table()
 
     def _ensure_table(self):
+        """Idempotent; also called per flush — a CN replica resync
+        (rep.tables = {}) wipes the in-memory stmt table, and the next
+        flush must recreate it instead of failing the user's
+        statement."""
         from matrixone_tpu.storage.engine import TableMeta
         if STMT_TABLE not in self.engine.tables:
             self.engine.create_table(
@@ -66,6 +70,7 @@ class StatementRecorder:
         if not buf:
             return
         import numpy as np
+        self._ensure_table()
         t = self.engine.get_table(STMT_TABLE)
         cols = list(zip(*buf))
         arrays = {

@@ -226,3 +226,39 @@ def test_transient_error_heals_without_breaking(tn_pair):
     assert not cat2.consumer.broken
     assert len(s2.execute("select * from h").rows()) == 2
     assert cat2.consumer.strikes == 0
+
+
+def test_trace_flush_does_not_freeze_txn_snapshots(tmp_path):
+    """Round-5 root cause: the statement recorder's committed_ts advance
+    wrote THROUGH the RemoteCatalog facade, creating an instance
+    attribute that shadowed the replica's live committed_ts — every
+    later BEGIN got a frozen snapshot and busy sessions hit spurious
+    write-write conflicts. The recorder must hang off the true engine."""
+    import time
+
+    from matrixone_tpu.cluster import RemoteCatalog, TNService
+    from matrixone_tpu.frontend import Session
+    from matrixone_tpu.utils.trace import STMT_TABLE
+
+    d = str(tmp_path / "store")
+    tn = TNService(data_dir=d).start()
+    cat = RemoteCatalog(("127.0.0.1", tn.port), data_dir=d)
+    try:
+        s = Session(catalog=cat)
+        s.execute("create table t (id bigint primary key, v bigint)")
+        s.execute("insert into t values (1, 1)")
+        # force a trace flush (querying the stmt table flushes it)
+        s.execute(f"select count(*) > 0 from {STMT_TABLE}")
+        # the facade must NOT carry its own committed_ts now
+        assert "committed_ts" not in vars(cat), \
+            "trace flush wrote committed_ts onto the RemoteCatalog"
+        # repeated txn write->commit->begin cycles: every begin must see
+        # the previous commit (no frozen snapshot, no conflicts)
+        for i in range(6):
+            s.execute("begin")
+            s.execute(f"update t set v = {i} where id = 1")
+            s.execute("commit")
+        assert s.execute("select v from t").rows() == [(5,)]
+    finally:
+        cat.close()
+        tn.stop()

@@ -187,7 +187,14 @@ def test_migrated_session_accounting_on_close():
         serving = [k for k, v in px.stats().items() if v > 0][0]
         h, p = serving.split(":")
         px.drain(h, int(p))
-        c.query("select 1")            # triggers migration
+        # migration happens at a COMMAND boundary: the serve loop is
+        # blocked reading the next command when drain lands, so the
+        # move occurs before the SECOND post-drain command
+        import time as _t
+        deadline = _t.time() + 10
+        while _t.time() < deadline and not px.drained(h, int(p)):
+            c.query("select 1")
+            _t.sleep(0.05)
         assert px.drained(h, int(p))
         c.close()
         import time as _t
