@@ -1,0 +1,63 @@
+"""Bootstrap + rolling catalog upgrades.
+
+Reference analogue: `pkg/bootstrap` (+ `bootstrap/versions/`): first
+boot creates the system tables; every later boot runs the ORDERED chain
+of version migrations so a data dir written by an older build upgrades
+in place — system tables appear/extend without dump/restore, and the
+manifest records the catalog version reached.
+
+Design here: migrations are idempotent functions keyed by the version
+they establish. `upgrade(engine)` runs every migration above the data
+dir's recorded version, in order, then stamps the engine; the next
+checkpoint persists the stamp. A brand-new engine starts at
+CATALOG_VERSION directly (migrations are for OLD dirs, not new ones) —
+but running them anyway is safe by the idempotency contract, which the
+tests enforce by running the chain twice.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+#: bump when adding a migration below
+CATALOG_VERSION = 3
+
+
+def _v2_account_tables(engine) -> None:
+    """r4 added tenants: dirs from before have no mo_account/mo_user/
+    mo_role/mo_user_role/mo_priv. AccountManager bootstraps them
+    idempotently (sys account + root included)."""
+    from matrixone_tpu.frontend.auth import AccountManager
+    mgr = getattr(engine, "_auth_mgr", None)
+    if mgr is None:
+        engine._auth_mgr = AccountManager(engine)
+
+
+def _v3_statement_info(engine) -> None:
+    """r1's observability table, for dirs that predate it (or lost it):
+    statement tracing must never fail a user statement because the
+    table is missing."""
+    from matrixone_tpu.utils.trace import StatementRecorder
+    if not hasattr(engine, "stmt_recorder"):
+        engine.stmt_recorder = StatementRecorder(engine)
+
+
+#: ordered: version N's migration brings a (N-1)-dir to N
+MIGRATIONS: Dict[int, Callable] = {
+    2: _v2_account_tables,
+    3: _v3_statement_info,
+}
+
+
+def upgrade(engine) -> List[int]:
+    """Run pending migrations; returns the versions applied. Safe to
+    call on every open (reference: bootstrap runs on every service
+    start and no-ops when current)."""
+    have = getattr(engine, "catalog_version", 1)
+    applied: List[int] = []
+    for ver in sorted(MIGRATIONS):
+        if ver > have:
+            MIGRATIONS[ver](engine)
+            applied.append(ver)
+    engine.catalog_version = max(have, CATALOG_VERSION)
+    return applied

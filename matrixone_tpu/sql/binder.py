@@ -1307,6 +1307,8 @@ _SCALAR_FUNCS = {
     "bit_count": ("bit_count", lambda ts: dt.INT64),
     "uuid": ("uuid", lambda ts: dt.VARCHAR),
     "rand": ("rand", lambda ts: dt.FLOAT64),
+    # ---- LLM family (func_builtin_llm.go role; endpoint-configured)
+    "llm_chat": ("llm_chat", lambda ts: dt.VARCHAR),
 }
 
 
@@ -1431,6 +1433,16 @@ def bind_scalar_function(name: str, args: List[BoundExpr]) -> BoundExpr:
         lnx = BoundFunc("ln", [args[1]], dt.FLOAT64)
         lnb = BoundFunc("ln", [args[0]], dt.FLOAT64)
         return BoundFunc("div", [lnx, lnb], dt.FLOAT64)
+    if name == "llm_embed":
+        # embedding width is session-configured (the endpoint's model
+        # decides; the session pins the SQL-visible vector type)
+        from matrixone_tpu.frontend.session import current_session
+        s = current_session()
+        dim = int((s.variables.get("llm_embed_dim", 16)
+                   if s is not None else 16))
+        if len(args) != 1:
+            raise BindError("llm_embed(text) takes one argument")
+        return BoundFunc("llm_embed", args, dt.vecf32(dim))
     if name in ("timestampadd", "timestampdiff"):
         if len(args) != 3 or not isinstance(args[0], BoundLiteral):
             raise BindError(f"{name}(unit, a, b) takes a unit keyword "

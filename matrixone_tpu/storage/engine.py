@@ -663,7 +663,12 @@ class Engine:
     """Catalog + single-writer commit service + WAL + checkpoint/replay."""
 
     def __init__(self, fs: Optional[FileService] = None, wal=None):
+        from matrixone_tpu import bootstrap as _bootstrap
         self.fs = fs if fs is not None else MemoryFS()
+        #: rolling-upgrade stamp (pkg/bootstrap/versions role): fresh
+        #: engines are born current; _load_checkpoint overwrites with
+        #: the data dir's recorded version and open() migrates up
+        self.catalog_version = _bootstrap.CATALOG_VERSION
         # wal: anything with append/truncate/replay — the local CRC log by
         # default, logservice.replicated.ReplicatedLog for the multi-
         # process log role (reference: logservice client behind tae/logstore)
@@ -1126,6 +1131,8 @@ class Engine:
 
     def _checkpoint_locked(self) -> None:
         manifest = {"ckpt_ts": self.hlc.now(), "tables": {},
+                    "catalog_version": getattr(self, "catalog_version",
+                                               None) or 1,
                     "snapshots": dict(self.snapshots),
                     "stages": dict(self.stages), "externals": {},
                     "publications": {k: list(v) for k, v
@@ -1203,6 +1210,10 @@ class Engine:
         eng._load_checkpoint()
         eng._replay_wal()
         eng.committed_ts = eng.hlc.now()
+        # rolling catalog upgrades (pkg/bootstrap/versions role): an
+        # old data dir gains the newer system tables in place
+        from matrixone_tpu import bootstrap
+        bootstrap.upgrade(eng)
         return eng
 
     @classmethod
@@ -1223,6 +1234,7 @@ class Engine:
             return
         manifest = json.loads(fs.read("meta/manifest.json").decode())
         self._ckpt_ts = manifest.get("ckpt_ts", 0)
+        self.catalog_version = manifest.get("catalog_version", 1)
         self.snapshots = dict(manifest.get("snapshots", {}))
         self.stages = dict(manifest.get("stages", {}))
         self.publications = {k: list(v) for k, v in

@@ -63,13 +63,24 @@ def open_location(engine, url: str):
 
 
 def read_datalink(engine, url: str) -> str:
-    """load_file(datalink): the file's text content (reference: datalink
-    type + load_file function)."""
+    """load_file(datalink): the file's TEXT content — documents
+    (.pdf/.docx) are extracted, everything else decodes as UTF-8
+    (reference: pkg/datalink document readers + load_file)."""
+    from matrixone_tpu.storage.doctext import extract_text
     src = open_location(engine, url)
     if isinstance(src, io.BytesIO):
-        return src.getvalue().decode("utf-8", errors="replace")
-    with open(src, "rb") as f:
-        return f.read().decode("utf-8", errors="replace")
+        blob = src.getvalue()
+    else:
+        with open(src, "rb") as f:
+            blob = f.read()
+    try:
+        return extract_text(url, blob)
+    except Exception as e:               # noqa: BLE001 — malformed
+        # document: a SQL-level error, never a raw BadZipFile/XML
+        # traceback out of the binder's const-fold
+        raise ExternalError(
+            f"cannot extract text from {url!r}: "
+            f"{type(e).__name__}: {e}") from None
 
 
 def _rg_excluded(rg_meta, names: List[str], filters, qmap) -> bool:

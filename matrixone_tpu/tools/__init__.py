@@ -1,0 +1,2 @@
+"""Ops tooling (reference: cmd/mo-tool, cmd/mo-inspect,
+cmd/mo-object-tool, cmd/mo-dashboard — ~27k LoC of operator CLIs)."""

@@ -1,0 +1,190 @@
+"""Offline data-dir inspector — the mo-tool / mo-inspect /
+mo-object-tool role (reference: cmd/mo-inspect object/checkpoint
+readers, VIEW_CKP_STATUS.md ops doc).
+
+Reads a cluster data dir DIRECTLY (no engine process needed):
+
+    python -m matrixone_tpu.tools.inspect manifest <data_dir>
+    python -m matrixone_tpu.tools.inspect tables   <data_dir>
+    python -m matrixone_tpu.tools.inspect objects  <data_dir> [table]
+    python -m matrixone_tpu.tools.inspect object   <data_dir> <path>
+    python -m matrixone_tpu.tools.inspect wal      <data_dir>
+    python -m matrixone_tpu.tools.inspect status   <data_dir>
+
+Every subcommand prints one JSON document (ops pipelines parse it; the
+reference's TUI dashboard role is the `status` summary).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Optional
+
+from matrixone_tpu.storage import objectio
+from matrixone_tpu.storage.fileservice import LocalFS
+
+
+def _load_manifest(fs) -> Optional[dict]:
+    if not fs.exists("meta/manifest.json"):
+        return None
+    return json.loads(fs.read("meta/manifest.json").decode())
+
+
+def cmd_manifest(fs) -> dict:
+    m = _load_manifest(fs)
+    if m is None:
+        return {"error": "no checkpoint manifest (engine never "
+                         "checkpointed)"}
+    return {
+        "ckpt_ts": m.get("ckpt_ts"),
+        "tables": sorted(m.get("tables", {})),
+        "externals": sorted(m.get("externals", {})),
+        "snapshots": m.get("snapshots", {}),
+        "publications": m.get("publications", {}),
+        "stages": m.get("stages", {}),
+        "dynamic_tables": sorted(m.get("dynamic_tables", {})),
+    }
+
+
+def cmd_tables(fs) -> dict:
+    m = _load_manifest(fs)
+    if m is None:
+        return {"error": "no manifest"}
+    out = {}
+    for name, tm in m.get("tables", {}).items():
+        objs = tm.get("objects", [])
+        rows = sum(o.get("n_rows", 0) for o in objs)
+        dead = sum(len(g) for _ts, g in tm.get("tombstones", []))
+        out[name] = {
+            "columns": [c for c, *_ in tm.get("schema", [])],
+            "pk": tm.get("pk", []),
+            "objects": len(objs),
+            "rows_in_objects": rows,
+            "tombstoned_rows": dead,
+            "live_rows_at_ckpt": rows - dead,
+            "next_gid": tm.get("next_gid"),
+        }
+    return out
+
+
+def cmd_objects(fs, root: str, table: Optional[str] = None) -> dict:
+    m = _load_manifest(fs) or {}
+    out = {}
+    for name, tm in m.get("tables", {}).items():
+        if table and name != table:
+            continue
+        entries = []
+        for ob in tm.get("objects", []):
+            path = ob["path"]
+            full = os.path.join(root, path)
+            size = os.path.getsize(full) if os.path.exists(full) else None
+            entries.append({
+                "path": path, "seg_id": ob.get("seg_id"),
+                "base_gid": ob.get("base_gid"),
+                "commit_ts": ob.get("commit_ts"),
+                "n_rows": ob.get("n_rows"),
+                "bytes_on_disk": size,
+                "zonemap_cols": sorted((ob.get("zonemaps") or {})),
+            })
+        out[name] = entries
+    return out
+
+
+def cmd_object(fs, path: str) -> dict:
+    """One object's header: per-column block offsets/codecs + zonemaps
+    (no column bytes are read — the v2 ranged-header path)."""
+    meta, raw = objectio.read_header_ranged(fs, path)
+    cols = raw.get("cols", {})
+    return {
+        "table": meta.table, "object_id": meta.object_id,
+        "n_rows": meta.n_rows, "commit_ts": meta.commit_ts,
+        "format_version": raw.get("v", 1),
+        "columns": {c: {"offset": off, "bytes": ln, "codec": codec}
+                    for c, (off, ln, codec) in cols.items()},
+        "zonemaps": {c: {"min": z.min, "max": z.max,
+                         "nulls": z.null_count}
+                     for c, z in meta.zonemaps.items()},
+    }
+
+
+def cmd_wal(fs) -> dict:
+    from matrixone_tpu.storage import wal as walmod
+    if not fs.exists("wal/wal.log"):
+        return {"records": 0, "note": "no local WAL (quorum-WAL "
+                                      "deployments journal in the log "
+                                      "replicas)"}
+    w = walmod.WalWriter(fs)
+    ops: dict = {}
+    n = 0
+    last_ts = 0
+    for h, _b in w.replay():
+        n += 1
+        ops[h.get("op", "?")] = ops.get(h.get("op", "?"), 0) + 1
+        last_ts = max(last_ts, h.get("ts", 0))
+    return {"records": n, "by_op": ops, "last_ts": last_ts}
+
+
+def cmd_status(fs, root: str) -> dict:
+    """The dashboard summary: checkpoint age, object totals, WAL tail
+    size — VIEW_CKP_STATUS.md's answers in one JSON."""
+    m = _load_manifest(fs)
+    wal = cmd_wal(fs)
+    if m is None:
+        return {"checkpointed": False, "wal": wal}
+    total_objs = 0
+    total_bytes = 0
+    total_rows = 0
+    for tm in m.get("tables", {}).values():
+        for ob in tm.get("objects", []):
+            total_objs += 1
+            total_rows += ob.get("n_rows", 0)
+            full = os.path.join(root, ob["path"])
+            if os.path.exists(full):
+                total_bytes += os.path.getsize(full)
+    return {
+        "checkpointed": True,
+        "ckpt_ts": m.get("ckpt_ts"),
+        "tables": len(m.get("tables", {})),
+        "objects": total_objs,
+        "object_bytes": total_bytes,
+        "rows_in_objects": total_rows,
+        "wal_tail": wal,
+        "snapshots": len(m.get("snapshots", {})),
+    }
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if len(args) < 2:
+        print(__doc__)
+        return 2
+    cmd, root = args[0], args[1]
+    if not os.path.isdir(root):
+        # READ-ONLY tool: LocalFS would mkdir a typo'd path and then
+        # report a healthy-but-empty cluster
+        print(json.dumps({"error": f"no such data dir: {root}"}))
+        return 2
+    fs = LocalFS(root)
+    if cmd == "manifest":
+        out = cmd_manifest(fs)
+    elif cmd == "tables":
+        out = cmd_tables(fs)
+    elif cmd == "objects":
+        out = cmd_objects(fs, root, args[2] if len(args) > 2 else None)
+    elif cmd == "object":
+        out = cmd_object(fs, args[2])
+    elif cmd == "wal":
+        out = cmd_wal(fs)
+    elif cmd == "status":
+        out = cmd_status(fs, root)
+    else:
+        print(__doc__)
+        return 2
+    print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

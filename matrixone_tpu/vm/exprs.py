@@ -169,7 +169,9 @@ _STRING_FUNCS = {"upper", "lower", "length", "reverse", "trim", "ltrim",
                  # r5 long tail (function_id.go families)
                  "left", "right", "ord", "insert_str", "elt",
                  "concat_ws", "split_part", "octet_length", "inet_aton",
-                 "str_to_date", "time_to_sec"}
+                 "str_to_date", "time_to_sec",
+                 # LLM: one endpoint call per DISTINCT value
+                 "llm_chat"}
 
 #: numeric input -> string output: evaluated over the column's UNIQUE
 #: values host-side (O(distinct)), gathered on device — the same
@@ -416,6 +418,11 @@ def _apply_string_func(op, s, lits):
             return (d0 - _dtm.date(1970, 1, 1)).days
         except ValueError:
             return None
+    if op == "llm_chat":
+        from matrixone_tpu import llm as _llm
+        from matrixone_tpu.frontend.session import current_session
+        sess = current_session()
+        return _llm.chat(s, sess.variables if sess else None)
     if op == "time_to_sec":
         try:
             t = s.strip()
@@ -892,10 +899,37 @@ def _eval_func(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
         n = ex.padded_len
         codes = jnp.arange(n, dtype=jnp.int32)
         return DeviceColumn(codes, jnp.ones((n,), jnp.bool_), e.dtype)
+    if op == "llm_embed":
+        return _eval_llm_embed(e, ex)
     if op in _SIMPLE:
         args = [eval_expr(a, ex) for a in e.args]
         return _SIMPLE[op](*args)
     raise EvalError(f"unsupported function {op}")
+
+
+def _eval_llm_embed(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
+    """llm_embed(text) -> vecf32: one endpoint call per DISTINCT
+    dictionary entry; embeddings gather on device by code."""
+    from matrixone_tpu import llm as _llm
+    from matrixone_tpu.frontend.session import current_session
+    sess = current_session()
+    variables = sess.variables if sess else None
+    dim = e.dtype.dim
+    arg = e.args[0]
+    d = _dict_of(arg, ex)
+    if d is None:
+        if isinstance(arg, BoundLiteral) and isinstance(arg.value, str):
+            vec = _llm.embed(arg.value, dim, variables)
+            data = jnp.asarray([vec], jnp.float32)
+            return DeviceColumn(data, jnp.ones((1,), jnp.bool_), e.dtype)
+        raise EvalError("llm_embed() needs a varchar column or literal")
+    col = eval_expr(arg, ex)
+    mat = np.zeros((max(len(d), 1), dim), np.float32)
+    for i, s in enumerate(d):
+        mat[i] = _llm.embed(s, dim, variables)
+    codes = jnp.clip(col.data, 0, max(len(d) - 1, 0))
+    out = jnp.asarray(mat)[codes]
+    return DeviceColumn(out, col.validity, e.dtype)
 
 
 def uuid_dict(ex: ExecBatch):

@@ -1,0 +1,82 @@
+"""LLM SQL functions against a LOCAL endpoint stub (reference:
+plan/function/func_builtin_llm.go; zero-egress test double)."""
+
+import http.server
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from matrixone_tpu.frontend import Session
+
+
+@pytest.fixture(scope="module")
+def llm_stub():
+    calls = {"chat": 0, "embed": 0}
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):     # noqa: N802
+            pass
+
+        def do_POST(self):             # noqa: N802
+            n = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(n))
+            if req["op"] == "chat":
+                calls["chat"] += 1
+                out = {"text": f"echo: {req['prompt'][:40]}"}
+            else:
+                calls["embed"] += 1
+                dim = int(req["dim"])
+                # deterministic embedding from the text hash
+                seed = sum(req["text"].encode()) % 97
+                out = {"embedding":
+                       [((seed + i) % 10) / 10 for i in range(dim)]}
+            body = json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}/v1", calls
+    srv.shutdown()
+
+
+def test_llm_chat_per_distinct(llm_stub):
+    ep, calls = llm_stub
+    s = Session()
+    s.execute(f"set llm_endpoint = '{ep}'")
+    s.execute("create table p (id bigint primary key, q varchar(32))")
+    s.execute("insert into p values (1, 'what is tpu'), (2, 'what is tpu'),"
+              " (3, 'other question')")
+    before = calls["chat"]
+    rows = s.execute("select id, llm_chat(q) from p order by id").rows()
+    assert rows[0][1] == "echo: what is tpu"
+    assert rows[1][1] == "echo: what is tpu"
+    assert rows[2][1] == "echo: other question"
+    # one call per DISTINCT prompt, not per row
+    assert calls["chat"] - before == 2
+
+
+def test_llm_embed_vector_search(llm_stub):
+    ep, calls = llm_stub
+    s = Session()
+    s.execute(f"set llm_endpoint = '{ep}'")
+    s.execute("set llm_embed_dim = 8")
+    rows = s.execute("select llm_embed('hello')").rows()
+    vec = rows[0][0]
+    assert len(vec) == 8
+    # embeddings compose with the vector kernels
+    d = s.execute("select l2_distance(llm_embed('hello'),"
+                  " llm_embed('hello'))").rows()[0][0]
+    assert float(d) < 1e-6
+
+
+def test_llm_no_endpoint_is_loud():
+    s = Session()
+    s.execute("create table t (q varchar(8))")
+    s.execute("insert into t values ('x')")
+    with pytest.raises(Exception, match="llm_endpoint"):
+        s.execute("select llm_chat(q) from t")
