@@ -405,6 +405,21 @@ class Session:
                 {"Key_name": dt.VARCHAR, "Algo": dt.VARCHAR,
                  "Columns": dt.VARCHAR, "Dirty": dt.INT64})
             return Result(batch=b)
+        if isinstance(stmt, ast.ShowVariables):
+            import re as _re
+            names = sorted(self.variables)
+            if stmt.like:
+                # SQL LIKE: only % and _ are wildcards; everything
+                # else (incl. regex/fnmatch metachars) is literal
+                pat = "".join(".*" if ch == "%" else "." if ch == "_"
+                              else _re.escape(ch) for ch in stmt.like)
+                rx = _re.compile(f"^{pat}$")
+                names = [n for n in names if rx.match(n)]
+            b = Batch.from_pydict(
+                {"Variable_name": names,
+                 "Value": [str(self.variables[n]) for n in names]},
+                {"Variable_name": dt.VARCHAR, "Value": dt.VARCHAR})
+            return Result(batch=b)
         if isinstance(stmt, ast.SetVariable):
             if isinstance(stmt.value, ast.Literal):
                 value = stmt.value.value

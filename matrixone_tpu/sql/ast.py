@@ -128,6 +128,16 @@ class Param(Node):
     index: int               # ? placeholders for prepared statements
 
 
+@dataclasses.dataclass
+class SysVar(Node):
+    name: str                # @@name (session scope)
+
+
+@dataclasses.dataclass
+class ShowVariables(Node):
+    like: Optional[str] = None
+
+
 # ------------------------------------------------------------ statements
 
 @dataclasses.dataclass

@@ -780,6 +780,21 @@ class Binder:
             return BoundFunc("neg", [a], a.dtype)
         if isinstance(e, ast.FuncCall):
             return self._bind_func(e, rec)
+        if isinstance(e, ast.SysVar):
+            # @@name folds to the SESSION's current value at bind time
+            # (reference: frontend/variables.go resolution)
+            from matrixone_tpu.frontend.session import current_session
+            s = current_session()
+            val = (s.variables.get(e.name) if s is not None else None)
+            if val is None:
+                return BoundLiteral(None, dt.INT64)
+            if isinstance(val, bool):
+                return BoundLiteral(int(val), dt.INT64)
+            if isinstance(val, int):
+                return BoundLiteral(val, dt.INT64)
+            if isinstance(val, float):
+                return BoundLiteral(val, dt.FLOAT64)
+            return BoundLiteral(str(val), dt.VARCHAR)
         if isinstance(e, ast.Cast):
             a = rec(e.expr)
             return BoundCast(a, type_from_name(e.type_name, e.type_args))

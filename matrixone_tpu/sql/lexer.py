@@ -73,6 +73,14 @@ def tokenize(sql: str) -> List[Token]:
                              else "int", text, i))
             i = j
             continue
+        if c == "@" and sql.startswith("@@", i):
+            # system variable reference: @@name / @@session.name
+            j = i + 2
+            while j < n and (sql[j].isalnum() or sql[j] in "_."):
+                j += 1
+            out.append(Token("sysvar", sql[i + 2:j].lower(), i))
+            i = j
+            continue
         if c == "'" or c == '"':
             quote = c
             j = i + 1

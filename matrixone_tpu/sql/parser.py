@@ -283,6 +283,22 @@ class Parser:
         self.expect_kw("show")
         if self.accept_kw("tables"):
             return ast.ShowTables()
+        nxt0 = self.peek()
+        if nxt0.kind == "ident" and nxt0.value.lower() in ("session",
+                                                           "global") \
+                and self.peek(1).kind == "ident" \
+                and self.peek(1).value.lower() == "variables":
+            self.next()               # scope word (session semantics)
+            nxt0 = self.peek()
+        if nxt0.kind == "ident" and nxt0.value.lower() == "variables":
+            self.next()
+            like = None
+            if self.accept_kw("like"):
+                tok = self.next()
+                if tok.kind != "str":
+                    raise ParseError("SHOW VARIABLES LIKE needs a string")
+                like = tok.value
+            return ast.ShowVariables(like)
         if self.accept_kw("snapshots"):
             return ast.ShowSnapshots()
         if self.at_ident("accounts"):
@@ -982,6 +998,13 @@ class Parser:
             idx = sum(1 for tk in self.toks[:self.i - 1]
                       if tk.kind == "op" and tk.value == "?")
             return ast.Param(idx)
+        if t.kind == "sysvar":
+            self.next()
+            name = t.value
+            for scope in ("session.", "global."):
+                if name.startswith(scope):
+                    name = name[len(scope):]
+            return ast.SysVar(name)
         if t.kind == "kw":
             if self.accept_kw("null"):
                 return ast.Literal(None, "null")

@@ -1,0 +1,81 @@
+"""Live cluster dashboard (reference: cmd/mo-dashboard TUI — here a
+poll-and-print status table over a LAUNCHED cluster's port map).
+
+    python -m matrixone_tpu.tools.dashboard <data_dir> [--watch SECS]
+
+Reads `<data_dir>/launch_ports.json` (written by matrixone_tpu.launch)
+and probes every role: log replicas (epoch), TN (commit frontier,
+checkpoint ts), CN fragment endpoints (fragments served), keepers
+(service table). One JSON document per poll; --watch repeats."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _probe(addr, op="ping", timeout=2.0):
+    from matrixone_tpu.cluster.rpc import RpcClient, parse_addr
+    try:
+        c = RpcClient(parse_addr(addr), timeout=timeout)
+        try:
+            resp, _ = c.call({"op": op})
+            return resp
+        finally:
+            c.close()
+    except Exception as e:               # noqa: BLE001
+        return {"ok": False, "err": f"{type(e).__name__}: {e}"}
+
+
+def snapshot(data_dir: str) -> dict:
+    ports_path = os.path.join(data_dir, "launch_ports.json")
+    if not os.path.exists(ports_path):
+        return {"error": f"no launch_ports.json under {data_dir} "
+                         f"(is the cluster launched?)"}
+    with open(ports_path) as f:
+        ports = json.load(f)
+    out: dict = {"at": time.strftime("%H:%M:%S")}
+    out["log"] = [{"addr": a, **_probe(a)} for a in ports.get("log", [])]
+    tn = ports.get("tn")
+    if tn:
+        out["tn"] = {"port": tn, **_probe(f"127.0.0.1:{tn}")}
+    out["cn_fragments"] = [
+        {"frag_port": p, **_probe(f"127.0.0.1:{p}", op="stats")}
+        for p in ports.get("frag", [])]
+    keepers = ports.get("keepers", [])
+    if keepers:
+        from matrixone_tpu.hakeeper import details_via_tcp
+        try:
+            svcs = details_via_tcp([("127.0.0.1", k) for k in keepers])
+            out["services"] = [
+                {"sid": s["sid"], "kind": s["kind"],
+                 "state": s["state"], "age_s": round(s["age_s"], 1)}
+                for s in svcs]
+        except Exception as e:           # noqa: BLE001
+            out["services"] = {"error": f"{type(e).__name__}: {e}"}
+    if ports.get("proxy"):
+        out["proxy_port"] = ports["proxy"]
+    return out
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args:
+        print(__doc__)
+        return 2
+    data_dir = args[0]
+    watch = 0.0
+    if "--watch" in args:
+        watch = float(args[args.index("--watch") + 1])
+    while True:
+        print(json.dumps(snapshot(data_dir), indent=2, default=str),
+              flush=True)
+        if not watch:
+            return 0
+        time.sleep(watch)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

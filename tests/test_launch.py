@@ -168,3 +168,17 @@ enabled = true
         c.close()
     finally:
         launcher.stop()
+
+
+def test_dashboard_snapshot(cluster):
+    """mo-dashboard role: one poll over a launched cluster reports
+    every role healthy."""
+    from matrixone_tpu.tools import dashboard
+    d, launcher = cluster
+    snap = dashboard.snapshot(f"{d}/data")
+    assert snap["tn"]["ok"] and "committed_ts" in snap["tn"]
+    assert len(snap["log"]) == 3 and all(r["ok"] for r in snap["log"])
+    assert len(snap["cn_fragments"]) == 2
+    assert all("frags_run" in c for c in snap["cn_fragments"])
+    kinds = {s["kind"] for s in snap["services"]}
+    assert {"tn", "cn"} <= kinds

@@ -235,3 +235,32 @@ def test_count_distinct():
     import pytest as _pt
     with _pt.raises(Exception, match="mixed with other"):
         s.execute("select count(distinct v), sum(v) from t")
+
+
+def test_sysvars_and_show_variables():
+    """@@var references + SHOW VARIABLES [LIKE] (frontend/variables.go
+    role) — what MySQL client libraries probe at connect."""
+    from matrixone_tpu.frontend import Session
+    s = Session()
+    s.execute("set ivf_nprobe = 12")
+    assert s.execute("select @@ivf_nprobe, @@session.ivf_nprobe"
+                     ).rows() == [(12, 12)]
+    assert s.execute("select @@batch_rows > 0").rows() == [(True,)] or \
+        s.execute("select @@batch_rows > 0").rows() == [(1,)]
+    assert s.execute("select @@no_such_var is null").rows()[0][0]
+    rows = dict(s.execute("show variables").rows())
+    assert rows["ivf_nprobe"] == "12"
+    assert s.execute("show variables like 'ivf%'").rows() == \
+        [("ivf_nprobe", "12")]
+
+
+def test_show_session_variables_and_like_escaping():
+    from matrixone_tpu.frontend import Session
+    s = Session()
+    s.execute("set weird_var = 5")
+    assert dict(s.execute("show session variables like 'weird%'"
+                          ).rows()) == {"weird_var": "5"}
+    assert dict(s.execute("show global variables like 'weird_var'"
+                          ).rows()) == {"weird_var": "5"}
+    # fnmatch metachars in the pattern are LITERAL under SQL LIKE
+    assert s.execute("show variables like '[ab]%'").rows() == []
