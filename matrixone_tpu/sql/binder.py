@@ -1458,6 +1458,10 @@ def bind_scalar_function(name: str, args: List[BoundExpr]) -> BoundExpr:
         if len(args) != 1:
             raise BindError("llm_embed(text) takes one argument")
         return BoundFunc("llm_embed", args, dt.vecf32(dim))
+    if name == "hex" and args and args[0].dtype.is_numeric:
+        # MySQL: hex(string) dumps bytes, hex(number) rounds to BIGINT
+        # and formats — two different functions behind one name
+        return BoundFunc("hex_int", args, dt.VARCHAR)
     if name in ("timestampadd", "timestampdiff"):
         if len(args) != 3 or not isinstance(args[0], BoundLiteral):
             raise BindError(f"{name}(unit, a, b) takes a unit keyword "

@@ -177,7 +177,7 @@ _STRING_FUNCS = {"upper", "lower", "length", "reverse", "trim", "ltrim",
 #: values host-side (O(distinct)), gathered on device — the same
 #: cost model as the dictionary-level string functions
 _NUM2STR_FUNCS = {"date_format", "sec_to_time", "inet_ntoa",
-                  "format_num"}
+                  "format_num", "hex_int"}
 
 
 #: marks the COLUMN's position in a string call's literal list — distinct
@@ -641,6 +641,14 @@ _MYSQL_FMT = {
 def _num2str_value(op, v, lits, dtype) -> "Optional[str]":
     """One unique input value -> output string (None = SQL NULL)."""
     import datetime as _dtm
+    if op == "hex_int":
+        x = float(v)
+        if dtype is not None and dtype.oid == dt.TypeOid.DECIMAL64:
+            x = x / 10 ** dtype.scale    # stored scaled (exact int)
+        n = int(round(x))                # MySQL: round to BIGINT first
+        if n < 0:                        # unsigned 64-bit view (MySQL)
+            n &= 0xFFFFFFFFFFFFFFFF
+        return format(n, "X")
     if op == "inet_ntoa":
         n = int(v)
         if n < 0 or n > 0xFFFFFFFF:

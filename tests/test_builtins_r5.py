@@ -182,3 +182,14 @@ def test_lag_null_default():
     assert s.execute("select id, lag(v, 1, NULL) over (order by id)"
                      " from w order by id").rows() == \
         [(1, None), (2, 10)]
+
+
+def test_hex_dual_semantics(sess):
+    """MySQL hex(): strings dump bytes, numbers round to BIGINT and
+    format — including float rounding, decimal descaling, and the
+    unsigned-64 view of negatives."""
+    assert sess.execute(
+        "select hex('abc'), hex(255), hex(255.5),"
+        " hex(cast(255 as decimal(6,2))), hex(-1), hex(0)"
+    ).rows() == [("616263", "FF", "100", "FF",
+                  "FFFFFFFFFFFFFFFF", "0")]
