@@ -72,27 +72,54 @@ def make_data(key, n, d, n_centers=2048):
 
 
 def bench_q1(n: int = None) -> dict:
-    """TPC-H Q1 rows/sec through the full SQL engine (BASELINE config #1).
+    """TPC-H Q1 rows/sec through the full SQL engine (BASELINE config #1),
+    measured WITH the object-backed storage path enabled: the table is
+    loaded, checkpointed to objectio objects on a LocalFS object store,
+    and its segments demoted to blockcache-served lazy views — every
+    timed scan goes through the out-of-core read path, no bypass.
 
     The reference publishes no first-party Q1 throughput (BASELINE.md), so
     vs_baseline is null; the number itself is the tracked metric."""
+    import tempfile
+
     from matrixone_tpu.frontend import Session
+    from matrixone_tpu.storage import blockcache
+    from matrixone_tpu.storage.engine import Engine
+    from matrixone_tpu.storage.fileservice import LocalFS
+    from matrixone_tpu.utils import metrics as M
     from matrixone_tpu.utils import tpch
     if n is None:
         n = int(os.environ.get("MO_BENCH_N",
                                100_000 if SMOKE else 6_001_215))
-    s = Session()
+    # size the decoded-column cache to the working set (~96 B/row over
+    # the scanned columns + validity) so the warm loop measures the hot
+    # path, not eviction thrash; an explicit MO_BLOCK_CACHE_MB wins
+    os.environ.setdefault("MO_BLOCK_CACHE_MB",
+                          str(max(256, n * 96 >> 20)))
+    fs = LocalFS(tempfile.mkdtemp(prefix="mo_bench_q1_"))
+    eng = Engine(fs)
+    s = Session(catalog=eng)
+    # load = generate + insert + checkpoint-to-objects + demote: the
+    # number includes every byte reaching the object store (r5 measured
+    # 23.74 s here; the coalesced lz4 write path is the fix)
     t0 = time.time()
     arrays = tpch.load_lineitem(s.catalog, n)
+    eng.checkpoint(demote=True)
     t_load = time.time() - t0
+    lazy = [seg.is_lazy for seg in eng.get_table("lineitem").segments]
+    assert lazy and all(lazy), "bench must run object-backed (no bypass)"
     oracle = tpch.q1_oracle(arrays)
-    rows = s.execute(tpch.Q1_SQL).rows()      # warm: compiles the pipeline
+    t0 = time.time()
+    rows = s.execute(tpch.Q1_SQL).rows()      # cold: decode + compile
+    t_cold = time.time() - t0
     exact = tpch.q1_check(rows, oracle)
+    blockcache.CACHE.reset_stats()            # warm loop accounting
     best = 0.0
     for _ in range(3):
         t0 = time.time()
         s.execute(tpch.Q1_SQL)
         best = max(best, n / (time.time() - t0))
+    cache = blockcache.CACHE.stats()
     # roofline-style evidence for the scan+agg path: Q1 touches 7
     # columns (l_quantity/extendedprice/discount/tax as decimal64,
     # returnflag/linestatus codes, shipdate) — effective scan bandwidth
@@ -107,6 +134,15 @@ def bench_q1(n: int = None) -> dict:
         "vs_baseline": None,
         "exact_vs_oracle": exact,
         "load_seconds": round(t_load, 2),
+        "cold_run_seconds": round(t_cold, 2),
+        "object_backed": True,
+        "object_write_seconds": round(M.object_write_seconds.get(), 3),
+        "blockcache_hits": cache["hits"],
+        "blockcache_misses": cache["misses"],
+        "blockcache_hit_rate": cache["hit_rate"],
+        "decode_seconds": cache["decode_seconds"],
+        "prefetch_ready": M.scan_prefetch.get(outcome="ready"),
+        "prefetch_waited": M.scan_prefetch.get(outcome="waited"),
         "backend": jax.default_backend(),
         "scan_gbps": round(q1_bytes * best / n / 1e9, 2),
         "hbm_util": (round(q1_bytes * best / n / pb, 4) if pb else None),

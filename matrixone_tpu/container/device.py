@@ -148,10 +148,22 @@ def from_numpy(arrays: Dict[str, np.ndarray],
     cols = {}
     for name, arr in arrays.items():
         dt = dtypes[name]
-        arr = np.asarray(arr, dtype=dt.np_dtype)
         val = None if validity is None else validity.get(name)
+        if (padded == n_rows and isinstance(arr, jax.Array)
+                and arr.dtype == np.dtype(dt.np_dtype)):
+            # already device-resident at the right dtype and length (the
+            # blockcache hands out ready-to-batch device arrays): skip
+            # the host round-trip entirely — this is the warm-scan path
+            jval = (val if isinstance(val, jax.Array)
+                    else jnp.ones(n_rows, jnp.bool_) if val is None
+                    else jnp.asarray(np.asarray(val, np.bool_)))
+            cols[name] = DeviceColumn(data=arr, validity=jval, dtype=dt)
+            continue
+        arr = np.asarray(arr, dtype=dt.np_dtype)
         if val is None:
             val = np.ones(n_rows, dtype=np.bool_)
+        else:
+            val = np.asarray(val, np.bool_)
         pad_n = padded - n_rows
         if pad_n:
             pad_shape = (pad_n,) + arr.shape[1:]

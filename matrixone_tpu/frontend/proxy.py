@@ -184,8 +184,13 @@ _COM_QUIT = 0x01
 _COM_QUERY = 0x03
 _COM_STMT_PREPARE = 0x16
 _COM_STMT_EXECUTE = 0x17
+_COM_STMT_SEND_LONG_DATA = 0x18
 _COM_STMT_CLOSE = 0x19
 _COM_STMT_RESET = 0x1A
+
+#: commands the server answers with NOTHING (MySQL protocol): waiting
+#: for a response here would wedge the relay and hang the client
+_NO_RESPONSE_CMDS = frozenset({_COM_STMT_CLOSE, _COM_STMT_SEND_LONG_DATA})
 
 
 def _read_pkt(sock: socket.socket) -> Optional[bytes]:
@@ -312,7 +317,7 @@ class SessionProxy(MOProxy):
             cmd = pkt[4]
             pkt = self._track_and_rewrite(sess, cmd, pkt)
             upstream.sendall(pkt)
-            if cmd == _COM_STMT_CLOSE:
+            if cmd in _NO_RESPONSE_CMDS:
                 continue                           # no response packet
             self._relay_response(sess, cmd, pkt, client, upstream)
 
@@ -332,7 +337,8 @@ class SessionProxy(MOProxy):
                 var = sql[4:].split("=", 1)[0].strip()
                 sess.sets[var] = raw
             return pkt
-        if cmd in (_COM_STMT_EXECUTE, _COM_STMT_CLOSE, _COM_STMT_RESET):
+        if cmd in (_COM_STMT_EXECUTE, _COM_STMT_CLOSE, _COM_STMT_RESET,
+                   _COM_STMT_SEND_LONG_DATA):   # all carry stmt-id@5:9
             cid = int.from_bytes(pkt[5:9], "little")
             bid = sess.id_map.get(cid, cid)
             if cmd == _COM_STMT_CLOSE:

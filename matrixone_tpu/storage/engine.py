@@ -455,6 +455,17 @@ class MVCCTable:
                     continue
                 yield arrays, validity, self.dicts, n
 
+    def scan_is_cold(self, columns: List[str]) -> bool:
+        """True when a scan of `columns` would miss the decoded-column
+        cache for at least one object-backed segment — ScanOp enables
+        its read-ahead stage only then (a warm scan should not pay a
+        prefetch thread)."""
+        cols = [c for c in columns if c != ROWID]
+        for seg in self.segments:
+            if seg.is_lazy and seg.arrays.cold_columns(cols):
+                return True
+        return False
+
     def visible_gids(self, gids: np.ndarray,
                      snapshot_ts: Optional[int] = None,
                      extra_deletes: Optional[np.ndarray] = None) -> np.ndarray:
@@ -1121,15 +1132,18 @@ class Engine:
             return kept
 
     # ------------------------------------------------- checkpoint / open
-    def checkpoint(self) -> None:
+    def checkpoint(self, demote: Optional[bool] = None) -> None:
         """Write all committed state as objectio objects + manifest, then
         truncate the WAL (tae/db/checkpoint/runner.go analogue). Runs under
         the commit lock so a concurrent commit cannot slip between the
-        manifest snapshot and the WAL truncation and be lost."""
-        with self._commit_lock:
-            self._checkpoint_locked()
+        manifest snapshot and the WAL truncation and be lost.
 
-    def _checkpoint_locked(self) -> None:
+        demote=True turns freshly-durable RAM segments into object-backed
+        views served through the blockcache (default: MO_LAZY_SEGMENTS)."""
+        with self._commit_lock:
+            self._checkpoint_locked(demote=demote)
+
+    def _checkpoint_locked(self, demote: Optional[bool] = None) -> None:
         manifest = {"ckpt_ts": self.hlc.now(), "tables": {},
                     "catalog_version": getattr(self, "catalog_version",
                                                None) or 1,
@@ -1164,7 +1178,8 @@ class Engine:
                         self.fs, meta, seg.arrays, seg.validity)
                     seg.zonemaps = {c: [z.min, z.max, z.null_count]
                                     for c, z in zms.items()}
-                    if os.environ.get("MO_LAZY_SEGMENTS") == "1":
+                    if demote or (demote is None and os.environ.get(
+                            "MO_LAZY_SEGMENTS") == "1"):
                         # demote the freshly-durable segment to an
                         # object-backed view: the WRITER's RAM is then
                         # bounded by the block cache too (the reference

@@ -54,6 +54,9 @@ class IcebergMeta:
     #: default spec id (identity transforms drive pruning)
     partition_fields: List[Tuple[str, str]]
     schema_fields: List[Tuple[str, str]]   # (name, iceberg type string)
+    #: spec id the partition_fields above describe; manifests written
+    #: under an EVOLVED spec must not be pruned with it
+    default_spec_id: int = 0
 
 
 def _resolve(root: str, path: str) -> str:
@@ -121,6 +124,7 @@ def load_table(root: str) -> IcebergMeta:
     fields = [(f["name"], str(f["type"])) for f in schema["fields"]]
     by_id = {f["id"]: f["name"] for f in schema["fields"]}
     # partition spec: v2 'partition-specs' + 'default-spec-id'
+    psid = 0
     if "partition-specs" in md:
         psid = md.get("default-spec-id", 0)
         spec = next(s for s in md["partition-specs"]
@@ -131,7 +135,8 @@ def load_table(root: str) -> IcebergMeta:
                for p in spec]
     return IcebergMeta(root=root, metadata_path=meta_path,
                        current_snapshot_id=cur, snapshots=snaps,
-                       partition_fields=pfields, schema_fields=fields)
+                       partition_fields=pfields, schema_fields=fields,
+                       default_spec_id=psid)
 
 
 def data_files(meta: IcebergMeta,
@@ -149,24 +154,47 @@ def data_files(meta: IcebergMeta,
         _schema, entries = avrolib.read_container(f.read())
     out: List[DataFile] = []
     for e in entries:
+        # v2 manifest-list `content`: 0 = data manifests, 1 = DELETE
+        # manifests (row-level deletes). Scanning only the data side of
+        # a table with live deletes would silently resurrect deleted
+        # rows — fail loudly instead.
+        if int(e.get("content", 0) or 0) != 0:
+            raise IcebergError(
+                "iceberg v2 row-level deletes are not supported: "
+                f"snapshot {sid} carries a delete manifest "
+                f"({e['manifest_path']})")
         man_path = _resolve(meta.root, e["manifest_path"])
         with open(man_path, "rb") as f:
             _ms, mentries = avrolib.read_container(f.read())
+        # partition evolution: a manifest written under a different
+        # spec-id stores partition tuples in ANOTHER layout — matching
+        # them against the default spec's fields could prune LIVE files.
+        # Conservatively disable pruning for those entries.
+        spec_ok = int(e.get("partition_spec_id",
+                            meta.default_spec_id) or 0) \
+            == meta.default_spec_id
         for me in mentries:
             status = me.get("status", 1)      # 0 existing | 1 added
             if status == 2:                   # 2 deleted
                 continue
             df = me["data_file"]
+            if int(df.get("content", 0) or 0) != 0:
+                # 1 = position deletes, 2 = equality deletes
+                raise IcebergError(
+                    "iceberg v2 delete file in data manifest "
+                    f"({df['file_path']}): row-level deletes are not "
+                    "supported")
             fmt = str(df.get("file_format", "PARQUET")).upper()
             if fmt != "PARQUET":
                 raise IcebergError(
                     f"unsupported data file format {fmt!r}")
             part_rec = df.get("partition") or {}
             part = {}
-            for (src, transform), (k, v) in zip(
-                    meta.partition_fields, part_rec.items()):
-                if transform == "identity":
-                    part[src] = v
+            if spec_ok:
+                for (src, transform), (k, v) in zip(
+                        meta.partition_fields, part_rec.items()):
+                    if transform == "identity":
+                        part[src] = v
             out.append(DataFile(
                 path=_resolve(meta.root, df["file_path"]),
                 partition=part,

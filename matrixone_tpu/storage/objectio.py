@@ -14,8 +14,11 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import struct
+import time
 import zlib
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -25,6 +28,54 @@ from matrixone_tpu.storage import arrowio
 from matrixone_tpu.storage.fileservice import FileService
 
 _MAGIC = b"MOTB"
+
+
+# ---------------------------------------------------------------- codecs
+# Block compression (reference: pkg/compress lz4). lz4 rides pyarrow's
+# bundled codec — ~10x faster than zlib-1 at a modestly worse ratio,
+# which is the right trade for a load path that is compression-bound.
+# zlib stays readable for objects written by older rounds.
+
+def _codec_name() -> str:
+    env = os.environ.get("MO_OBJECT_CODEC")
+    if env in ("lz4", "zlib", "none"):
+        return env
+    return "lz4" if pa.Codec.is_available("lz4") else "zlib"
+
+
+def _compress(buf: bytes, codec: str) -> bytes:
+    if codec == "lz4":
+        return pa.Codec("lz4").compress(buf, asbytes=True)
+    if codec == "zlib":
+        return zlib.compress(buf, level=1)
+    return buf
+
+
+def _decompress(buf: bytes, codec: str, raw_len: Optional[int]) -> bytes:
+    if codec == "lz4":
+        return pa.Codec("lz4").decompress(buf, decompressed_size=raw_len,
+                                          asbytes=True)
+    if codec == "zlib":
+        return zlib.decompress(buf)
+    return buf
+
+
+#: shared column-block serializer pool: IPC serialization and both
+#: codecs release the GIL, so per-column work overlaps across the pool
+#: (the load-time write batching — one fileservice round-trip per
+#: OBJECT, with all its column blocks built in parallel)
+_POOL: Optional[ThreadPoolExecutor] = None
+
+
+def _pool() -> ThreadPoolExecutor:
+    global _POOL
+    if _POOL is None:
+        _POOL = ThreadPoolExecutor(
+            max_workers=int(os.environ.get(
+                "MO_OBJECT_WRITE_THREADS",
+                str(min(8, (os.cpu_count() or 2) * 2)))),
+            thread_name_prefix="mo-objw")
+    return _POOL
 
 
 @dataclasses.dataclass
@@ -97,24 +148,39 @@ def write_object(fs: FileService, meta: ObjectMeta,
 
     v2 layout (out-of-core read path, VERDICT r4 Missing #1): every
     column is its own independently-compressed Arrow IPC block, and the
-    header records {col: [offset, length, codec]} into the body — so a
-    reader can fetch ONE column with one ranged read (S3 Range GET),
-    the way the reference's objectio reads column blocks
+    header records {col: [offset, length, codec, raw_len]} into the
+    body — so a reader can fetch ONE column with one ranged read (S3
+    Range GET), the way the reference's objectio reads column blocks
     (`pkg/objectio/block_info.go` + fileservice IOVector entries).
 
-    Block compression (reference: pkg/compress lz4): zlib level 1 per
-    column — cheap, typically 2-4x on columnar data."""
+    Column blocks are serialized + compressed in parallel on the shared
+    pool and coalesced into ONE fileservice write per object — the load
+    path is compression-bound, not IO-bound, so this is where the r5
+    5.4x load regression went."""
+    from matrixone_tpu.utils import metrics as M
+    t0 = time.perf_counter()
+    codec = _codec_name() if compress else "none"
+
+    def build(c: str):
+        ipc = arrowio.arrays_to_ipc({c: arrays[c]}, {c: validity[c]})
+        ck = codec
+        raw_len = len(ipc)
+        if ck != "none":
+            packed = _compress(ipc, ck)
+            if len(packed) < raw_len:
+                ipc = packed
+            else:
+                ck = "none"
+        return c, ipc, ck, raw_len
+
+    cols = list(arrays)
+    built = list(_pool().map(build, cols)) if len(cols) > 1 \
+        else [build(c) for c in cols]
     blocks = []
     cols_index: Dict[str, list] = {}
     off = 0
-    for c in arrays:
-        ipc = arrowio.arrays_to_ipc({c: arrays[c]}, {c: validity[c]})
-        codec = "none"
-        if compress:
-            packed = zlib.compress(ipc, level=1)
-            if len(packed) < len(ipc):
-                ipc, codec = packed, "zlib"
-        cols_index[c] = [off, len(ipc), codec]
+    for c, ipc, ck, raw_len in built:
+        cols_index[c] = [off, len(ipc), ck, raw_len]
         blocks.append(ipc)
         off += len(ipc)
     meta_json = json.loads(meta.to_json())
@@ -124,6 +190,7 @@ def write_object(fs: FileService, meta: ObjectMeta,
     blob = _MAGIC + struct.pack("<I", len(mj)) + mj + b"".join(blocks)
     path = object_path(meta.table, meta.object_id)
     fs.write(path, blob)
+    M.object_write_seconds.inc(time.perf_counter() - t0)
     return path
 
 
@@ -164,10 +231,10 @@ def read_object(fs: FileService, path: str
         return meta, arrays, validity
     arrays: Dict[str, np.ndarray] = {}
     validity: Dict[str, np.ndarray] = {}
-    for c, (off, ln, codec) in raw["cols"].items():
-        ipc = body[off:off + ln]
-        if codec == "zlib":
-            ipc = zlib.decompress(ipc)
+    for c, ent in raw["cols"].items():
+        off, ln, codec = ent[0], ent[1], ent[2]
+        raw_len = ent[3] if len(ent) > 3 else None
+        ipc = _decompress(body[off:off + ln], codec, raw_len)
         a, v = arrowio.ipc_to_arrays(ipc)
         arrays[c] = a[c]
         validity[c] = v[c]
@@ -199,10 +266,11 @@ def read_column_block(fs: FileService, path: str, raw: dict, col: str
     """Fetch one column of a v2 object given its PARSED header `raw`
     (from read_header_ranged — callers cache it so N column fetches
     cost N ranged reads, not 2N). Returns (data, validity)."""
-    off, ln, codec = raw["cols"][col]
-    ipc = fs.read_range(path, raw["_body_off"] + off, ln)
-    if codec == "zlib":
-        ipc = zlib.decompress(ipc)
+    ent = raw["cols"][col]
+    off, ln, codec = ent[0], ent[1], ent[2]
+    raw_len = ent[3] if len(ent) > 3 else None
+    ipc = _decompress(fs.read_range(path, raw["_body_off"] + off, ln),
+                      codec, raw_len)
     a, v = arrowio.ipc_to_arrays(ipc)
     return a[col], v[col]
 

@@ -101,8 +101,11 @@ def cmd_object(fs, path: str) -> dict:
         "table": meta.table, "object_id": meta.object_id,
         "n_rows": meta.n_rows, "commit_ts": meta.commit_ts,
         "format_version": raw.get("v", 1),
-        "columns": {c: {"offset": off, "bytes": ln, "codec": codec}
-                    for c, (off, ln, codec) in cols.items()},
+        # col entries: [off, len, codec] (pre-r6) or [off, len, codec,
+        # raw_len] (lz4 blocks record their decompressed size)
+        "columns": {c: {"offset": e[0], "bytes": e[1], "codec": e[2],
+                        **({"raw_bytes": e[3]} if len(e) > 3 else {})}
+                    for c, e in cols.items()},
         "zonemaps": {c: {"min": z.min, "max": z.max,
                          "nulls": z.null_count}
                      for c, z in meta.zonemaps.items()},

@@ -114,3 +114,20 @@ txn_commits = REGISTRY.counter(
     "mo_txn_commit_total", "transaction commits by outcome")
 join_spills = REGISTRY.counter(
     "mo_join_spill_total", "joins whose build side Grace-spilled to host")
+blockcache_ops = REGISTRY.counter(
+    "mo_blockcache_ops_total", "decoded-column cache lookups by outcome")
+blockcache_bytes = REGISTRY.counter(
+    "mo_blockcache_fetch_bytes_total",
+    "decoded bytes brought into the block cache on misses")
+decode_seconds = REGISTRY.counter(
+    "mo_object_decode_seconds_total",
+    "seconds spent fetching+decoding object column blocks (miss path)")
+object_write_seconds = REGISTRY.counter(
+    "mo_object_write_seconds_total",
+    "seconds spent serializing+writing objectio objects")
+scan_prefetch = REGISTRY.counter(
+    "mo_scan_prefetch_total",
+    "scan read-ahead outcomes: chunks served ready vs waited-on")
+scan_prefetch_wait_seconds = REGISTRY.counter(
+    "mo_scan_prefetch_wait_seconds_total",
+    "seconds the scan consumer blocked waiting on the prefetcher")

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import fnmatch
+import math as _math
 import re
 from typing import Dict, List, Optional
 
@@ -642,10 +643,26 @@ def _num2str_value(op, v, lits, dtype) -> "Optional[str]":
     """One unique input value -> output string (None = SQL NULL)."""
     import datetime as _dtm
     if op == "hex_int":
-        x = float(v)
+        # MySQL: round the argument to BIGINT, then format. Integers
+        # must NOT round-trip through float (2^53 truncates the low
+        # bits of a BIGINT); decimals round half-away-from-zero in the
+        # exact scaled-integer domain; floats round half-away-from-zero
+        # (Python round() is banker's: hex(254.5) would give 'FE').
         if dtype is not None and dtype.oid == dt.TypeOid.DECIMAL64:
-            x = x / 10 ** dtype.scale    # stored scaled (exact int)
-        n = int(round(x))                # MySQL: round to BIGINT first
+            scale = 10 ** dtype.scale
+            sv = int(v)
+            q, r = divmod(abs(sv), scale)
+            if 2 * r >= scale:
+                q += 1
+            n = -q if sv < 0 else q
+        elif isinstance(v, (int, np.integer)) or (
+                dtype is not None and dtype.is_integer):
+            n = int(v)
+        else:
+            x = float(v)
+            n = _math.floor(abs(x) + 0.5)
+            if x < 0:
+                n = -n
         if n < 0:                        # unsigned 64-bit view (MySQL)
             n &= 0xFFFFFFFFFFFFFFFF
         return format(n, "X")

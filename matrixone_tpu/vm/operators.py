@@ -15,6 +15,9 @@ pull loop (`vm/pipeline/pipeline.go:62`). Differences by design:
 from __future__ import annotations
 
 import dataclasses
+import os
+import threading
+import time
 from typing import Dict, Iterator, List, Optional
 
 import jax
@@ -62,9 +65,83 @@ def chunk_to_execbatch(arrays, validity, table_dicts, n, columns, schema
     return ExecBatch(batch=db, dicts=dicts2, mask=db.row_mask())
 
 
+class _ChunkPrefetcher:
+    """Bounded read-ahead over a chunk iterator (reference: the CN
+    reader's merged-IO pipelining, `pkg/fileservice/io_merger.go` role).
+
+    A worker thread pulls chunk N+1 — which for object-backed segments
+    triggers the column fetch + decode through the blockcache — while
+    the consumer's filter/agg compute runs over chunk N, so cold-read IO
+    overlaps device compute. Exceptions propagate to the consumer;
+    closing stops the worker and closes the source generator."""
+
+    _DONE, _ITEM, _ERR = 0, 1, 2
+
+    def __init__(self, gen, depth: int):
+        import queue
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(gen,), daemon=True,
+            name="mo-scan-prefetch")
+        self._thread.start()
+
+    def _run(self, gen) -> None:
+        import queue
+        try:
+            for item in gen:
+                while True:
+                    if self._stop.is_set():
+                        gen.close()
+                        return
+                    try:
+                        self._q.put((self._ITEM, item), timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+            self._q.put((self._DONE, None))
+        except BaseException as e:                    # noqa: BLE001
+            # deliver the error with the same patience as items: a full
+            # queue must never swallow it (the consumer would block on
+            # get() forever with no DONE sentinel)
+            import queue
+            while not self._stop.is_set():
+                try:
+                    self._q.put((self._ERR, e), timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+
+    def __iter__(self):
+        from matrixone_tpu.utils import metrics as M
+        while True:
+            ready = not self._q.empty()
+            t0 = 0.0 if ready else time.perf_counter()
+            kind, payload = self._q.get()
+            if kind == self._DONE:
+                return
+            if kind == self._ERR:
+                raise payload
+            M.scan_prefetch.inc(outcome="ready" if ready else "waited")
+            if not ready:
+                M.scan_prefetch_wait_seconds.inc(
+                    time.perf_counter() - t0)
+            yield payload
+
+    def close(self) -> None:
+        self._stop.set()
+        while not self._q.empty():     # unblock a Full worker
+            try:
+                self._q.get_nowait()
+            except Exception:                         # noqa: BLE001
+                break
+
+
 class ScanOp(Operator):
     """Table scan with filter pushdown + zonemap chunk pruning
-    (reference: colexec/table_scan + readutil block pruning)."""
+    (reference: colexec/table_scan + readutil block pruning), plus a
+    read-ahead stage decoding chunk N+1 while chunk N computes
+    (MO_SCAN_PREFETCH chunks deep; 0 disables)."""
 
     def __init__(self, node: P.Scan, relation, batch_rows: int = 1 << 20,
                  ctx=None):
@@ -94,24 +171,47 @@ class ScanOp(Operator):
             batch_rows = int(self.ctx.variables.get("batch_rows",
                                                     batch_rows))
         shard = self.node.shard
-        for ci, chunk in enumerate(self.rel.iter_chunks(
-                self.node.columns, batch_rows, filters=filters,
-                qualified_names=qnames, **read_args)):
-            if shard is not None and ci % shard[1] != shard[0]:
-                # distributed scan: peers cover disjoint chunk strides of
-                # the SAME deterministic chunk sequence (same snapshot,
-                # same filters -> same pruning on every replica)
-                continue
-            arrays, validity, dicts, n = chunk
-            M.rows_scanned.inc(n, table=self.node.table)
-            ex = chunk_to_execbatch(arrays, validity, dicts, n,
-                                    self.node.columns, self.node.schema)
-            # evaluate pushed filters as an early mask (zonemap pruning
-            # already dropped fully-excluded chunks host-side)
-            for f in filters:
-                pred = eval_expr(f, ex)
-                ex.mask = ex.mask & F.predicate_mask(pred, ex.batch)
-            yield ex
+        chunks = self.rel.iter_chunks(
+            self.node.columns, batch_rows, filters=filters,
+            qualified_names=qnames, **read_args)
+        # read-ahead: ON for scans that will actually fetch+decode cold
+        # object blocks (IO to overlap with compute); OFF for warm scans
+        # where a handoff thread is pure overhead. MO_SCAN_PREFETCH
+        # forces a depth (0 disables).
+        env_depth = os.environ.get("MO_SCAN_PREFETCH")
+        try:
+            depth = int(env_depth)          # explicit depth (0 = off)
+        except (TypeError, ValueError):     # unset / "auto": cold-only
+            is_cold = getattr(self.rel, "scan_is_cold", None)
+            depth = 2 if (is_cold is not None
+                          and is_cold(self.node.columns)) else 0
+        prefetcher = None
+        if depth > 0:
+            prefetcher = _ChunkPrefetcher(chunks, depth)
+            chunks = iter(prefetcher)
+        try:
+            for ci, chunk in enumerate(chunks):
+                if shard is not None and ci % shard[1] != shard[0]:
+                    # distributed scan: peers cover disjoint chunk
+                    # strides of the SAME deterministic chunk sequence
+                    # (same snapshot, same filters -> same pruning on
+                    # every replica)
+                    continue
+                arrays, validity, dicts, n = chunk
+                M.rows_scanned.inc(n, table=self.node.table)
+                ex = chunk_to_execbatch(arrays, validity, dicts, n,
+                                        self.node.columns,
+                                        self.node.schema)
+                # evaluate pushed filters as an early mask (zonemap
+                # pruning already dropped fully-excluded chunks
+                # host-side)
+                for f in filters:
+                    pred = eval_expr(f, ex)
+                    ex.mask = ex.mask & F.predicate_mask(pred, ex.batch)
+                yield ex
+        finally:
+            if prefetcher is not None:
+                prefetcher.close()
 
 
 class MaterializedOp(Operator):
@@ -440,6 +540,8 @@ class AggOp(Operator):
 
     def _grouped_agg_inner(self, nkeys, key_dicts):
         state = None   # dict: keys:[arrays], kvalid:[arrays], partials per agg
+        dense = None       # small-key dense accumulator (no hash, no sort)
+        dense_checked = False
         for ex in self.child.execute():
             self._agg_tracker.observe(ex)
             keys = [eval_expr(k, ex) for k in self.node.group_keys]
@@ -452,6 +554,18 @@ class AggOp(Operator):
                       for k in keys]
             values = [None if (a.func == "count" and a.arg is None)
                       else _agg_value(a, ex) for a in self.node.aggs]
+            if not dense_checked:
+                dense_checked = True
+                dense = self._dense_init(ex)
+            if dense is not None:
+                if self._dense_sizes(ex) == list(dense["sizes"]):
+                    self._dense_step(dense, kdata, kvalid, ex.mask, values)
+                    continue
+                # a key dictionary grew mid-stream (concurrent insert /
+                # union arm): the dense key space is stale — convert the
+                # partials to a standard group table and continue general
+                state = self._dense_to_state(dense)
+                dense = None
             if self._spill is not None:
                 self._spill.add_raw(kdata, kvalid, ex.mask, values)
                 continue
@@ -466,6 +580,9 @@ class AggOp(Operator):
                     self._spill.add_state(state, self.node.aggs)
                     state = None
                 self._spill.add_raw(kdata, kvalid, ex.mask, values)
+        if dense is not None:
+            yield self._finalize(self._dense_to_state(dense), key_dicts)
+            return
         if self._spill is None:
             if state is None:
                 state = self._empty_state()
@@ -488,6 +605,184 @@ class AggOp(Operator):
                     self._merge(pstate, st, allow_spill=False)
             if pstate is not None and int(jax.device_get(pstate["n"])):
                 yield self._finalize(pstate, key_dicts)
+
+    # ---- dense small-key fast path (the Q1 shape: GROUP BY two dict-
+    # coded columns with additive aggregates). Group ids come from a
+    # mixed-radix expansion over the key dictionaries instead of
+    # hash+argsort, and the deduplicated partial lanes fold as fused
+    # masked sums (ops/agg.dense_lane_partials); cross-chunk merge is an
+    # elementwise add of (G,)-sized partials — no re-grouping sort.
+    def _dense_sizes(self, ex) -> Optional[List[int]]:
+        """Per-key dense domain sizes, or None when a key has no bounded
+        code space (numeric keys, computed strings without a dict)."""
+        sizes = []
+        for k in self.node.group_keys:
+            d = _expr_dict(k, ex)
+            if d is not None:
+                sizes.append(max(len(d), 1))
+            elif k.dtype.oid == TypeOid.BOOL:
+                sizes.append(2)
+            else:
+                return None
+        return sizes
+
+    @staticmethod
+    def _dense_fields(a: AggCall) -> List[tuple]:
+        """(class, field) layout of one aggregate's partial state —
+        shared by the per-chunk step and the state converter so the two
+        can never disagree on stack order."""
+        if a.func == "count":
+            return [("int", "count")]
+        if a.func in ("sum", "avg"):
+            cls = "float" if a.arg.dtype.is_float else "int"
+            return [(cls, "sum"), ("int", "count")]
+        return [("float", "sum"), ("float", "sumsq"), ("int", "count")]
+
+    def _dense_init(self, ex) -> Optional[dict]:
+        if os.environ.get("MO_DENSE_GROUPS") == "0":
+            return None
+        dense_funcs = {"count", "sum", "avg"} | STDDEV_AGGS
+        for a in self.node.aggs:
+            # min/max/bit partials don't merge additively; distinct
+            # needs per-group key sets — all take the general path
+            if a.distinct or a.func not in dense_funcs:
+                return None
+        sizes = self._dense_sizes(ex)
+        if sizes is None:
+            return None
+        g = 1
+        n_fields = 1
+        for s in sizes:
+            g *= s + 1
+        for a in self.node.aggs:
+            n_fields += len(self._dense_fields(a))
+        if g > int(os.environ.get("MO_DENSE_GROUPS_MAX", "256")) \
+                or g * n_fields > 4096:
+            # the masked-sum family unrolls G x fields reductions at
+            # trace time — cap the XLA graph size
+            return None
+        # accumulators live at FULL (NULL-slotted) granularity; all-valid
+        # chunks compute in the compact key space and scatter into the
+        # matching full slots
+        partials = []
+        for a in self.node.aggs:
+            partials.append({f: jnp.zeros((g,), jnp.int64 if c == "int"
+                                          else jnp.float64)
+                             for c, f in self._dense_fields(a)})
+        return {"sizes": tuple(sizes), "partials": partials,
+                "rows": jnp.zeros((g,), jnp.int64)}
+
+    def _dense_step(self, dense, kdata, kvalid, mask, values) -> None:
+        # ONE fused host sync answers every 'no NULLs here?' question for
+        # the chunk: all-valid keys shrink the key space (no NULL slots)
+        # and all-valid agg args collapse their count field into the
+        # shared rows lane
+        checks = list(kvalid)
+        vidx = {}
+        for v in values:
+            if v is not None and id(v.validity) not in vidx:
+                vidx[id(v.validity)] = len(checks)
+                checks.append(v.validity)
+        flags = np.asarray(jax.device_get(
+            jnp.asarray([jnp.all(c) for c in checks])))
+        keys_allvalid = bool(flags[:len(kvalid)].all())
+        with_null = not keys_allvalid
+        # build deduplicated lanes: plain-column agg args share their
+        # DeviceColumn object (eval_expr returns the batch column), so
+        # sum(l_quantity) and avg(l_quantity) collapse to ONE lane;
+        # counts over all-valid args collapse into the rows lane
+        int_vals, int_masks, float_vals, float_masks = [], [], [], []
+        lane_of = {}                    # dedupe key -> ("int"|"float", idx)
+        fieldmap = []                   # per agg: [(field, lane-or-"rows")]
+        for a, v in zip(self.node.aggs, values):
+            allv = v is None or bool(flags[vidx[id(v.validity)]])
+            mkey = "rows" if allv else id(v.validity)
+            mval = None if allv else v.validity
+            x = None
+            fm = []
+            for cls, field in self._dense_fields(a):
+                if field == "count" and mkey == "rows":
+                    fm.append((field, "rows"))
+                    continue
+                if cls == "float" and field != "count" \
+                        and a.func in STDDEV_AGGS and x is None:
+                    x = _float_of(v)
+                val = (None if field == "count"
+                       else x * x if field == "sumsq"
+                       else x if x is not None else v.data)
+                key = (cls, field == "sumsq",
+                       None if field == "count" else id(v.data), mkey)
+                lane = lane_of.get(key)
+                if lane is None:
+                    if cls == "int":
+                        lane = ("int", len(int_vals))
+                        int_vals.append(val)
+                        int_masks.append(mval)
+                    else:
+                        lane = ("float", len(float_vals))
+                        float_vals.append(val)
+                        float_masks.append(mval)
+                    lane_of[key] = lane
+                fm.append((field, lane))
+            fieldmap.append(fm)
+        ints, floats, rows = A.dense_lane_partials(
+            tuple(kdata), tuple(kvalid), mask,
+            tuple(int_vals), tuple(int_masks),
+            tuple(float_vals), tuple(float_masks),
+            sizes=dense["sizes"], with_null=with_null)
+        # scatter the chunk's compact-space results into the full-space
+        # accumulators (identity when the chunk used NULL slots)
+        pos = self._dense_positions(dense, with_null)
+        for fm, part in zip(fieldmap, dense["partials"]):
+            for field, lane in fm:
+                add = (rows if lane == "rows"
+                       else ints[lane[1]] if lane[0] == "int"
+                       else floats[lane[1]])
+                part[field] = part[field].at[pos].add(
+                    add.astype(part[field].dtype))
+        dense["rows"] = dense["rows"].at[pos].add(rows)
+
+    def _dense_positions(self, dense, with_null: bool):
+        """Full-space slot of each compact-space slot (cached)."""
+        key = ("pos", with_null)
+        pos = dense.get(key)
+        if pos is None:
+            sizes = dense["sizes"]
+            strides_c, g_eff = A.dense_slot_strides(
+                sizes, null_slots=with_null)
+            strides_f, _g_full = A.dense_slot_strides(sizes)
+            pos = np.zeros(g_eff, np.int32)
+            for slot in range(g_eff):
+                full, rem = 0, slot
+                for s, stc, stf in zip(sizes, strides_c, strides_f):
+                    digit = rem // stc
+                    rem = rem % stc
+                    full += digit * stf
+                pos[slot] = full
+            pos = jnp.asarray(pos)
+            dense[key] = pos
+        return pos
+
+    def _dense_to_state(self, dense) -> dict:
+        """Dense accumulator -> the standard state dict. `present` is
+        scattered over the G slots (not front-packed); every consumer —
+        _merge's re-group, _finalize's output mask, the session's
+        mask-compacting _to_host — works off the mask, so that's fine."""
+        sizes = dense["sizes"]
+        strides, g = A.dense_slot_strides(sizes)
+        present = dense["rows"] > 0
+        slots = jnp.arange(g, dtype=jnp.int32)
+        keys, kvalid = [], []
+        for k_ast, s, st in zip(self.node.group_keys, sizes, strides):
+            code = (slots // st) % (s + 1)
+            valid = code < s
+            keys.append(code.astype(jnp.int32 if k_ast.dtype.is_varlen
+                                    else k_ast.dtype.jnp_dtype))
+            kvalid.append(valid)
+        n = jnp.sum(present.astype(jnp.int32))
+        return {"keys": keys, "kvalid": kvalid, "present": present,
+                "partials": [dict(p) for p in dense["partials"]],
+                "n": n}
 
     def _revive_values(self, vals):
         """Spilled (data, validity) np pairs -> DeviceColumns (dtype is

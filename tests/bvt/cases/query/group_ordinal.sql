@@ -1,4 +1,4 @@
 create table go_ (g varchar(8), v bigint);
 insert into go_ values ('a', 1), ('a', 2), ('b', 3);
 select g, sum(v) from go_ group by 1 order by 1;
-select g, sum(v) as total from go_ group by g order by total desc;
+select g, sum(v) as total from go_ group by g order by total desc, g;
