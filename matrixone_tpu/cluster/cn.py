@@ -26,8 +26,10 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from matrixone_tpu.cluster.rpc import (ERR_TYPES, RpcClient, pack_blobs,
+from matrixone_tpu.cluster.rpc import (ERR_TYPES, RpcClient, deadline_scope,
+                                       new_rid, pack_blobs,
                                        parse_addr as _parse_addr)
+from matrixone_tpu.utils.fault import INJECTOR
 from matrixone_tpu.logservice.replicated import _recv_msg, _send_msg
 from matrixone_tpu.storage import arrowio, wal as walmod
 from matrixone_tpu.storage.engine import (Engine, WalApplier,
@@ -130,6 +132,9 @@ class LogtailConsumer:
                 self._cv.notify_all()
 
     def _consume_once(self) -> None:
+        if INJECTOR.trigger("logtail.subscribe") == "drop":
+            raise ConnectionError(
+                "fault injected: logtail subscription dropped")
         sock = socket.create_connection(self.addr, timeout=30.0)
         sock.settimeout(1.0)
         try:
@@ -189,6 +194,8 @@ class LogtailConsumer:
             rep.hlc.update(ts)
             self.applied_ts = max(self.applied_ts, ts)
             self._cv.notify_all()
+        from matrixone_tpu.utils.sync import notify_waiters
+        notify_waiters()
 
     def _resync_table(self, name: str) -> None:
         """A TN merge rewrote the table's gids: rebuild from the fresh
@@ -356,6 +363,12 @@ class RemoteCatalog:
         return getattr(self._replica, k)
 
     def _call(self, header: dict, blob: bytes = b"") -> dict:
+        # every TN call carries an idempotency rid, minted ONCE per
+        # logical call: a transport retry re-sends the SAME rid and the
+        # TN's dedup cache replays the recorded response instead of
+        # re-executing (write-safe retries — a mid-call disconnect on
+        # commit can no longer double-apply)
+        header = dict(header, rid=new_rid())
         resp, _ = self._client.call(header, blob)
         if not resp.get("ok"):
             err = resp.get("err", "TN error")
@@ -600,15 +613,20 @@ class FragmentServer:
                     continue
                 try:
                     kind = header.get("kind")
-                    if kind == "shuffle_scan":
-                        resp, rblob = run_shuffle_scan(self.catalog,
-                                                       header)
-                    elif kind == "shuffle_join":
-                        resp, rblob = run_shuffle_join(self.catalog,
-                                                       header)
-                    else:
-                        resp, rblob = execute_fragment(self.catalog,
-                                                       header)
+                    # propagate the caller's remaining budget into the
+                    # fragment's own nested RPCs (shuffle pushes to
+                    # peer CNs inherit the coordinator's deadline)
+                    with deadline_scope(
+                            ms=header.get("deadline_ms") or 180_000):
+                        if kind == "shuffle_scan":
+                            resp, rblob = run_shuffle_scan(self.catalog,
+                                                           header)
+                        elif kind == "shuffle_join":
+                            resp, rblob = run_shuffle_join(self.catalog,
+                                                           header)
+                        else:
+                            resp, rblob = execute_fragment(self.catalog,
+                                                           header)
                     self.frags_run += 1
                 except Exception as e:           # noqa: BLE001
                     resp, rblob = {"ok": False,

@@ -1,17 +1,52 @@
-"""Shared wire helpers for the CN<->TN and CN<->CN RPC: blob framing,
-error-type mapping, and the request/response client. One definition —
-the framing is a cross-process protocol and hand-maintained copies would
-drift."""
+"""Resilient RPC fabric shared by every lane (CN->TN commits/DDL, CN->CN
+fragment shipping, proxy relay, worker offload): blob framing, an error
+taxonomy, pooled per-peer connections, per-call deadlines that propagate
+into nested calls, exponential backoff with jitter, and per-peer circuit
+breakers with half-open probing.
+
+Reference analogue: `pkg/common/morpc` — pooled backends, futures,
+circuit breaking, deadline-carrying contexts. One definition — the
+framing is a cross-process protocol and hand-maintained copies would
+drift.
+
+Error taxonomy (callers classify by isinstance, never by string):
+
+  * TransportError (ConnectionError) — the peer was unreachable or the
+    connection died; RETRYABLE, but only for calls that are idempotent:
+    reads, or mutations carrying an idempotency request-id ("rid") that
+    the server dedups (a blind re-send of a mutation after a partial
+    send could double-apply it).
+  * DeadlineExceeded (TimeoutError) — the call's time budget ran out;
+    not retried (the budget is gone).
+  * BreakerOpen (ConnectionError) — the peer's circuit is open; raised
+    WITHOUT touching the network so callers degrade (reroute, local
+    fallback) instead of hanging on a known-bad peer.
+  * engine errors (ConflictError, ...) — the server executed the call
+    and said no; NEVER retried.
+
+`MO_RPC_RESILIENCE=off` disables retries/breakers/deadline enforcement
+(single attempt, errors surface raw) — the chaos drills use it to prove
+the layer is what keeps queries alive under injected faults.
+"""
 
 from __future__ import annotations
 
+import itertools
+import json
+import os
+import random
 import socket
 import struct
 import threading
-from typing import List, Optional
+import time
+from collections import OrderedDict
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
 
 from matrixone_tpu.storage.engine import (ConflictError, ConstraintError,
                                           DuplicateKeyError)
+from matrixone_tpu.utils import metrics as M
+from matrixone_tpu.utils.fault import INJECTOR
 
 
 def parse_addr(addr) -> tuple:
@@ -21,49 +56,61 @@ def parse_addr(addr) -> tuple:
     return host, int(port)
 
 
-class RpcClient:
-    """One serialized request/response socket (morpc backend analogue,
-    minimum form). Reconnects once per call on failure. Used for CN->TN
-    commits/DDL and CN->CN fragment shipping."""
+# --------------------------------------------------------------- config
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
 
-    def __init__(self, addr, timeout: float = 30.0):
-        self.addr = parse_addr(addr)
-        self.timeout = timeout
-        self._sock: Optional[socket.socket] = None
-        self._lock = threading.Lock()
 
-    def _connect(self) -> socket.socket:
-        s = socket.create_connection(self.addr, timeout=self.timeout)
-        s.settimeout(self.timeout)
-        return s
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
 
-    def call(self, header: dict, blob: bytes = b""):
-        from matrixone_tpu.logservice.replicated import (_recv_msg,
-                                                         _send_msg)
-        with self._lock:
-            for attempt in (0, 1):
-                if self._sock is None:
-                    self._sock = self._connect()
-                try:
-                    _send_msg(self._sock, header, blob)
-                    return _recv_msg(self._sock)
-                except (OSError, ConnectionError):
-                    try:
-                        self._sock.close()
-                    except OSError:
-                        pass
-                    self._sock = None
-                    if attempt:
-                        raise
 
-    def close(self) -> None:
-        with self._lock:
-            if self._sock is not None:
-                try:
-                    self._sock.close()
-                except OSError:
-                    pass
-                self._sock = None
+def resilience_enabled() -> bool:
+    return os.environ.get("MO_RPC_RESILIENCE", "on").lower() \
+        not in ("off", "0", "false")
+
+
+#: retry/backoff/breaker knobs (see README "Resilience knobs")
+RETRIES = _env_int("MO_RPC_RETRIES", 4)              # attempts per call
+BACKOFF_BASE = _env_float("MO_RPC_BACKOFF_BASE", 0.02)
+BACKOFF_MAX = _env_float("MO_RPC_BACKOFF_MAX", 1.0)
+POOL_SIZE = _env_int("MO_RPC_POOL", 2)               # idle socks per peer
+BREAKER_THRESHOLD = _env_int("MO_RPC_BREAKER_THRESHOLD", 5)
+BREAKER_COOLDOWN = _env_float("MO_RPC_BREAKER_COOLDOWN", 2.0)
+
+
+def backoff_delay(attempt: int) -> float:
+    """Exponential backoff with full jitter: attempt 1 -> ~BASE,
+    doubling, capped at BACKOFF_MAX."""
+    d = min(BACKOFF_MAX, BACKOFF_BASE * (2 ** max(0, attempt - 1)))
+    return d * (0.5 + random.random())
+
+
+# ------------------------------------------------------- error taxonomy
+class RpcError(Exception):
+    """Marker base for fabric-level failures."""
+
+
+class TransportError(RpcError, ConnectionError):
+    """Peer unreachable / connection died. Retryable for idempotent
+    calls. Subclasses ConnectionError so pre-fabric handlers that catch
+    (OSError, ConnectionError) keep working."""
+
+
+class DeadlineExceeded(RpcError, TimeoutError):
+    """The call's time budget ran out (possibly inherited from an
+    enclosing deadline_scope)."""
+
+
+class BreakerOpen(RpcError, ConnectionError):
+    """The peer's circuit is open: failing fast instead of dialing."""
+
 
 ERR_TYPES = {"conflict": ConflictError, "duplicate": DuplicateKeyError,
              "constraint": ConstraintError}
@@ -77,6 +124,393 @@ def err_name(e: Exception) -> str:
     if isinstance(e, ConstraintError):
         return "constraint"
     return "error"
+
+
+# ------------------------------------------------- deadline propagation
+class Deadline:
+    """Absolute expiry on the monotonic clock."""
+
+    __slots__ = ("expires_at",)
+
+    def __init__(self, seconds: float):
+        self.expires_at = time.monotonic() + seconds
+
+    def remaining(self) -> float:
+        return self.expires_at - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0
+
+
+_tls = threading.local()
+
+
+def current_deadline() -> Optional[Deadline]:
+    return getattr(_tls, "deadline", None)
+
+
+@contextmanager
+def deadline_scope(seconds: Optional[float] = None, *,
+                   ms: Optional[float] = None):
+    """Bound every RPC issued in this thread's dynamic extent. Nested
+    scopes can only SHRINK the budget (a callee never outlives its
+    caller's deadline); servers re-enter the scope from the request's
+    `deadline_ms` header, so the budget follows the call chain across
+    processes."""
+    budget = (ms / 1000.0) if ms is not None else \
+        (seconds if seconds is not None else 30.0)
+    new = Deadline(budget)
+    prev = current_deadline()
+    if prev is not None:
+        new.expires_at = min(new.expires_at, prev.expires_at)
+    _tls.deadline = new
+    try:
+        yield new
+    finally:
+        _tls.deadline = prev
+
+
+# ------------------------------------------------------ circuit breaker
+class CircuitBreaker:
+    """closed -> (threshold consecutive failures) -> open -> (cooldown)
+    -> half-open: ONE probe call allowed; success closes, failure
+    re-opens. State changes are exported via mo_rpc_breaker_state and
+    wake utils.sync waiters."""
+
+    def __init__(self, addr: tuple, threshold: Optional[int] = None,
+                 cooldown: Optional[float] = None):
+        self.addr = addr
+        self.peer = f"{addr[0]}:{addr[1]}"
+        self.threshold = threshold or BREAKER_THRESHOLD
+        self.cooldown = cooldown if cooldown is not None else \
+            BREAKER_COOLDOWN
+        self.state = "closed"
+        self.failures = 0
+        self.opened_at = 0.0
+        self._probe_in_flight = False
+        self._lock = threading.Lock()
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open":
+                if (time.monotonic() - self.opened_at) >= self.cooldown:
+                    self._set("half-open")
+                    self._probe_in_flight = True
+                    return True
+                return False
+            # half-open: admit a single probe at a time
+            if not self._probe_in_flight:
+                self._probe_in_flight = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.failures = 0
+            self._probe_in_flight = False
+            if self.state != "closed":
+                self._set("closed")
+
+    def release_probe(self) -> None:
+        """An admitted call exited without a verdict (e.g. its deadline
+        expired before the attempt ran): free the half-open probe slot
+        so the breaker cannot wedge with a probe nobody owns."""
+        with self._lock:
+            self._probe_in_flight = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._probe_in_flight = False
+            self.failures += 1
+            if self.state == "half-open" or \
+                    (self.state == "closed"
+                     and self.failures >= self.threshold):
+                self.opened_at = time.monotonic()
+                self._set("open")
+            elif self.state == "open":
+                self.opened_at = time.monotonic()   # stay open, re-arm
+
+    _STATE_CODE = {"closed": 0, "half-open": 1, "open": 2}
+
+    def _set(self, state: str) -> None:
+        # called with the lock held
+        self.state = state
+        M.rpc_breaker_state.set(self._STATE_CODE[state], peer=self.peer)
+        M.rpc_breaker_transitions.inc(peer=self.peer, state=state)
+        from matrixone_tpu.utils.sync import notify_waiters
+        notify_waiters()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"state": self.state, "failures": self.failures,
+                    "threshold": self.threshold,
+                    "cooldown_s": self.cooldown}
+
+
+_breakers: Dict[tuple, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+
+
+def breaker_for(addr) -> CircuitBreaker:
+    key = parse_addr(addr)
+    with _breakers_lock:
+        b = _breakers.get(key)
+        if b is None:
+            b = _breakers[key] = CircuitBreaker(key)
+        return b
+
+
+def breaker_states() -> Dict[str, dict]:
+    """Per-peer breaker view (mo_ctl('rpc','status'))."""
+    with _breakers_lock:
+        bs = list(_breakers.values())
+    return {b.peer: b.snapshot() for b in bs}
+
+
+def reset_breakers() -> None:
+    """Test hook: forget every peer's breaker state."""
+    with _breakers_lock:
+        _breakers.clear()
+
+
+# ------------------------------------------------------ request dedup
+class _Pending:
+    __slots__ = ("event",)
+
+    def __init__(self):
+        self.event = threading.Event()
+
+
+class RequestDedup:
+    """Server-side idempotency: rid -> (resp, blob), LRU-bounded. A
+    retried mutation (same rid, possibly on a NEW connection after a
+    mid-call disconnect) replays the recorded response instead of
+    re-executing — the exactly-once half of write-safe retries.
+
+    In-flight coverage: the retry can arrive (new connection, new
+    handler thread) while the FIRST attempt is still executing — the
+    backoff is milliseconds, a cold commit can be seconds. claim() makes
+    the duplicate WAIT for the original's result instead of racing a
+    second execution."""
+
+    def __init__(self, cap: int = 4096):
+        self.cap = cap
+        self._d: "OrderedDict[str, object]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def claim(self, rid: str, timeout: float = 30.0):
+        """-> ("mine", None): caller must execute then complete(rid).
+        -> ("done", (resp, blob)): replay this recorded response."""
+        with self._lock:
+            ent = self._d.get(rid)
+            if ent is None:
+                self._d[rid] = _Pending()
+                return "mine", None
+            if isinstance(ent, tuple):
+                self._d.move_to_end(rid)
+                return "done", ent
+            event = ent.event
+        event.wait(timeout)
+        with self._lock:
+            ent = self._d.get(rid)
+            if isinstance(ent, tuple):
+                return "done", ent
+        return "done", ({"ok": False,
+                         "err": f"duplicate request {rid} still "
+                                f"in flight after {timeout}s"}, b"")
+
+    def complete(self, rid: str, resp: dict, blob: bytes = b"") -> None:
+        with self._lock:
+            ent = self._d.get(rid)
+            self._d[rid] = (resp, blob)
+            self._d.move_to_end(rid)
+            while len(self._d) > self.cap:
+                k = next(iter(self._d))
+                if isinstance(self._d[k], _Pending):
+                    break            # never evict an in-flight entry
+                self._d.popitem(last=False)
+        if isinstance(ent, _Pending):
+            ent.event.set()          # wake waiting duplicates
+
+
+_rid_counter = itertools.count(1)
+_rid_prefix = f"{os.getpid():x}-{random.getrandbits(32):08x}"
+
+
+def new_rid() -> str:
+    """Process-unique idempotency id for one LOGICAL call (generate once,
+    reuse across every retry of that call)."""
+    return f"{_rid_prefix}-{next(_rid_counter)}"
+
+
+# ------------------------------------------------------------ transport
+class RpcClient:
+    """Pooled request/response channel to one peer (morpc backend
+    analogue). Thread-safe: concurrent calls each check a socket out of
+    the per-peer pool (up to `pool_size` kept warm; bursts open
+    ephemeral sockets that are closed on return).
+
+    Retry policy: transport failures are retried with jittered
+    exponential backoff, but ONLY when the call is marked idempotent —
+    `retryable=True` (reads) or a header carrying "rid" (mutations the
+    server dedups). Everything is bounded by the call deadline and the
+    peer's circuit breaker."""
+
+    def __init__(self, addr, timeout: float = 30.0,
+                 pool_size: Optional[int] = None,
+                 retries: Optional[int] = None):
+        self.addr = parse_addr(addr)
+        self.timeout = timeout
+        self.pool_size = pool_size if pool_size is not None else POOL_SIZE
+        self.retries = retries if retries is not None else RETRIES
+        self._idle: List[socket.socket] = []
+        self._lock = threading.Lock()
+        self._closed = False
+        self.breaker = breaker_for(self.addr)
+
+    # ---- socket pool
+    def _checkout(self, budget: float) -> socket.socket:
+        with self._lock:
+            if self._idle:
+                return self._idle.pop()
+        s = socket.create_connection(
+            self.addr, timeout=max(0.001, min(self.timeout, budget)))
+        return s
+
+    def _checkin(self, s: socket.socket) -> None:
+        with self._lock:
+            if not self._closed and len(self._idle) < self.pool_size:
+                self._idle.append(s)
+                return
+        try:
+            s.close()
+        except OSError:
+            pass
+
+    # ---- call
+    def call(self, header: dict, blob: bytes = b"",
+             retryable: Optional[bool] = None) -> Tuple[dict, bytes]:
+        on = resilience_enabled()
+        op = str(header.get("op", ""))
+        if retryable is None:
+            retryable = "rid" in header
+        dl = current_deadline() or Deadline(self.timeout)
+        attempts = max(1, self.retries) if (on and retryable) else 1
+        if on and not self.breaker.allow():
+            M.rpc_errors.inc(kind="breaker", op=op)
+            raise BreakerOpen(
+                f"circuit open for peer {self.addr} "
+                f"({self.breaker.failures} consecutive failures)")
+        last: Optional[BaseException] = None
+        for attempt in range(attempts):
+            if attempt:
+                M.rpc_retries.inc(op=op)
+                delay = min(backoff_delay(attempt),
+                            max(0.0, dl.remaining()))
+                if delay > 0:
+                    time.sleep(delay)
+                if on and not self.breaker.allow():
+                    M.rpc_errors.inc(kind="breaker", op=op)
+                    raise BreakerOpen(
+                        f"circuit open for peer {self.addr}")
+            if on and dl.expired():
+                M.rpc_errors.inc(kind="deadline", op=op)
+                self.breaker.release_probe()
+                raise DeadlineExceeded(
+                    f"rpc {op!r} to {self.addr}: deadline exceeded "
+                    f"after {attempt} attempt(s)") from last
+            M.rpc_attempts.inc(op=op)
+            t0 = time.perf_counter()
+            try:
+                out = self._attempt(header, blob, dl)
+                if on:
+                    self.breaker.record_success()
+                M.rpc_seconds.observe(time.perf_counter() - t0)
+                return out
+            except DeadlineExceeded:
+                M.rpc_errors.inc(kind="deadline", op=op)
+                if on:
+                    self.breaker.release_probe()
+                raise         # subclasses TimeoutError/OSError: not a
+                              # transport failure, never retried
+            except (OSError, ConnectionError) as e:
+                if on:
+                    self.breaker.record_failure()
+                last = e
+            except Exception:  # noqa: BLE001 — breaker-counted, re-raised
+                # a garbage/mis-protocol response (struct/json decode
+                # error) is a misbehaving peer: count it so the breaker
+                # can open (and a half-open probe is not leaked), but
+                # propagate the real error — re-sending cannot help
+                if on:
+                    self.breaker.record_failure()
+                raise
+        if on and dl.expired():
+            M.rpc_errors.inc(kind="deadline", op=op)
+            raise DeadlineExceeded(
+                f"rpc {op!r} to {self.addr}: deadline exceeded "
+                f"({last!r})") from last
+        M.rpc_errors.inc(kind="transport", op=op)
+        raise TransportError(
+            f"rpc {op!r} to {self.addr} failed after {attempts} "
+            f"attempt(s): {last!r}") from last
+
+    def _attempt(self, header: dict, blob: bytes,
+                 dl: Deadline) -> Tuple[dict, bytes]:
+        from matrixone_tpu.logservice.replicated import (_recv_msg,
+                                                         _send_msg)
+        rem = dl.remaining()
+        if rem <= 0:
+            raise DeadlineExceeded(
+                f"rpc to {self.addr}: no budget left before send")
+        s = self._checkout(rem)
+        ok = False
+        try:
+            s.settimeout(max(0.001, min(self.timeout, dl.remaining())))
+            wire = dict(header)
+            wire["deadline_ms"] = int(max(1.0, dl.remaining() * 1000))
+            fault = INJECTOR.trigger("rpc.send")
+            if fault == "drop":
+                raise ConnectionError(
+                    "fault injected: connection dropped at rpc.send")
+            if fault == "partial":
+                # torn half-frame: the server sees a truncated message
+                # and drops the connection; the request was NOT applied
+                hj = json.dumps(wire).encode()
+                frame = (struct.pack("<I", len(hj)) + hj
+                         + struct.pack("<I", len(blob)) + blob)
+                s.sendall(frame[:max(1, len(frame) // 2)])
+                raise ConnectionError(
+                    "fault injected: partial send at rpc.send")
+            _send_msg(s, wire, blob)
+            if INJECTOR.trigger("rpc.recv") == "drop":
+                # mid-call disconnect AFTER the request reached the
+                # peer: the hazard idempotency rids exist for
+                raise ConnectionError(
+                    "fault injected: connection dropped at rpc.recv")
+            out = _recv_msg(s)
+            ok = True
+            return out
+        finally:
+            if ok:
+                self._checkin(s)
+            else:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            idle, self._idle = self._idle, []
+        for s in idle:
+            try:
+                s.close()
+            except OSError:
+                pass
 
 
 def pack_blobs(blobs: List[bytes]) -> bytes:

@@ -136,7 +136,8 @@ class LogtailHub:
             return None
 
 
-from matrixone_tpu.cluster.rpc import err_name as _err_name, unpack_blobs
+from matrixone_tpu.cluster.rpc import (RequestDedup, deadline_scope,
+                                       err_name as _err_name, unpack_blobs)
 
 
 class TNService:
@@ -156,6 +157,9 @@ class TNService:
         self._remote_txns: Dict[str, float] = {}     # token -> deadline
         self._txn_lock = threading.Lock()
         self._txn_ids = itertools.count(1)
+        # idempotency: retried CN calls (same rid, any connection) replay
+        # the recorded response instead of re-executing the mutation
+        self._rids = RequestDedup()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("127.0.0.1", port))
@@ -207,11 +211,32 @@ class TNService:
                 if op == "subscribe":
                     self._serve_logtail(conn, header.get("from_ts", 0))
                     return
+                rid = header.get("rid")
+                if rid:
+                    # idempotency: a retry of a call we already executed
+                    # (or are STILL executing on another thread) replays
+                    # the recorded response instead of re-running it
+                    dl_ms = header.get("deadline_ms") or 30_000
+                    kind, ent = self._rids.claim(
+                        rid, timeout=max(0.05, dl_ms / 1000.0))
+                    if kind == "done":
+                        resp, rblob = dict(ent[0], dedup=True), ent[1]
+                        _send_msg(conn, resp, rblob)
+                        continue
                 try:
-                    resp, rblob = self._dispatch(op, header, blob)
+                    # re-enter the caller's remaining time budget so
+                    # nested calls (quorum WAL appends) inherit it
+                    with deadline_scope(
+                            ms=header.get("deadline_ms") or 30_000):
+                        resp, rblob = self._dispatch(op, header, blob)
                 except Exception as e:        # noqa: BLE001
                     resp, rblob = {"ok": False, "err": str(e),
                                    "etype": _err_name(e)}, b""
+                if rid:
+                    # record (and wake waiting duplicates) BEFORE the
+                    # send: a disconnect between our apply and the
+                    # client's read is exactly the window a retry closes
+                    self._rids.complete(rid, resp, rblob)
                 _send_msg(conn, resp, rblob)
                 if op == "stop":
                     import os

@@ -293,33 +293,100 @@ class SessionProxy(MOProxy):
 
     # ---------------------------------------------------- command loop
     def _command_loop(self, sess, client, cur):
+        from matrixone_tpu.utils.fault import INJECTOR
         while True:
             backend, upstream = cur["backend"], cur["upstream"]
             if backend.draining and not sess.txn_open:
                 moved = self._migrate(sess, backend, upstream)
                 if moved is not None:
-                    try:
-                        upstream.close()
-                    except OSError:
-                        pass
-                    with self._lock:
-                        backend.active -= 1
-                    cur["backend"], cur["upstream"] = moved
+                    self._swap_upstream(cur, moved)
                     backend, upstream = moved
             pkt = _read_pkt(client)
             if pkt is None or pkt[4] == _COM_QUIT:
                 if pkt is not None:
                     try:
-                        upstream.sendall(pkt)
+                        cur["upstream"].sendall(pkt)
                     except OSError:
                         pass
                 return
             cmd = pkt[4]
-            pkt = self._track_and_rewrite(sess, cmd, pkt)
-            upstream.sendall(pkt)
-            if cmd in _NO_RESPONSE_CMDS:
-                continue                           # no response packet
-            self._relay_response(sess, cmd, pkt, client, upstream)
+            orig = pkt          # pre-rewrite: a failover re-rewrites
+                                # stmt ids against the NEW backend's map
+            # capture BEFORE tracking mutates it: a COMMIT flips
+            # txn_open to False during _track_and_rewrite, but its
+            # transaction (still open on the dying backend) is exactly
+            # what a failover would silently lose — the guard must see
+            # the state the command STARTED in
+            txn_was_open = sess.txn_open
+            for attempt in (0, 1):
+                backend, upstream = cur["backend"], cur["upstream"]
+                wire = self._track_and_rewrite(sess, cmd, orig)
+                sent_to_client: list = []
+                try:
+                    if INJECTOR.trigger("proxy.relay") == "drop":
+                        # chaos drill: the backing CN's socket dies
+                        # mid-session, right under this command
+                        try:
+                            upstream.close()
+                        except OSError:
+                            pass
+                    upstream.sendall(wire)
+                    if cmd not in _NO_RESPONSE_CMDS:
+                        self._relay_response(sess, cmd, wire, client,
+                                             upstream, sent_to_client)
+                    break
+                except (ConnectionError, OSError):
+                    # Backend lost mid-command. Fail over ONCE, and only
+                    # when a replay is invisible AND safe: no response
+                    # bytes relayed yet, no open transaction (whose
+                    # workspace died with the backend), and a command
+                    # whose re-send cannot double-apply — the backend
+                    # may have executed it before dying, and unlike the
+                    # CN->TN lane the wire protocol carries no
+                    # idempotency rid, so mutations surface the error
+                    # to the client instead of risking a double-apply.
+                    if attempt or sent_to_client or txn_was_open \
+                            or sess.txn_open \
+                            or not self._replay_safe(sess, cmd, orig):
+                        raise
+                    with self._lock:
+                        backend.down_until = time.monotonic() + 5.0
+                    moved = self._migrate(sess, backend, upstream)
+                    if moved is None:
+                        raise
+                    from matrixone_tpu.utils import metrics as _M
+                    _M.proxy_failovers.inc()
+                    self._swap_upstream(cur, moved)
+
+    #: statement prefixes whose re-execution is side-effect free
+    _SAFE_SQL = ("select", "show", "desc", "describe", "explain", "set",
+                 "use", "begin", "start transaction")
+
+    def _replay_safe(self, sess, cmd: int, pkt: bytes) -> bool:
+        """May this command be re-sent to a NEW backend when the old one
+        died mid-relay? Only when executing it twice is harmless — the
+        old backend may have applied it before the connection died."""
+        if cmd == _COM_STMT_PREPARE:
+            return True                  # re-prepare is idempotent
+        if cmd == _COM_QUERY:
+            sql = pkt[5:].decode("utf-8", "replace").lstrip().lower()
+            return sql.startswith(self._SAFE_SQL)
+        if cmd == _COM_STMT_EXECUTE:
+            cid = int.from_bytes(pkt[5:9], "little")
+            sql = (sess.stmts.get(cid) or "").lstrip().lower()
+            return sql.startswith(self._SAFE_SQL)
+        return False   # SEND_LONG_DATA, CLOSE, RESET, unknown: no replay
+
+    def _swap_upstream(self, cur, moved) -> None:
+        try:
+            cur["upstream"].close()
+        except OSError:
+            pass
+        with self._lock:
+            cur["backend"].active -= 1
+        cur["backend"], cur["upstream"] = moved
+        from matrixone_tpu.utils.sync import notify_waiters
+        notify_waiters()
 
     def _track_and_rewrite(self, sess, cmd: int, pkt: bytes) -> bytes:
         if cmd == _COM_QUERY:
@@ -350,13 +417,19 @@ class SessionProxy(MOProxy):
         return pkt
 
     def _relay_response(self, sess, cmd: int, req: bytes, client,
-                        upstream):
+                        upstream, sent=None):
         """Forward one COMPLETE response, streaming packets through and
-        rewriting the stmt id in PREPARE_OK to the client-visible one."""
+        rewriting the stmt id in PREPARE_OK to the client-visible one.
+        Appends a marker to `sent` after the first byte reaches the
+        client — past that point a backend loss cannot fail over (the
+        client already saw a partial response)."""
+        if sent is None:
+            sent = []
         first = _read_pkt(upstream)
         if first is None:
             raise ConnectionError("backend closed")
         hdr = first[4]
+        sent.append(True)
         if cmd == _COM_STMT_PREPARE and hdr == 0x00:
             bid = int.from_bytes(first[5:9], "little")
             sql = req[5:].decode("utf-8", "replace")

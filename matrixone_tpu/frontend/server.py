@@ -434,7 +434,7 @@ class _Conn:
                     sql = body.decode("utf-8", "replace")
                     try:
                         r = self.session.execute(sql)
-                    except Exception as e:
+                    except Exception as e:  # noqa: BLE001 — wire ERR pkt
                         self.send_err(str(e))
                         continue
                     self._result_to_packets(r, binary=False)
@@ -443,14 +443,14 @@ class _Conn:
                     self.seq = 1
                     try:
                         self._handle_prepare(body.decode("utf-8", "replace"))
-                    except Exception as e:
+                    except Exception as e:  # noqa: BLE001 — wire ERR pkt
                         self.send_err(str(e))
                     continue
                 if cmd == _COM_STMT_EXECUTE:
                     self.seq = 1
                     try:
                         self._handle_execute(body)
-                    except Exception as e:
+                    except Exception as e:  # noqa: BLE001 — wire ERR pkt
                         self.send_err(str(e))
                     continue
                 if cmd == _COM_STMT_CLOSE:

@@ -153,7 +153,7 @@ class Session:
             self._liid_set = False     # last_insert_id(): per statement
             try:
                 r = self._execute_stmt(st)
-            except Exception as e:
+            except Exception as e:   # noqa: BLE001 — recorded, re-raised
                 dt_ = _time.perf_counter() - t0
                 M.query_seconds.observe(dt_)
                 self.catalog.stmt_recorder.record(
@@ -424,15 +424,10 @@ class Session:
             if isinstance(stmt.value, ast.Literal):
                 value = stmt.value.value
                 # fault injection control (reference: mo_ctl addfaultpoint)
-                from matrixone_tpu.utils.fault import INJECTOR
+                from matrixone_tpu.utils.fault import INJECTOR, parse_spec
                 if stmt.name == "fault_point" and isinstance(value, str):
-                    parts = value.split(":")
-                    if len(parts) < 2:
-                        raise BindError(
-                            "fault_point format: 'name:action[:arg]'")
                     try:
-                        INJECTOR.add(parts[0], parts[1],
-                                     parts[2] if len(parts) > 2 else None)
+                        INJECTOR.add(**parse_spec(value))
                     except ValueError as e:
                         raise BindError(str(e))
                 elif stmt.name == "fault_point_clear":
@@ -697,6 +692,38 @@ class Session:
             if hasattr(self.catalog, "stmt_recorder"):
                 self.catalog.stmt_recorder.flush()
             out = "flushed"
+        elif cmd == "fault":
+            # operational fault-point surface (reference: mo_ctl
+            # addfaultpoint): status | clear | arm:<spec>
+            import json as _json
+            from matrixone_tpu.utils.fault import INJECTOR, parse_spec
+            if arg in ("", "status"):
+                out = _json.dumps(INJECTOR.describe(), sort_keys=True)
+            elif arg == "clear":
+                INJECTOR.clear()
+                out = "faults cleared"
+            elif arg.startswith("arm:"):
+                try:
+                    INJECTOR.add(**parse_spec(arg[4:]))
+                except ValueError as e:
+                    raise BindError(str(e))
+                out = f"armed {arg[4:].split(':', 1)[0]}"
+            else:
+                raise BindError(f"unknown fault subcommand {arg!r}; "
+                                "use status | clear | arm:<spec>")
+        elif cmd == "rpc":
+            # per-peer circuit breaker state + the CN's logtail breaker
+            import json as _json
+            from matrixone_tpu.cluster.rpc import breaker_states
+            st = {"breakers": breaker_states()}
+            consumer = getattr(self.catalog, "consumer", None)
+            if consumer is not None:
+                st["logtail"] = {
+                    "state": "open" if consumer.broken else "closed",
+                    "strikes": consumer.strikes,
+                    "applied_ts": consumer.applied_ts,
+                    "last_error": consumer.last_error}
+            out = _json.dumps(st, sort_keys=True)
         else:
             raise BindError(f"unknown mo_ctl command {cmd!r}")
         b = Batch.from_pydict({"mo_ctl": [out]}, {"mo_ctl": dt.VARCHAR})
@@ -898,7 +925,7 @@ class Session:
         self.catalog.register_dynamic(stmt.name, stmt.sql_text)
         try:
             n = refresh_dynamic_table(self, stmt.name)
-        except Exception:
+        except Exception:  # noqa: BLE001 — compensating drop, re-raised
             # no orphan catalog/WAL state from a failed CREATE: the
             # drop is WAL-logged too, so replay converges to "absent"
             self.catalog.drop_table(stmt.name, if_exists=True)
@@ -1087,7 +1114,7 @@ class Session:
                 total += txn.write_batch(table, arrays, validity)
             if self.txn is None:
                 txn.commit()
-        except BaseException:
+        except BaseException:  # noqa: BLE001 — rollback, then re-raised
             if self.txn is None:
                 txn.rollback()
             raise

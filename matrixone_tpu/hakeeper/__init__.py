@@ -27,6 +27,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from matrixone_tpu.logservice.replicated import _recv_msg, _send_msg
+from matrixone_tpu.utils.sync import notify_waiters
 
 STATE_UP = "up"
 STATE_DOWN = "down"
@@ -151,6 +152,7 @@ class HAKeeper:
             self.operators.append({"op": "takeover", "at": time.time(),
                                    "gen": self.keeper_gen})
             self._persist_locked()
+        notify_waiters()
         threading.Thread(target=self._tick_loop, daemon=True).start()
 
     def demote(self) -> None:
@@ -162,6 +164,7 @@ class HAKeeper:
                 return
             self.role = "standby"
             self.operators.append({"op": "demoted", "at": time.time()})
+        notify_waiters()
         print("[hakeeper] demoted: a newer keeper generation owns the "
               "store", file=sys.stderr, flush=True)
 
@@ -208,6 +211,7 @@ class HAKeeper:
                 "downs": self.services.get(sid, {}).get("downs", 0),
             }
             self._persist_locked()
+        notify_waiters()
 
     def heartbeat(self, sid: str, stats: Optional[dict] = None) -> bool:
         with self._lock:
@@ -219,12 +223,14 @@ class HAKeeper:
                 rec["meta"].update(stats)
             if rec["state"] == STATE_DOWN:
                 rec["state"] = STATE_UP   # service came back on its own
-            return True
+        notify_waiters()
+        return True
 
     def deregister(self, sid: str) -> None:
         with self._lock:
             self.services.pop(sid, None)
             self._persist_locked()
+        notify_waiters()
 
     def details(self, kind: Optional[str] = None) -> List[dict]:
         with self._lock:
@@ -261,6 +267,7 @@ class HAKeeper:
                 import sys
                 self.role = "standby"
                 self.operators.append({"op": "demoted", "at": time.time()})
+                notify_waiters()
                 print("[hakeeper] demoted: a newer keeper generation "
                       "owns the store; persist refused", file=sys.stderr,
                       flush=True)
@@ -330,6 +337,8 @@ class HAKeeper:
                     op["repair"] = f"failed: {e}"
             with self._lock:
                 self.operators.append(op)
+        if newly_down:
+            notify_waiters()
 
     # ---------------------------------------------------------- TCP server
     def _serve(self) -> None:

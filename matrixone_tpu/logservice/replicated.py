@@ -438,6 +438,18 @@ class ReplicatedLog:
             if s is None:
                 return None
             try:
+                # an enclosing deadline (e.g. a TN handler re-entered
+                # the CN's remaining budget) caps this replica's I/O:
+                # nested calls never outlive the caller's deadline
+                from matrixone_tpu.cluster.rpc import current_deadline
+                dl = current_deadline()
+                if dl is not None:
+                    rem = dl.remaining()
+                    if rem <= 0:
+                        return None     # caller's budget is gone
+                    s.settimeout(max(0.001, min(self.timeout, rem)))
+                else:
+                    s.settimeout(self.timeout)
                 _send_msg(s, header, blob)
                 return _recv_msg(s)
             except (OSError, ConnectionError):
@@ -450,6 +462,9 @@ class ReplicatedLog:
 
     # ---- WalWriter interface
     def append(self, header: dict, arrow_blob: bytes = b"") -> None:
+        from matrixone_tpu.utils.fault import INJECTOR
+        if INJECTOR.trigger("wal.append") == "fail":
+            raise ConnectionError("fault injected: wal.append failed")
         hj = json.dumps(header).encode()
         payload = struct.pack("<I", len(hj)) + hj + arrow_blob
         self.seq += 1

@@ -456,31 +456,43 @@ def pool_for(catalog) -> "FragmentPeers":
 
 
 class FragmentPeers:
-    """Connection pool over the peer CNs' fragment endpoints. The
-    timeout is generous: a cold peer jit-compiles every fragment shape
-    on its first query, and a premature timeout silently downgrades the
-    cluster to local execution."""
+    """Connection pool over the peer CNs' fragment endpoints (pooled
+    RpcClient per peer, LANES warm sockets each — shuffle L/R overlap).
+    The default timeout is generous: a cold peer jit-compiles every
+    fragment shape on its first query, and a premature timeout silently
+    downgrades the cluster to local execution. `MO_FRAG_TIMEOUT`
+    overrides it (the chaos drills shrink it so a dead peer trips the
+    breaker in seconds, after which queries degrade to local execution
+    instantly instead of hanging)."""
 
     LANES = 2     # concurrent fragments per peer (shuffle L/R overlap)
 
-    def __init__(self, addrs, timeout: float = 180.0):
-        from matrixone_tpu.cluster.rpc import RpcClient
+    def __init__(self, addrs, timeout: Optional[float] = None):
+        from matrixone_tpu.cluster.rpc import RpcClient, _env_float
+        if timeout is None:
+            timeout = _env_float("MO_FRAG_TIMEOUT", 180.0)
+        self.timeout = timeout
         self.addrs = list(addrs)
-        self.clients = [[RpcClient(a, timeout=timeout)
-                         for _ in range(self.LANES)]
+        self.clients = [RpcClient(a, timeout=timeout,
+                                  pool_size=self.LANES)
                         for a in self.addrs]
 
     def close(self) -> None:
-        for lanes in self.clients:
-            for c in lanes:
-                c.close()
+        for c in self.clients:
+            c.close()
 
     def run(self, headers: List[dict]) -> List[Tuple[dict, bytes]]:
+        from matrixone_tpu.cluster.rpc import deadline_scope
         n = len(self.addrs)
 
         def one(i):
-            c = self.clients[i % n][(i // n) % self.LANES]
-            resp, blob = c.call({"op": "run_fragment", **headers[i]})
+            c = self.clients[i % n]
+            # fragments are read-only: transport retries are safe, and
+            # a peer whose breaker is open fails the batch instantly
+            # (BreakerOpen) -> try_distribute falls back to local
+            with deadline_scope(self.timeout):
+                resp, blob = c.call({"op": "run_fragment", **headers[i]},
+                                    retryable=True)
             if not resp.get("ok"):
                 raise RuntimeError(
                     f"fragment on {self.addrs[i % n]}: "
@@ -972,9 +984,11 @@ def run_shuffle_scan(catalog, header: dict) -> Tuple[dict, bytes]:
         else:
             c = RpcClient(tuple(header["peer_addrs"][j]), timeout=60.0)
             try:
+                # idempotent: a retried put overwrites the same bucket
+                # key with the same bytes
                 r, _ = c.call({"op": "shuffle_put", "shuffle_id": sid,
                                "side": side, "from": me, "to": j},
-                              bblob)
+                              bblob, retryable=True)
                 if not r.get("ok"):
                     raise RuntimeError(r.get("err"))
             finally:

@@ -73,11 +73,26 @@ class RemoteScopeCoordinator:
             header["filters"] = [expr_to_json(f) for f in filters]
 
         def run_one(i_chunk):
+            from matrixone_tpu.cluster.rpc import TransportError
             i, (arrays, validity) = i_chunk
-            client = self.clients[i % len(self.clients)]
             blob = arrowio.arrays_to_ipc(arrays, validity)
-            # client.run raises RuntimeError on worker error headers
-            rh, rblob = client.run(header, blob)
+            n = len(self.clients)
+            # chunk-level failover: the stage is pure compute over the
+            # shipped chunk, so when a worker stays unreachable after
+            # the client's own retries the chunk reroutes to the next
+            # worker instead of failing the whole distributed scope
+            last: Exception = None
+            for hop in range(n):
+                client = self.clients[(i + hop) % n]
+                try:
+                    # client.run raises RuntimeError on worker error
+                    # headers (non-transport: never rerouted)
+                    rh, rblob = client.run(header, blob)
+                    break
+                except (TransportError, ConnectionError) as e:
+                    last = e
+            else:
+                raise last
             parts, _ = arrowio.ipc_to_arrays(rblob)
             return rh["n_groups"], parts
 

@@ -189,6 +189,9 @@ def write_object(fs: FileService, meta: ObjectMeta,
     mj = json.dumps(meta_json).encode()
     blob = _MAGIC + struct.pack("<I", len(mj)) + mj + b"".join(blocks)
     path = object_path(meta.table, meta.object_id)
+    from matrixone_tpu.utils.fault import INJECTOR
+    if INJECTOR.trigger("object.write") == "fail":
+        raise IOError(f"fault injected: object.write {path}")
     fs.write(path, blob)
     M.object_write_seconds.inc(time.perf_counter() - t0)
     return path
@@ -222,6 +225,9 @@ def read_object(fs: FileService, path: str
                 ) -> Tuple[ObjectMeta, Dict[str, np.ndarray],
                            Dict[str, np.ndarray]]:
     """Full object read (v1 whole-IPC objects and v2 per-column)."""
+    from matrixone_tpu.utils.fault import INJECTOR
+    if INJECTOR.trigger("object.read") == "fail":
+        raise IOError(f"fault injected: object.read {path}")
     blob = fs.read(path)
     meta, raw, body = _parse_header(blob)
     if raw.get("v", 1) < 2:
@@ -266,6 +272,9 @@ def read_column_block(fs: FileService, path: str, raw: dict, col: str
     """Fetch one column of a v2 object given its PARSED header `raw`
     (from read_header_ranged — callers cache it so N column fetches
     cost N ranged reads, not 2N). Returns (data, validity)."""
+    from matrixone_tpu.utils.fault import INJECTOR
+    if INJECTOR.trigger("object.read") == "fail":
+        raise IOError(f"fault injected: object.read {path}")
     ent = raw["cols"][col]
     off, ln, codec = ent[0], ent[1], ent[2]
     raw_len = ent[3] if len(ent) > 3 else None

@@ -29,6 +29,9 @@ class WalWriter:
         self.path = path
 
     def append(self, header: dict, arrow_blob: bytes = b"") -> None:
+        from matrixone_tpu.utils.fault import INJECTOR
+        if INJECTOR.trigger("wal.append") == "fail":
+            raise IOError("fault injected: wal.append failed")
         hj = json.dumps(header).encode()
         payload = struct.pack("<I", len(hj)) + hj + arrow_blob
         frame = struct.pack("<III", _FRAME_MAGIC, len(payload),

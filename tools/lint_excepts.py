@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Fail on new broad exception swallowing in the cluster/frontend lanes.
+
+A bare `except Exception`/`except BaseException`/`except:` in the RPC or
+wire-protocol layers is how partial failures turn into silent data loss —
+every broad catch there must either narrow its type or carry a
+`# noqa: BLE001` comment with a justification (the convention the
+existing annotated sites follow).
+
+Usage: python tools/lint_excepts.py [repo_root]
+Exit 0 = clean, 1 = findings (printed one per line as path:lineno).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+#: lanes where broad catches must be justified
+LINT_DIRS = ("matrixone_tpu/cluster", "matrixone_tpu/frontend")
+
+#: bare `except:` or any except clause naming Exception/BaseException —
+#: including tuple forms like `except (Exception, ValueError):`
+_BROAD = re.compile(
+    r"^\s*except\s*(:|[^:]*\b(Exception|BaseException)\b)")
+_NOQA = re.compile(r"#\s*noqa")
+
+
+def scan_file(path: str):
+    findings = []
+    with open(path, encoding="utf-8") as f:
+        lines = f.readlines()
+    for i, line in enumerate(lines, 1):
+        if not _BROAD.match(line):
+            continue
+        # the noqa may sit on the except line itself or (for short
+        # lines) be the sole content of the line directly above
+        prev = lines[i - 2] if i >= 2 else ""
+        if _NOQA.search(line) or _NOQA.search(prev):
+            continue
+        findings.append((path, i, line.strip()))
+    return findings
+
+
+def main(root: str = ".") -> int:
+    findings = []
+    for d in LINT_DIRS:
+        base = os.path.join(root, d)
+        for dirpath, _dirs, files in os.walk(base):
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    findings.extend(scan_file(os.path.join(dirpath, fn)))
+    for path, lineno, text in findings:
+        print(f"{path}:{lineno}: unjustified broad except "
+              f"(add a narrower type or '# noqa: BLE001 — why'): {text}")
+    if findings:
+        print(f"{len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "."))
