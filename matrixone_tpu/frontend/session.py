@@ -85,7 +85,13 @@ class Session:
         self.txn_client = TxnClient(self.catalog)
         self.txn = None                 # active explicit transaction
         self.last_insert_id = 0         # MySQL LAST_INSERT_ID()
-        self.variables = {"gpu_mode": 1, "batch_rows": 1 << 20}
+        import os as _os
+        self.variables = {"gpu_mode": 1, "batch_rows": 1 << 20,
+                          # SET ivf_shards = N routes vector queries onto
+                          # an N-device mesh (vm/vector_scan.py); the env
+                          # default serves deployments that shard always
+                          "ivf_shards": int(_os.environ.get(
+                              "MO_IVF_SHARDS", "0") or 0)}
         self._procs = registry_for(self.catalog)
         self.conn_id = self._procs.register(user if auth is None
                                             else f"{auth.account}:"

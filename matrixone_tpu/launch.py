@@ -40,7 +40,10 @@ import subprocess
 import sys
 import threading
 import time
-import tomllib
+try:
+    import tomllib                 # py311+
+except ModuleNotFoundError:        # this image ships py310: use tomli
+    import tomli as tomllib
 from typing import Dict, List, Optional
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

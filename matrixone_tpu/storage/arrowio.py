@@ -14,6 +14,18 @@ from typing import Dict, List, Tuple
 import numpy as np
 import pyarrow as pa
 
+# Warm pyarrow's lazy numpy/pandas interop at import time: the FIRST
+# pa.array()/np.asarray(arrow) call in a process imports pandas (~1.5s of
+# module stats on this image), and before this warmup that bill landed
+# inside whatever request touched Arrow first — measured as a 20k-row LOAD
+# "running" at 17k rows/s when the steady-state path does 160k+
+# (test_load_through_cn_throughput). One-time process cost, never a
+# per-request one.
+try:
+    np.asarray(pa.array([0], type=pa.int64()))
+except Exception:                                          # noqa: BLE001
+    pass  # arrow interop probed lazily as before (never fatal at import)
+
 
 @dataclasses.dataclass
 class DictEncoded:

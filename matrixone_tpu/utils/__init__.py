@@ -1,3 +1,24 @@
 from matrixone_tpu.utils import fault, metrics, tpch, trace
 
-__all__ = ["fault", "metrics", "tpch", "trace"]
+__all__ = ["fault", "metrics", "tpch", "trace",
+           "enable_compilation_cache"]
+
+
+def enable_compilation_cache(min_compile_seconds: float = 0.05) -> bool:
+    """Point jax at the persistent XLA compilation cache shared by the
+    test rig and bench (the cuVS worker the design chases caches its
+    compiled kernels the same way). Honors JAX_COMPILATION_CACHE_DIR,
+    defaults to ~/.cache/mo_tpu_jax; MO_JAX_CACHE=0 disables. Returns
+    whether the cache was enabled. Call before the first compile."""
+    import os
+
+    import jax
+    if os.environ.get("MO_JAX_CACHE", "1") == "0":
+        return False
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.expanduser("~/.cache/mo_tpu_jax"))
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_seconds)
+    return True

@@ -180,6 +180,30 @@ rpc_breaker_transitions = REGISTRY.counter(
     "circuit breaker state transitions, by peer and new state")
 fault_fired = REGISTRY.counter(
     "mo_fault_triggered_total", "armed fault points that fired, by point")
+
+# ---- vector search fast path (vectorindex/, reference: cgo/cuvs worker)
+vector_search_seconds = REGISTRY.counter(
+    "mo_vector_search_seconds_total",
+    "IVF search wall seconds by stage (probe/score/merge — filled by the "
+    "diagnostic staged re-execution, bench.py)")
+vector_search_queries = REGISTRY.counter(
+    "mo_vector_search_queries_total", "queries entering ivf search")
+vector_search_pad_rows = REGISTRY.counter(
+    "mo_vector_search_pad_rows_total",
+    "pad rows added by the internal power-of-two batch bucketing "
+    "(waste visibility: pad/queries = batch occupancy loss)")
+vector_build_seconds = REGISTRY.counter(
+    "mo_vector_build_seconds_total",
+    "IVF build wall seconds by stage (kmeans/assign/pack)")
+vector_shard_imbalance = REGISTRY.gauge(
+    "mo_vector_shard_imbalance",
+    "sharded IVF row imbalance: max shard rows / mean shard rows")
+vector_batch_rows = REGISTRY.counter(
+    "mo_vector_batch_rows_total",
+    "worker micro-batcher: real query rows dispatched to the device")
+vector_batch_coalesced = REGISTRY.counter(
+    "mo_vector_batch_coalesced_total",
+    "worker micro-batcher: requests that rode another request's dispatch")
 proxy_failovers = REGISTRY.counter(
     "mo_proxy_failover_total",
     "proxied sessions moved to another backend after a backend loss")

@@ -1,9 +1,12 @@
 from matrixone_tpu.vectorindex import (brute_force, hnsw, ivf_flat,
-                                       ivf_pq, kmeans, recall)
+                                       ivf_pq, kmeans, recall, sharded)
 from matrixone_tpu.vectorindex.hnsw import HnswIndex
 from matrixone_tpu.vectorindex.ivf_flat import IvfFlatIndex, build, search
 from matrixone_tpu.vectorindex.ivf_pq import IvfPqIndex
+from matrixone_tpu.vectorindex.sharded import (ShardedIvfIndex, shard_ivf,
+                                               search_sharded)
 
 __all__ = ["brute_force", "hnsw", "ivf_flat", "ivf_pq", "kmeans",
-           "recall", "HnswIndex", "IvfFlatIndex", "IvfPqIndex", "build",
-           "search"]
+           "recall", "sharded", "HnswIndex", "IvfFlatIndex", "IvfPqIndex",
+           "ShardedIvfIndex", "build", "search", "shard_ivf",
+           "search_sharded"]

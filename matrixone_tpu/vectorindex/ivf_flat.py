@@ -18,20 +18,32 @@ TPU-native replacement for the reference's IVF-Flat stack:
    1.0 on tight clusters);
  * search is batched: queries are processed in fixed-size chunks; each chunk
    top-nprobes the centroid table (one matmul), gathers its probed clusters
-   into a padded [chunk, nprobe*pad, d] tensor, and scores candidates with
-   one more matmul. `pad` = max cluster size, kept near the mean by the
-   balanced k-means penalty (same reason cuVS balances: blog.md:36);
+   into a padded [chunk, nprobe, pad, d] tensor, and scores candidates
+   PER QUERY — a batched [pad, d] @ [d] contraction (einsum), NOT the
+   seed's [qc, m] x [qc, d] -> [qc, m, qc] matmul that computed every
+   query's score against every OTHER query's candidates and kept only the
+   diagonal: a query_chunk-fold (32x) flops waste that kept the MXU busy
+   doing nothing (r05 roofline: 0.0045 TFLOPS achieved). Top-k is
+   two-stage: per-probe partial top-k (over pad lanes) then a global merge
+   over nprobe*k — the full nprobe*pad sort never happens;
  * optional exact re-rank of the final k in f64 sequential order makes
    results bit-identical to the CPU scalar path (BASELINE.json requirement).
 
 The index is a pytree of device arrays — it lives in HBM between queries,
 exactly like the cuvs_worker_t's persistent device-resident indexes
-(`cgo/cuvs/README.md`).
+(`cgo/cuvs/README.md`). For multi-chip serving see vectorindex/sharded.py
+(cluster-sharded inverted lists over the parallel/mesh.py mesh).
+
+Batch contract: `search` pads any batch size internally to the next
+power of two and strips pad rows before returning — callers no longer
+carry host-side padding code, and dynamic batch sizes reuse a small set
+of compiled shapes (the cuvs compile-cache role).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Optional, Tuple
 
@@ -40,6 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from matrixone_tpu.ops import distance as D
+from matrixone_tpu.utils import metrics as M
 from matrixone_tpu.vectorindex import kmeans
 
 METRIC_L2 = "l2"
@@ -86,7 +99,11 @@ def build(dataset: jnp.ndarray, nlist: int, metric: str = METRIC_L2,
           n_iter: int = 10, seed: int = 0, storage_dtype=None,
           balance_weight: float = 0.3, kmeans_sample: Optional[int] = 262144,
           compute_dtype=jnp.bfloat16,
-          max_list_factor: Optional[float] = 4.0) -> IvfFlatIndex:
+          max_list_factor: Optional[float] = 4.0,
+          kmeans_minibatch: Optional[int] = None,
+          balance_mode: str = "cap",
+          target_list_size: int = 224,
+          mesh=None) -> IvfFlatIndex:
     """Build an IVF-Flat index on device.
 
     cosine metric stores normalized vectors (cosine -> inner product), the
@@ -97,26 +114,60 @@ def build(dataset: jnp.ndarray, nlist: int, metric: str = METRIC_L2,
     what bounds search memory: the probe gather is [chunk, nprobe * cap, d],
     so one runaway cluster would otherwise set the budget for every query
     (observed: a 42k-row cluster at mean 977 = 15.7 GB gather on v5e).
+
+    kmeans_minibatch rotates Lloyd iterations through fixed-size blocks of
+    the training sample (see kmeans.fit) — the big build_seconds lever.
+    mesh (parallel/mesh.py) parallelizes the full-dataset assignment pass
+    across devices. Build stages are metered in mo_vector_build_seconds.
+
+    balance_mode picks how oversized lists are bounded:
+      "cap"   — capped_labels relocation to the next-nearest centroid
+                (seed behavior; bounded memory, costs recall on strongly
+                clustered data);
+      "split" — kmeans.split_oversized: big clusters become local child
+                clusters capped at target_list_size (recall goes UP and
+                the padded gather budget shrinks ~3x; nlist grows by the
+                number of extra children). The serving-bench default.
     """
     n, d = dataset.shape
     data = jnp.asarray(dataset)
     if metric == METRIC_COSINE:
         data = D.normalize(data)
+    t0 = time.perf_counter()
     km = kmeans.fit(data, nlist, n_iter=n_iter, seed=seed,
                     balance_weight=balance_weight, sample=kmeans_sample,
-                    compute_dtype=compute_dtype)
-    if max_list_factor is not None:
+                    compute_dtype=compute_dtype,
+                    minibatch=kmeans_minibatch,
+                    final_assign=(max_list_factor is None
+                                  or balance_mode == "split"))
+    jax.block_until_ready(km.centroids)
+    M.vector_build_seconds.inc(time.perf_counter() - t0, stage="kmeans")
+    t0 = time.perf_counter()
+    centroids = km.centroids
+    if balance_mode == "split":
+        cents2, labels2, _cap = kmeans.split_oversized(
+            np.asarray(data), np.asarray(centroids), np.asarray(km.labels),
+            target=target_list_size, seed=seed)
+        centroids = jnp.asarray(cents2)
+        labels = jnp.asarray(labels2)
+        counts = jnp.asarray(np.bincount(
+            labels2, minlength=len(cents2)).astype(np.int32))
+        nlist = len(cents2)
+    elif max_list_factor is not None:
         labels, counts, _ = kmeans.capped_labels(
-            data, km.centroids, nlist, max_list_factor,
-            compute_dtype=compute_dtype)
+            data, centroids, nlist, max_list_factor,
+            compute_dtype=compute_dtype, mesh=mesh)
     else:
         labels = km.labels
         counts = km.cluster_sizes
+    jax.block_until_ready(counts)
+    M.vector_build_seconds.inc(time.perf_counter() - t0, stage="assign")
+    t0 = time.perf_counter()
     order = jnp.argsort(labels).astype(jnp.int32)
     offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                                jnp.cumsum(counts).astype(jnp.int32)])
     sorted_vecs = data[order].astype(jnp.float32)
-    sorted_centroids = km.centroids[labels[order]]
+    sorted_centroids = centroids[labels[order]]
     residuals = sorted_vecs - sorted_centroids          # small magnitude
     r_norm2 = jnp.sum(jnp.square(residuals), axis=-1)
     r_dot_c = jnp.sum(residuals * sorted_centroids, axis=-1)
@@ -124,33 +175,31 @@ def build(dataset: jnp.ndarray, nlist: int, metric: str = METRIC_L2,
         residuals = residuals.astype(storage_dtype)
     max_cs = int(jnp.max(counts))
     max_cs = ((max_cs + 127) // 128) * 128  # lane-align the gather budget
-    return IvfFlatIndex(centroids=km.centroids, vectors=residuals,
-                        r_norm2=r_norm2, r_dot_c=r_dot_c, ids=order,
-                        offsets=offsets, metric=metric,
-                        max_cluster_size=max_cs, n=n)
+    index = IvfFlatIndex(centroids=centroids, vectors=residuals,
+                         r_norm2=r_norm2, r_dot_c=r_dot_c, ids=order,
+                         offsets=offsets, metric=metric,
+                         max_cluster_size=max_cs, n=n)
+    jax.block_until_ready(index.vectors)
+    M.vector_build_seconds.inc(time.perf_counter() - t0, stage="pack")
+    return index
 
 
-@partial(jax.jit, static_argnames=("k", "nprobe", "query_chunk",
-                                   "compute_dtype", "use_pallas"))
-def search(index: IvfFlatIndex, queries: jnp.ndarray, k: int, nprobe: int,
-           query_chunk: int = 32, compute_dtype=jnp.bfloat16,
-           use_pallas: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Batched IVF search -> (distances [b,k], row_positions [b,k] int32).
+def _bucket_batch(b: int, query_chunk: int) -> Tuple[int, int]:
+    """(padded batch, effective chunk): batches pad up to the next power
+    of two so dynamic sizes reuse a small set of compiled shapes, and the
+    chunk never exceeds the padded batch (a 1-query SQL lookup compiles a
+    1-row kernel, not a 32-row one). The effective chunk is rounded DOWN
+    to a power of two so it always divides the padded batch — a caller's
+    query_chunk=48 must not crash the chunk reshape."""
+    target = max(1, 1 << (max(b, 1) - 1).bit_length())
+    qc = max(1, min(query_chunk, target))
+    return target, 1 << (qc.bit_length() - 1)
 
-    Distances are squared l2 (metric=l2) or 1-ip (cosine/ip). b must be a
-    multiple of query_chunk (pad queries host-side). use_pallas (session
-    `SET use_pallas = 1`) runs the centroid probe through the hand-tiled
-    fused-epilogue kernel when nlist is tile-aligned.
-    """
-    b, d = queries.shape
-    assert b % query_chunk == 0, (
-        f"query batch {b} must be a multiple of query_chunk={query_chunk}; "
-        f"pad queries host-side (ids of pad rows are discarded)")
-    q = queries.astype(jnp.float32)
-    if index.metric == METRIC_COSINE:
-        q = D.normalize(q)
-    # 1) probe centroids: [b, nlist] -> top-nprobe clusters per query.
-    # full f32 precision: these scores re-enter the candidate distances
+
+def _probe(index: IvfFlatIndex, q: jnp.ndarray, nprobe: int,
+           use_pallas: bool):
+    """Stage 1: centroid scores + top-nprobe clusters per query.
+    Full f32 precision: these scores re-enter the candidate distances."""
     if index.metric == METRIC_L2:
         # orient the tiled axis along nlist (the large dim) and let the
         # shared gate in ops/distance.py decide pallas-vs-XLA — one
@@ -161,53 +210,156 @@ def search(index: IvfFlatIndex, queries: jnp.ndarray, k: int, nprobe: int,
     else:
         cdist = -D.inner_product(q, index.centroids)
     cprobe_scores, probes = jax.lax.top_k(-cdist, nprobe)  # [b, nprobe]
-    cprobe_scores = -cprobe_scores                     # ||c-q||^2 / -c.q
+    return -cprobe_scores, probes                      # ||c-q||^2 / -c.q
 
+
+def _score_chunk(index: IvfFlatIndex, qc, pc, cs, pmask, k: int,
+                 compute_dtype):
+    """Score one query chunk's probed clusters and return its top-k.
+
+    qc [qc, d] queries, pc [qc, nprobe] probed cluster ids, cs [qc, nprobe]
+    probe-stage scores, pmask [qc, nprobe] live-probe mask (False lanes are
+    ignored entirely — the sharded path masks probes owned by other
+    devices). Per-query scoring + two-stage top-k (see module docstring).
+    """
+    query_chunk, nprobe = pc.shape
     pad = index.max_cluster_size
+    starts = index.offsets[pc]                         # [qc, nprobe]
+    ends = index.offsets[pc + 1]
+    lane = jnp.arange(pad, dtype=jnp.int32)
+    cand = starts[:, :, None] + lane[None, None, :]    # [qc, nprobe, pad]
+    valid = (cand < ends[:, :, None]) & pmask[:, :, None]
+    cand = jnp.where(valid, cand, 0)
+    vecs = index.vectors[cand]                         # [qc, nprobe, pad, d]
+    # per-query candidate scoring: contract d for each query's own
+    # candidates only ([pad, d] @ [d] batched over (query, probe))
+    own = jnp.einsum("qpld,qd->qpl",
+                     vecs.astype(compute_dtype), qc.astype(compute_dtype),
+                     preferred_element_type=jnp.float32)
+    # residual decomposition: ||x-q||^2 = ||c-q||^2 + ||r||^2
+    #                                    + 2 r.c - 2 r.q
+    #          (ip/cosine):      x.q    = c.q + r.q
+    if index.metric == METRIC_L2:
+        rn = index.r_norm2[cand]
+        rc = index.r_dot_c[cand]
+        dist = jnp.maximum(cs[:, :, None] + rn + 2.0 * rc - 2.0 * own, 0.0)
+    else:
+        dist = 1.0 - (-cs[:, :, None] + own)           # cs = -c.q
+    dist = jnp.where(valid, dist, jnp.inf)
+    # two-stage top-k: per-probe partial top-k, then merge nprobe*kk
+    kk = min(k, pad)
+    s1, p1 = jax.lax.top_k(-dist, kk)                  # [qc, nprobe, kk]
+    c1 = jnp.take_along_axis(cand, p1, axis=2)
+    s1f = s1.reshape(query_chunk, nprobe * kk)
+    c1f = c1.reshape(query_chunk, nprobe * kk)
+    top_s, top_pos = jax.lax.top_k(s1f, min(k, nprobe * kk))
+    top_cand = jnp.take_along_axis(c1f, top_pos, axis=1)
+    return -top_s, index.ids[top_cand].astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("k", "nprobe", "query_chunk",
+                                   "compute_dtype", "use_pallas"))
+def _search(index: IvfFlatIndex, queries: jnp.ndarray, k: int, nprobe: int,
+            query_chunk: int, compute_dtype, use_pallas: bool):
+    b, d = queries.shape
+    q = queries.astype(jnp.float32)
+    if index.metric == METRIC_COSINE:
+        q = D.normalize(q)
+    cprobe_scores, probes = _probe(index, q, nprobe, use_pallas)
     n_chunks = b // query_chunk
     q_chunks = q.reshape(n_chunks, query_chunk, d)
     probe_chunks = probes.reshape(n_chunks, query_chunk, nprobe)
     cscore_chunks = cprobe_scores.reshape(n_chunks, query_chunk, nprobe)
+    pmask = jnp.ones((query_chunk, nprobe), jnp.bool_)
 
     def step(_, inp):
-        qc, pc, cs = inp  # [qc, d], [qc, nprobe], [qc, nprobe]
-        starts = index.offsets[pc]                     # [qc, nprobe]
-        ends = index.offsets[pc + 1]
-        lane = jnp.arange(pad, dtype=jnp.int32)
-        cand = starts[:, :, None] + lane[None, None, :]   # [qc, nprobe, pad]
-        valid = cand < ends[:, :, None]
-        cand = jnp.where(valid, cand, 0)
-        m = nprobe * pad
-        cand_flat = cand.reshape(query_chunk, m)          # [qc, m]
-        vecs = index.vectors[cand_flat]                   # [qc, m, d]
-        # score all chunk queries against all candidates in one MXU matmul,
-        # then take each query's own row (flops are cheaper than a second
-        # HBM pass; see module docstring)
-        dots = jax.lax.dot_general(
-            vecs.astype(compute_dtype), qc.astype(compute_dtype),
-            dimension_numbers=(((2,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)           # [qc, m, qc]
-        own = jnp.take_along_axis(
-            dots, jnp.arange(query_chunk)[:, None, None], axis=2)[:, :, 0]
-        # residual decomposition: ||x-q||^2 = ||c-q||^2 + ||r||^2
-        #                                    + 2 r.c - 2 r.q
-        #          (ip/cosine):      x.q    = c.q + r.q
-        cs_m = jnp.repeat(cs, pad, axis=1)                # [qc, m]
-        if index.metric == METRIC_L2:
-            rn = index.r_norm2[cand_flat]
-            rc = index.r_dot_c[cand_flat]
-            dist = jnp.maximum(cs_m + rn + 2.0 * rc - 2.0 * own, 0.0)
-        else:
-            dist = 1.0 - (-cs_m + own)                    # cs = -c.q
-        dist = jnp.where(valid.reshape(query_chunk, m), dist, jnp.inf)
-        top_s, top_pos = jax.lax.top_k(-dist, k)          # [qc, k]
-        top_cand = jnp.take_along_axis(cand_flat, top_pos, axis=1)
-        top_ids = index.ids[top_cand]
-        return None, (-top_s, top_ids.astype(jnp.int32))
+        qc, pc, cs = inp
+        return None, _score_chunk(index, qc, pc, cs, pmask, k,
+                                  compute_dtype)
 
     _, (dists, ids) = jax.lax.scan(
         step, None, (q_chunks, probe_chunks, cscore_chunks))
-    return dists.reshape(b, k), ids.reshape(b, k)
+    return dists.reshape(b, -1), ids.reshape(b, -1)
+
+
+def search(index: IvfFlatIndex, queries: jnp.ndarray, k: int, nprobe: int,
+           query_chunk: int = 32, compute_dtype=jnp.bfloat16,
+           use_pallas: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched IVF search -> (distances [b,k], row_positions [b,k] int32).
+
+    Distances are squared l2 (metric=l2) or 1-ip (cosine/ip). Any batch
+    size b works: queries are padded internally to the next power of two
+    (pad rows are zero queries whose results are stripped before return),
+    so callers never carry padding code and compiled-shape reuse is
+    bounded at log2(max batch) entries. use_pallas (session
+    `SET use_pallas = 1`) runs the centroid probe through the hand-tiled
+    fused-epilogue kernel when nlist is tile-aligned.
+    """
+    b, d = queries.shape
+    target, qc_eff = _bucket_batch(b, query_chunk)
+    q = jnp.asarray(queries)
+    if target != b:
+        q = jnp.concatenate([q, jnp.zeros((target - b, d), q.dtype)])
+        M.vector_search_pad_rows.inc(target - b)
+    M.vector_search_queries.inc(b)
+    dists, ids = _search(index, q, k, nprobe, qc_eff, compute_dtype,
+                         use_pallas)
+    if target != b:
+        dists, ids = dists[:b], ids[:b]
+    return dists, ids
+
+
+_probe_jit = jax.jit(_probe, static_argnames=("nprobe", "use_pallas"))
+_score_jit = jax.jit(_score_chunk, static_argnames=("k", "compute_dtype"))
+
+
+def search_profiled(index: IvfFlatIndex, queries: jnp.ndarray, k: int,
+                    nprobe: int, query_chunk: int = 32,
+                    compute_dtype=jnp.bfloat16) -> dict:
+    """Diagnostic re-execution of the search pipeline with a device sync
+    between stages, attributing wall time to probe / score / merge.
+    NOT the serving path (the fused `search` kernel is) — bench.py runs
+    this once per round to fill the mo_vector_search_seconds stage
+    counters and the per-stage JSON breakdown."""
+    b, d = queries.shape
+    target, qc_eff = _bucket_batch(b, query_chunk)
+    q = jnp.asarray(queries, jnp.float32)
+    if target != b:
+        q = jnp.concatenate([q, jnp.zeros((target - b, d), q.dtype)])
+    if index.metric == METRIC_COSINE:
+        q = D.normalize(q)
+    probe_fn = _probe_jit
+    score_fn = _score_jit
+    pmask = jnp.ones((qc_eff, nprobe), jnp.bool_)
+    # warm the compile caches so stage times measure execution, not XLA
+    jax.block_until_ready(probe_fn(index, q, nprobe=nprobe,
+                                   use_pallas=False))
+    t0 = time.perf_counter()
+    cs, probes = probe_fn(index, q, nprobe=nprobe, use_pallas=False)
+    jax.block_until_ready(probes)
+    t_probe = time.perf_counter() - t0
+    jax.block_until_ready(score_fn(index, q[:qc_eff], probes[:qc_eff],
+                                   cs[:qc_eff], pmask, k=k,
+                                   compute_dtype=compute_dtype))
+    t_score = 0.0
+    parts = []
+    t0 = time.perf_counter()
+    for i in range(0, target, qc_eff):
+        out = score_fn(index, q[i:i + qc_eff], probes[i:i + qc_eff],
+                       cs[i:i + qc_eff], pmask, k=k,
+                       compute_dtype=compute_dtype)
+        parts.append(out)
+    jax.block_until_ready(parts[-1])
+    t_score = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    dists = np.concatenate([np.asarray(p[0]) for p in parts])[:b]
+    ids = np.concatenate([np.asarray(p[1]) for p in parts])[:b]
+    t_merge = time.perf_counter() - t0
+    M.vector_search_seconds.inc(t_probe, stage="probe")
+    M.vector_search_seconds.inc(t_score, stage="score")
+    M.vector_search_seconds.inc(t_merge, stage="merge")
+    return {"probe_seconds": t_probe, "score_seconds": t_score,
+            "merge_seconds": t_merge, "dists": dists, "ids": ids}
 
 
 def rerank_exact(dataset: jnp.ndarray, queries: jnp.ndarray,

@@ -91,7 +91,8 @@ def build(dataset: jnp.ndarray, nlist: int, n_subspaces: int = 16,
         data = D.normalize(data)
     km = kmeans.fit(data, nlist, n_iter=n_iter, seed=seed,
                     balance_weight=balance_weight, sample=kmeans_sample,
-                    compute_dtype=compute_dtype)
+                    compute_dtype=compute_dtype,
+                    final_assign=max_list_factor is None)
     if max_list_factor is not None:
         labels, counts, _ = kmeans.capped_labels(
             data, km.centroids, nlist, max_list_factor,
@@ -131,17 +132,10 @@ def build(dataset: jnp.ndarray, nlist: int, n_subspaces: int = 16,
 
 @partial(jax.jit, static_argnames=("k", "nprobe", "query_chunk",
                                    "compute_dtype", "use_pallas"))
-def search(index: IvfPqIndex, queries: jnp.ndarray, k: int, nprobe: int,
-           query_chunk: int = 32, compute_dtype=None,
-           use_pallas: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Batched ADC search -> (approx distances [b,k], row positions [b,k]).
-
-    use_pallas (session `SET use_pallas = 1`) scores candidates through
-    the hand-tiled one-hot-matmul ADC kernel (ops/pallas_kernels.py)
-    instead of the XLA take_along_axis gather when the cluster pad is
-    tile-aligned."""
+def _search(index: IvfPqIndex, queries: jnp.ndarray, k: int, nprobe: int,
+            query_chunk: int = 32, compute_dtype=None,
+            use_pallas: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
     b, d = queries.shape
-    assert b % query_chunk == 0
     M = index.n_subspaces
     ds = d // M
     q = queries.astype(jnp.float32)
@@ -196,12 +190,43 @@ def search(index: IvfPqIndex, queries: jnp.ndarray, k: int, nprobe: int,
                 axis=4)[..., 0]                          # [qc,np,pad,M]
             dist = jnp.sum(gathered, axis=-1)            # [qc, nprobe, pad]
         dist = jnp.where(valid, dist, jnp.inf)
-        m_tot = nprobe * pad
-        dist_flat = dist.reshape(query_chunk, m_tot)
-        cand_flat = cand.reshape(query_chunk, m_tot)
-        top_s, top_pos = jax.lax.top_k(-dist_flat, k)
-        top_cand = jnp.take_along_axis(cand_flat, top_pos, axis=1)
+        # two-stage top-k (same shape argument as ivf_flat: the top-k of
+        # the probe union is contained in the union of per-probe top-ks)
+        kk = min(k, pad)
+        s1, p1 = jax.lax.top_k(-dist, kk)              # [qc, nprobe, kk]
+        c1 = jnp.take_along_axis(cand, p1, axis=2)
+        s1f = s1.reshape(query_chunk, nprobe * kk)
+        c1f = c1.reshape(query_chunk, nprobe * kk)
+        top_s, top_pos = jax.lax.top_k(s1f, min(k, nprobe * kk))
+        top_cand = jnp.take_along_axis(c1f, top_pos, axis=1)
         return None, (-top_s, index.ids[top_cand].astype(jnp.int32))
 
     _, (dists, ids) = jax.lax.scan(step, None, (q_chunks, probe_chunks))
-    return dists.reshape(b, k), ids.reshape(b, k)
+    return dists.reshape(b, -1), ids.reshape(b, -1)
+
+
+def search(index: IvfPqIndex, queries: jnp.ndarray, k: int, nprobe: int,
+           query_chunk: int = 32, compute_dtype=None,
+           use_pallas: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched ADC search -> (approx distances [b,k], row positions [b,k]).
+
+    Same batch contract as ivf_flat.search: any b works, padded
+    internally to the next power of two. use_pallas (session
+    `SET use_pallas = 1`) scores candidates through the hand-tiled
+    one-hot-matmul ADC kernel (ops/pallas_kernels.py) instead of the XLA
+    take_along_axis gather when the cluster pad is tile-aligned."""
+    from matrixone_tpu.utils import metrics as Mx
+    from matrixone_tpu.vectorindex.ivf_flat import _bucket_batch
+    b, d = queries.shape
+    target, qc_eff = _bucket_batch(b, query_chunk)
+    q = jnp.asarray(queries)
+    if target != b:
+        q = jnp.concatenate([q, jnp.zeros((target - b, d), q.dtype)])
+        Mx.vector_search_pad_rows.inc(target - b)
+    Mx.vector_search_queries.inc(b)
+    dists, ids = _search(index, q, k, nprobe, query_chunk=qc_eff,
+                         compute_dtype=compute_dtype,
+                         use_pallas=use_pallas)
+    if target != b:
+        dists, ids = dists[:b], ids[:b]
+    return dists, ids

@@ -28,6 +28,39 @@ class VectorTopKOp(Operator):
         self.ctx = ctx
         self.schema = node.schema
 
+    def _sharded_view(self, ix, index):
+        """Route the query onto the device mesh when `SET ivf_shards = N`
+        (or the MO_IVF_SHARDS env default) asks for it and the mesh has
+        the devices. The cluster-sharded repack of the current index_obj
+        is cached on the IndexMeta, keyed by the source index object
+        itself — a recluster/refresh swaps index_obj, which invalidates
+        the cache automatically. Returns None for the single-device
+        path."""
+        import os
+
+        import jax
+        want = (self.ctx.variables or {}).get(
+            "ivf_shards", os.environ.get("MO_IVF_SHARDS", 0))
+        try:
+            want = int(want)
+        except (TypeError, ValueError):
+            return None
+        n_dev = len(jax.devices())
+        shards = min(want, n_dev, index.nlist)
+        if shards < 2:
+            return None
+        cached = ix.options.get("_sharded")
+        # identity (not id()) comparison: holding the source index in the
+        # cache entry both proves provenance and prevents id-reuse aliasing
+        if cached is not None and cached[0] is index \
+                and cached[1] == shards:
+            return cached[2]
+        from matrixone_tpu.parallel.mesh import make_mesh
+        from matrixone_tpu.vectorindex import sharded as shmod
+        sidx = shmod.shard_ivf(index, make_mesh(shards))
+        ix.options["_sharded"] = (index, shards, sidx)
+        return sidx
+
     def execute(self) -> Iterator[ExecBatch]:
         from matrixone_tpu.vectorindex import ivf_flat, ivf_pq
         from matrixone_tpu import indexing
@@ -76,16 +109,23 @@ class VectorTopKOp(Operator):
             nprobe = min(self.node.nprobe, index.nlist)
             pool = nprobe * index.max_cluster_size
             k = min(self.node.k, index.n, pool) or 1
-            search_fn = (ivf_pq.search if ix.algo == "ivfpq"
-                         else ivf_flat.search)
             # session SET use_pallas = 1 routes the probe/ADC kernels
             # through the hand-tiled Pallas paths (gpu_mode analogue)
             from matrixone_tpu.ops import pallas_kernels as PK
             up = PK.effective_use_pallas(
                 (self.ctx.variables or {}).get("use_pallas"))
-            dists, pos = search_fn(index, jnp.asarray(q), k=k,
-                                   nprobe=nprobe, query_chunk=1,
-                                   use_pallas=up)
+            # no host-side padding: search buckets the batch internally
+            sharded_ix = (self._sharded_view(ix, index)
+                          if ix.algo == "ivfflat" else None)
+            if sharded_ix is not None:
+                from matrixone_tpu.vectorindex import sharded as shmod
+                dists, pos = shmod.search_sharded(
+                    sharded_ix, jnp.asarray(q), k=k, nprobe=nprobe)
+            else:
+                search_fn = (ivf_pq.search if ix.algo == "ivfpq"
+                             else ivf_flat.search)
+                dists, pos = search_fn(index, jnp.asarray(q), k=k,
+                                       nprobe=nprobe, use_pallas=up)
             main_d = np.asarray(dists)[0]
             pos = np.asarray(pos)[0]
             keep = pos >= 0

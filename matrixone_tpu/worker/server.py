@@ -48,24 +48,44 @@ class _MicroBatcher:
     (reference: cgo/cuvs dynamic_batching.hpp). Drain-loop design: the
     first arrival becomes the key's dispatcher and loops draining the
     bucket; requests that land WHILE a dispatch is on the device coalesce
-    into the next batch. Sequential callers pay zero added latency (no
-    collection sleep); batching emerges exactly when there is queueing."""
+    into the next batch.
 
-    def __init__(self, max_batch: int = 256):
+    Coalescing needs a short collection LINGER: once the compiled kernel
+    is warm a dispatch returns in ~1ms, so a drain loop that grabs the
+    queue instantly sees at most whatever raced in during that 1ms and
+    concurrency-N degrades to ~N dispatches (observed: 26 dispatches for
+    40 threads). The leader therefore waits up to `linger_s` while there
+    are MORE requests in flight (entered `run`, not yet dispatched) than
+    are queued on its key — i.e. stragglers are demonstrably on their
+    way. Sequential callers still pay ZERO added latency: with one
+    request in flight the linger condition is false on arrival and the
+    queue-empty exit is immediate. In-flight requests on other keys can
+    linger a drain by at most linger_s per round — bounded, and a worker
+    typically serves one hot index."""
+
+    def __init__(self, max_batch: int = 256, linger_s: Optional[float] = None):
+        import os
         self.max_batch = max_batch
+        self.linger_s = (float(os.environ.get("MO_BATCH_LINGER_MS", "4"))
+                         / 1e3) if linger_s is None else linger_s
         self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
         self._pending: Dict[tuple, list] = {}
         self._busy: Dict[tuple, bool] = {}
+        self._inflight = 0         # entered run(), not yet dispatch-grabbed
         self.dispatches = 0
         self.requests = 0
 
     def run(self, key: tuple, queries: np.ndarray, fn):
         """fn(all_queries) -> (d, i) arrays; returns this caller's slice."""
+        from matrixone_tpu.utils import metrics as M
         entry = {"q": queries, "out": None, "err": None,
                  "ev": threading.Event()}
-        with self._lock:
+        with self._cv:
             self.requests += 1
+            self._inflight += 1
             self._pending.setdefault(key, []).append(entry)
+            self._cv.notify_all()
             leader = not self._busy.get(key, False)
             if leader:
                 self._busy[key] = True
@@ -79,7 +99,27 @@ class _MicroBatcher:
         clean_exit = False
         try:
             while True:
-                with self._lock:
+                with self._cv:
+                    if self.linger_s > 0:
+                        # progress-extending window: every arrival buys
+                        # another linger_s (stragglers on a loaded box
+                        # trickle in slower than one fixed window), hard-
+                        # capped at 5x so worst-case added latency stays
+                        # bounded even under a sustained arrival stream
+                        now = time.monotonic()
+                        deadline = now + self.linger_s
+                        hard = now + 5 * self.linger_s
+                        seen = len(self._pending.get(key, ()))
+                        while seen < min(self._inflight, self.max_batch):
+                            now = time.monotonic()
+                            left = min(deadline, hard) - now
+                            if left <= 0:
+                                break
+                            self._cv.wait(left)
+                            cur = len(self._pending.get(key, ()))
+                            if cur > seen:
+                                seen = cur
+                                deadline = time.monotonic() + self.linger_s
                     bucket = self._pending.get(key, [])
                     batch, rest = (bucket[:self.max_batch],
                                    bucket[self.max_batch:])
@@ -87,11 +127,14 @@ class _MicroBatcher:
                         self._pending[key] = rest
                     else:
                         self._pending.pop(key, None)
+                    self._inflight -= len(batch)
                     if not batch:
                         self._busy[key] = False
                         clean_exit = True
                         break
                     self.dispatches += 1
+                    M.vector_batch_rows.inc(sum(len(e["q"]) for e in batch))
+                    M.vector_batch_coalesced.inc(len(batch) - 1)
                 try:
                     qs = np.concatenate([e["q"] for e in batch])
                     d, i = fn(qs)
@@ -267,29 +310,32 @@ class WorkerCore:
         from matrixone_tpu.vectorindex import ivf_flat
         devices = jax.devices()
         if mode == "sharded":
-            # rows split across devices; each shard is its own IVF index
-            # searched in parallel and merged by distance
-            n_shards = min(len(devices), max(1, len(data)))
-            bounds = np.linspace(0, len(data), n_shards + 1).astype(int)
-            parts = []
-            for s in range(n_shards):
-                lo, hi = int(bounds[s]), int(bounds[s + 1])
-                if hi <= lo:
-                    continue
-                with jax.default_device(devices[s]):
-                    idx = ivf_flat.build(
-                        jnp.asarray(data[lo:hi]),
-                        nlist=max(1, min(nlist // n_shards or 1, hi - lo)),
-                        metric=metric, storage_dtype=jnp.bfloat16)
-                parts.append((idx, lo))
-            # keep the host copy for exact re-ranking of the cross-shard
-            # merge: ranking the union on bf16 approximate distances
-            # measurably loses recall vs a single index (near-tie noise
-            # at every shard boundary); the reference's cuvs worker keeps
-            # the dataset for refine the same way
-            entry = {"mode": "sharded", "parts": parts, "n": len(data),
-                     "data": np.asarray(data, np.float32),
-                     "metric": metric}
+            # ONE index, its inverted lists cluster-sharded across the
+            # mesh (vectorindex/sharded.py). The seed built a separate
+            # per-device sub-index over a row slice and kept a full host
+            # f32 copy of the dataset for an exact re-rank of the merged
+            # union; the cluster-sharded path is bit-identical to the
+            # single-device index by construction, so both the host copy
+            # and the re-rank pass are gone. Tradeoff: the build itself
+            # is single-device (peak build memory = the whole dataset on
+            # one chip) before shard_ivf spreads the result; SERVING
+            # capacity is n/S per chip, but an index too big for one
+            # chip at build time needs a distributed build (mesh= exists
+            # on ivf_flat.build for the assignment pass) — tracked as
+            # follow-up, the seed's row-sliced mode returned different
+            # (lower-recall) results and is not a drop-in fallback.
+            from matrixone_tpu.parallel.mesh import make_mesh
+            from matrixone_tpu.vectorindex import sharded as shmod
+            idx = ivf_flat.build(jnp.asarray(data),
+                                 nlist=max(1, min(nlist, len(data))),
+                                 metric=metric, storage_dtype=jnp.bfloat16)
+            n_shards = max(1, min(len(devices), idx.nlist))
+            if n_shards > 1:
+                sidx = shmod.shard_ivf(idx, make_mesh(n_shards))
+                entry = {"mode": "sharded", "sharded": sidx,
+                         "n": len(data)}
+            else:
+                entry = {"mode": "single", "index": idx, "n": len(data)}
         elif mode == "replicated":
             idx = ivf_flat.build(jnp.asarray(data),
                                  nlist=max(1, min(nlist, len(data))),
@@ -323,74 +369,33 @@ class WorkerCore:
     def _search_all(self, entry: dict, q: np.ndarray, k: int, nprobe: int):
         import jax.numpy as jnp
         from matrixone_tpu.vectorindex import ivf_flat
+        # NO host-side padding here: ivf_flat.search buckets batches to
+        # powers of two internally, so dynamic batch sizes reuse a small
+        # set of compiled shapes (cuvs compile-cache role) without every
+        # caller carrying pad/strip code
         n = len(q)
-        # bucket to power-of-2 row counts: dynamic batch sizes must reuse
-        # a small set of compiled shapes, or per-size recompiles stall the
-        # batch leader and fragment the queue (cuvs compile-cache role)
-        chunk = 32
-        bucket = max(chunk, 1 << (max(n - 1, 0)).bit_length())
-        pad = bucket - n
-        if pad:
-            q = np.concatenate([q, np.zeros((pad, q.shape[1]), q.dtype)])
 
-        def dispatch(idx, overfetch: int = 0):
+        def one(idx):
             np_ = min(nprobe, idx.nlist)
-            kk = min(k + overfetch, idx.n,
-                     np_ * idx.max_cluster_size) or 1
-            return ivf_flat.search(idx, jnp.asarray(q), k=kk,
-                                   nprobe=np_, query_chunk=chunk)
-
-        def one(idx, offset):
-            d, i = dispatch(idx)
-            return (np.asarray(d)[:n],
-                    np.asarray(i)[:n].astype(np.int64) + offset)
+            kk = min(k, idx.n, np_ * idx.max_cluster_size) or 1
+            d, i = ivf_flat.search(idx, jnp.asarray(q), k=kk,
+                                   nprobe=np_)
+            return np.asarray(d), np.asarray(i).astype(np.int64)
 
         if entry["mode"] == "sharded":
-            # dispatch every shard before materializing any: the device
-            # calls are async, so shards overlap instead of serializing on
-            # the first shard's np.asarray.  Shards OVERFETCH (k + margin):
-            # a shard's local-k cutoff sits inside bf16 near-tie noise, and
-            # truncating at exactly k per shard measurably drops union
-            # recall (~6pp at small shards); the global merge cuts back
-            # to k
-            lazy = [(dispatch(idx, overfetch=k + 8), off)
-                    for idx, off in entry["parts"]]
-            ds = [np.asarray(d)[:n] for (d, _i), _ in lazy]
-            ids = [np.asarray(i)[:n].astype(np.int64) + off
-                   for (_d, i), off in lazy]
-            all_d = np.concatenate(ds, axis=1)
-            all_i = np.concatenate(ids, axis=1)
-            data = entry.get("data")
-            if data is not None:
-                # exact re-rank of the union candidates via the SAME
-                # rerank_exact kernel every other exact path uses —
-                # restores the recall that approximate cross-shard
-                # ranking loses. The candidates are GATHERED host-side
-                # first (n x shards*(2k+8) rows): shipping the whole
-                # dataset to the device per search batch would be a
-                # gigabyte-scale transfer at real index sizes.
-                n_q, m = all_i.shape
-                cand = data[all_i.reshape(-1)]         # [n*M, d] host
-                local_ids = np.arange(n_q * m,
-                                      dtype=np.int64).reshape(n_q, m)
-                d_r, loc = ivf_flat.rerank_exact(
-                    jnp.asarray(cand), jnp.asarray(q[:n], np.float32),
-                    jnp.asarray(local_ids),
-                    metric=entry.get("metric", "l2"),
-                    valid=jnp.asarray(np.isfinite(all_d)))
-                loc = np.asarray(loc)
-                all_d = np.asarray(d_r)
-                all_i = all_i.reshape(-1)[loc]
-                return all_d[:, :k], all_i[:, :k]
-            order = np.argsort(all_d, axis=1)[:, :k]
-            return (np.take_along_axis(all_d, order, axis=1),
-                    np.take_along_axis(all_i, order, axis=1))
+            from matrixone_tpu.vectorindex import sharded as shmod
+            sidx = entry["sharded"]
+            np_ = min(nprobe, sidx.nlist)
+            kk = min(k, sidx.n, np_ * sidx.max_cluster_size) or 1
+            d, i = shmod.search_sharded(sidx, jnp.asarray(q), k=kk,
+                                        nprobe=np_)
+            return np.asarray(d), np.asarray(i).astype(np.int64)
         if entry["mode"] == "replicated":
             with self._lock:
                 r = entry["rr"][0]
                 entry["rr"][0] = (r + 1) % len(entry["replicas"])
-            return one(entry["replicas"][r], 0)
-        return one(entry["index"], 0)
+            return one(entry["replicas"][r])
+        return one(entry["index"])
 
     def health(self) -> dict:
         import jax

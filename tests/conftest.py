@@ -20,6 +20,17 @@ import jax  # noqa: E402
 # not enough once the axon plugin registered).
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the suite's wall time is dominated by
+# recompiling the same kernels run after run (measured: a 64-list IVF
+# build drops 3.7s -> 1.8s across processes). The threshold is LOW on
+# purpose — the suite compiles hundreds of distinct small programs at
+# 0.05-0.3s each, and that tail is minutes of every run. The cache lives
+# OUTSIDE the repo and also serves subprocess tests (bench smoke, graft
+# entry). MO_JAX_CACHE=0 disables.
+from matrixone_tpu.utils import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache(min_compile_seconds=0.05)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -251,7 +251,7 @@ def test_sysvars_and_show_variables():
     rows = dict(s.execute("show variables").rows())
     assert rows["ivf_nprobe"] == "12"
     assert s.execute("show variables like 'ivf%'").rows() == \
-        [("ivf_nprobe", "12")]
+        [("ivf_nprobe", "12"), ("ivf_shards", "0")]
 
 
 def test_show_session_variables_and_like_escaping():

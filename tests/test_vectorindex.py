@@ -107,6 +107,77 @@ def test_rerank_exact_orders_bit_identically(rng):
         np.testing.assert_array_equal(np.asarray(dist)[i], exp[order])
 
 
+def test_search_pads_any_batch_size():
+    """Callers no longer pad to query_chunk: odd batch sizes are padded
+    internally (power-of-two bucketing) and pad rows never leak into or
+    perturb real rows' results. The comparison runs both sides at the
+    SAME compiled shape (37 padded to 64 internally vs an explicit
+    zero-padded 64 batch), so equality is bit-exact — cross-shape runs
+    can legitimately differ in the last ulp on near-ties."""
+    rng = np.random.default_rng(91)
+    x = _clustered_data(rng, n=8000, d=16)
+    q = x[rng.integers(0, len(x), 37)].astype(np.float32)
+    index = ivf_flat.build(jnp.asarray(x), nlist=32, n_iter=6,
+                           kmeans_sample=None, compute_dtype=None)
+    d_a, i_a = ivf_flat.search(index, jnp.asarray(q), k=5, nprobe=8,
+                               compute_dtype=jnp.float32)
+    assert i_a.shape == (37, 5)
+    q64 = np.concatenate([q, np.zeros((27, 16), np.float32)])
+    d_b, i_b = ivf_flat.search(index, jnp.asarray(q64), k=5, nprobe=8,
+                               compute_dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(i_a), np.asarray(i_b)[:37])
+    np.testing.assert_array_equal(np.asarray(d_a), np.asarray(d_b)[:37])
+
+
+def test_kmeans_single_compile(rng):
+    """The Lloyd loop must be ONE compiled program: the balance-weight
+    schedule is traced, so flipping balancing on mid-fit (the late-iter
+    schedule) cannot trigger a second XLA compile. Guard via the jit
+    cache-miss counter (_cache_size)."""
+    x = _clustered_data(rng, n=6000, d=16)
+    before = kmeans._lloyd_loop._cache_size()
+    kmeans.fit(jnp.asarray(x), 32, n_iter=6, balance_weight=0.4,
+               sample=None)
+    after_one = kmeans._lloyd_loop._cache_size()
+    # second fit, same shapes, different weights/seed: zero new compiles
+    kmeans.fit(jnp.asarray(x), 32, n_iter=6, balance_weight=0.0, seed=3,
+               sample=None)
+    after_two = kmeans._lloyd_loop._cache_size()
+    assert after_one - before == 1, (before, after_one)
+    assert after_two == after_one, (after_one, after_two)
+
+
+def test_split_balance_build():
+    """balance_mode='split' bounds every inverted list by local cluster
+    splitting instead of cross-cluster relocation: the padded gather
+    budget shrinks while recall does NOT regress vs the capped build.
+    Own fixed rng: the shared session fixture makes data depend on test
+    order and this guards an absolute recall floor."""
+    rng = np.random.default_rng(4242)
+    x = _clustered_data(rng, n=16000, d=32, n_clusters=40)
+    q = (x[rng.integers(0, len(x), 48)]
+         + 0.01 * rng.standard_normal((48, 32))).astype(np.float32)
+    kw = dict(nlist=64, n_iter=6, kmeans_sample=None,
+              compute_dtype=None)
+    cap = ivf_flat.build(jnp.asarray(x), **kw)
+    split = ivf_flat.build(jnp.asarray(x), balance_mode="split",
+                           target_list_size=224, **kw)
+    assert split.max_cluster_size <= cap.max_cluster_size
+    offs = np.asarray(split.offsets)
+    assert offs[-1] == len(x)
+    assert sorted(np.asarray(split.ids).tolist()) == list(range(len(x)))
+    padded, n = brute_force.pad_dataset(jnp.asarray(x), chunk_size=4096)
+    _, truth = brute_force.search(padded, jnp.asarray(q), k=20, n_valid=n,
+                                  chunk_size=4096)
+    r_cap, r_split = [
+        recall_at_k(np.asarray(ivf_flat.search(
+            ix, jnp.asarray(q), k=20, nprobe=8,
+            compute_dtype=jnp.float32)[1]), np.asarray(truth))
+        for ix in (cap, split)]
+    assert r_split >= 0.86, r_split        # the bench acceptance guard
+    assert r_split >= r_cap - 0.02, (r_split, r_cap)
+
+
 def test_ivf_pq_recall_and_memory():
     # own fixed rng: the shared session fixture makes data depend on test
     # execution order, and PQ recall thresholds are draw-sensitive
@@ -129,13 +200,15 @@ def test_ivf_pq_recall_and_memory():
     r = recall_at_k(np.asarray(ids), np.asarray(truth))
     assert r >= 0.4, r        # raw ADC: PQ trades recall for 16x memory
     # exact re-rank over a deeper candidate pool recovers recall (this is
-    # what the SQL path's overfetch+Project-recompute does)
-    _, ids50 = ivf_pq.search(index, jnp.asarray(q), k=50, nprobe=8,
-                             query_chunk=16)
+    # what the SQL path's overfetch+Project-recompute does). Pool 100 at
+    # n=20000: pool 50 sat within ~2pp of the threshold and flapped with
+    # the k-means fp ordering (draw-sensitive, per the fixture note)
+    _, ids100 = ivf_pq.search(index, jnp.asarray(q), k=100, nprobe=8,
+                              query_chunk=16)
     _, rr = ivf_flat.rerank_exact(jnp.asarray(x), jnp.asarray(q),
-                                  ids50)
+                                  ids100)
     r2 = recall_at_k(np.asarray(rr)[:, :10], np.asarray(truth))
-    assert r2 >= 0.8, (r, r2)
+    assert r2 >= 0.85, (r, r2)
 
 
 def test_hnsw_recall():
@@ -172,10 +245,13 @@ def test_hnsw_native_walker_matches_python_oracle():
     the pure-Python oracle's recall on clustered data."""
     from matrixone_tpu.vectorindex import hnsw
     from matrixone_tpu.vectorindex.recall import recall_at_k
+    # 2000 pts, not 4000: the pure-python oracle build is O(n*ef*M) and
+    # was alone ~50s of every tier-1 run — the native-vs-oracle recall
+    # comparison this guards is just as discriminating at half the size
     rng = np.random.default_rng(11)
     centers = rng.normal(size=(16, 24)).astype(np.float32)
-    lab = rng.integers(0, 16, 4000)
-    data = centers[lab] + rng.normal(size=(4000, 24)).astype(np.float32) * 0.15
+    lab = rng.integers(0, 16, 2000)
+    data = centers[lab] + rng.normal(size=(2000, 24)).astype(np.float32) * 0.15
     q = centers[rng.integers(0, 16, 64)] + \
         rng.normal(size=(64, 24)).astype(np.float32) * 0.15
 
