@@ -41,10 +41,18 @@ class Backend:
 
 class MOProxy:
     def __init__(self, backends: List[Tuple[str, int]],
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_conns: int = 0):
+        import os
         self.backends = [Backend(h, p) for h, p in backends]
         self.host = host
         self.port = port
+        #: connection-level admission (serving layer, reference: proxy
+        #: tier connection caps): per-backend concurrent session cap —
+        #: when every backend is full a NEW client is refused instead of
+        #: piling more sessions onto overloaded CNs. 0 = unlimited.
+        self.max_conns = max_conns or int(
+            os.environ.get("MO_PROXY_MAX_CONNS", "0") or 0)
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self._stopping = threading.Event()
@@ -55,7 +63,9 @@ class MOProxy:
         with self._lock:
             live = [b for b in self.backends
                     if not b.draining and b.down_until <= now
-                    and b not in exclude]
+                    and b not in exclude
+                    and (self.max_conns <= 0
+                         or b.active < self.max_conns)]
             if not live:
                 return None
             b = min(live, key=lambda x: x.active)
@@ -138,6 +148,8 @@ class MOProxy:
     def _serve_conn(self, client: socket.socket):
         got = self._connect()
         if got is None:
+            from matrixone_tpu.utils import metrics as _M
+            _M.proxy_conn_refused.inc()
             client.close()
             return
         backend, upstream = got
@@ -235,6 +247,8 @@ class SessionProxy(MOProxy):
     def _serve_conn(self, client: socket.socket):
         got = self._connect()
         if got is None:
+            from matrixone_tpu.utils import metrics as _M
+            _M.proxy_conn_refused.inc()
             client.close()
             return
         # migration rebinds the session to a new backend/upstream: the

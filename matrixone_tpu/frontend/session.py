@@ -93,6 +93,7 @@ class Session:
                           "ivf_shards": int(_os.environ.get(
                               "MO_IVF_SHARDS", "0") or 0)}
         self._procs = registry_for(self.catalog)
+        self._admission_depth = 0      # re-entrant execute() guard
         self.conn_id = self._procs.register(user if auth is None
                                             else f"{auth.account}:"
                                                  f"{auth.user}")
@@ -102,11 +103,13 @@ class Session:
         and embed cluster call this on disconnect/shutdown)."""
         self._procs.unregister(self.conn_id)
 
-    def _ctx(self) -> ExecContext:
+    def _ctx(self, frozen_ts: Optional[int] = None) -> ExecContext:
+        if frozen_ts is None and self.txn is None:
+            frozen_ts = self.catalog.committed_ts
         return ExecContext(catalog=self.catalog, txn=self.txn,
                            variables=self.variables,
                            frozen_ts=(None if self.txn is not None
-                                      else self.catalog.committed_ts))
+                                      else frozen_ts))
 
     def _index_skip_tables(self) -> frozenset:
         """Index rewrites serve only frontier (autocommit) reads: an open
@@ -136,18 +139,55 @@ class Session:
             rec_host.stmt_recorder = StatementRecorder(rec_host)
         if STMT_TABLE in sql:
             self.catalog.stmt_recorder.flush()
-        stmts = parse(sql)
-        if params is not None:
-            stmts = [_substitute_params(st, params) for st in stmts]
+        # serving layer (matrixone_tpu/serving): normalize the statement
+        # and route repeated shapes through the plan/result caches; falls
+        # back to the raw parse path whenever anything is off-template
+        sv = self._serving_prepare(sql, params)
+        stmts = sv.make_stmts() if sv is not None else None
+        if stmts is None:
+            # raw path: first occurrence of a template (or an
+            # unusable one) — the result cache still participates
+            # through sv, the plan cache does not (template_mode off)
+            if sv is not None:
+                sv.template_mode = False
+                if not sv.result_enabled():
+                    sv = None
+            stmts = parse(sql)
+            if params is not None:
+                stmts = [_substitute_params(st, params) for st in stmts]
         _tok = _CURRENT_SESSION.set(self)
         try:
-            return self._execute_stmts(stmts, sql)
+            return self._execute_stmts(stmts, sql, sv)
         finally:
             _CURRENT_SESSION.reset(_tok)
 
-    def _execute_stmts(self, stmts, sql: str) -> Result:
+    def _serving_prepare(self, sql: str, params):
+        """-> _ServingCtx when this statement may use the serving caches
+        (single statement, autocommit, deterministic, plain params)."""
+        if self.txn is not None:
+            return None
+        from matrixone_tpu.serving import serving_for
+        state = serving_for(self.catalog)
+        if not (state.plan_cache.enabled or state.result_cache.enabled):
+            return None
+        norm = state.plan_cache.normalized(sql)
+        if norm is None or norm.n_stmts != 1 or norm.nondet:
+            return None
+        try:
+            full = norm.full_params(params)
+        except (IndexError, TypeError, ValueError):
+            return None            # arity mismatch: raw path raises it
+        for p in full:
+            if not isinstance(p, (int, float, str, bool, type(None),
+                                  datetime.date)):
+                return None
+        return _ServingCtx(state, norm, full, self._acct())
+
+    def _execute_stmts(self, stmts, sql: str, serving=None) -> Result:
         import time as _time
+        from matrixone_tpu.serving import serving_for
         from matrixone_tpu.utils import metrics as M
+        adm = serving_for(self.catalog).admission
         results = []
         for st in stmts:
             if self._procs.is_terminated(self.conn_id):
@@ -157,22 +197,62 @@ class Session:
             t0 = _time.perf_counter()
             self._procs.start_query(self.conn_id, sql)
             self._liid_set = False     # last_insert_id(): per statement
+            ann = {"cache_hit": "none", "queue_wait_ms": 0}
+            self._exec_ann = ann
+            ticket = None
             try:
-                r = self._execute_stmt(st)
+                if adm.enabled and self._admission_gated(st):
+                    lane = ("background" if str(self.variables.get(
+                        "query_priority", "")).lower() == "background"
+                        else "interactive")
+                    ticket = adm.acquire(account=self._acct(), lane=lane,
+                                         conn_id=self.conn_id,
+                                         registry=self._procs)
+                    self._admission_depth += 1
+                    ann["queue_wait_ms"] = int(
+                        ticket.queue_wait_s * 1000)
+                r = self._execute_stmt(st, serving)
             except Exception as e:   # noqa: BLE001 — recorded, re-raised
                 dt_ = _time.perf_counter() - t0
                 M.query_seconds.observe(dt_)
                 self.catalog.stmt_recorder.record(
-                    sql, "error", dt_, 0, error=str(e)[:1024])
+                    sql, "error", dt_, 0, error=str(e)[:1024],
+                    cache_hit=ann["cache_hit"],
+                    queue_wait_ms=ann["queue_wait_ms"])
                 raise
             finally:
+                if ticket is not None:
+                    self._admission_depth -= 1
+                    ticket.release()
                 self._procs.end_query(self.conn_id)
             dt_ = _time.perf_counter() - t0
             M.query_seconds.observe(dt_)
             rows_out = len(r.batch) if r.batch is not None else r.affected
-            self.catalog.stmt_recorder.record(sql, "ok", dt_, rows_out)
+            self.catalog.stmt_recorder.record(
+                sql, "ok", dt_, rows_out, cache_hit=ann["cache_hit"],
+                queue_wait_ms=ann["queue_wait_ms"])
             results.append(r)
         return results[-1] if results else Result()
+
+    def _admission_gated(self, st: ast.Node) -> bool:
+        """Workload statements pass admission; control statements (SET,
+        txn control, KILL, SHOW, mo_ctl) never queue — an operator must
+        always be able to inspect and kill. Re-entrant executes (dynamic
+        table refresh inside an admitted statement) bypass too, or a
+        1-slot server would deadlock against itself."""
+        if self._admission_depth > 0:
+            return False
+        if isinstance(st, (ast.Select, ast.Union)):
+            return not self._is_ctl_select(st)
+        return isinstance(st, (ast.Insert, ast.Update, ast.Delete,
+                               ast.LoadData))
+
+    @staticmethod
+    def _is_ctl_select(st: ast.Node) -> bool:
+        return (isinstance(st, ast.Select) and st.from_ is None
+                and len(st.items) == 1
+                and isinstance(st.items[0].expr, ast.FuncCall)
+                and st.items[0].expr.name == "mo_ctl")
 
     # ------------------------------------------------------ privileges
     def _mgr(self):
@@ -233,13 +313,13 @@ class Session:
                                ast.AlterPartition, ast.RestoreTable)):
             self._check("create")
 
-    def _execute_stmt(self, stmt: ast.Node) -> Result:
+    def _execute_stmt(self, stmt: ast.Node, serving=None) -> Result:
         self._enforce(stmt)
         acc = self._account_stmt(stmt)
         if acc is not None:
             return acc
         if isinstance(stmt, (ast.Select, ast.Union)):
-            return self._select(stmt)
+            return self._select(stmt, serving=serving)
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt)
         if isinstance(stmt, ast.DropTable):
@@ -717,6 +797,63 @@ class Session:
             else:
                 raise BindError(f"unknown fault subcommand {arg!r}; "
                                 "use status | clear | arm:<spec>")
+        elif cmd == "serving":
+            # serving-layer ops surface: plan/result cache + admission
+            # (matrixone_tpu/serving; reference: proxy/queryservice tier)
+            import json as _json
+            from matrixone_tpu.serving import serving_for
+            sv = serving_for(self.catalog)
+            if arg in ("", "status"):
+                out = _json.dumps(sv.status(), sort_keys=True,
+                                  default=str)
+            elif arg == "clear":
+                sv.clear()
+                out = "serving caches cleared"
+            elif arg.startswith("slots:"):
+                try:
+                    sv.admission.slots = int(arg.split(":", 1)[1])
+                except ValueError:
+                    raise BindError(f"bad slot count in {arg!r}")
+                out = f"admission slots = {sv.admission.slots}"
+            elif arg.startswith("account_slots:"):
+                try:
+                    sv.admission.account_slots = int(
+                        arg.split(":", 1)[1])
+                except ValueError:
+                    raise BindError(f"bad account slot count in {arg!r}")
+                out = (f"admission account_slots = "
+                       f"{sv.admission.account_slots}")
+            elif arg in ("plan:on", "plan:off"):
+                sv.plan_cache.enabled = arg.endswith(":on")
+                if not sv.plan_cache.enabled:
+                    sv.plan_cache.clear()
+                out = f"plan cache {'on' if sv.plan_cache.enabled else 'off'}"
+            elif arg.startswith("result:"):
+                sub = arg.split(":", 1)[1]
+                if sub == "off":
+                    sv.result_cache.max_bytes = 0
+                    sv.result_cache.clear()
+                elif sub == "on":
+                    if sv.result_cache.max_bytes <= 0:
+                        sv.result_cache.max_bytes = 64 << 20
+                else:
+                    try:
+                        mb = int(sub)
+                    except ValueError:
+                        raise BindError(
+                            f"unknown result subcommand {sub!r}; use "
+                            f"on | off | <mb>")
+                    # shrinking must evict NOW: a read-hot workload never
+                    # calls put(), so its eviction loop would not run
+                    sv.result_cache.set_max_bytes(mb << 20)
+                    if sv.result_cache.max_bytes <= 0:
+                        sv.result_cache.clear()
+                out = f"result cache {sv.result_cache.max_bytes >> 20} MB"
+            else:
+                raise BindError(
+                    f"unknown serving subcommand {arg!r}; use status | "
+                    f"clear | slots:<n> | account_slots:<n> | "
+                    f"plan:<on|off> | result:<on|off|mb>")
         elif cmd == "rpc":
             # per-peer circuit breaker state + the CN's logtail breaker
             import json as _json
@@ -744,21 +881,85 @@ class Session:
         return optimize_plan(node, self.catalog)
 
     # ------------------------------------------------------------- select
-    def _select(self, sel: ast.Select) -> Result:
+    def _select(self, sel: ast.Select, serving=None) -> Result:
         from matrixone_tpu.sql.optimize import apply_indices
         ctl = self._try_mo_ctl(sel)
         if ctl is not None:
             return ctl
-        self._prepare_select(sel)
-        node = Binder(self.catalog).bind_statement(sel)
-        node = self._cbo(node)
-        node = apply_indices(node, self.catalog,
-                             nprobe=int(self.variables.get("ivf_nprobe", 8)),
-                             skip_tables=self._index_skip_tables())
+        sv = serving if (serving is not None and self.txn is None) else None
+        lazy = sv is not None and sv.owns_pristine(sel)
+        if sv is not None and not sv.usable_for(sel):
+            sv = None
+        if sv is None and lazy:
+            # caches declined but the caller handed us the pristine
+            # template: bind a private substituted copy, never the
+            # shared template itself
+            sel = serving.instantiate(raise_errors=True)
+            lazy = False
+        ann = getattr(self, "_exec_ann", None)
+        # ---- result cache: serve the whole statement if every scanned
+        # table is still at the version the entry was stored under
+        if sv is not None and sv.result_enabled():
+            hit = sv.state.result_cache.get(
+                sv.result_key(), self._recompute_versions)
+            if hit is not None:
+                batch, stored = hit
+                # privileges gate CACHED results too: the entry's
+                # version tuple carries the scanned table names, so an
+                # unprivileged user in the same account can never read
+                # a colleague's warm rows
+                if self.auth is not None and not self.auth.is_admin:
+                    for ent in stored[1]:
+                        self._check("select", ent[0])
+                if ann is not None:
+                    ann["cache_hit"] = "result"
+                return Result(batch=batch)
+        # ---- plan cache: skip prepare/bind/optimize on a hit (only in
+        # template mode — raw-path literals carry no parameter tags)
+        node = None
+        plan_missed = False
+        if sv is not None and sv.template_mode and sv.plan_enabled():
+            gens = self._serving_gens()
+            outcome, node = sv.state.plan_cache.lookup(
+                sv.plan_key(), gens[0], gens[1], sv.full)
+            plan_missed = outcome == "miss"
+            if node is not None and ann is not None \
+                    and ann["cache_hit"] == "none":
+                ann["cache_hit"] = "plan"
+        if node is None:
+            if lazy:
+                # instantiate the template only now: a plan-cache hit
+                # above never pays the AST deepcopy at all
+                sel = sv.instantiate(raise_errors=True)
+            self._prepare_select(sel)
+            node = Binder(self.catalog).bind_statement(sel)
+            node = self._cbo(node)
+            node = apply_indices(
+                node, self.catalog,
+                nprobe=int(self.variables.get("ivf_nprobe", 8)),
+                skip_tables=self._index_skip_tables())
+            if sv is not None and sv.template_mode \
+                    and sv.plan_enabled() and plan_missed:
+                # store under the gens captured at LOOKUP time: a DDL
+                # racing the bind must orphan this entry, so the plan
+                # bound against the old schema never passes the gen
+                # check under the post-DDL generation
+                sv.state.plan_cache.store(
+                    sv.plan_key(), node, len(sv.full), gens[0], gens[1])
         if self.auth is not None and not self.auth.is_admin:
             for tname in _plan_tables(node):
                 self._check("select", tname)
-        ctx = self._ctx()
+        # versions and the execution snapshot must be captured
+        # ATOMICALLY under the engine commit lock: a commit bumps table
+        # versions BEFORE advancing committed_ts, so a lock-free capture
+        # can pair mid-commit versions with an old snapshot — the entry
+        # then publishes old rows under a key that matches the
+        # post-commit state (the staleness chaos drill caught exactly
+        # this).  Execution is then FROZEN at the captured ts.
+        versions = frozen = None
+        if sv is not None and sv.result_enabled():
+            versions, frozen = self._capture_versions(node)
+        ctx = self._ctx(frozen_ts=frozen)
         node = self._maybe_distribute(node, ctx)
         op = compile_plan(node, ctx)
         out_batches = []
@@ -769,17 +970,93 @@ class Session:
             out_batches.append(self._to_host(ex, node.schema))
         if not out_batches:
             empty = {n: Vector.from_values([], d) for n, d in node.schema}
-            return Result(batch=Batch(empty))
-        if len(out_batches) == 1:
-            return Result(batch=out_batches[0])
-        # concatenate host batches
-        cols = {}
-        for n, d in node.schema:
-            vals = []
-            for b in out_batches:
-                vals.extend(b.columns[n].to_pylist())
-            cols[n] = Vector.from_values(vals, d)
-        return Result(batch=Batch(cols))
+            result = Result(batch=Batch(empty))
+        elif len(out_batches) == 1:
+            result = Result(batch=out_batches[0])
+        else:
+            # concatenate host batches
+            cols = {}
+            for n, d in node.schema:
+                vals = []
+                for b in out_batches:
+                    vals.extend(b.columns[n].to_pylist())
+                cols[n] = Vector.from_values(vals, d)
+            result = Result(batch=Batch(cols))
+        if versions is not None and result.batch is not None:
+            sv.state.result_cache.put(sv.result_key(), result.batch,
+                                      versions)
+        return result
+
+    # ------------------------------------------------- serving versions
+    def _serving_gens(self):
+        return (getattr(self.catalog, "ddl_gen", 0),
+                getattr(self.catalog, "stats_gen", 0))
+
+    def _capture_versions(self, node):
+        """-> ((ddl_gen, per-scan table versions), frozen_ts) for the
+        result cache, or (None, None) when any scanned table is
+        unversionable (external / scan-in-place tables change outside
+        the commit funnel).  Runs under the engine commit lock so the
+        version tuple and the snapshot ts are one consistent point —
+        never a mid-commit mixture."""
+        from matrixone_tpu.serving.plan_cache import iter_plan_values
+        lock = getattr(self.catalog, "_commit_lock", None)
+        if lock is None:
+            return None, None
+        scans = set()
+        for v in iter_plan_values(node):
+            if isinstance(v, (P.Scan, P.VectorTopK, P.FulltextTopK)):
+                scans.add((v.table, getattr(v, "as_of_ts", None)))
+        with lock:
+            ts0 = getattr(self.catalog, "committed_ts", None)
+            entries = []
+            for table, as_of in sorted(scans, key=lambda x: (x[0],
+                                                             x[1] or -1)):
+                try:
+                    t = self.catalog.get_table(table)
+                except Exception:   # noqa: BLE001 — raced drop: bypass
+                    return None, None
+                if as_of is not None and ts0 is not None \
+                        and as_of <= ts0:
+                    # strictly in the committed past: immutable (every
+                    # future commit gets ts > committed_ts >= as_of).
+                    # A future-dated as-of still SEES later commits, so
+                    # it falls through to live versioning below.
+                    entries.append((table, "asof", as_of))
+                    continue
+                ver = getattr(t, "last_commit_ts", None)
+                if ver is None or getattr(t, "is_external", False):
+                    return None, None
+                entries.append((table, ver, len(t.segments),
+                                len(t.tombstones)))
+            if ts0 is None:
+                return None, None
+            return (getattr(self.catalog, "ddl_gen", 0),
+                    tuple(entries)), ts0
+
+    def _recompute_versions(self, stored):
+        """Re-evaluate a stored entry's version tuple against the live
+        catalog (under the commit lock: a mid-commit read could match a
+        consistent future tuple and serve rows ahead of the frontier);
+        any mismatch (incl. a dropped table) orphans the entry."""
+        lock = getattr(self.catalog, "_commit_lock", None)
+        if lock is None:
+            return None
+        try:
+            with lock:
+                entries = []
+                for ent in stored[1]:
+                    if ent[1] == "asof":
+                        entries.append(ent)     # immutable past
+                        continue
+                    t = self.catalog.get_table(ent[0])
+                    entries.append(
+                        (ent[0], getattr(t, "last_commit_ts", -1),
+                         len(t.segments), len(t.tombstones)))
+                return (getattr(self.catalog, "ddl_gen", 0),
+                        tuple(entries))
+        except Exception:       # noqa: BLE001 — table gone: never match
+            return None
 
     def _account_stmt(self, stmt: ast.Node) -> Optional[Result]:
         """CREATE ACCOUNT/USER/ROLE, GRANT/REVOKE, SHOW GRANTS
@@ -1326,6 +1603,111 @@ class Session:
         return Result(affected=n)
 
 
+class _ServingCtx:
+    """Per-execution serving context: one normalized statement routed
+    through the plan/result caches (matrixone_tpu/serving).
+
+    Two operating modes: `template_mode` (template activated — plan
+    cache participates, parameter literals are tagged) and raw mode
+    (first occurrence of a template — only the result cache
+    participates, the statement executes through the ordinary parse
+    path at zero added cost)."""
+
+    def __init__(self, state, norm, full_params, scope: str):
+        self.state = state
+        self.norm = norm
+        self.full = full_params
+        self.scope = scope
+        self.template_mode = False
+        self._pristine = None      # cached template AST (never mutated)
+        self._usable = None        # lazily computed on the template AST
+
+    def make_stmts(self):
+        """-> [stmt] from the cached template AST, or None (raw path).
+        SELECT/UNION return the PRISTINE template — `_select`
+        instantiates lazily, so a plan-cache hit never pays the AST
+        deepcopy; other statement kinds instantiate eagerly (their
+        executors mutate the AST)."""
+        tpl = self.state.plan_cache.template_ast(self.norm.template)
+        if tpl is None:
+            return None
+        # every `?` must surface as an ast.Param: a parser that absorbs
+        # one as raw text (e.g. index option values) would execute with
+        # a literal '?' — structurally-consumed params mean the template
+        # is unusable, not just uncacheable
+        if _param_indexes(tpl) != set(range(len(self.full))):
+            return None
+        self.template_mode = True
+        self._pristine = tpl
+        if isinstance(tpl, (ast.Select, ast.Union)):
+            return [tpl]
+        st = self.instantiate()
+        return None if st is None else [st]
+
+    def owns_pristine(self, stmt) -> bool:
+        return self._pristine is not None and stmt is self._pristine
+
+    def instantiate(self, raise_errors: bool = False):
+        """Fresh substituted copy of the template.  Bind-time parameter
+        errors raise when `raise_errors` (callers already committed to
+        the template path), else return None (the raw path reports
+        them properly)."""
+        import copy as _copy
+        st = _copy.deepcopy(self._pristine)
+        try:
+            return _substitute_params(st, self.full)
+        except BindError:
+            if raise_errors:
+                raise
+            return None
+
+    def usable_for(self, sel) -> bool:
+        """Caches are only safe for statements whose execution is fully
+        visible in the final plan: uncorrelated subqueries / EXISTS
+        execute at prepare time and fold to constants (their tables
+        would escape the version key), and @@sysvars read session state."""
+        if self._usable is None:
+            self._usable = not _ast_has(
+                sel, (ast.Subquery, ast.Exists, ast.SysVar))
+        return self._usable
+
+    def result_enabled(self) -> bool:
+        return self.state.result_cache.enabled
+
+    def plan_enabled(self) -> bool:
+        return self.state.plan_cache.enabled
+
+    def _vars_key(self, variables=None):
+        s = current_session()
+        v = s.variables if s is not None else {}
+        return (str(v.get("cbo", 1)), int(v.get("ivf_nprobe", 8) or 8),
+                int(v.get("ivf_shards", 0) or 0))
+
+    def plan_key(self) -> tuple:
+        return ("plan", self.scope, self.norm.template,
+                self.norm.sig_for(self.full), self._vars_key())
+
+    def result_key(self) -> tuple:
+        # the sig guards numerically-equal params of different types:
+        # tuple((1,)) == tuple((1.0,)) but INT64 and decimal results differ
+        return ("result", self.scope, self.norm.template,
+                self.norm.sig_for(self.full), tuple(self.full),
+                self._vars_key())
+
+
+def _param_indexes(node) -> set:
+    """All ast.Param indexes reachable in a statement."""
+    from matrixone_tpu.serving.plan_cache import iter_plan_values
+    return {x.index for x in iter_plan_values(node)
+            if isinstance(x, ast.Param)}
+
+
+def _ast_has(node, kinds) -> bool:
+    """Does any reachable node match `kinds`?"""
+    from matrixone_tpu.serving.plan_cache import iter_plan_values
+    return any(isinstance(x, kinds) for x in iter_plan_values(node))
+
+
 def _plan_tables(node) -> set:
     """Base tables a plan reads (SELECT privilege targets)."""
     out = set()
@@ -1373,7 +1755,12 @@ def _substitute_params(node, params: list):
     if isinstance(node, ast.Param):
         if node.index >= len(params):
             raise BindError(f"missing value for parameter {node.index + 1}")
-        return _param_literal(params[node.index])
+        lit = _param_literal(params[node.index])
+        # serving plan cache: remember which parameter produced this
+        # literal so a cached plan can be re-parameterized (the tag
+        # survives into BoundLiteral via binder._bind_literal)
+        lit._param_idx = node.index
+        return lit
     if dc.is_dataclass(node) and isinstance(node, ast.Node):
         def sub(x):
             if isinstance(x, ast.Node):

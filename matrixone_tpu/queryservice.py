@@ -60,6 +60,15 @@ class ProcessRegistry:
             if rec is not None:
                 rec.update(state="idle", query="", killed=False)
 
+    def set_queued(self, cid: int, queued: bool) -> None:
+        """Admission control (serving/admission.py) flips the visible
+        state while a statement waits for a slot, so SHOW PROCESSLIST
+        distinguishes queue time from execute time."""
+        with self._lock:
+            rec = self._procs.get(cid)
+            if rec is not None and rec["state"] in ("running", "queued"):
+                rec["state"] = "queued" if queued else "running"
+
     def kill(self, cid: int, query_only: bool = True) -> bool:
         """KILL QUERY interrupts the current statement; plain KILL (the
         MySQL connection form) additionally marks the connection

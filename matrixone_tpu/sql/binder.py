@@ -1095,24 +1095,36 @@ def _require_bool(e: BoundExpr, where: str):
 
 
 def _bind_literal(e: ast.Literal) -> BoundLiteral:
+    out = None
     if e.kind == "int":
-        return BoundLiteral(int(e.value), dt.INT64)
-    if e.kind == "float":
+        out = BoundLiteral(int(e.value), dt.INT64)
+    elif e.kind == "float":
         text = str(e.value)
         if "e" not in text.lower() and "." in text:
             frac = text.split(".", 1)[1]
             if len(frac) <= 8:
                 scale = len(frac)
                 scaled = int(round(float(text) * 10 ** scale))
-                return BoundLiteral(scaled, dt.decimal64(18, scale))
-        return BoundLiteral(float(text), dt.FLOAT64)
-    if e.kind == "str":
-        return BoundLiteral(str(e.value), dt.VARCHAR)
-    if e.kind == "bool":
-        return BoundLiteral(bool(e.value), dt.BOOL)
-    if e.kind == "null":
-        return BoundLiteral(None, dt.INT64)  # typeless null; cast on use
-    raise BindError(f"unknown literal kind {e.kind}")
+                out = BoundLiteral(scaled, dt.decimal64(18, scale))
+        if out is None:
+            out = BoundLiteral(float(text), dt.FLOAT64)
+    elif e.kind == "str":
+        out = BoundLiteral(str(e.value), dt.VARCHAR)
+    elif e.kind == "bool":
+        out = BoundLiteral(bool(e.value), dt.BOOL)
+    elif e.kind == "null":
+        out = BoundLiteral(None, dt.INT64)  # typeless null; cast on use
+    else:
+        raise BindError(f"unknown literal kind {e.kind}")
+    # serving plan cache: parameter-derived literals keep their index so
+    # a cached plan can re-derive the value through this SAME transform
+    # (serving/plan_cache.py PlanCache._instantiate); transforms that
+    # build NEW literals drop the tag, which verifiably marks the plan
+    # non-cacheable rather than ever patching a wrong value
+    idx = getattr(e, "_param_idx", None)
+    if idx is not None:
+        out._param_idx = idx
+    return out
 
 
 def _literal_in_arg_domain(lit: BoundLiteral, arg_t: DType):
@@ -1322,6 +1334,24 @@ _SCALAR_FUNCS = {
     "bit_count": ("bit_count", lambda ts: dt.INT64),
     "uuid": ("uuid", lambda ts: dt.VARCHAR),
     "rand": ("rand", lambda ts: dt.FLOAT64),
+    # ---- r6 long tail (serving PR): date/time
+    "weekofyear": ("weekofyear", lambda ts: dt.INT32),
+    "to_seconds": ("to_seconds", lambda ts: dt.INT64),
+    "timediff": ("timediff", lambda ts: dt.VARCHAR),
+    "addtime": ("addtime", lambda ts: dt.VARCHAR),
+    "subtime": ("subtime", lambda ts: dt.VARCHAR),
+    "time_format": ("time_format", lambda ts: dt.VARCHAR),
+    "maketime": ("maketime", lambda ts: dt.VARCHAR),
+    # ---- r6: string / net / json
+    "is_ipv4": ("is_ipv4", lambda ts: dt.BOOL),
+    "is_ipv6": ("is_ipv6", lambda ts: dt.BOOL),
+    "inet6_aton": ("inet6_aton", lambda ts: dt.VARCHAR),
+    "inet6_ntoa": ("inet6_ntoa", lambda ts: dt.VARCHAR),
+    "json_quote": ("json_quote", lambda ts: dt.VARCHAR),
+    "json_contains": ("json_contains", lambda ts: dt.BOOL),
+    "char": ("char_fn", lambda ts: dt.VARCHAR),
+    "make_set": ("make_set", lambda ts: dt.VARCHAR),
+    "export_set": ("export_set", lambda ts: dt.VARCHAR),
     # ---- LLM family (func_builtin_llm.go role; endpoint-configured)
     "llm_chat": ("llm_chat", lambda ts: dt.VARCHAR),
 }
@@ -1385,6 +1415,7 @@ _DATE_ARG_FUNCS = {
     "dayofyear", "weekday", "week", "yearweek", "quarter", "last_day",
     "to_days", "datediff", "monthname", "dayname", "hour", "minute",
     "second", "microsecond", "unix_timestamp", "date_format",
+    "weekofyear", "to_seconds", "adddate", "subdate",
 }
 
 
@@ -1408,6 +1439,18 @@ def _coerce_date_literals(name: str, args: List[BoundExpr]) -> None:
                                        dt.DATE)
         except ValueError:
             pass        # not a date string: leave for the kernel/error
+
+
+def _literal_round_int(a: BoundLiteral) -> int:
+    """MySQL-style integer view of a numeric literal: decimals unscale
+    first, fractional values round half away from zero."""
+    import math
+    v = a.value
+    if a.dtype.oid == TypeOid.DECIMAL64:
+        v = v / 10 ** a.dtype.scale
+    x = float(v)
+    n = int(math.floor(abs(x) + 0.5))
+    return -n if x < 0 else n
 
 
 def bind_scalar_function(name: str, args: List[BoundExpr]) -> BoundExpr:
@@ -1475,6 +1518,69 @@ def bind_scalar_function(name: str, args: List[BoundExpr]) -> BoundExpr:
             raise BindError(
                 "timestampadd() count must be an integer literal "
                 "(per-row counts are not supported yet)")
+    if name in ("adddate", "subdate") and len(args) == 2:
+        # MySQL 2-arg form: adddate(d, n) adds n DAYS (the INTERVAL form
+        # is rewritten in _bind_func before reaching here).  A literal
+        # delta may arrive as a scaled decimal (1.5 -> value 15 at
+        # scale 1) or float — unscale and round to whole days (MySQL
+        # rounds the day count), never use the scaled integer raw.
+        delta = args[1]
+        sign = -1 if name == "subdate" else 1
+        if isinstance(delta, BoundLiteral):
+            if delta.value is None:
+                return BoundLiteral(None, dt.INT64)   # MySQL: NULL in -> NULL
+            try:
+                delta = BoundLiteral(sign * _literal_round_int(delta),
+                                     dt.INT64)
+            except (TypeError, ValueError):
+                raise BindError(f"{name}() day count must be numeric")
+        elif not delta.dtype.is_integer:
+            raise BindError(
+                f"{name}() per-row day counts must be integers")
+        elif sign < 0:
+            delta = BoundFunc("neg", [delta], delta.dtype)
+        return BoundFunc("date_add_days", [args[0], delta], dt.DATE)
+    if name == "char":
+        # CHAR(65, 66) -> 'AB': each value contributes its big-endian
+        # bytes (MySQL); NULLs are skipped. All-literal calls fold.
+        if args and all(isinstance(a, BoundLiteral) for a in args):
+            bs = b""
+            for a in args:
+                if a.value is None:
+                    continue
+                try:
+                    n = _literal_round_int(a)
+                except (TypeError, ValueError):
+                    raise BindError("char() arguments must be numeric")
+                if n < 0:
+                    # matches the runtime path (vm/exprs char_fn):
+                    # negative code points yield NULL
+                    return BoundLiteral(None, dt.INT64)
+                bs += n.to_bytes(max((n.bit_length() + 7) // 8, 1), "big")
+            return BoundLiteral(bs.decode("utf-8", "replace"), dt.VARCHAR)
+        if len(args) != 1:
+            raise BindError(
+                "char() over columns supports a single argument")
+    if name == "maketime":
+        if len(args) != 3:
+            raise BindError("maketime(hour, minute, second)")
+        if all(isinstance(a, BoundLiteral) for a in args):
+            if any(a.value is None for a in args):
+                return BoundLiteral(None, dt.INT64)   # MySQL: NULL in -> NULL
+            try:
+                h, m, s = (_literal_round_int(a) for a in args)
+            except (TypeError, ValueError):
+                raise BindError("maketime() arguments must be numeric")
+            if not (0 <= m < 60 and 0 <= s < 60):
+                # typeless NULL (same convention as _bind_literal: a
+                # varchar-typed NULL const has no device representation)
+                return BoundLiteral(None, dt.INT64)
+            sign = "-" if h < 0 else ""
+            return BoundLiteral(f"{sign}{abs(h):02d}:{m:02d}:{s:02d}",
+                                dt.VARCHAR)
+        if not all(isinstance(a, BoundLiteral) for a in args[1:]):
+            raise BindError(
+                "maketime() minute/second must be literals for now")
     if name == "if" and len(args) == 3:
         _require_bool(args[0], "if()")
         vt = (args[1].dtype if not (isinstance(args[1], BoundLiteral)

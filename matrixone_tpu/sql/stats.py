@@ -149,6 +149,14 @@ class StatsProvider:
         st = self.table(name)
         if st is None:
             raise KeyError(f"no such table {name!r}")
+        # fresh stats can change CBO join orders: orphan cached plans
+        # built against the old estimates (serving/plan_cache.py keys
+        # on stats_gen)
+        host = getattr(self.catalog, "_inner", self.catalog)
+        try:
+            host.stats_gen = getattr(host, "stats_gen", 0) + 1
+        except Exception:     # noqa: BLE001 — read-only facade: plans
+            pass              # just won't invalidate on ANALYZE there
         return st
 
 

@@ -122,6 +122,11 @@ class MVCCTable:
         self.meta = meta
         self.segments: List[Segment] = []
         self.tombstones: List[Tuple[int, np.ndarray]] = []  # (commit_ts, gids)
+        #: commit TS of the last data change applied to THIS table — the
+        #: per-table version the serving result cache keys on (any commit
+        #: funnels through apply_segment/apply_tombstones, including WAL
+        #: replay and the CN logtail apply, so replicas stay versioned)
+        self.last_commit_ts = 0
         self.next_gid = 0
         self.next_seg = 0
         self.dicts: Dict[str, List[str]] = {
@@ -349,6 +354,7 @@ class MVCCTable:
 
     def apply_segment(self, seg: Segment) -> None:
         self.segments.append(seg)
+        self.last_commit_ts = max(self.last_commit_ts, seg.commit_ts)
 
     def insert_segments(self, arrays, validity, commit_ts: int
                         ) -> List[Segment]:
@@ -373,6 +379,7 @@ class MVCCTable:
     def apply_tombstones(self, commit_ts: int, gids: np.ndarray) -> None:
         if len(gids):
             self.tombstones.append((commit_ts, np.asarray(gids, np.int64)))
+            self.last_commit_ts = max(self.last_commit_ts, commit_ts)
 
     # --------------------------------------------------------------- read
     def _dead_gids(self, snapshot_ts: Optional[int],
@@ -693,6 +700,15 @@ class Engine:
         # re-acquisition must not deadlock
         self._commit_lock = threading.RLock()
         self._subscribers: List[Callable] = []   # logtail analogue
+        #: catalog-shape generation: bumped on every DDL (create/drop
+        #: table, index, snapshot, partition change). Serving caches key
+        #: on it so plans and results never outlive the schema they were
+        #: built against; replicas bump via the same methods during
+        #: WAL/logtail apply.
+        self.ddl_gen = 0
+        #: bumped by ANALYZE TABLE (sql/stats.py) — cached plans whose
+        #: join order predates a stats refresh re-optimize
+        self.stats_gen = 0
         self._ckpt_ts = 0
         self.snapshots: Dict[str, int] = {}      # Git-for-data named points
         self.stages: Dict[str, str] = {}         # CREATE STAGE name -> url
@@ -719,6 +735,7 @@ class Engine:
         t = MVCCTable(meta)
         t.engine = self
         self.tables[meta.name] = t
+        self.ddl_gen += 1
         if log:
             self.wal.append({"op": "create_table", "name": meta.name,
                              "ts": self.hlc.now(),
@@ -744,6 +761,7 @@ class Engine:
                 from matrixone_tpu.storage import blockcache
                 blockcache.CACHE.drop_path(seg.obj_path)
         del self.tables[name]
+        self.ddl_gen += 1
         self.sources.discard(name)
         self.dynamic_tables.pop(name, None)
         # publications must not reference dropped tables (a subscriber
@@ -774,6 +792,7 @@ class Engine:
         t = ExternalTable(meta, location, fmt, engine=self,
                           snapshot=snapshot)
         self.tables[meta.name] = t
+        self.ddl_gen += 1
         if log:
             self.wal.append({"op": "create_external", "name": meta.name,
                              "ts": self.hlc.now(), "snapshot": snapshot,
@@ -841,6 +860,7 @@ class Engine:
         pid = spec.names.index(part)
         spec.names.pop(pid)
         spec.bounds.pop(pid)
+        self.ddl_gen += 1
         # part_ids above the dropped slot shift down; the dropped slot's
         # segments (all rows tombstoned by the caller) become unpartitioned
         # so they are never structurally pruned against the new layout
@@ -865,6 +885,7 @@ class Engine:
         """Catalog an index meta (sessions go through this rather than
         mutating `indexes` directly, so tenant scoping can intercept)."""
         self.indexes[meta.name] = meta
+        self.ddl_gen += 1
 
     def indexes_on(self, table: str) -> List[IndexMeta]:
         return [ix for ix in self.indexes.values() if ix.table == table]
@@ -875,11 +896,13 @@ class Engine:
         TAE snapshot reads, docs arXiv 2604.03927)."""
         ts = self.hlc.now()
         self.snapshots[name] = ts
+        self.ddl_gen += 1
         self.wal.append({"op": "create_snapshot", "name": name, "ts": ts})
         return ts
 
     def drop_snapshot(self, name: str) -> None:
         self.snapshots.pop(name, None)
+        self.ddl_gen += 1
         self.wal.append({"op": "drop_snapshot", "name": name,
                          "ts": self.hlc.now()})
 
@@ -1114,6 +1137,7 @@ class Engine:
                 for p in old_paths:
                     blockcache.CACHE.drop_path(p)
             t.tombstones = []
+            t.last_commit_ts = max(t.last_commit_ts, merge_ts)
             t._pk_bloom = None     # rebuilt lazily over the merged rows
             self.committed_ts = max(self.committed_ts, merge_ts)
             for ix in self.indexes_on(name):

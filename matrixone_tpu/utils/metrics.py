@@ -207,3 +207,35 @@ vector_batch_coalesced = REGISTRY.counter(
 proxy_failovers = REGISTRY.counter(
     "mo_proxy_failover_total",
     "proxied sessions moved to another backend after a backend loss")
+proxy_conn_refused = REGISTRY.counter(
+    "mo_proxy_conn_refused_total",
+    "client connections refused: every backend at its connection cap")
+
+# ---- serving layer (serving/, reference: proxy/queryservice tier)
+plan_cache_ops = REGISTRY.counter(
+    "mo_plan_cache_ops_total",
+    "plan cache lookups by outcome (hit/miss/uncacheable/invalidated/"
+    "bypass)")
+plan_cache_entries = REGISTRY.gauge(
+    "mo_plan_cache_entries", "resident plan cache entries")
+result_cache_ops = REGISTRY.counter(
+    "mo_result_cache_ops_total",
+    "result cache lookups by outcome (hit/miss/stale/bypass)")
+result_cache_entries = REGISTRY.gauge(
+    "mo_result_cache_entries", "resident result cache entries")
+result_cache_bytes = REGISTRY.gauge(
+    "mo_result_cache_bytes", "bytes held by cached result sets")
+result_cache_evictions = REGISTRY.counter(
+    "mo_result_cache_evictions_total",
+    "result entries evicted by the byte-budget LRU")
+admission_total = REGISTRY.counter(
+    "mo_admission_total",
+    "admission decisions by lane and outcome (admitted/shed_capacity/"
+    "shed_timeout/shed_deadline/killed)")
+admission_queue_seconds = REGISTRY.histogram(
+    "mo_admission_queue_seconds",
+    "time admitted statements spent waiting for a slot")
+admission_running = REGISTRY.gauge(
+    "mo_admission_running", "statements currently holding a slot")
+admission_queued = REGISTRY.gauge(
+    "mo_admission_queued", "statements waiting in the admission queue")

@@ -29,6 +29,11 @@ _SCHEMA = [
     ("rows_out", dt.INT64),
     ("error", dt.TEXT),
     ("ts", dt.INT64),
+    # serving forensics: which cache served it (plan/result/none) and how
+    # long it sat in the admission queue — duration_us minus
+    # queue_wait_ms is true execute time
+    ("cache_hit", dt.varchar(8)),
+    ("queue_wait_ms", dt.INT64),
 ]
 
 
@@ -47,17 +52,28 @@ class StatementRecorder:
         flush must recreate it instead of failing the user's
         statement."""
         from matrixone_tpu.storage.engine import TableMeta
+        if STMT_TABLE in self.engine.tables:
+            have = [c for c, _ in
+                    self.engine.tables[STMT_TABLE].meta.schema]
+            if "cache_hit" not in have:
+                # pre-serving data dir: trace rows are observability
+                # data — recreate with the widened schema rather than
+                # fail every flush
+                self.engine.drop_table(STMT_TABLE, if_exists=True,
+                                       log=False)
         if STMT_TABLE not in self.engine.tables:
             self.engine.create_table(
                 TableMeta(STMT_TABLE, list(_SCHEMA), ["stmt_id"]),
                 if_not_exists=True, log=False)
 
     def record(self, statement: str, status: str, duration_s: float,
-               rows_out: int, error: Optional[str] = None):
+               rows_out: int, error: Optional[str] = None,
+               cache_hit: str = "none", queue_wait_ms: int = 0):
         with self._lock:
             rec = (self._next_id, statement[:4096], status,
                    int(duration_s * 1e6), rows_out, error or "",
-                   time.time_ns() // 1000)
+                   time.time_ns() // 1000, cache_hit,
+                   int(queue_wait_ms))
             self._next_id += 1
             self._buf.append(rec)
             need_flush = len(self._buf) >= self.flush_every
@@ -78,11 +94,13 @@ class StatementRecorder:
             "duration_us": np.asarray(cols[3], np.int64),
             "rows_out": np.asarray(cols[4], np.int64),
             "ts": np.asarray(cols[6], np.int64),
+            "queue_wait_ms": np.asarray(cols[8], np.int64),
         }
         strings = {
             "statement": t.encode_strings_list("statement", list(cols[1])),
             "status": t.encode_strings_list("status", list(cols[2])),
             "error": t.encode_strings_list("error", list(cols[5])),
+            "cache_hit": t.encode_strings_list("cache_hit", list(cols[7])),
         }
         arrays.update(strings)
         validity = {c: np.ones(len(buf), np.bool_) for c in arrays}

@@ -171,6 +171,10 @@ _STRING_FUNCS = {"upper", "lower", "length", "reverse", "trim", "ltrim",
                  "left", "right", "ord", "insert_str", "elt",
                  "concat_ws", "split_part", "octet_length", "inet_aton",
                  "str_to_date", "time_to_sec",
+                 # r6 long tail: net/json/time-string families
+                 "is_ipv4", "is_ipv6", "inet6_aton", "inet6_ntoa",
+                 "json_quote", "json_contains",
+                 "timediff", "addtime", "subtime", "time_format",
                  # LLM: one endpoint call per DISTINCT value
                  "llm_chat"}
 
@@ -178,7 +182,9 @@ _STRING_FUNCS = {"upper", "lower", "length", "reverse", "trim", "ltrim",
 #: values host-side (O(distinct)), gathered on device — the same
 #: cost model as the dictionary-level string functions
 _NUM2STR_FUNCS = {"date_format", "sec_to_time", "inet_ntoa",
-                  "format_num", "hex_int"}
+                  "format_num", "hex_int",
+                  # r6: bit-set and byte presentations of a numeric col
+                  "char_fn", "make_set", "export_set", "maketime"}
 
 
 #: marks the COLUMN's position in a string call's literal list — distinct
@@ -197,7 +203,10 @@ def _string_arg_info(e, ex, want_col: bool = True):
     lits = []
     for a in e.args:
         if isinstance(a, BoundLiteral):
-            lits.append(a.value)
+            v = a.value
+            if v is not None and a.dtype.oid == dt.TypeOid.DECIMAL64:
+                v = v / 10 ** a.dtype.scale   # surface the REAL value,
+            lits.append(v)                    # never the scaled integer
             continue
         src = _dict_of(a, ex)
         if src is None:
@@ -259,6 +268,33 @@ def _json_path(doc, path: str):
     return cur
 
 
+def _parse_time_str(s: str):
+    """'[-]H+:MM:SS' (MySQL TIME text, hours may exceed 23) -> signed
+    seconds, or None on malformed input."""
+    import re as _re
+    m = _re.fullmatch(r"(-?)(\d{1,3}):([0-5]?\d):([0-5]?\d)(?:\.\d+)?",
+                      s.strip())
+    if m is None:
+        return None
+    sec = int(m.group(2)) * 3600 + int(m.group(3)) * 60 + int(m.group(4))
+    return -sec if m.group(1) else sec
+
+
+def _fmt_time(sec: int) -> str:
+    sign = "-" if sec < 0 else ""
+    sec = abs(sec)
+    return f"{sign}{sec // 3600:02d}:{sec % 3600 // 60:02d}:{sec % 60:02d}"
+
+
+def _is_ipv6_text(s: str) -> bool:
+    import ipaddress
+    try:
+        return isinstance(ipaddress.ip_address(s.strip()),
+                          ipaddress.IPv6Address)
+    except ValueError:
+        return False
+
+
 def _soundex(s: str) -> str:
     codes = {**dict.fromkeys("BFPV", "1"), **dict.fromkeys("CGJKQSXZ", "2"),
              **dict.fromkeys("DT", "3"), "L": "4",
@@ -301,6 +337,98 @@ def _apply_string_func(op, s, lits):
     if op not in ("concat_ws", "elt") and any(x is None for x in lits):
         return None
 
+    if op == "is_ipv4":
+        parts = at(0, "").split(".")
+        return len(parts) == 4 and all(
+            p.isdigit() and len(p) <= 3 and int(p) <= 255 for p in parts)
+    if op == "is_ipv6":
+        return _is_ipv6_text(at(0, ""))
+    if op == "inet6_aton":
+        # MySQL returns VARBINARY(16); surfaced here as its hex text
+        # (the engine has no binary type — hex() of the reference value)
+        import ipaddress
+        try:
+            return ipaddress.ip_address(at(0, "").strip()).packed.hex()
+        except ValueError:
+            return None
+    if op == "inet6_ntoa":
+        import ipaddress
+        try:
+            raw = bytes.fromhex(at(0, ""))
+            if len(raw) not in (4, 16):
+                return None
+            return str(ipaddress.ip_address(raw))
+        except ValueError:
+            return None
+    if op == "json_quote":
+        import json as _json
+        return _json.dumps(str(at(0, "")))
+    if op == "json_contains":
+        import json as _json
+        doc = _json_parse(at(0, ""))
+        cand = _json_parse(at(1, ""))
+        if doc is _JSON_BAD or cand is _JSON_BAD:
+            return None
+
+        def contains(d, c):
+            # MySQL: a candidate ARRAY is contained in a target array
+            # iff EVERY candidate element is contained in SOME element
+            # of the target; a non-array candidate iff some target
+            # element contains it
+            if isinstance(d, list):
+                if isinstance(c, list):
+                    return all(any(contains(x, y) for x in d) for y in c)
+                return any(contains(x, c) for x in d)
+            if isinstance(d, dict) and isinstance(c, dict):
+                return all(k in d and contains(d[k], v)
+                           for k, v in c.items())
+            if isinstance(d, bool) != isinstance(c, bool):
+                return False        # MySQL: true != 1 in JSON
+            return d == c
+        return bool(contains(doc, cand))
+    if op == "timediff":
+        a, b = _parse_time_str(at(0, "")), _parse_time_str(at(1, ""))
+        if a is None or b is None:
+            return None
+        return _fmt_time(a - b)
+    if op in ("addtime", "subtime"):
+        a, b = _parse_time_str(at(0, "")), _parse_time_str(at(1, ""))
+        if a is None or b is None:
+            return None
+        return _fmt_time(a + b if op == "addtime" else a - b)
+    if op == "time_format":
+        sec = _parse_time_str(at(0, ""))
+        fmt = at(1, "%H:%i:%s")
+        if sec is None or fmt is None:
+            return None
+        sign = "-" if sec < 0 else ""
+        sec = abs(sec)
+        h, mi, ss = sec // 3600, sec % 3600 // 60, sec % 60
+        out, i = [], 0
+        while i < len(fmt):
+            if fmt[i] == "%" and i + 1 < len(fmt):
+                c = fmt[i + 1]
+                i += 2
+                if c == "H":
+                    out.append(f"{sign}{h:02d}")
+                elif c == "k":
+                    out.append(f"{sign}{h}")
+                elif c == "h" or c == "I":
+                    out.append(f"{(h % 12) or 12:02d}")
+                elif c == "i":
+                    out.append(f"{mi:02d}")
+                elif c == "s" or c == "S":
+                    out.append(f"{ss:02d}")
+                elif c == "p":
+                    out.append("AM" if (h % 24) < 12 else "PM")
+                elif c == "T":
+                    out.append(f"{sign}{h:02d}:{mi:02d}:{ss:02d}")
+                else:
+                    out.append(c)
+            else:
+                out.append(fmt[i])
+                i += 1
+        return "".join(out)
     if op == "upper":
         return s.upper()
     if op == "lower":
@@ -639,30 +767,32 @@ _MYSQL_FMT = {
 }
 
 
+def _round_bigint(v, dtype) -> int:
+    """MySQL: round a numeric argument to BIGINT. Integers must NOT
+    round-trip through float (2^53 truncates the low bits of a BIGINT);
+    decimals round half-away-from-zero in the exact scaled-integer
+    domain; floats round half-away-from-zero (Python round() is
+    banker's: hex(254.5) would give 'FE')."""
+    if dtype is not None and dtype.oid == dt.TypeOid.DECIMAL64:
+        scale = 10 ** dtype.scale
+        sv = int(v)
+        q, r = divmod(abs(sv), scale)
+        if 2 * r >= scale:
+            q += 1
+        return -q if sv < 0 else q
+    if isinstance(v, (int, np.integer)) or (
+            dtype is not None and dtype.is_integer):
+        return int(v)
+    x = float(v)
+    n = _math.floor(abs(x) + 0.5)
+    return -n if x < 0 else n
+
+
 def _num2str_value(op, v, lits, dtype) -> "Optional[str]":
     """One unique input value -> output string (None = SQL NULL)."""
     import datetime as _dtm
     if op == "hex_int":
-        # MySQL: round the argument to BIGINT, then format. Integers
-        # must NOT round-trip through float (2^53 truncates the low
-        # bits of a BIGINT); decimals round half-away-from-zero in the
-        # exact scaled-integer domain; floats round half-away-from-zero
-        # (Python round() is banker's: hex(254.5) would give 'FE').
-        if dtype is not None and dtype.oid == dt.TypeOid.DECIMAL64:
-            scale = 10 ** dtype.scale
-            sv = int(v)
-            q, r = divmod(abs(sv), scale)
-            if 2 * r >= scale:
-                q += 1
-            n = -q if sv < 0 else q
-        elif isinstance(v, (int, np.integer)) or (
-                dtype is not None and dtype.is_integer):
-            n = int(v)
-        else:
-            x = float(v)
-            n = _math.floor(abs(x) + 0.5)
-            if x < 0:
-                n = -n
+        n = _round_bigint(v, dtype)
         if n < 0:                        # unsigned 64-bit view (MySQL)
             n &= 0xFFFFFFFFFFFFFFFF
         return format(n, "X")
@@ -682,6 +812,39 @@ def _num2str_value(op, v, lits, dtype) -> "Optional[str]":
         if dtype is not None and dtype.oid == dt.TypeOid.DECIMAL64:
             x = x / 10 ** dtype.scale      # stored scaled (exact int)
         return f"{x:,.{max(nd, 0)}f}"
+    if op == "char_fn":
+        n = _round_bigint(v, dtype)
+        if n < 0:
+            return None
+        bs = n.to_bytes(max((n.bit_length() + 7) // 8, 1), "big")
+        return bs.decode("utf-8", "replace")
+    if op == "make_set":
+        # NULL strings are skipped (MySQL), but the bit mask rounds
+        bits = _round_bigint(v, dtype)
+        out = [str(s) for i, s in enumerate(lits[1:])
+               if s is not None and bits & (1 << i)]
+        return ",".join(out)
+    if op == "export_set":
+        # MySQL: a NULL on/off/separator/count argument -> NULL result
+        if any(x is None for x in lits[1:5]):
+            return None
+        bits = _round_bigint(v, dtype)
+        on = str(lits[1]) if len(lits) > 1 else "1"
+        off = str(lits[2]) if len(lits) > 2 else "0"
+        sep = str(lits[3]) if len(lits) > 3 else ","
+        width = _round_bigint(lits[4], None) if len(lits) > 4 else 64
+        return sep.join(on if bits & (1 << i) else off
+                        for i in range(max(0, min(width, 64))))
+    if op == "maketime":
+        h = _round_bigint(v, dtype)
+        m = (_round_bigint(lits[1], None)
+             if len(lits) > 1 and lits[1] is not None else -1)
+        s = (_round_bigint(lits[2], None)
+             if len(lits) > 2 and lits[2] is not None else -1)
+        if not (0 <= m < 60 and 0 <= s < 60):
+            return None
+        sign = "-" if h < 0 else ""
+        return f"{sign}{abs(h):02d}:{m:02d}:{s:02d}"
     if op == "date_format":
         fmt = str(lits[1]) if len(lits) > 1 else "%Y-%m-%d"
         if dtype is not None and dtype.oid in (dt.TypeOid.DATETIME,
@@ -711,6 +874,17 @@ def _num2str_value(op, v, lits, dtype) -> "Optional[str]":
     raise EvalError(op)
 
 
+def _unscaled_literal(a):
+    """Literal argument value with decimals unscaled to their real
+    magnitude (stored scaled: 3.7 at scale 1 is the integer 37)."""
+    if not isinstance(a, BoundLiteral):
+        return None
+    v = a.value
+    if v is not None and a.dtype.oid == dt.TypeOid.DECIMAL64:
+        return v / 10 ** a.dtype.scale
+    return v
+
+
 def _num2str_parts(e: BoundFunc, ex: ExecBatch):
     """(col, unique_vals, inverse_codes, formatted) for a numeric->string
     function — shared by eval and dictionary derivation so codes and
@@ -727,9 +901,8 @@ def _num2str_parts(e: BoundFunc, ex: ExecBatch):
     col = eval_expr(e.args[0], ex)
     vals = np.asarray(jax.device_get(col.data))
     uniq, inv = np.unique(vals, return_inverse=True)
-    strs = [_num2str_value(e.op, u, [None] + [
-        a.value if isinstance(a, BoundLiteral) else None
-        for a in e.args[1:]], e.args[0].dtype) for u in uniq]
+    lits = [None] + [_unscaled_literal(a) for a in e.args[1:]]
+    strs = [_num2str_value(e.op, u, lits, e.args[0].dtype) for u in uniq]
     cache[key] = (col, uniq, inv, strs)
     return cache[key]
 
@@ -1150,7 +1323,8 @@ _MONTH_NAMES = ["January", "February", "March", "April", "May", "June",
 _DAY_NAMES = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
               "Saturday", "Sunday"]
 
-_DATE_FUNCS = {"weekday", "dayofweek", "dayofyear", "quarter", "week",
+_DATE_FUNCS = {"weekofyear", "to_seconds",
+               "weekday", "dayofweek", "dayofyear", "quarter", "week",
                "last_day", "to_days", "from_days", "datediff", "hour",
                "minute", "second", "date", "unix_timestamp",
                "from_unixtime", "monthname", "dayname",
@@ -1204,6 +1378,22 @@ def _eval_date_func(e: BoundFunc, ex: ExecBatch) -> DeviceColumn:
         return DeviceColumn(days.astype(jnp.int32), a.validity, dt.DATE)
     if op == "to_days":
         return DeviceColumn(days + 719528, a.validity, dt.INT64)
+    if op == "to_seconds":
+        # MySQL TO_SECONDS: seconds since year 0 = TO_DAYS*86400 + time
+        base = (days + 719528).astype(jnp.int64) * 86_400
+        if a.dtype.oid in (dt.TypeOid.DATETIME, dt.TypeOid.TIMESTAMP):
+            us = a.data.astype(jnp.int64)
+            base = base + (us - jnp.floor_divide(us, _US_PER_DAY)
+                           * _US_PER_DAY) // 1_000_000
+        return DeviceColumn(base, a.validity, dt.INT64)
+    if op == "weekofyear":
+        # ISO-8601 week number (MySQL week(d, 3)): the week containing
+        # this date's Thursday, numbered within that Thursday's year
+        th = days + 3 - (days + 3) % 7      # Monday-start week's Thursday
+        ty, tm, td = _civil_from_days(th)
+        jan1 = _days_from_civil(ty, jnp.ones_like(tm), jnp.ones_like(td))
+        wk = (th - jan1) // 7 + 1
+        return DeviceColumn(wk.astype(jnp.int32), a.validity, dt.INT32)
     if op == "unix_timestamp":
         if a.dtype.oid in (dt.TypeOid.DATETIME, dt.TypeOid.TIMESTAMP):
             out = jnp.floor_divide(a.data.astype(jnp.int64), 1_000_000)

@@ -1,0 +1,207 @@
+"""Round-6 builtin breadth (serving PR; reference: function_id.go
+families): adddate/subdate days form, weekofyear/to_seconds,
+char/make_set/export_set/maketime, timediff/addtime/subtime/time_format,
+is_ipv4/is_ipv6/inet6_aton/inet6_ntoa, json_quote/json_contains.
+Expected values are MySQL-8 oracle outputs."""
+
+import datetime
+
+import pytest
+
+from matrixone_tpu.frontend import Session
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.execute("create table b6 (id bigint primary key, s varchar(48),"
+              " d date, n bigint)")
+    s.execute("insert into b6 values"
+              " (1, '1.2.3.4',  date '2024-01-15', 5),"
+              " (2, '::1',      date '2023-01-01', 3),"
+              " (3, 'not-an-ip', date '2020-12-31', 0)")
+    return s
+
+
+def test_adddate_subdate_days(sess):
+    D = datetime.date
+    r = sess.execute("select adddate(d, 3), subdate(d, 3) from b6"
+                     " order by id").rows()
+    assert r == [(D(2024, 1, 18), D(2024, 1, 12)),
+                 (D(2023, 1, 4), D(2022, 12, 29)),
+                 (D(2021, 1, 3), D(2020, 12, 28))]
+    # interval form still routes through date_add (month clamping)
+    assert sess.execute("select adddate(date '2024-01-31', interval"
+                        " 1 month)").rows() == [(D(2024, 2, 29),)]
+    # string date argument coerces (MySQL)
+    assert sess.execute("select adddate('2024-01-15', 1)").rows() == \
+        [(D(2024, 1, 16),)]
+    # NULL day count folds to NULL, not a bind-time TypeError
+    assert sess.execute("select adddate('2020-01-01', null)").rows() == \
+        [(None,)]
+    assert sess.execute("select subdate('2020-01-01', null)").rows() == \
+        [(None,)]
+
+
+def test_weekofyear_iso(sess):
+    # MySQL: WEEKOFYEAR = WEEK(d, 3) (ISO-8601)
+    r = sess.execute("select weekofyear(d) from b6 order by id").rows()
+    assert r == [(3,), (52,), (53,)]
+    assert sess.execute("select weekofyear('2024-12-30')").rows() == \
+        [(1,)]          # Monday of ISO week 1 of 2025
+
+
+def test_to_seconds(sess):
+    # MySQL: TO_SECONDS('2024-01-15') = TO_DAYS * 86400 = 63872496000
+    assert sess.execute("select to_seconds(date '2024-01-15')"
+                        ).rows() == [(63872496000,)]
+    assert sess.execute("select to_seconds(d) - to_days(d) * 86400"
+                        " from b6 where id = 1").rows() == [(0,)]
+
+
+def test_char_function(sess):
+    assert sess.execute("select char(77, 121, 83, 81, 76)").rows() == \
+        [("MySQL",)]
+    # NULL args are skipped (MySQL), not null-propagated
+    assert sess.execute("select char(65, null, 66)").rows() == [("AB",)]
+    # decimal args unscale and round (MySQL: char(65.25) -> 'A')
+    assert sess.execute("select char(65.25)").rows() == [("A",)]
+    assert sess.execute("select char(65.5)").rows() == [("B",)]
+    # column form: one numeric argument per row
+    assert sess.execute("select char(n + 64) from b6 order by id"
+                        ).rows() == [("E",), ("C",), ("@",)]
+    # negative code point -> NULL (both fold and runtime paths)
+    assert sess.execute("select char(-1)").rows() == [(None,)]
+    assert sess.execute("select char(n - 10) from b6 where id = 3"
+                        ).rows() == [(None,)]
+
+
+def test_adddate_fractional_days(sess):
+    # MySQL rounds fractional day counts: 1.5 -> 2 days
+    D = datetime.date
+    assert sess.execute("select adddate(date '2020-01-10', 1.5),"
+                        " subdate(date '2020-01-10', 1.5)").rows() == \
+        [(D(2020, 1, 12), D(2020, 1, 8))]
+
+
+def test_make_set_and_export_set(sess):
+    assert sess.execute("select make_set(5, 'a', 'b', 'c')").rows() == \
+        [("a,c",)]
+    # NULL members are skipped
+    assert sess.execute("select make_set(3, 'x', null, 'z')").rows() == \
+        [("x",)]
+    assert sess.execute("select make_set(n, 'p', 'q', 'r') from b6"
+                        " order by id").rows() == \
+        [("p,r",), ("p,q",), ("",)]
+    assert sess.execute("select export_set(5, 'Y', 'N', ',', 4)"
+                        ).rows() == [("Y,N,Y,N",)]
+    assert sess.execute("select export_set(6, '1', '0', '', 8)"
+                        ).rows() == [("01100000",)]
+    # decimal bit masks round (MySQL: 1.5 -> 2), not scaled-int reuse
+    assert sess.execute("select make_set(1.5, 'a', 'b')").rows() == \
+        [("b",)]
+    # export_set NULL on/off/sep -> NULL (unlike make_set's skip)
+    assert sess.execute("select export_set(5, null, 'N')").rows() == \
+        [(None,)]
+    # decimal width rounds (MySQL: 3.7 -> 4), not the scaled int 37
+    assert sess.execute("select export_set(5, 'Y', 'N', ',', 3.7)"
+                        ).rows() == [("Y,N,Y,N",)]
+
+
+def test_maketime(sess):
+    assert sess.execute("select maketime(12, 15, 30)").rows() == \
+        [("12:15:30",)]
+    assert sess.execute("select maketime(12, 61, 30)").rows() == \
+        [(None,)]       # out-of-range minute -> NULL (MySQL)
+    assert sess.execute("select maketime(null, 0, 0)").rows() == \
+        [(None,)]       # NULL argument -> NULL, not a TypeError
+    assert sess.execute("select maketime(10, 30.0, 0)").rows() == \
+        [("10:30:00",)]  # decimal minute unscales, not scaled-int 300
+    assert sess.execute("select maketime(n, 5.9, 0) from b6 order by id"
+                        ).rows() == [("05:06:00",), ("03:06:00",),
+                                     ("00:06:00",)]  # runtime path rounds
+    # non-numeric string counts raise a clean bind error, not a traceback
+    import pytest as _pytest
+    from matrixone_tpu.sql.binder import BindError
+    with _pytest.raises(BindError):
+        sess.execute("select adddate('2020-01-01', 'abc')")
+    with _pytest.raises(BindError):
+        sess.execute("select maketime('a', 0, 0)")
+    assert sess.execute("select maketime(n, 30, 0) from b6 order by id"
+                        ).rows() == [("05:30:00",), ("03:30:00",),
+                                     ("00:30:00",)]
+
+
+def test_time_arithmetic(sess):
+    assert sess.execute("select timediff('12:00:00', '10:30:00')"
+                        ).rows() == [("01:30:00",)]
+    assert sess.execute("select timediff('10:30:00', '12:00:00')"
+                        ).rows() == [("-01:30:00",)]
+    assert sess.execute("select addtime('10:00:00', '01:30:00'),"
+                        " subtime('10:00:00', '01:30:00')").rows() == \
+        [("11:30:00", "08:30:00")]
+    # malformed time -> NULL
+    assert sess.execute("select timediff('nope', '10:00:00')").rows() \
+        == [(None,)]
+
+
+def test_time_format(sess):
+    assert sess.execute(
+        "select time_format('09:05:07', '%H:%i:%s')").rows() == \
+        [("09:05:07",)]
+    assert sess.execute(
+        "select time_format('25:03:04', '%H|%i|%s|%p')").rows() == \
+        [("25|03|04|AM",)]      # 25h -> 1 AM (MySQL %p wraps mod 24)
+    assert sess.execute(
+        "select time_format('14:00:00', '%h %p')").rows() == \
+        [("02 PM",)]
+
+
+def test_ip_predicates(sess):
+    r = sess.execute("select is_ipv4(s), is_ipv6(s) from b6"
+                     " order by id").rows()
+    assert r == [(True, False), (False, True), (False, False)]
+    assert sess.execute("select is_ipv4('256.1.1.1')").rows() == \
+        [(False,)]
+
+
+def test_inet6_roundtrip(sess):
+    # our varbinary surface is hex text; the round trip is the oracle
+    assert sess.execute(
+        "select inet6_ntoa(inet6_aton('2001:db8::1'))").rows() == \
+        [("2001:db8::1",)]
+    assert sess.execute(
+        "select inet6_aton('::1')").rows() == \
+        [("0" * 31 + "1",)]
+    r = sess.execute("select inet6_ntoa(inet6_aton(s)) from b6"
+                     " order by id").rows()
+    assert r == [("1.2.3.4",), ("::1",), (None,)]
+
+
+def test_json_quote_and_contains(sess):
+    assert sess.execute("select json_quote('a\"b')").rows() == \
+        [('"a\\"b"',)]
+    assert sess.execute("select json_contains('[1,2,3]', '2')"
+                        ).rows() == [(True,)]
+    assert sess.execute("select json_contains('[1,2,3]', '5')"
+                        ).rows() == [(False,)]
+    assert sess.execute(
+        "select json_contains('{\"a\": 1, \"b\": 2}', '{\"a\": 1}')"
+        ).rows() == [(True,)]
+    assert sess.execute("select json_contains('not json', '1')"
+                        ).rows() == [(None,)]
+    # array candidate: every element contained in SOME target element
+    assert sess.execute("select json_contains('[1,2,3]', '[1,3]')"
+                        ).rows() == [(True,)]
+    assert sess.execute("select json_contains('[1,2,3]', '[1,5]')"
+                        ).rows() == [(False,)]
+    assert sess.execute("select json_contains('[1,2,[3,4]]', '[3]')"
+                        ).rows() == [(True,)]
+    # a nested-array element must sit in SOME element, not distribute
+    assert sess.execute("select json_contains('[1,2,3]', '[[1,2]]')"
+                        ).rows() == [(False,)]
+    assert sess.execute("select json_contains('[[1,2],3]', '[[1,2]]')"
+                        ).rows() == [(True,)]
+    # JSON true and 1 are distinct types in MySQL
+    assert sess.execute("select json_contains('[true]', '1')"
+                        ).rows() == [(False,)]

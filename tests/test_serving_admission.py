@@ -1,0 +1,237 @@
+"""Admission control (serving/admission.py): two-lane priority, load
+shedding, per-account quotas, deadline-capped queue waits, KILL of
+queued queries, and metrics accounting."""
+
+import threading
+import time
+
+import pytest
+
+from matrixone_tpu.frontend import Session
+from matrixone_tpu.serving import AdmissionRejected, serving_for
+from matrixone_tpu.serving.admission import AdmissionController
+from matrixone_tpu.storage.engine import Engine
+from matrixone_tpu.utils import metrics as M
+
+
+@pytest.fixture()
+def rig():
+    eng = Engine()
+    s = Session(catalog=eng)
+    s.execute("create table a (id bigint primary key, v bigint)")
+    s.execute("insert into a values (1, 1), (2, 2)")
+    s.execute("select v from a where id = 1")      # warm compile
+    sv = serving_for(eng)
+    sv.admission.slots = 1
+    sv.admission.queue_ms = 8000
+    sv.admission.bg_queue_ms = 150
+    yield eng, s, sv
+    sv.admission.slots = 0
+
+
+def _snapshot():
+    out = {}
+    for lane in ("interactive", "background"):
+        for oc in ("admitted", "shed_capacity", "shed_timeout",
+                   "shed_deadline", "killed"):
+            out[(lane, oc)] = M.admission_total.get(lane=lane, outcome=oc)
+    return out
+
+
+def test_saturated_bg_sheds_interactive_completes(rig):
+    eng, s, sv = rig
+    before = _snapshot()
+    tk = sv.admission.acquire(account="sys")       # occupy the only slot
+    outcomes = []
+
+    def bg():
+        sb = Session(catalog=eng)
+        sb.variables["query_priority"] = "background"
+        try:
+            sb.execute("select v from a where id = 1")
+            outcomes.append("bg-ran")
+        except AdmissionRejected as e:
+            assert getattr(e, "retryable", False)
+            outcomes.append("bg-shed")
+        finally:
+            sb.close()
+
+    def inter():
+        si = Session(catalog=eng)
+        try:
+            outcomes.append(
+                ("int", si.execute("select v from a where id = 2").rows()))
+        finally:
+            si.close()
+
+    t1 = threading.Thread(target=bg)
+    t2 = threading.Thread(target=inter)
+    t1.start()
+    t2.start()
+    t1.join(10)
+    time.sleep(0.1)
+    tk.release()                                   # interactive proceeds
+    t2.join(30)
+    assert "bg-shed" in outcomes
+    assert ("int", [(2,)]) in outcomes
+    after = _snapshot()
+    # every submitted query landed in exactly one outcome bucket
+    assert after[("background", "shed_timeout")] \
+        - before[("background", "shed_timeout")] == 1
+    assert after[("interactive", "admitted")] \
+        - before[("interactive", "admitted")] == 2    # tk + the query
+    assert sv.admission.running == 0
+    assert sum(len(q) for q in sv.admission._queues.values()) == 0
+
+
+def test_kill_removes_queued_query(rig):
+    eng, s, sv = rig
+    from matrixone_tpu.queryservice import QueryKilled
+    tk = sv.admission.acquire(account="sys")
+    sb = Session(catalog=eng)
+    out = []
+
+    def victim():
+        try:
+            sb.execute("select v from a where id = 1")
+            out.append("ran")
+        except QueryKilled:
+            out.append("killed")
+    t = threading.Thread(target=victim)
+    t.start()
+    time.sleep(0.3)
+    pl = {p["Id"]: p["State"] for p in s._procs.processlist()}
+    assert pl[sb.conn_id] == "queued"              # visible while waiting
+    s.execute(f"kill query {sb.conn_id}")
+    t.join(10)
+    tk.release()
+    sb.close()
+    assert out == ["killed"]
+
+
+def test_deadline_caps_queue_wait(rig):
+    eng, s, sv = rig
+    from matrixone_tpu.cluster.rpc import deadline_scope
+    tk = sv.admission.acquire(account="sys")
+    sb = Session(catalog=eng)
+    t0 = time.monotonic()
+    with deadline_scope(0.3):
+        with pytest.raises(AdmissionRejected):
+            sb.execute("select v from a where id = 1")
+    waited = time.monotonic() - t0
+    assert waited < 5.0        # 8s lane budget was capped by the 0.3s
+    tk.release()
+    sb.close()
+
+
+def test_expired_deadline_sheds_immediately(rig):
+    eng, s, sv = rig
+    from matrixone_tpu.cluster.rpc import deadline_scope
+    before = _snapshot()
+    with deadline_scope(0.01):
+        time.sleep(0.05)
+        with pytest.raises(AdmissionRejected):
+            sv.admission.acquire(account="sys")
+    after = _snapshot()
+    assert after[("interactive", "shed_deadline")] \
+        - before[("interactive", "shed_deadline")] == 1
+
+
+def test_per_account_quota_does_not_block_other_accounts():
+    adm = AdmissionController(slots=4, queue_ms=2000,
+                              account_slots=1)
+    t1 = adm.acquire(account="acct1")
+    # acct1 at quota: its next acquire queues; acct2 must pass anyway
+    blocked = []
+
+    def second():
+        try:
+            t = adm.acquire(account="acct1")
+            t.release()
+            blocked.append("acct1-ran")
+        except AdmissionRejected:
+            blocked.append("acct1-shed")
+    th = threading.Thread(target=second)
+    th.start()
+    time.sleep(0.1)
+    t2 = adm.acquire(account="acct2")              # free despite queue
+    t2.release()
+    t1.release()                                   # unblocks acct1
+    th.join(5)
+    assert blocked == ["acct1-ran"]
+
+
+def test_bg_not_starved_by_quota_blocked_interactive():
+    """Interactive waiters stuck on their ACCOUNT quota must not starve
+    background work while global slots sit free (code-review finding)."""
+    adm = AdmissionController(slots=4, queue_ms=3000, bg_queue_ms=2000,
+                              account_slots=1)
+    t_a = adm.acquire(account="acct1")
+    blocked = []
+
+    def quota_blocked():
+        t = adm.acquire(account="acct1")     # queues: acct1 at quota
+        blocked.append("ran")
+        t.release()
+    th = threading.Thread(target=quota_blocked)
+    th.start()
+    time.sleep(0.15)
+    t0 = time.monotonic()
+    t_bg = adm.acquire(account="acct2", lane="background")
+    assert time.monotonic() - t0 < 1.0       # admitted promptly
+    t_bg.release()
+    t_a.release()                            # unblocks the acct1 waiter
+    th.join(5)
+    assert blocked == ["ran"]
+
+
+def test_queue_capacity_shed():
+    adm = AdmissionController(slots=1, queue_ms=5000, max_queue=0)
+    tk = adm.acquire(account="sys")
+    with pytest.raises(AdmissionRejected) as ei:
+        adm.acquire(account="sys")
+    assert "retry" in str(ei.value)
+    tk.release()
+
+
+def test_control_statements_bypass_admission(rig):
+    eng, s, sv = rig
+    tk = sv.admission.acquire(account="sys")       # saturate
+    # SET / SHOW / mo_ctl / KILL never queue
+    s.execute("set foo = 1")
+    s.execute("show tables")
+    s.execute("select mo_ctl('serving','status')")
+    tk.release()
+
+
+def test_disabled_admission_is_zero_cost(rig):
+    eng, s, sv = rig
+    sv.admission.slots = 0
+    assert not sv.admission.enabled
+    assert s.execute("select v from a where id = 1").rows() == [(1,)]
+
+
+def test_disabled_acquire_release_keeps_accounting(rig):
+    # a ticket issued while disabled never incremented `running`, so its
+    # release must not decrement it (slots flipped mid-flight would
+    # otherwise over-admit forever)
+    eng, s, sv = rig
+    sv.admission.slots = 0
+    tk = sv.admission.acquire(account="sys")
+    tk.release()
+    assert sv.admission.running == 0
+    assert sv.admission._by_account == {}
+    sv.admission.slots = 1
+    tk = sv.admission.acquire(account="sys")
+    assert sv.admission.running == 1        # the cap still binds
+    tk.release()
+    assert sv.admission.running == 0
+
+
+def test_mo_ctl_slots_knob(rig):
+    eng, s, sv = rig
+    s.execute("select mo_ctl('serving','slots:7')")
+    assert sv.admission.slots == 7
+    s.execute("select mo_ctl('serving','account_slots:3')")
+    assert sv.admission.account_slots == 3
+    s.execute("select mo_ctl('serving','slots:1')")

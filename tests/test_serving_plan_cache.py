@@ -1,0 +1,271 @@
+"""Serving plan cache (serving/plan_cache.py): normalization, hit/miss,
+correctness of re-parameterized plans, and invalidation on DDL/ANALYZE.
+
+The plan cache is ON by default, so the whole suite live-fires it; these
+tests pin the contract: a hit must produce exactly the rows a cold
+bind/optimize would, for every parameter value, or not hit at all."""
+
+import pytest
+
+from matrixone_tpu.frontend import Session
+from matrixone_tpu.serving import serving_for
+from matrixone_tpu.serving.plan_cache import normalize
+from matrixone_tpu.storage.engine import Engine
+from matrixone_tpu.utils import metrics as M
+
+
+@pytest.fixture()
+def sess():
+    s = Session(catalog=Engine())
+    s.execute("create table pt (id bigint primary key, grp varchar(8),"
+              " val bigint, price decimal(10,2), d date)")
+    s.execute("insert into pt values"
+              " (1, 'a', 10, 1.50, date '2024-01-01'),"
+              " (2, 'a', 20, 2.25, date '2024-02-01'),"
+              " (3, 'b', 30, 3.00, date '2024-03-01'),"
+              " (4, 'b', 40, 4.75, date '2024-04-01')")
+    return s
+
+
+def _hits():
+    return M.plan_cache_ops.get(outcome="hit")
+
+
+# ------------------------------------------------------- normalization
+def test_normalize_parameterizes_literals():
+    n = normalize("select a from t where b = 5 and c = 'x' and d < 1.5")
+    assert n.template.count("?") == 3
+    assert [s[1] for s in n.slots] == [5, "x", 1.5]
+    assert not n.nondet
+
+
+def test_normalize_preserves_structural_literals():
+    # LIMIT/OFFSET, INTERVAL counts, AS OF, DATE literals and type args
+    # must stay literal: the parser demands literal tokens there
+    n = normalize("select a from t where b = 7 limit 10 offset 2")
+    assert [s[1] for s in n.slots] == [7]
+    assert "limit 10" in n.template and "offset 2" in n.template
+    n = normalize("select date_add(d, interval 3 day) from t")
+    assert n.slots == []
+    n = normalize("select * from t as of timestamp 12345")
+    assert n.slots == []
+    n = normalize("select cast(v as decimal(10,2)) from t where v = 9")
+    assert [s[1] for s in n.slots] == [9]
+    n = normalize("select * from t where d >= date '2024-01-01'")
+    assert n.slots == []
+
+
+def test_normalize_detects_nondeterminism():
+    assert normalize("select now()").nondet
+    assert normalize("select rand() * 5").nondet
+    assert normalize("select a, uuid() from t").nondet
+    assert not normalize("select a from t").nondet
+
+
+def test_normalize_whitespace_and_case_insensitive():
+    a = normalize("SELECT v FROM pt WHERE id = 3")
+    b = normalize("select   v  from pt\n where id = 99")
+    assert a.template == b.template     # same shape, one cache entry
+
+
+def test_normalize_prepared_merges_client_params():
+    n = normalize("select a from t where b = ? and c = 7")
+    assert [s[0] for s in n.slots] == ["c", "x"]
+    assert n.full_params([42]) == [42, 7]
+    with pytest.raises((IndexError, ValueError)):
+        n.full_params([])
+    with pytest.raises(ValueError):
+        n.full_params([1, 2])
+
+
+# ---------------------------------------------------------- hit behavior
+def test_repeated_adhoc_hits_and_matches_cold(sess):
+    sv = serving_for(sess.catalog)
+    sv.plan_cache.clear()
+    q = "select grp, val from pt where id = {} order by val"
+    cold = {i: sess.execute(q.format(i)).rows() for i in (1, 2, 3, 4)}
+    h0 = _hits()
+    warm = {i: sess.execute(q.format(i)).rows() for i in (1, 2, 3, 4)}
+    assert _hits() - h0 == 4
+    assert warm == cold
+    assert warm[3] == [("b", 30)]
+
+
+def test_prepared_statement_hits(sess):
+    # occurrence 1 notes the template, 2 activates+stores, 3+ hit
+    h0 = _hits()
+    r1 = sess.execute("select val from pt where id = ?", [2]).rows()
+    r2 = sess.execute("select val from pt where id = ?", [4]).rows()
+    r3 = sess.execute("select val from pt where id = ?", [1]).rows()
+    assert (r1, r2, r3) == ([(20,)], [(40,)], [(10,)])
+    assert _hits() - h0 >= 1
+
+
+def test_param_values_patch_into_aggregates(sess):
+    q = "select grp, sum(val) from pt where val >= {} group by grp" \
+        " order by grp"
+    cold = sess.execute(q.format(15)).rows()
+    assert cold == [("a", 20), ("b", 70)]
+    sess.execute(q.format(25))       # second occurrence: activates+stores
+    # different literal -> plan hit with patched filter
+    h0 = _hits()
+    r = sess.execute(q.format(35)).rows()
+    assert _hits() - h0 == 1
+    assert r == [("b", 40)]
+
+
+def test_decimal_scale_change_stays_correct(sess):
+    q = "select id from pt where price > {} order by id"
+    assert sess.execute(q.format("2.50")).rows() == [(3,), (4,)]
+    # same template, different decimal scale -> sig differs or re-bind;
+    # either way the rows must be right
+    assert sess.execute(q.format("3.5")).rows() == [(4,)]
+    assert sess.execute(q.format("2.50")).rows() == [(3,), (4,)]
+
+
+def test_string_params(sess):
+    q = "select sum(val) from pt where grp = '{}'"
+    assert sess.execute(q.format("a")).rows() == [(30,)]
+    sess.execute(q.format("b"))      # activates + stores the template
+    h0 = _hits()
+    assert sess.execute(q.format("b")).rows() == [(70,)]
+    assert sess.execute(q.format("a")).rows() == [(30,)]
+    assert _hits() - h0 == 2
+
+
+# --------------------------------------------------------- invalidation
+def test_ddl_invalidates(sess):
+    q = "select val from pt where id = 1"
+    sess.execute(q)
+    sess.execute(q)                  # activates + stores
+    h0 = _hits()
+    sess.execute(q)
+    assert _hits() - h0 == 1
+    inv0 = M.plan_cache_ops.get(outcome="invalidated")
+    sess.execute("create table other (x bigint primary key)")
+    sess.execute(q)          # ddl_gen bumped: entry must re-bind
+    assert M.plan_cache_ops.get(outcome="invalidated") - inv0 >= 1
+
+
+def test_analyze_invalidates(sess):
+    q = "select val from pt where id = 2"
+    sess.execute(q)
+    sess.execute(q)
+    inv0 = M.plan_cache_ops.get(outcome="invalidated")
+    sess.execute("analyze table pt")
+    assert sess.execute(q).rows() == [(20,)]
+    assert M.plan_cache_ops.get(outcome="invalidated") - inv0 >= 1
+
+
+def test_drop_and_recreate_table_reuses_nothing_stale(sess):
+    q = "select val from pt where id = 1"
+    assert sess.execute(q).rows() == [(10,)]
+    sess.execute(q)
+    sess.execute("drop table pt")
+    sess.execute("create table pt (id bigint primary key, grp"
+                 " varchar(8), val bigint, price decimal(10,2), d date)")
+    sess.execute("insert into pt values"
+                 " (1, 'z', 999, 1.00, date '2020-01-01')")
+    assert sess.execute(q).rows() == [(999,)]
+
+
+# ------------------------------------------------------------- bypasses
+def test_in_txn_bypasses_plan_cache(sess):
+    q = "select val from pt where id = 1"
+    sess.execute(q)
+    h0 = _hits()
+    sess.execute("begin")
+    try:
+        assert sess.execute(q).rows() == [(10,)]
+        assert _hits() - h0 == 0     # txn reads never touch the caches
+    finally:
+        sess.execute("rollback")
+
+
+def test_subquery_statements_are_uncacheable(sess):
+    q = ("select grp from pt where val = "
+         "(select max(val) from pt) limit 1")
+    r1 = sess.execute(q).rows()
+    h0 = _hits()
+    r2 = sess.execute(q).rows()
+    assert r1 == r2 == [("b",)]
+    assert _hits() - h0 == 0
+
+
+def test_uncacheable_tombstone_expires_on_ddl(sess):
+    """An uncacheable marking is pinned to the gens at mark time: the
+    DDL that made the template uncacheable (e.g. a vector index forcing
+    VectorTopK plans) may be reverted, and the template must become
+    cacheable again instead of tombstoned forever."""
+    sv = serving_for(sess.catalog)
+    pc = sv.plan_cache
+    key = ("plan", "t", "select ?", ("i",), ())
+    pc.mark_uncacheable(key, ddl_gen=3, stats_gen=1)
+    assert pc.lookup(key, 3, 1, [1]) == ("uncacheable", None)
+    assert pc.lookup(key, 3, 1, [1]) == ("uncacheable", None)
+    inv0 = M.plan_cache_ops.get(outcome="invalidated")
+    # a DDL bump expires the tombstone: plain miss, template re-probes
+    assert pc.lookup(key, 4, 1, [1]) == ("miss", None)
+    assert M.plan_cache_ops.get(outcome="invalidated") - inv0 == 1
+    # stats bumps expire it too (same entry lifecycle as live plans)
+    pc.mark_uncacheable(key, ddl_gen=4, stats_gen=1)
+    assert pc.lookup(key, 4, 2, [1]) == ("miss", None)
+
+
+def test_nondeterministic_bypass(sess):
+    import time
+    r1 = sess.execute("select now()").rows()
+    time.sleep(0.01)
+    r2 = sess.execute("select now()").rows()
+    assert r1[0][0] <= r2[0][0]
+    h0 = _hits()
+    sess.execute("select now()")
+    assert _hits() - h0 == 0
+
+
+def test_tenant_scope_isolates_plan_keys():
+    """Two accounts with same-named tables must never share a plan."""
+    eng = Engine()
+    root = Session(catalog=eng)
+    root.execute("create account t1 admin_name 'u' identified by 'p'")
+    root.execute("create account t2 admin_name 'u' identified by 'p'")
+    from matrixone_tpu.frontend.auth import AccountManager
+    mgr = root._mgr()
+    s1 = Session(catalog=eng, auth=mgr.context_for("t1", "u"),
+                 auth_manager=mgr)
+    s2 = Session(catalog=eng, auth=mgr.context_for("t2", "u"),
+                 auth_manager=mgr)
+    for s, v in ((s1, 111), (s2, 222)):
+        s.execute("create table tt (id bigint primary key, v bigint)")
+        s.execute(f"insert into tt values (1, {v})")
+    q = "select v from tt where id = 1"
+    assert s1.execute(q).rows() == [(111,)]
+    assert s2.execute(q).rows() == [(222,)]
+    assert s1.execute(q).rows() == [(111,)]     # warm: still scoped
+
+
+def test_mo_ctl_serving_status_and_clear(sess):
+    import json
+    sess.execute("select val from pt where id = 1")
+    out = sess.execute("select mo_ctl('serving','status')").rows()[0][0]
+    st = json.loads(out)
+    assert {"plan_cache", "result_cache", "admission"} <= set(st)
+    assert st["plan_cache"]["enabled"] is True
+    sess.execute("select mo_ctl('serving','clear')")
+    st2 = json.loads(sess.execute(
+        "select mo_ctl('serving','status')").rows()[0][0])
+    assert st2["plan_cache"]["entries"] == 0
+
+
+def test_plan_cache_off_knob(sess):
+    sv = serving_for(sess.catalog)
+    sess.execute("select mo_ctl('serving','plan:off')")
+    try:
+        q = "select val from pt where id = 1"
+        sess.execute(q)
+        h0 = _hits()
+        assert sess.execute(q).rows() == [(10,)]
+        assert _hits() - h0 == 0
+        assert not sv.plan_cache.enabled
+    finally:
+        sess.execute("select mo_ctl('serving','plan:on')")

@@ -1,0 +1,239 @@
+"""Serving result cache (serving/result_cache.py): MVCC-keyed result
+reuse — hits serve bit-identical rows, any commit touching a referenced
+table orphans the entry, AS OF reads cache indefinitely, and statement
+tracing records which cache served each query."""
+
+import pytest
+
+from matrixone_tpu.frontend import Session
+from matrixone_tpu.serving import serving_for
+from matrixone_tpu.storage.engine import Engine
+from matrixone_tpu.utils import metrics as M
+
+
+@pytest.fixture()
+def sess():
+    s = Session(catalog=Engine())
+    s.execute("create table rc (id bigint primary key, v bigint,"
+              " tag varchar(8))")
+    s.execute("insert into rc values (1, 10, 'x'), (2, 20, 'y'),"
+              " (3, 30, 'x')")
+    s.execute("select mo_ctl('serving','result:on')")
+    return s
+
+
+def _hits():
+    return M.result_cache_ops.get(outcome="hit")
+
+
+def test_hit_serves_identical_rows(sess):
+    q = "select tag, sum(v) from rc group by tag order by tag"
+    cold = sess.execute(q).rows()
+    h0 = _hits()
+    warm = sess.execute(q).rows()
+    assert _hits() - h0 == 1
+    assert warm == cold == [("x", 40), ("y", 20)]
+
+
+def test_commit_between_identical_queries_yields_fresh_rows(sess):
+    q = "select sum(v) from rc"
+    assert sess.execute(q).rows() == [(60,)]
+    sess.execute(q)                      # cached
+    sess.execute("insert into rc values (4, 40, 'z')")
+    assert sess.execute(q).rows() == [(100,)]       # NOT the cached 60
+    sess.execute("update rc set v = 11 where id = 1")
+    assert sess.execute(q).rows() == [(101,)]
+    sess.execute("delete from rc where id = 4")
+    assert sess.execute(q).rows() == [(61,)]
+
+
+def test_other_table_commit_keeps_entry(sess):
+    sess.execute("create table unrelated (x bigint primary key)")
+    q = "select sum(v) from rc"
+    sess.execute(q)
+    sess.execute(q)
+    h0 = _hits()
+    sess.execute("insert into unrelated values (1)")
+    # unrelated write does not bump rc's version; ddl_gen unchanged too
+    assert sess.execute(q).rows() == [(60,)]
+    assert _hits() - h0 == 1
+
+
+def test_as_of_snapshot_immutable_and_cacheable(sess):
+    sess.execute("create snapshot s1")
+    q = "select sum(v) from rc as of snapshot 's1'"
+    assert sess.execute(q).rows() == [(60,)]
+    sess.execute("insert into rc values (9, 900, 'w')")
+    # once committed_ts has passed the snapshot ts, the as-of read is
+    # provably immutable: this execution re-caches it as such...
+    assert sess.execute(q).rows() == [(60,)]
+    h0 = _hits()
+    # ...and from here on writes never orphan it
+    assert sess.execute(q).rows() == [(60,)]
+    assert _hits() - h0 == 1
+    sess.execute("insert into rc values (10, 1000, 'w')")
+    h1 = _hits()
+    assert sess.execute(q).rows() == [(60,)]
+    assert _hits() - h1 == 1
+    # while the frontier read sees the writes
+    assert sess.execute("select sum(v) from rc").rows() == [(1960,)]
+
+
+def test_future_as_of_is_not_immortal(sess):
+    """An as-of timestamp AT OR AHEAD of the commit frontier still sees
+    later commits — it must version like a live read, never cache as
+    immutable past (code-review finding)."""
+    fut = sess.catalog.committed_ts + 10 ** 15
+    q = f"select sum(v) from rc as of timestamp {fut}"
+    assert sess.execute(q).rows() == [(60,)]
+    sess.execute(q)                      # cached as live-versioned
+    sess.execute("insert into rc values (11, 40, 'f')")
+    assert sess.execute(q).rows() == [(100,)]    # fresh, not 60
+
+
+def test_read_your_writes_in_txn_bypasses(sess):
+    q = "select sum(v) from rc"
+    sess.execute(q)
+    sess.execute(q)                      # cached at 60
+    sess.execute("begin")
+    try:
+        sess.execute("insert into rc values (5, 500, 'q')")
+        # the txn's dirty workspace must be visible — a cache hit at the
+        # frontier would hide the session's own write
+        assert sess.execute(q).rows() == [(560,)]
+    finally:
+        sess.execute("rollback")
+    assert sess.execute(q).rows() == [(60,)]
+
+
+def test_nondeterministic_never_cached(sess):
+    r1 = sess.execute("select rand()").rows()
+    r2 = sess.execute("select rand()").rows()
+    assert r1 != r2
+
+
+def test_params_key_entries_separately(sess):
+    q = "select v from rc where id = ?"
+    assert sess.execute(q, [1]).rows() == [(10,)]
+    assert sess.execute(q, [2]).rows() == [(20,)]
+    h0 = _hits()
+    assert sess.execute(q, [1]).rows() == [(10,)]
+    assert sess.execute(q, [2]).rows() == [(20,)]
+    assert _hits() - h0 == 2
+
+
+def test_equal_params_of_different_types_key_separately(sess):
+    # tuple((1,)) == tuple((1.0,)): without the type signature in the
+    # key, 'select 1.0 + 0' would hit 'select 1 + 0's INT64 entry and
+    # return 1 instead of 1.0
+    def typed(sql):
+        r = sess.execute(sql)
+        col = next(iter(r.batch.columns.values()))
+        return r.rows(), col.dtype.oid
+    cold_i = typed("select 1 + 0")
+    cold_f = typed("select 1.0 + 0")
+    assert cold_i[1] != cold_f[1]        # INT64 vs decimal
+    assert typed("select 1 + 0") == cold_i       # warm: own entry,
+    assert typed("select 1.0 + 0") == cold_f     # own dtype
+
+
+def test_byte_budget_lru_eviction(sess):
+    sv = serving_for(sess.catalog)
+    sv.result_cache.max_bytes = 6000     # tiny: a few entries
+    sv.result_cache.clear()
+    ev0 = M.result_cache_evictions.get()
+    for i in range(1, 4):
+        for _ in range(2):
+            sess.execute(f"select v, tag from rc where id <= {i}"
+                         f" order by id")
+    st = sv.result_cache.stats()
+    assert st["bytes"] <= 6000
+    # either everything fit, or the LRU evicted to stay under budget
+    assert st["entries"] <= 3
+    assert M.result_cache_evictions.get() >= ev0
+
+
+def test_shrinking_budget_evicts_immediately(sess):
+    """mo_ctl('serving','result:<mb>') shrinking the budget must free
+    memory NOW — a read-hot workload never calls put(), so the put()-side
+    eviction loop alone would hold the old budget indefinitely."""
+    sv = serving_for(sess.catalog)
+    for i in range(1, 4):
+        sess.execute(f"select v, tag from rc where id <= {i}")
+    assert sv.result_cache.stats()["entries"] == 3
+    sv.result_cache.set_max_bytes(1)        # 1 byte: everything must go
+    st = sv.result_cache.stats()
+    assert st["entries"] == 0 and st["bytes"] == 0
+    # the mo_ctl surface routes through the same eviction
+    for i in range(1, 4):
+        sess.execute(f"select v from rc where id = {i}")
+    sess.execute("select mo_ctl('serving','result:64')")
+    assert sv.result_cache.max_bytes == 64 << 20
+
+
+def test_oversized_result_not_cached(sess):
+    sv = serving_for(sess.catalog)
+    sv.result_cache.max_bytes = 1024
+    sv.result_cache.clear()
+    sess.execute("select * from rc")
+    sess.execute("select * from rc")
+    assert sv.result_cache.stats()["entries"] == 0  # > budget/4: skipped
+
+
+def test_result_cache_off_by_default():
+    s = Session(catalog=Engine())
+    s.execute("create table d0 (x bigint primary key)")
+    sv = serving_for(s.catalog)
+    assert not sv.result_cache.enabled
+
+
+def test_trace_records_cache_hit_and_queue_wait(sess):
+    q = "select sum(v) from rc"
+    sess.execute(q)
+    sess.execute(q)                      # result hit
+    rows = sess.execute(
+        "select statement, cache_hit, queue_wait_ms from"
+        " system_statement_info order by stmt_id").rows()
+    hits = [c for stmt, c, _ in rows if stmt == q]
+    assert "result" in hits              # the warm run was attributed
+    assert all(w is not None and w >= 0 for _, _, w in rows)
+
+
+def test_cached_results_still_gate_on_privileges():
+    """A result-cache hit must re-check SELECT privileges — a warm
+    entry must never leak another user's rows (code-review finding)."""
+    from matrixone_tpu.frontend.auth import AuthError
+    eng = Engine()
+    root = Session(catalog=eng)
+    root.execute("create account acme admin_name 'adm' identified"
+                 " by 'p'")
+    mgr = root._mgr()
+    adm = Session(catalog=eng, auth=mgr.context_for("acme", "adm"),
+                  auth_manager=mgr)
+    adm.execute("create table secret (id bigint primary key, v bigint)")
+    adm.execute("insert into secret values (1, 42)")
+    adm.execute("create user bob identified by 'p'")
+    adm.execute("select mo_ctl('serving','result:on')")
+    q = "select v from secret where id = 1"
+    adm.execute(q)
+    adm.execute(q)                       # warm: entry resident
+    bob = Session(catalog=eng, auth=mgr.context_for("acme", "bob"),
+                  auth_manager=mgr)
+    with pytest.raises(AuthError):
+        bob.execute(q)
+    # and once granted, bob may ride the same warm entry
+    adm.execute("create role reader")
+    adm.execute("grant select on secret to reader")
+    adm.execute("grant reader to bob")
+    assert bob.execute(q).rows() == [(42,)]
+
+
+def test_merge_orphans_entries(sess):
+    """mo_ctl('merge') rewrites gids — cached results must not survive
+    a merged table's physical rewrite."""
+    q = "select sum(v) from rc"
+    sess.execute("insert into rc values (7, 70, 'm')")
+    sess.execute(q)
+    sess.execute(q)
+    sess.execute("select mo_ctl('merge', 'rc')")
+    assert sess.execute(q).rows() == [(130,)]
