@@ -170,6 +170,11 @@ class Session:
         state = serving_for(self.catalog)
         if not (state.plan_cache.enabled or state.result_cache.enabled):
             return None
+        # nondeterministic UDFs must bypass both caches exactly like
+        # now()/rand(): feed the registry's nondet names to statement
+        # normalization (version-cached: a few attr reads when idle)
+        from matrixone_tpu.udf.catalog import sync_serving as _udf_sync
+        _udf_sync(self.catalog, state)
         norm = state.plan_cache.normalized(sql)
         if norm is None or norm.n_stmts != 1 or norm.nondet:
             return None
@@ -306,6 +311,10 @@ class Session:
             self._check("insert", stmt.table)
         elif isinstance(stmt, ast.DropTable):
             self._check("drop", stmt.name)
+        elif isinstance(stmt, ast.CreateFunction):
+            self._check("create")
+        elif isinstance(stmt, ast.DropFunction):
+            self._check("drop")
         elif isinstance(stmt, (ast.CreateTable, ast.CreateIndex,
                                ast.CreateExternalTable, ast.CreateSource,
                                ast.CreateDynamicTable, ast.CreateStage,
@@ -327,6 +336,12 @@ class Session:
             return Result()
         if isinstance(stmt, ast.CreateIndex):
             return self._create_index(stmt)
+        if isinstance(stmt, ast.CreateFunction):
+            return self._create_function(stmt)
+        if isinstance(stmt, ast.DropFunction):
+            return self._drop_function(stmt)
+        if isinstance(stmt, ast.ShowFunctions):
+            return self._show_functions()
         if isinstance(stmt, ast.Insert):
             return self._insert(stmt)
         if isinstance(stmt, ast.Explain):
@@ -588,6 +603,13 @@ class Session:
                     except StopIteration:
                         st["seconds"] += _time.perf_counter() - t0
                         return
+                    # settle the batch's device work before stamping the
+                    # operator: JAX dispatch is async, so without the
+                    # sync a heavy projection's time would be billed to
+                    # whichever DOWNSTREAM operator first touches the
+                    # arrays (lazy-dispatch skew)
+                    for c in ex.batch.columns.values():
+                        _jax.block_until_ready(c.data)
                     st["seconds"] += _time.perf_counter() - t0
                     st["batches"] += 1
                     st["rows"] += int(_jax.device_get(
@@ -605,10 +627,20 @@ class Session:
             pass
 
         def render(o, indent=0):
+            from matrixone_tpu.sql.plan import _udf_call_notes
             st = stats[id(o)]
-            line = ("  " * indent + f"{st['op']}  rows={st['rows']} "
+            notes = _udf_call_notes(getattr(o, "node", None)) \
+                if getattr(o, "node", None) is not None else ""
+            line = ("  " * indent + f"{st['op']}{notes}  rows={st['rows']} "
                     f"batches={st['batches']} time={st['seconds']*1000:.1f}ms")
             out = [line]
+            if notes:
+                # the UdfCall rides the operator's pull loop: its
+                # rows/batches ARE the operator's (EXPLAIN ANALYZE
+                # surface for the udf subsystem)
+                out.append("  " * (indent + 1)
+                           + f"{notes.strip()}  rows={st['rows']} "
+                             f"batches={st['batches']}")
             for attr in ("child", "left", "right"):
                 c = getattr(o, attr, None)
                 if c is not None:
@@ -854,6 +886,21 @@ class Session:
                     f"unknown serving subcommand {arg!r}; use status | "
                     f"clear | slots:<n> | account_slots:<n> | "
                     f"plan:<on|off> | result:<on|off|mb>")
+        elif cmd == "udf":
+            # UDF subsystem ops surface: compile-cache + tier counters
+            import json as _json
+            from matrixone_tpu import udf as U
+            from matrixone_tpu.udf import catalog as ucat
+            if arg in ("", "status"):
+                st = U.stats()
+                st["functions"] = len(ucat.registry_for(self.catalog))
+                out = _json.dumps(st, sort_keys=True)
+            elif arg == "clear":
+                U.COMPILE_CACHE.clear()
+                out = "udf compile cache cleared"
+            else:
+                raise BindError(f"unknown udf subcommand {arg!r}; "
+                                "use status | clear")
         elif cmd == "rpc":
             # per-peer circuit breaker state + the CN's logtail breaker
             import json as _json
@@ -1214,6 +1261,96 @@ class Session:
             self.catalog.drop_table(stmt.name, if_exists=True)
             raise
         return Result(affected=n)
+
+    # --------------------------------------------------------------- udf
+    def _create_function(self, stmt: ast.CreateFunction) -> Result:
+        """CREATE [OR REPLACE] FUNCTION: validate + trial-compile the
+        body, then persist one row in the system_udf catalog table via
+        the ordinary commit pipeline — durability, restart replay, and
+        CN replication all ride the existing funnels (udf/catalog.py)."""
+        import time as _time
+        from matrixone_tpu import udf as U
+        from matrixone_tpu.udf import catalog as ucat
+        if self.txn is not None:
+            raise BindError(
+                "CREATE FUNCTION inside an explicit transaction is not "
+                "supported (function DDL is autocommit)")
+        props = {str(k).lower(): str(v).lower()
+                 for k, v in stmt.properties.items()}
+        for k in props:
+            if k not in ("deterministic", "vectorized"):
+                raise BindError(f"unknown function property {k!r}; "
+                                f"use 'deterministic' | 'vectorized'")
+        meta = U.UdfMeta(
+            name=stmt.name.lower(),
+            kind="aggregate" if stmt.aggregate else "scalar",
+            arg_names=[a for a, _t, _ta in stmt.args],
+            arg_types=[type_from_name(t, ta) for _a, t, ta in stmt.args],
+            ret_type=type_from_name(stmt.ret_type, stmt.ret_args),
+            language=stmt.language, body=stmt.body,
+            deterministic=props.get("deterministic", "true") != "false",
+            vectorized=props.get("vectorized", "true") != "false")
+        try:
+            U.validate_meta(meta)
+        except U.UdfError as e:
+            raise BindError(str(e))
+        ucat.ensure_table(self.catalog)
+        existing = ucat.registry_for(self.catalog)
+        if meta.name in existing and not stmt.or_replace:
+            raise BindError(f"function {meta.name!r} already exists "
+                            f"(use CREATE OR REPLACE FUNCTION)")
+        t = self.catalog.get_table(ucat.UDF_TABLE)
+        batch = ucat.row_batch(meta, _time.time_ns() // 1000)
+        arrays, validity = t.batch_to_arrays(batch)
+        txn = self.txn_client.begin()
+        try:
+            if meta.name in existing:
+                # OR REPLACE: delete + insert commit atomically
+                txn.delete_rows(ucat.UDF_TABLE, ucat.gids_for_name(
+                    self.catalog, meta.name))
+            txn.write_batch(ucat.UDF_TABLE, arrays, validity)
+            txn.commit()
+        except BaseException:   # noqa: BLE001 — rollback, then re-raised
+            txn.rollback()
+            raise
+        return Result()
+
+    def _drop_function(self, stmt: ast.DropFunction) -> Result:
+        from matrixone_tpu.udf import catalog as ucat
+        if self.txn is not None:
+            raise BindError(
+                "DROP FUNCTION inside an explicit transaction is not "
+                "supported (function DDL is autocommit)")
+        u = ucat.registry_for(self.catalog).get(stmt.name.lower())
+        if u is None:
+            if stmt.if_exists:
+                return Result()
+            raise BindError(f"no such function {stmt.name!r}")
+        gids = ucat.gids_for_name(self.catalog, stmt.name)
+        txn = self.txn_client.begin()
+        try:
+            txn.delete_rows(ucat.UDF_TABLE, gids)
+            txn.commit()
+        except BaseException:   # noqa: BLE001 — rollback, then re-raised
+            txn.rollback()
+            raise
+        return Result(affected=len(gids))
+
+    def _show_functions(self) -> Result:
+        from matrixone_tpu.udf import catalog as ucat
+        reg = ucat.registry_for(self.catalog)
+        names = sorted(reg)
+        b = Batch.from_pydict(
+            {"Function": names,
+             "Kind": [reg[n].kind for n in names],
+             "Signature": [reg[n].signature() for n in names],
+             "Language": [reg[n].language for n in names],
+             "Deterministic": [int(reg[n].deterministic) for n in names],
+             "Vectorized": [int(reg[n].vectorized) for n in names]},
+            {"Function": dt.VARCHAR, "Kind": dt.VARCHAR,
+             "Signature": dt.TEXT, "Language": dt.VARCHAR,
+             "Deterministic": dt.INT64, "Vectorized": dt.INT64})
+        return Result(batch=b)
 
     def _alter_partition(self, stmt: ast.AlterPartition) -> Result:
         """TRUNCATE/DROP PARTITION (partitionservice management ops):

@@ -141,9 +141,13 @@ def _render(tokens: List[Token]) -> str:
     return " ".join(out)
 
 
-def normalize(sql: str) -> Optional[Normalized]:
+def normalize(sql: str,
+              extra_nondet: frozenset = frozenset()
+              ) -> Optional[Normalized]:
     """Parameterize one statement's literals. Returns None when the text
-    cannot be normalized (lex error) — callers fall back to raw SQL."""
+    cannot be normalized (lex error) — callers fall back to raw SQL.
+    `extra_nondet` adds dynamically-registered nondeterministic function
+    names (UDFs) to the static NONDET_FUNCS set."""
     try:
         tokens = tokenize(sql)
     except LexError:
@@ -161,7 +165,7 @@ def normalize(sql: str) -> Optional[Normalized]:
         if t.kind == "ident" and nxt is not None \
                 and nxt.kind == "op" and nxt.value == "(":
             low = t.value.lower()
-            if low in NONDET_FUNCS:
+            if low in NONDET_FUNCS or low in extra_nondet:
                 nondet = True
             if low in _TYPE_ARG_NAMES:
                 type_depth += 1     # consume literals until the ")"
@@ -272,10 +276,16 @@ def plan_is_cacheable(plan, n_params: int) -> bool:
     """Verify the plan can be re-parameterized: every parameter index
     surfaces as a tagged literal, and no node bakes values outside the
     literal protocol (vector/fulltext rewrites copy the query constant
-    into plain node fields)."""
+    into plain node fields).  Plans calling a NON-deterministic UDF take
+    the same uncacheable-tombstone path (normalization already flags
+    them by name; this is the backstop for bodies that turn
+    nondeterministic via OR REPLACE between normalize and store)."""
     from matrixone_tpu.sql import plan as P
+    from matrixone_tpu.sql.expr import BoundUdfCall
     for v in iter_plan_values(plan):
         if isinstance(v, (P.VectorTopK, P.FulltextTopK, P.Materialized)):
+            return False
+        if isinstance(v, BoundUdfCall) and not v.deterministic:
             return False
     if n_params == 0:
         return True
@@ -307,6 +317,8 @@ class PlanCache:
         self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
         self._norm_cache: "OrderedDict[str, Optional[Normalized]]" = \
             OrderedDict()
+        #: dynamically-registered nondeterministic function names (UDFs)
+        self.dynamic_nondet: frozenset = frozenset()
         # template text -> parsed AST; _SEEN: noted once, not yet
         # activated; False: template does not parse (a literal landed in
         # a structural position) — raw path serves it
@@ -343,6 +355,16 @@ class PlanCache:
                 self._ast_cache.popitem(last=False)
         return node if node is not False else None
 
+    def set_dynamic_nondet(self, names: frozenset) -> None:
+        """Swap the dynamic nondet set (CREATE/DROP FUNCTION with
+        'deterministic'='false'); cached Normalized entries carry stale
+        nondet flags, so the normalization cache resets with it."""
+        with self._lock:
+            if self.dynamic_nondet == names:
+                return
+            self.dynamic_nondet = names
+            self._norm_cache.clear()
+
     # ------------------------------------------------------- normalize
     def normalized(self, sql: str) -> Optional[Normalized]:
         """normalize() with a small raw-text LRU in front: the common
@@ -353,7 +375,7 @@ class PlanCache:
             if hit is not _MISS:
                 self._norm_cache.move_to_end(sql)
                 return hit
-        norm = normalize(sql)
+        norm = normalize(sql, self.dynamic_nondet)
         with self._lock:
             self._norm_cache[sql] = norm
             while len(self._norm_cache) > 512:

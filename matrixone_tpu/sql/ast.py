@@ -430,6 +430,33 @@ class RefreshDynamicTable(Node):
 
 
 @dataclasses.dataclass
+class CreateFunction(Node):
+    """CREATE [OR REPLACE] [AGGREGATE] FUNCTION f(x FLOAT, ...)
+    RETURNS FLOAT LANGUAGE PYTHON [PROPERTIES ('k'='v', ...)]
+    AS $$ body $$ (reference: mo_user_defined_function DDL)."""
+    name: str
+    args: List[Tuple[str, str, Tuple[int, ...]]]  # (name, type, targs)
+    ret_type: str
+    ret_args: Tuple[int, ...]
+    language: str
+    body: str
+    properties: dict = dataclasses.field(default_factory=dict)
+    or_replace: bool = False
+    aggregate: bool = False
+
+
+@dataclasses.dataclass
+class DropFunction(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
+class ShowFunctions(Node):
+    pass
+
+
+@dataclasses.dataclass
 class SetVariable(Node):
     name: str
     value: Node

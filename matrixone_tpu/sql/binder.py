@@ -205,6 +205,9 @@ class Binder:
             else:
                 items.append(it)
 
+        if self._has_udf_agg(items):
+            return self._bind_udf_aggregate(node, scope, sel, items)
+
         has_aggs = any(self._contains_agg(it.expr) for it in items) \
             or (sel.having is not None and self._contains_agg(sel.having)) \
             or any(self._contains_agg(o.expr) for o in sel.order_by) \
@@ -424,6 +427,55 @@ class Binder:
                 e = ast.BinaryOp("and", e, r)
             res = self.bind_expr(e, full_scope)
         return lkeys, rkeys, res
+
+    # ----------------------------------------------------- aggregate UDFs
+    def _udf_agg_of(self, e: ast.Node):
+        from matrixone_tpu.udf import catalog as _ucat
+        if not (isinstance(e, ast.FuncCall) and e.window is None):
+            return None
+        u = _ucat.lookup(self.catalog, e.name)
+        return u if u is not None and u.kind == "aggregate" else None
+
+    def _has_udf_agg(self, items) -> bool:
+        return any(self._udf_agg_of(it.expr) is not None for it in items)
+
+    def _bind_udf_aggregate(self, node, scope, sel, items):
+        """SELECT agg_udf(expr), ... FROM t [WHERE ...] — every item must
+        be an aggregate-UDF call; the whole (filtered) input reduces to
+        one row.  GROUP BY with aggregate UDFs is not supported yet (the
+        grouped kernels are built for the fixed aggregate algebra)."""
+        if sel.group_by:
+            raise BindError(
+                "aggregate UDFs with GROUP BY are not supported yet")
+        if sel.having is not None:
+            raise BindError(
+                "HAVING with aggregate UDFs is not supported yet")
+        if sel.distinct:
+            raise BindError(
+                "DISTINCT with aggregate UDFs is not supported")
+        calls, schema = [], []
+        for idx, it in enumerate(items):
+            u = self._udf_agg_of(it.expr)
+            if u is None:
+                raise BindError(
+                    "a query using an aggregate UDF must select only "
+                    "aggregate UDF calls")
+            args = [self.bind_expr(a, scope) for a in it.expr.args]
+            b = _bind_udf_call(u, args)
+            calls.append(b)
+            schema.append((it.alias or _expr_name(it.expr, idx),
+                           b.dtype))
+        out = plan.UdfAggregate(node, calls, schema)
+        # the result is ONE row: LIMIT/OFFSET still apply (LIMIT 0 /
+        # OFFSET 1 must yield zero rows); ORDER BY would need key
+        # resolution against the reduced row — reject it rather than
+        # silently ignoring the clause
+        if sel.order_by:
+            raise BindError(
+                "ORDER BY with aggregate UDFs is not supported yet")
+        if sel.limit is not None or sel.offset:
+            out = plan.Limit(out, sel.limit, sel.offset or 0, out.schema)
+        return self._pushdown_filters(out)
 
     # --------------------------------------------------------- aggregates
     def _contains_agg(self, e: ast.Node) -> bool:
@@ -890,6 +942,14 @@ class Binder:
             return _bind_date_add_unit(rec(e.args[0]),
                                        sign * iv.value, iv.unit)
         args = [rec(a) for a in e.args]
+        from matrixone_tpu.udf import catalog as _ucat
+        u = _ucat.lookup(self.catalog, e.name)
+        if u is not None:
+            if u.kind == "aggregate":
+                raise BindError(
+                    f"aggregate UDF {e.name}() is only allowed as a "
+                    f"top-level select item")
+            return _bind_udf_call(u, args)
         if e.name == "load_file":
             # datalink resolution (reference: load_file over the datalink
             # type): a constant URL reads at bind time through the stage
@@ -982,6 +1042,30 @@ class Binder:
 
 
 # ------------------------------------------------------------------ helpers
+
+def _bind_udf_call(u, args: List[BoundExpr]) -> BoundExpr:
+    """Type-check and coerce a resolved UDF call; the definition is
+    snapshot into the bound expression (see BoundUdfCall docstring)."""
+    from matrixone_tpu.sql.expr import BoundUdfCall
+    if len(args) != len(u.arg_types):
+        raise BindError(
+            f"{u.name}() takes {len(u.arg_types)} argument(s), "
+            f"got {len(args)}")
+    coerced = []
+    for i, (a, want) in enumerate(zip(args, u.arg_types)):
+        if a.dtype == want:
+            coerced.append(a)
+        elif a.dtype.is_numeric and want.is_numeric:
+            coerced.append(BoundCast(a, want))
+        else:
+            raise BindError(
+                f"{u.name}() argument {i + 1}: {a.dtype} is not "
+                f"compatible with declared type {want}")
+    return BoundUdfCall(
+        u.name.lower(), coerced, u.ret_type, u.body,
+        list(u.arg_names), list(u.arg_types), u.body_hash,
+        u.deterministic, u.vectorized, u.kind == "aggregate")
+
 
 def dataclasses_fields_values(e):
     import dataclasses as dc

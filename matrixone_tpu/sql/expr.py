@@ -40,6 +40,24 @@ class BoundFunc(BoundExpr):
 
 
 @dataclasses.dataclass
+class BoundUdfCall(BoundExpr):
+    """A resolved user-defined function call. The definition is SNAPSHOT
+    at bind time (body + hash ride the expression), so a cached plan
+    executes the body it was bound against — DROP/REPLACE invalidates
+    through ddl_gen, never by mutating in-flight plans."""
+    name: str
+    args: List[BoundExpr]
+    dtype: DType                  # declared RETURNS type
+    body: str
+    arg_names: List[str]
+    arg_types: List[DType]        # declared argument types
+    body_hash: str
+    deterministic: bool = True
+    vectorized: bool = True
+    is_aggregate: bool = False
+
+
+@dataclasses.dataclass
 class BoundCast(BoundExpr):
     arg: BoundExpr
     dtype: DType

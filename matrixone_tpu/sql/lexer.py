@@ -81,6 +81,16 @@ def tokenize(sql: str) -> List[Token]:
             out.append(Token("sysvar", sql[i + 2:j].lower(), i))
             i = j
             continue
+        if c == "$" and sql.startswith("$$", i):
+            # dollar-quoted body (CREATE FUNCTION ... AS $$ ... $$):
+            # verbatim text, no escape processing — Python bodies are
+            # full of quotes and backslashes
+            j = sql.find("$$", i + 2)
+            if j < 0:
+                raise LexError(f"unterminated $$ body at {i}")
+            out.append(Token("str", sql[i + 2:j], i))
+            i = j + 2
+            continue
         if c == "'" or c == '"':
             quote = c
             j = i + 1

@@ -313,6 +313,9 @@ class Parser:
                 user = self.next().value
             return ast.ShowGrants(user)
         nxt = self.peek()
+        if nxt.kind == "ident" and nxt.value.lower() == "functions":
+            self.next()
+            return ast.ShowFunctions()
         if nxt.kind == "ident" and nxt.value.lower() == "stages":
             self.next()
             return ast.ShowStages()
@@ -556,6 +559,12 @@ class Parser:
     def create(self) -> ast.Node:
         self.expect_kw("create")
         t0 = self.peek()
+        if self.at_kw("or") \
+                or (t0.kind == "ident" and t0.value.lower() == "function") \
+                or (t0.kind == "ident" and t0.value.lower() == "aggregate"
+                    and self.peek(1).kind == "ident"
+                    and self.peek(1).value.lower() == "function"):
+            return self._create_function()
         if t0.kind == "ident" and t0.value.lower() == "account":
             # CREATE ACCOUNT [IF NOT EXISTS] name
             #   ADMIN_NAME 'user' IDENTIFIED BY 'password'
@@ -696,6 +705,64 @@ class Parser:
             return ast.CreateSnapshot(self.ident())
         return self._create_rest()
 
+    def _create_function(self) -> ast.Node:
+        """CREATE [OR REPLACE] [AGGREGATE] FUNCTION f(x FLOAT, ...)
+        RETURNS FLOAT LANGUAGE PYTHON [PROPERTIES ('k'='v', ...)]
+        AS $$ body $$ | AS 'body'."""
+        or_replace = False
+        if self.accept_kw("or"):
+            self._expect_word("replace")
+            or_replace = True
+        aggregate = False
+        t = self.peek()
+        if t.kind == "ident" and t.value.lower() == "aggregate":
+            self.next()
+            aggregate = True
+        self._expect_word("function")
+        name = self.ident()
+
+        def type_args() -> tuple:
+            if not self.accept_op("("):
+                return ()
+            vals = [int(self.next().value)]
+            while self.accept_op(","):
+                vals.append(int(self.next().value))
+            self.expect_op(")")
+            return tuple(vals)
+
+        self.expect_op("(")
+        args = []
+        if not self.at_op(")"):
+            while True:
+                aname = self.ident()
+                tname = self.ident().lower()
+                args.append((aname, tname, type_args()))
+                if not self.accept_op(","):
+                    break
+        self.expect_op(")")
+        self._expect_word("returns")
+        rtype = self.ident().lower()
+        rargs = type_args()
+        self._expect_word("language")
+        lang = self.ident().lower()
+        props = {}
+        t = self.peek()
+        if t.kind == "ident" and t.value.lower() == "properties":
+            self.next()
+            self.expect_op("(")
+            while True:
+                k = self._str_lit("property name")
+                self.expect_op("=")
+                v = self._str_lit("property value")
+                props[k.lower()] = v
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        self.expect_kw("as")
+        body = self._str_lit("function body")
+        return ast.CreateFunction(name, args, rtype, rargs, lang, body,
+                                  props, or_replace, aggregate)
+
     def _partition_clause(self):
         """PARTITION BY RANGE(col) (PARTITION p VALUES LESS THAN (x|
         MAXVALUE), ...) | PARTITION BY HASH(col) PARTITIONS n."""
@@ -809,6 +876,13 @@ class Parser:
         if self.accept_kw("snapshot"):
             return ast.DropSnapshot(self.ident())
         t0 = self.peek()
+        if t0.kind == "ident" and t0.value.lower() == "function":
+            self.next()
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return ast.DropFunction(self.ident(), if_exists)
         if t0.kind == "ident" and t0.value.lower() == "stage":
             self.next()
             return ast.DropStage(self.ident())

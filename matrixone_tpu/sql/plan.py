@@ -163,6 +163,17 @@ class Fill(PlanNode):
 
 
 @dataclasses.dataclass
+class UdfAggregate(PlanNode):
+    """Whole-relation aggregate UDFs: materialize each call's argument
+    columns (masked + valid rows only) and run the body ONCE per call —
+    one output row (matrixone_tpu/udf; reference: pkg/udf aggregate
+    registration)."""
+    child: PlanNode
+    calls: List[BoundExpr]        # BoundUdfCall per output column
+    schema: Schema
+
+
+@dataclasses.dataclass
 class VectorTopK(PlanNode):
     """Index-accelerated `ORDER BY distance(col, const) LIMIT k` — the
     reference's applyIndices rewrite (plan/apply_indices_ivfflat.go)."""
@@ -192,6 +203,33 @@ class FulltextTopK(PlanNode):
     schema: Schema
 
 
+def _udf_call_notes(node: PlanNode) -> str:
+    """` UdfCall f [jit|row|remote]` markers for every UDF call inside
+    this node's expressions (EXPLAIN surface for the udf subsystem)."""
+    from matrixone_tpu.sql.expr import BoundUdfCall, walk
+    roots: List[BoundExpr] = []
+    if isinstance(node, Project):
+        roots = list(node.exprs)
+    elif isinstance(node, Filter):
+        roots = [node.pred]
+    elif isinstance(node, UdfAggregate):
+        roots = list(node.calls)
+    elif isinstance(node, Scan):
+        roots = list(node.filters)
+    calls = [e for r in roots for e in walk(r)
+             if isinstance(e, BoundUdfCall)]
+    if not calls:
+        return ""
+    from matrixone_tpu.udf.executor import expected_tier
+    seen = []
+    for c in calls:
+        tier = ("aggregate" if c.is_aggregate else expected_tier(c))
+        note = f"UdfCall {c.name} [{tier}]"
+        if note not in seen:
+            seen.append(note)
+    return " " + " ".join(seen)
+
+
 def explain(node: PlanNode, indent: int = 0) -> str:
     pad = "  " * indent
     name = type(node).__name__
@@ -210,6 +248,7 @@ def explain(node: PlanNode, indent: int = 0) -> str:
         extra = f" index={node.index_name} k={node.k} metric={node.metric}"
     elif isinstance(node, FulltextTopK):
         extra = f" index={node.index_name} k={node.k} query={node.query!r}"
+    extra += _udf_call_notes(node)
     lines = [f"{pad}{name}{extra}  -> {[n for n, _ in node.schema]}"]
     for attr in ("child", "left", "right"):
         c = getattr(node, attr, None)

@@ -13,7 +13,8 @@ from typing import List
 from matrixone_tpu.container.dtypes import DType, TypeOid
 from matrixone_tpu.sql.expr import (AggCall, BoundCase, BoundCast, BoundCol,
                                     BoundExpr, BoundFunc, BoundInList,
-                                    BoundIsNull, BoundLike, BoundLiteral)
+                                    BoundIsNull, BoundLike, BoundLiteral,
+                                    BoundUdfCall)
 
 
 def dtype_to_json(d: DType) -> list:
@@ -52,6 +53,19 @@ def expr_to_json(e: BoundExpr) -> dict:
         return {"t": "like", "arg": expr_to_json(e.arg),
                 "pattern": e.pattern, "negated": e.negated,
                 "dtype": dtype_to_json(e.dtype)}
+    if isinstance(e, BoundUdfCall):
+        # the DEFINITION ships with the call (body + hash): the peer
+        # evaluates exactly the body this plan was bound against, no
+        # catalog round-trip (pkg/udf pythonservice request shape)
+        return {"t": "udf", "name": e.name,
+                "args": [expr_to_json(a) for a in e.args],
+                "dtype": dtype_to_json(e.dtype), "body": e.body,
+                "arg_names": list(e.arg_names),
+                "arg_types": [dtype_to_json(t) for t in e.arg_types],
+                "body_hash": e.body_hash,
+                "deterministic": e.deterministic,
+                "vectorized": e.vectorized,
+                "is_aggregate": e.is_aggregate}
     raise TypeError(f"cannot serialize {type(e).__name__}")
 
 
@@ -79,6 +93,13 @@ def expr_from_json(d: dict) -> BoundExpr:
     if t == "like":
         return BoundLike(expr_from_json(d["arg"]), d["pattern"],
                          d["negated"], dt_)
+    if t == "udf":
+        return BoundUdfCall(
+            d["name"], [expr_from_json(a) for a in d["args"]], dt_,
+            d["body"], list(d["arg_names"]),
+            [dtype_from_json(x) for x in d["arg_types"]],
+            d["body_hash"], d.get("deterministic", True),
+            d.get("vectorized", True), d.get("is_aggregate", False))
     raise TypeError(f"cannot deserialize expr kind {t}")
 
 

@@ -1076,6 +1076,13 @@ class Engine:
             for tname in set(list(inserts) + list(deletes)):
                 for ix in self.indexes_on(tname):
                     ix.dirty = True
+                # UDF definitions live in an ordinary table but ARE
+                # catalog shape: a commit touching system_udf is DDL —
+                # serving caches must not outlive the function set they
+                # were planned against (matrixone_tpu/udf)
+                from matrixone_tpu.udf.catalog import is_udf_table
+                if is_udf_table(tname):
+                    self.ddl_gen += 1
             self.committed_ts = commit_ts
             M.txn_commits.inc(outcome="ok")
             return affected
@@ -1469,6 +1476,12 @@ class WalApplier:
             for tname in touched:
                 for ix in eng.indexes_on(tname):
                     ix.dirty = True
+                # replicas learn UDF DDL as logtail rows on system_udf:
+                # bump ddl_gen the same way the TN's commit pipeline does
+                # so the CN's plan/result caches invalidate in step
+                from matrixone_tpu.udf.catalog import is_udf_table
+                if is_udf_table(tname):
+                    eng.ddl_gen += 1
             self.pending = []
             return ts
         return None

@@ -1,0 +1,24 @@
+"""Python/JAX UDF subsystem (reference analogue: pkg/udf +
+pkg/udf/pythonservice — CREATE FUNCTION catalog, restricted-dialect
+bodies, jit-compiled vectorized execution, worker offload).
+
+Layout:
+  catalog.py  — the system_udf table + the registry derived from it
+  sandbox.py  — restricted Python/jnp dialect validation + frozen exec
+  executor.py — jit / row-loop / remote tiers over one compile cache
+"""
+
+from matrixone_tpu.udf.catalog import (UDF_TABLE, UdfMeta, ensure_table,
+                                       is_udf_table, lookup, nondet_names,
+                                       registry_for, sync_serving,
+                                       validate_meta)
+from matrixone_tpu.udf.executor import (COMPILE_CACHE, eval_udf_aggregate,
+                                        eval_udf_call, expected_tier,
+                                        stats)
+from matrixone_tpu.udf.sandbox import UdfError, compile_body
+
+__all__ = ["UDF_TABLE", "UdfMeta", "UdfError", "COMPILE_CACHE",
+           "compile_body", "ensure_table", "eval_udf_aggregate",
+           "eval_udf_call", "expected_tier", "is_udf_table", "lookup",
+           "nondet_names", "registry_for", "stats", "sync_serving",
+           "validate_meta"]

@@ -1,0 +1,428 @@
+"""UDF execution: jit-compiled vectorized tier, row-loop fallback, and
+remote worker offload.
+
+Reference analogue: the `pkg/udf/pythonservice` gRPC worker evaluates
+user Python per batch in a separate process; here the SAME body has
+three tiers:
+
+  jit    — the body is traced ONCE per (body-hash, dtype-signature) into
+           a jitted JAX function over whole column arrays; the call then
+           runs on device like any builtin kernel (compile-once /
+           execute-many — the accelerator path BASELINE.json names).
+  row    — bodies that fail tracing (data-dependent Python control flow)
+           run per row on host numpy: correct, slow, and counted.
+  remote — MO_UDF_OFFLOAD=1 ships the arg columns to the worker process
+           (Arrow batches over the PR-2 fabric semantics: retries for
+           transport faults, circuit breaker, deadline propagation) and
+           falls back to local evaluation when the worker is gone.
+
+All tiers share ONE compile cache and ONE numpy evaluation routine, so
+`MO_UDF_OFFLOAD=0/1` produce bit-identical results by construction.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from matrixone_tpu.udf.sandbox import UdfError, compile_body
+from matrixone_tpu.utils import metrics as M
+
+#: sentinel: tracing this (body, sig) failed — row tier from now on
+_JIT_FAILED = object()
+
+#: rows between deadline checks in the row-loop tier
+_ROW_CHECK = 4096
+
+
+def _jit_enabled() -> bool:
+    return os.environ.get("MO_UDF_JIT", "1") != "0"
+
+
+def _offload_addr() -> Optional[str]:
+    """Worker address when offload is armed: MO_UDF_OFFLOAD=1 plus an
+    address from MO_UDF_WORKER or the session's `udf_worker` variable."""
+    if os.environ.get("MO_UDF_OFFLOAD") != "1":
+        return None
+    addr = os.environ.get("MO_UDF_WORKER", "")
+    if not addr:
+        from matrixone_tpu.frontend.session import current_session
+        s = current_session()
+        addr = str((s.variables.get("udf_worker") or "")
+                   if s is not None else "")
+    return addr or None
+
+
+class UdfCompileCache:
+    """LRU of (body_hash, dtype signature) -> compiled callables.
+
+    One entry holds BOTH forms of a body: the sandboxed Python function
+    (row tier + aggregate tier) and its jitted wrapper (vector tier),
+    which flips to _JIT_FAILED the first time tracing fails for this
+    signature.  `mo_ctl('udf', 'status'|'clear')` exposes it."""
+
+    def __init__(self, max_entries: Optional[int] = None):
+        if max_entries is None:
+            try:
+                max_entries = int(os.environ.get(
+                    "MO_UDF_COMPILE_CACHE", "") or 256)
+            except ValueError:
+                max_entries = 256
+        self.max_entries = max(max_entries, 8)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, dict]" = OrderedDict()
+
+    def entry(self, key: tuple, name: str, body: str,
+              arg_names: List[str]) -> dict:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                self._entries.move_to_end(key)
+                M.udf_compile.inc(outcome="hit")
+                return e
+        M.udf_compile.inc(outcome="miss")
+        fn = compile_body(name, body, arg_names)   # UdfError on bad body
+        e = {"py": fn, "jit": None, "name": name}
+        with self._lock:
+            e = self._entries.setdefault(key, e)
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+        return e
+
+    def jitted(self, e: dict):
+        """Jitted wrapper for an entry (created once; _JIT_FAILED after a
+        trace failure)."""
+        if e["jit"] is None:
+            import jax
+            e["jit"] = jax.jit(e["py"])
+        return e["jit"]
+
+    def mark_jit_failed(self, e: dict) -> None:
+        e["jit"] = _JIT_FAILED
+        M.udf_compile.inc(outcome="trace_fail")
+
+    def jit_failed(self, e: dict) -> bool:
+        return e["jit"] is _JIT_FAILED
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            n = len(self._entries)
+            failed = sum(1 for e in self._entries.values()
+                         if e["jit"] is _JIT_FAILED)
+        return {"entries": n, "jit_failed": failed,
+                "max_entries": self.max_entries,
+                "hits": int(M.udf_compile.get(outcome="hit")),
+                "misses": int(M.udf_compile.get(outcome="miss")),
+                "trace_failures": int(
+                    M.udf_compile.get(outcome="trace_fail"))}
+
+
+#: process-global cache (sessions and the worker service share it)
+COMPILE_CACHE = UdfCompileCache()
+
+
+def _sig(e) -> tuple:
+    return tuple((int(t.oid), t.width, t.scale) for t in e.arg_types) \
+        + ((int(e.dtype.oid),) if hasattr(e, "dtype") else ())
+
+
+def _cache_key(e) -> tuple:
+    return (e.body_hash,) + _sig(e)
+
+
+def _check_deadline(name: str) -> None:
+    from matrixone_tpu.cluster.rpc import DeadlineExceeded, \
+        current_deadline
+    dl = current_deadline()
+    if dl is not None and dl.expired():
+        raise DeadlineExceeded(
+            f"udf {name!r}: call deadline exhausted before evaluation")
+
+
+def expected_tier(e) -> str:
+    """Static tier label for EXPLAIN: the tier this call WILL take on
+    its next execution (remote wins over jit; a known trace failure or
+    MO_UDF_JIT=0 demotes to row)."""
+    if _offload_addr() is not None:
+        return "remote"
+    if not (_jit_enabled() and e.vectorized):
+        return "row"
+    ce = COMPILE_CACHE._entries.get(_cache_key(e))
+    if ce is not None and ce["jit"] is _JIT_FAILED:
+        return "row"
+    return "jit"
+
+
+# --------------------------------------------------------- numpy kernel
+
+def eval_numpy(name: str, body: str, body_hash: str,
+               arg_names: List[str], arg_types, ret_type,
+               arg_arrays: List[np.ndarray], valid: np.ndarray,
+               vectorized: bool = True
+               ) -> Tuple[np.ndarray, np.ndarray, str]:
+    """Evaluate over host arrays -> (result, validity, tier).
+
+    Shared verbatim by the worker's udf_eval service and the local
+    remote-fallback path, which is what makes MO_UDF_OFFLOAD=0/1
+    bit-identical: there is exactly one implementation per tier."""
+    from matrixone_tpu.container.dtypes import TypeOid
+    sig = tuple((int(t.oid), t.width, t.scale) for t in arg_types) \
+        + (int(ret_type.oid),)
+    entry = COMPILE_CACHE.entry((body_hash,) + sig, name, body,
+                                arg_names)
+    n = len(valid)
+    np_ret = (np.bool_ if ret_type.oid == TypeOid.BOOL
+              else ret_type.np_dtype)
+    if vectorized and _jit_enabled() \
+            and not COMPILE_CACHE.jit_failed(entry):
+        import jax
+        import jax.numpy as jnp
+        try:
+            fnj = COMPILE_CACHE.jitted(entry)
+            out = np.asarray(jax.device_get(
+                fnj(*[jnp.asarray(a) for a in arg_arrays])))
+            if out.ndim == 0:
+                out = np.full(n, out[()], np_ret)
+            out = np.ascontiguousarray(out).astype(np_ret, copy=False)
+            if out.shape != (n,):
+                raise UdfError(
+                    f"udf {name!r}: body produced shape "
+                    f"{out.shape}, expected ({n},)")
+            return out, valid.copy(), "jit"
+        except UdfError:
+            raise
+        except Exception:       # noqa: BLE001 — tracing/runtime failed:
+            COMPILE_CACHE.mark_jit_failed(entry)   # row tier is the
+            # documented fallback for non-traceable bodies
+    return _row_eval(name, entry["py"], arg_arrays, valid, np_ret)
+
+
+def _row_eval(name: str, fn, arg_arrays, valid, np_ret
+              ) -> Tuple[np.ndarray, np.ndarray, str]:
+    n = len(valid)
+    out = np.zeros(n, np_ret)
+    out_valid = valid.copy()
+    idxs = np.nonzero(valid)[0]
+    for j, i in enumerate(idxs):
+        if j % _ROW_CHECK == 0:
+            _check_deadline(name)
+        try:
+            v = fn(*[a[i].item() if a.ndim else a for a in arg_arrays])
+            if v is None:
+                out_valid[i] = False
+            else:
+                # coercion stays INSIDE the try: an out-of-range return
+                # (2**70 into int64 -> OverflowError) must surface as a
+                # clean udf error too, not a raw numpy traceback
+                out[i] = np_ret(v) if np_ret is np.bool_ else v
+        except UdfError:
+            raise
+        except Exception as ex:     # noqa: BLE001 — user code: surface
+            raise UdfError(         # as a clean engine error, no
+                f"udf {name!r}: {type(ex).__name__}: {ex}")  # traceback
+    return out, out_valid, "row"
+
+
+# --------------------------------------------------------- device entry
+
+def _broadcast(data, n: int):
+    import jax.numpy as jnp
+    if data.shape[0] == n:
+        return data
+    return jnp.broadcast_to(data[:1], (n,) + data.shape[1:])
+
+
+def eval_udf_call(e, ex):
+    """vm/exprs entry: BoundUdfCall over an ExecBatch -> DeviceColumn."""
+    import jax
+    import jax.numpy as jnp
+    from matrixone_tpu.container.device import DeviceColumn
+    from matrixone_tpu.vm.exprs import eval_expr
+    _check_deadline(e.name)
+    n = ex.padded_len
+    cols = [eval_expr(a, ex) for a in e.args]
+    datas = [_broadcast(c.data, n) for c in cols]
+    valid = jnp.ones((n,), jnp.bool_)
+    for c in cols:
+        valid = valid & _broadcast(c.validity, n)
+    # rows a WHERE already filtered out — and padding rows — must not
+    # reach the per-row tiers: the jit tier computes them harmlessly
+    # in-vector (like every builtin kernel), but a row-loop body would
+    # pay Python time for them and could ERROR on values the user's
+    # predicate explicitly excluded (1.0/x ... WHERE x <> 0)
+    eval_valid = valid & ex.mask
+
+    addr = _offload_addr()
+    if addr is not None:
+        from matrixone_tpu.cluster.rpc import (BreakerOpen,
+                                               TransportError)
+        try:
+            out, out_valid, tier = _remote_eval(e, addr, datas,
+                                                eval_valid)
+            M.udf_calls.inc(tier="remote")
+            M.udf_rows.inc(int(n), tier="remote")
+            M.udf_offload.inc(outcome="ok")
+            return DeviceColumn(jnp.asarray(out), jnp.asarray(out_valid),
+                                e.dtype)
+        except BreakerOpen:
+            M.udf_offload.inc(outcome="fallback_breaker")
+        except TransportError:
+            M.udf_offload.inc(outcome="fallback_transport")
+        # fall through: local evaluation serves the query
+
+    entry = COMPILE_CACHE.entry(_cache_key(e), e.name, e.body,
+                                e.arg_names)
+    if e.vectorized and _jit_enabled() \
+            and not COMPILE_CACHE.jit_failed(entry):
+        try:
+            fnj = COMPILE_CACHE.jitted(entry)
+            out = fnj(*datas)
+            out = jnp.asarray(out)
+            if out.ndim == 0:
+                out = jnp.broadcast_to(out, (n,))
+            if out.shape != (n,):
+                raise UdfError(
+                    f"udf {e.name!r}: body produced shape "
+                    f"{out.shape}, expected ({n},)")
+            from matrixone_tpu.container.dtypes import TypeOid
+            jnp_ret = (jnp.bool_ if e.dtype.oid == TypeOid.BOOL
+                       else e.dtype.jnp_dtype)
+            M.udf_calls.inc(tier="jit")
+            M.udf_rows.inc(int(n), tier="jit")
+            return DeviceColumn(out.astype(jnp_ret), valid, e.dtype)
+        except UdfError:
+            raise
+        except Exception:       # noqa: BLE001 — non-traceable body:
+            COMPILE_CACHE.mark_jit_failed(entry)   # documented row-tier
+            # fallback (counted in mo_udf_compile trace_fail)
+    from matrixone_tpu.container.dtypes import TypeOid
+    np_ret = (np.bool_ if e.dtype.oid == TypeOid.BOOL
+              else e.dtype.np_dtype)
+    host_args = [np.asarray(jax.device_get(d)) for d in datas]
+    host_valid = np.asarray(jax.device_get(eval_valid))
+    out, out_valid, _tier = _row_eval(e.name, entry["py"], host_args,
+                                      host_valid, np_ret)
+    M.udf_calls.inc(tier="row")
+    M.udf_rows.inc(int(n), tier="row")
+    return DeviceColumn(jnp.asarray(out), jnp.asarray(out_valid),
+                        e.dtype)
+
+
+def eval_udf_aggregate(e, arg_arrays: List[np.ndarray]):
+    """Aggregate UDF: ONE body call over the group's compacted column
+    arrays -> python scalar (None = SQL NULL)."""
+    entry = COMPILE_CACHE.entry(_cache_key(e), e.name, e.body,
+                                e.arg_names)
+    _check_deadline(e.name)
+    try:
+        v = entry["py"](*arg_arrays)
+    except Exception as ex:         # noqa: BLE001 — user code: clean
+        raise UdfError(f"udf {e.name!r}: {type(ex).__name__}: {ex}")
+    M.udf_calls.inc(tier="aggregate")
+    M.udf_rows.inc(int(len(arg_arrays[0]) if arg_arrays else 0),
+                   tier="aggregate")
+    if v is None:
+        return None
+    arr = np.asarray(v)
+    if arr.ndim != 0:
+        raise UdfError(
+            f"udf {e.name!r}: aggregate body must return a scalar, got "
+            f"shape {arr.shape}")
+    return arr.item()
+
+
+# --------------------------------------------------------------- remote
+
+_clients: Dict[str, object] = {}
+_clients_lock = threading.Lock()
+
+
+def _client_for(addr: str):
+    with _clients_lock:
+        c = _clients.get(addr)
+        if c is None:
+            from matrixone_tpu.worker.client import WorkerClient
+            c = _clients[addr] = WorkerClient(addr)
+        return c
+
+
+def reset_clients() -> None:
+    """Drop cached worker channels (tests restart workers on new ports)."""
+    with _clients_lock:
+        for c in _clients.values():
+            try:
+                c.close()
+            except Exception:       # noqa: BLE001 — teardown best-effort
+                pass
+        _clients.clear()
+
+
+def _remote_eval(e, addr: str, datas, valid):
+    """Ship arg columns to the worker's udf_eval service (the wire
+    format lives in ONE place: WorkerClient.udf_eval). Transport
+    failures raise TransportError/BreakerOpen (callers fall back local);
+    worker-side body errors raise UdfError (deterministic: no fallback)."""
+    import jax
+    from matrixone_tpu.cluster import rpc as _rpc
+    from matrixone_tpu.utils.fault import INJECTOR
+    breaker = _rpc.breaker_for(addr)
+    if not breaker.allow():
+        raise _rpc.BreakerOpen(f"udf worker {addr}: circuit open")
+    if INJECTOR.trigger("udf.remote") == "drop":
+        breaker.record_failure()
+        raise _rpc.TransportError("fault injected: udf.remote drop")
+    host_args = [np.asarray(jax.device_get(d)) for d in datas]
+    host_valid = np.asarray(jax.device_get(valid))
+    dl = _rpc.current_deadline()
+    dl_ms = max(int(dl.remaining() * 1000), 1) if dl is not None else None
+    try:
+        out = _client_for(addr).udf_eval(e, host_args, host_valid,
+                                         deadline_ms=dl_ms)
+    except (_rpc.TransportError, _rpc.BreakerOpen):
+        breaker.record_failure()
+        raise
+    except _rpc.DeadlineExceeded:
+        breaker.record_abandon()
+        raise
+    except RuntimeError as ex:
+        # the worker answered with an error frame ("worker: <Type>: …").
+        # Only a BODY error (UdfError) is deterministic — re-raised as
+        # UdfError, never retried or failed over.  A worker-side
+        # deadline keeps its taxonomy (the budget is gone; falling back
+        # would just time out again), and anything else is transient as
+        # far as this caller can tell: surface it as TransportError so
+        # the caller falls back to local evaluation — which reproduces
+        # a genuine body error identically anyway (same compiled body).
+        msg = str(ex)
+        if "UdfError" in msg:
+            breaker.record_success()
+            raise UdfError(msg)
+        if "DeadlineExceeded" in msg:
+            breaker.record_abandon()
+            raise _rpc.DeadlineExceeded(msg)
+        breaker.record_failure()
+        raise _rpc.TransportError(msg)
+    breaker.record_success()
+    return out
+
+
+def stats() -> dict:
+    return {
+        "compile_cache": COMPILE_CACHE.stats(),
+        "calls": {t: int(M.udf_calls.get(tier=t))
+                  for t in ("jit", "row", "remote", "aggregate")},
+        "rows": {t: int(M.udf_rows.get(tier=t))
+                 for t in ("jit", "row", "remote", "aggregate")},
+        "offload": {o: int(M.udf_offload.get(outcome=o))
+                    for o in ("ok", "fallback_breaker",
+                              "fallback_transport")},
+    }

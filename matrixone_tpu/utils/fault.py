@@ -25,6 +25,9 @@ tests/test_chaos.py):
   wal.append         WAL append (local WalWriter and quorum client)
   proxy.relay        proxy command forwarding (arg "drop" = backend
                      socket dropped mid-session)
+  udf.remote         remote UDF offload, before the worker call (arg
+                     "drop" = transport loss: the executor falls back
+                     to local evaluation)
 """
 
 from __future__ import annotations

@@ -239,3 +239,23 @@ admission_running = REGISTRY.gauge(
     "mo_admission_running", "statements currently holding a slot")
 admission_queued = REGISTRY.gauge(
     "mo_admission_queued", "statements waiting in the admission queue")
+
+# ---- Python/JAX UDF subsystem (udf/, reference: pkg/udf/pythonservice)
+udf_calls = REGISTRY.counter(
+    "mo_udf_calls_total",
+    "UDF evaluations by tier (jit/row/remote/aggregate)")
+udf_rows = REGISTRY.counter(
+    "mo_udf_rows_total", "rows processed by UDF evaluations, by tier")
+udf_compile = REGISTRY.counter(
+    "mo_udf_compile_total",
+    "UDF compile-cache lookups by outcome (hit/miss/trace_fail)")
+udf_offload = REGISTRY.counter(
+    "mo_udf_offload_total",
+    "remote UDF offload outcomes (ok/fallback_breaker/"
+    "fallback_transport)")
+udf_batch_rows = REGISTRY.counter(
+    "mo_udf_batch_rows_total",
+    "rows through the worker's UDF micro-batcher")
+udf_batch_coalesced = REGISTRY.counter(
+    "mo_udf_batch_coalesced_total",
+    "remote UDF requests that rode another request's dispatch")

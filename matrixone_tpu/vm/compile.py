@@ -24,6 +24,8 @@ def compile_plan(node: P.PlanNode, ctx) -> ops.Operator:
         return ops.FilterOp(node, compile_plan(node.child, ctx))
     if isinstance(node, P.Project):
         return ops.ProjectOp(node, compile_plan(node.child, ctx))
+    if isinstance(node, P.UdfAggregate):
+        return ops.UdfAggregateOp(node, compile_plan(node.child, ctx))
     if isinstance(node, P.Aggregate):
         from matrixone_tpu.ops import pallas_kernels as PK
         return ops.AggOp(node, compile_plan(node.child, ctx),

@@ -31,7 +31,8 @@ from matrixone_tpu.container.dtypes import DType, TypeOid
 from matrixone_tpu.ops import distance as D, scalar as S
 from matrixone_tpu.sql.expr import (BoundCase, BoundCast, BoundCol,
                                     BoundExpr, BoundFunc, BoundInList,
-                                    BoundIsNull, BoundLike, BoundLiteral)
+                                    BoundIsNull, BoundLike, BoundLiteral,
+                                    BoundUdfCall)
 
 
 @dataclasses.dataclass
@@ -137,6 +138,9 @@ def eval_expr(e: BoundExpr, ex: ExecBatch) -> DeviceColumn:
             lut = ~lut
         hit = jnp.asarray(lut)[jnp.clip(arg.data, 0, len(d) - 1)]
         return DeviceColumn(hit, arg.validity, dt.BOOL)
+    if isinstance(e, BoundUdfCall):
+        from matrixone_tpu.udf.executor import eval_udf_call
+        return eval_udf_call(e, ex)
     if isinstance(e, BoundFunc):
         return _eval_func(e, ex)
     raise EvalError(f"unsupported expression {type(e).__name__}")

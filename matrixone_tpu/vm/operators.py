@@ -317,6 +317,51 @@ def _expr_dict(e: BoundExpr, ex: ExecBatch):
     return _dict_of(e, ex)
 
 
+class UdfAggregateOp(Operator):
+    """Whole-relation aggregate UDFs (plan.UdfAggregate): compact every
+    call's argument columns host-side (filter mask AND arg validity —
+    NULL-in-any-argument rows are skipped, matching builtin aggregate
+    NULL semantics) and run each body ONCE over the concatenated
+    arrays. One output row."""
+
+    def __init__(self, node: "P.UdfAggregate", child: Operator):
+        self.node = node
+        self.child = child
+        self.schema = node.schema
+
+    def execute(self) -> Iterator[ExecBatch]:
+        from matrixone_tpu.udf.executor import (_broadcast,
+                                                eval_udf_aggregate)
+        parts: List[List[list]] = [[[] for _ in c.args]
+                                   for c in self.node.calls]
+        for ex in self.child.execute():
+            n = ex.padded_len
+            for ci, call in enumerate(self.node.calls):
+                cols = [eval_expr(a, ex) for a in call.args]
+                keep = ex.mask
+                datas = []
+                for col in cols:
+                    datas.append(_broadcast(col.data, n))
+                    keep = keep & _broadcast(col.validity, n)
+                km = np.asarray(jax.device_get(keep))
+                for ai, d in enumerate(datas):
+                    arr = np.asarray(jax.device_get(d))[km]
+                    if len(arr):
+                        parts[ci][ai].append(arr)
+        cols_out: Dict[str, DeviceColumn] = {}
+        for ci, call in enumerate(self.node.calls):
+            arrays = [np.concatenate(p) if p
+                      else np.zeros(0, call.arg_types[ai].np_dtype)
+                      for ai, p in enumerate(parts[ci])]
+            v = eval_udf_aggregate(call, arrays)
+            name, dtype = self.schema[ci]
+            cols_out[name] = (DeviceColumn.const_null(dtype) if v is None
+                              else DeviceColumn.const(v, dtype))
+        db = DeviceBatch(columns=cols_out, n_rows=1)
+        yield ExecBatch(batch=db, dicts={},
+                        mask=jnp.ones((1,), jnp.bool_))
+
+
 # -------------------------------------------------------------- aggregate
 
 class _NeedSpill(Exception):

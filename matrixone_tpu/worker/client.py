@@ -99,6 +99,31 @@ class WorkerClient:
                          "metric": metric, "mode": mode},
                         arrowio.arrays_to_ipc({"data": data}, val))[0]
 
+    def udf_eval(self, u, arg_arrays, valid: np.ndarray,
+                 deadline_ms: Optional[float] = None):
+        """Evaluate a UDF over host arg arrays on the worker; `u` is any
+        object with name/body/body_hash/arg_names/arg_types and a result
+        dtype (`ret_type` or `dtype`).  -> (result, validity, tier)."""
+        from matrixone_tpu.sql.serde import dtype_to_json
+        from matrixone_tpu.storage import arrowio
+        ret = getattr(u, "ret_type", None) or u.dtype
+        arrays = {f"_a{i}": np.asarray(a)
+                  for i, a in enumerate(arg_arrays)}
+        arrays["_valid"] = np.asarray(valid, np.bool_)
+        val = {c: np.ones(len(arrays["_valid"]), np.bool_)
+               for c in arrays}
+        header = {"op": "udf_eval", "name": u.name, "body": u.body,
+                  "body_hash": u.body_hash,
+                  "arg_names": list(u.arg_names),
+                  "arg_types": [dtype_to_json(t) for t in u.arg_types],
+                  "ret_type": dtype_to_json(ret),
+                  "vectorized": bool(getattr(u, "vectorized", True))}
+        if deadline_ms is not None:
+            header["deadline_ms"] = float(deadline_ms)
+        h, b = self.run(header, arrowio.arrays_to_ipc(arrays, val))
+        out, out_val = arrowio.ipc_to_arrays(b)
+        return out["out"], out_val["out"], h.get("tier", "jit")
+
     def search_index(self, name: str, queries: np.ndarray, k: int = 10,
                      nprobe: int = 8):
         from matrixone_tpu.storage import arrowio

@@ -63,6 +63,28 @@ class _MicroBatcher:
     linger a drain by at most linger_s per round — bounded, and a worker
     typically serves one hot index."""
 
+    # payload hooks — subclasses coalesce other shapes (udf arg tuples)
+    # through the SAME drain/linger machinery
+    @staticmethod
+    def _rows_of(q) -> int:
+        return len(q)
+
+    @staticmethod
+    def _concat(qs):
+        return np.concatenate(qs)
+
+    @staticmethod
+    def _slice(outs, off: int, n: int):
+        return tuple(o[off:off + n] for o in outs)
+
+    def _count(self, batch) -> None:
+        # metric lane hook — the UDF subclass reports into mo_udf_batch_*
+        # so vector-search coalescing dashboards never see UDF traffic
+        from matrixone_tpu.utils import metrics as M
+        M.vector_batch_rows.inc(
+            sum(self._rows_of(e["q"]) for e in batch))
+        M.vector_batch_coalesced.inc(len(batch) - 1)
+
     def __init__(self, max_batch: int = 256, linger_s: Optional[float] = None):
         import os
         self.max_batch = max_batch
@@ -133,19 +155,18 @@ class _MicroBatcher:
                         clean_exit = True
                         break
                     self.dispatches += 1
-                    M.vector_batch_rows.inc(sum(len(e["q"]) for e in batch))
-                    M.vector_batch_coalesced.inc(len(batch) - 1)
+                    self._count(batch)
                 try:
-                    qs = np.concatenate([e["q"] for e in batch])
-                    d, i = fn(qs)
+                    qs = self._concat([e["q"] for e in batch])
+                    outs = fn(qs)
                     off = 0
                     for e in batch:
-                        n = len(e["q"])
-                        e["out"] = (d[off:off + n], i[off:off + n])
+                        n = self._rows_of(e["q"])
+                        e["out"] = self._slice(outs, off, n)
                         off += n
-                except Exception as err:   # noqa: BLE001
-                    for e in batch:
-                        e["err"] = err
+                except Exception as err:   # noqa: BLE001 — delivered to
+                    for e in batch:        # every co-batched caller and
+                        e["err"] = err     # re-raised on their threads
                 finally:
                     for e in batch:
                         e["ev"].set()
@@ -163,6 +184,35 @@ class _MicroBatcher:
         return entry["out"]
 
 
+class _UdfMicroBatcher(_MicroBatcher):
+    """Micro-batching for remote UDF evaluation: a request's payload is
+    the TUPLE (arg0, ..., argK, validity); concurrent calls to the same
+    (body-hash, signature) coalesce row-wise into one jitted dispatch —
+    the cuvs dynamic-batching pattern applied to the Python-UDF-worker
+    seam."""
+
+    @staticmethod
+    def _rows_of(q) -> int:
+        return len(q[-1])
+
+    @staticmethod
+    def _concat(qs):
+        return tuple(np.concatenate(parts) for parts in zip(*qs))
+
+    @staticmethod
+    def _slice(outs, off: int, n: int):
+        # (result, validity, tier): slice the arrays, share the tier —
+        # followers report the tier their rows ACTUALLY ran under, not
+        # a guess (the whole batch runs in one eval_numpy call)
+        return tuple(o[off:off + n] for o in outs[:2]) + tuple(outs[2:])
+
+    def _count(self, batch) -> None:
+        from matrixone_tpu.utils import metrics as M
+        M.udf_batch_rows.inc(
+            sum(self._rows_of(e["q"]) for e in batch))
+        M.udf_batch_coalesced.inc(len(batch) - 1)
+
+
 class WorkerCore:
     """Device-owning state + stage execution (transport-independent)."""
 
@@ -172,6 +222,7 @@ class WorkerCore:
         self.stages_run = 0
         self._lock = threading.Lock()
         self.batcher = _MicroBatcher()
+        self.udf_batcher = _UdfMicroBatcher()
 
     # ---- stage execution
     def run_stage(self, header: dict, blob: bytes) -> bytes:
@@ -277,6 +328,48 @@ class WorkerCore:
             val_out = {c: np.ones(len(v), np.bool_)
                        for c, v in arrays_out.items()}
             return pack(out, arrowio.arrays_to_ipc(arrays_out, val_out))
+
+        if op == "udf_eval":
+            # Python-UDF service (reference: pkg/udf/pythonservice
+            # pyserver RunRequest): the definition rides the request, the
+            # compile cache makes repeats compile-free, and concurrent
+            # same-signature calls coalesce through the micro-batcher.
+            from matrixone_tpu.cluster.rpc import deadline_scope
+            from matrixone_tpu.udf import executor as uexec
+            arrays, _val = arrowio.ipc_to_arrays(blob)
+            arg_ts = [dtype_from_json(x) for x in header["arg_types"]]
+            ret = dtype_from_json(header["ret_type"])
+            args = tuple(np.asarray(arrays[f"_a{i}"])
+                         for i in range(len(arg_ts)))
+            valid = np.asarray(arrays["_valid"], np.bool_)
+            key = ("udf", header["body_hash"],
+                   tuple((int(t.oid), t.width, t.scale) for t in arg_ts),
+                   int(ret.oid))
+            def run_fn(qs):
+                # the trailing tier string rides the batcher's output
+                # tuple (its _slice passes non-array extras through), so
+                # coalesced FOLLOWERS report the tier that actually ran
+                return uexec.eval_numpy(
+                    str(header.get("name", "?")), header["body"],
+                    header["body_hash"], list(header["arg_names"]),
+                    arg_ts, ret, list(qs[:-1]), qs[-1],
+                    vectorized=bool(header.get("vectorized", True)))
+
+            dl_ms = header.get("deadline_ms")
+            if dl_ms:
+                # re-enter the caller's remaining budget (same contract
+                # as the TN handlers: the deadline follows the call
+                # chain across processes)
+                with deadline_scope(ms=float(dl_ms)):
+                    out, out_valid, tier = self.udf_batcher.run(
+                        key, args + (valid,), run_fn)
+            else:
+                out, out_valid, tier = self.udf_batcher.run(
+                    key, args + (valid,), run_fn)
+            return pack({"tier": tier, "n": int(len(out))},
+                        arrowio.arrays_to_ipc(
+                            {"out": out},
+                            {"out": np.asarray(out_valid, np.bool_)}))
 
         if op == "load_index":
             from matrixone_tpu.storage import arrowio
@@ -405,7 +498,9 @@ class WorkerCore:
                 "stages_run": self.stages_run,
                 "indexes": sorted(self.indexes),
                 "batch_requests": self.batcher.requests,
-                "batch_dispatches": self.batcher.dispatches}
+                "batch_dispatches": self.batcher.dispatches,
+                "udf_batch_requests": self.udf_batcher.requests,
+                "udf_batch_dispatches": self.udf_batcher.dispatches}
 
 
 class TpuWorkerServer:
@@ -421,7 +516,9 @@ class TpuWorkerServer:
             header, blob = unpack(request)
             try:
                 return self.core.run_stage(header, blob)
-            except Exception as e:
+            except Exception as e:   # noqa: BLE001 — service boundary:
+                # every failure becomes a typed error frame the client
+                # re-raises; swallowing here would hang the caller
                 return pack({"error": f"{type(e).__name__}: {e}"})
 
         def health_handler(request: bytes, context):

@@ -17,8 +17,11 @@ import os
 import re
 import sys
 
-#: lanes where broad catches must be justified
-LINT_DIRS = ("matrixone_tpu/cluster", "matrixone_tpu/frontend")
+#: lanes where broad catches must be justified — the RPC/wire layers,
+#: plus UDF execution and the worker service (user code runs there: a
+#: silent broad except is exactly where a body error becomes wrong rows)
+LINT_DIRS = ("matrixone_tpu/cluster", "matrixone_tpu/frontend",
+             "matrixone_tpu/udf", "matrixone_tpu/worker")
 
 #: bare `except:` or any except clause naming Exception/BaseException —
 #: including tuple forms like `except (Exception, ValueError):`
