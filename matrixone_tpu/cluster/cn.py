@@ -26,7 +26,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from matrixone_tpu.cluster.rpc import (ERR_TYPES, RpcClient, deadline_scope,
+from matrixone_tpu.cluster.rpc import (ERR_TYPES, RpcClient,
+                                       backoff_delay, deadline_scope,
                                        new_rid, pack_blobs,
                                        parse_addr as _parse_addr)
 from matrixone_tpu.utils.fault import INJECTOR
@@ -87,12 +88,18 @@ class LogtailConsumer:
 
     # ------------------------------------------------------------ loop
     def _run(self) -> None:
+        attempt = 0
         while not self._stop.is_set():
             try:
                 self._consume_once()
+                attempt = 0
             except (OSError, ConnectionError):
-                # TN down or restarting: resubscribe from what we have
-                time.sleep(0.25)
+                # TN down or restarting: resubscribe from what we have.
+                # Jittered backoff, not a flat tick — every CN loses the
+                # stream at the same instant a TN restarts, and a fixed
+                # retry interval re-synchronizes the whole fleet's dials
+                attempt += 1
+                time.sleep(backoff_delay(attempt))
             except Exception as e:            # noqa: BLE001
                 import sys
                 self.last_error = repr(e)
@@ -126,7 +133,8 @@ class LogtailConsumer:
                         print(f"[cn-logtail] BREAKER OPEN: {e!r}",
                               file=sys.stderr, flush=True)
                         break
-                time.sleep(0.5)
+                attempt += 1
+                time.sleep(backoff_delay(attempt))
         if self.broken:
             with self._cv:         # wake any wait_ts blockers to fail
                 self._cv.notify_all()
@@ -136,6 +144,9 @@ class LogtailConsumer:
             raise ConnectionError(
                 "fault injected: logtail subscription dropped")
         sock = socket.create_connection(self.addr, timeout=30.0)
+        # molint: disable=deadline-propagation -- poll TICK, not a
+        # deadline: the recv loop below continues on socket.timeout so
+        # the 1s value only bounds how often _stop is re-checked
         sock.settimeout(1.0)
         try:
             _send_msg(sock, {"op": "subscribe", "from_ts": self.applied_ts})
@@ -209,6 +220,9 @@ class LogtailConsumer:
                 rep._load_manifest_table(name, tm, replace=True)
             else:
                 rep.tables.pop(name, None)
+            # the table's gids (or the table itself) just changed out
+            # from under every cached plan/result pinned to them
+            rep.ddl_gen += 1
             for ix in rep.indexes_on(name):
                 ix.dirty = True     # gids changed under any local index
 
@@ -224,6 +238,9 @@ class LogtailConsumer:
             rep.sources = set()
             rep.dynamic_tables = {}
             rep._load_checkpoint()
+            # the whole catalog was swapped: every cached plan/result
+            # keyed to the pre-resync shape is invalid
+            rep.ddl_gen += 1
             for ix in rep.indexes.values():
                 ix.dirty = True
             rep.committed_ts = max(rep.committed_ts, rep._ckpt_ts)

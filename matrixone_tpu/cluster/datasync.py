@@ -20,7 +20,7 @@ import threading
 import time
 from typing import Optional
 
-from matrixone_tpu.cluster.rpc import parse_addr
+from matrixone_tpu.cluster.rpc import backoff_delay, parse_addr
 from matrixone_tpu.logservice.replicated import _recv_msg, _send_msg
 from matrixone_tpu.storage import wal as walmod
 from matrixone_tpu.storage.engine import Engine, WalApplier
@@ -108,13 +108,17 @@ class StandbyAgent:
 
     # --------------------------------------------------------------- sync
     def _run(self) -> None:
+        attempt = 0
         while not self._stop.is_set():
             try:
                 self._consume_once()
+                attempt = 0
             except (OSError, ConnectionError):
                 # primary down: hold position; promotion is the
-                # operator's call (we ARE the recovery path)
-                time.sleep(0.25)
+                # operator's call (we ARE the recovery path).  Jittered
+                # backoff so standbys don't re-dial in lockstep
+                attempt += 1
+                time.sleep(backoff_delay(attempt))
             except Exception as e:            # noqa: BLE001
                 import sys
                 self.last_error = repr(e)
@@ -130,10 +134,14 @@ class StandbyAgent:
                     self.applied_ts = self._durable_position()
                 except Exception as e2:       # noqa: BLE001
                     self.last_error = repr(e2)
-                time.sleep(1.0)
+                attempt += 1
+                time.sleep(backoff_delay(attempt))
 
     def _consume_once(self) -> None:
         sock = socket.create_connection(self.addr, timeout=30.0)
+        # molint: disable=deadline-propagation -- poll TICK, not a
+        # deadline: the recv loop continues on socket.timeout; the 1s
+        # only bounds how often _stop is re-checked
         sock.settimeout(1.0)
         try:
             _send_msg(sock, {"op": "subscribe",

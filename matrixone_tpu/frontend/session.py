@@ -901,6 +901,26 @@ class Session:
             else:
                 raise BindError(f"unknown udf subcommand {arg!r}; "
                                 "use status | clear")
+        elif cmd == "lint":
+            # static-analysis ops surface (tools/molint): checker
+            # inventory, last-run findings, suppression count —
+            # mirrors the mo_ctl('udf'|'serving'|'rpc') pattern
+            import json as _json
+            try:
+                from tools import molint
+            except ImportError:
+                raise BindError(
+                    "molint unavailable: the tools/ package is not on "
+                    "sys.path (run from a repo checkout)")
+            if arg in ("", "status"):
+                out = _json.dumps(molint.last_run_status(),
+                                  sort_keys=True)
+            elif arg == "run":
+                _f, st = molint.run_checks(molint.repo_root())
+                out = _json.dumps(st, sort_keys=True)
+            else:
+                raise BindError(f"unknown lint subcommand {arg!r}; "
+                                "use status | run")
         elif cmd == "rpc":
             # per-peer circuit breaker state + the CN's logtail breaker
             import json as _json

@@ -88,8 +88,9 @@ class HAKeeper:
             return
         try:
             snap = self._restore() or {}
-        except Exception:
-            snap = {}
+        except Exception:   # noqa: BLE001 — operator-supplied restore
+            snap = {}       # callback; promotion must proceed on a
+                            # fresh state rather than crash the keeper
         for sid, rec in snap.items():
             if sid.startswith("__"):       # reserved store keys (gen)
                 continue
@@ -136,8 +137,9 @@ class HAKeeper:
         try:
             snap = self._restore() or {}
             return int(snap.get("__keeper_gen", {}).get("gen", 0))
-        except Exception:
-            return 0
+        except Exception:   # noqa: BLE001 — operator-supplied restore
+            return 0        # callback; a missing/corrupt store reads
+                            # as generation 0
 
     def promote(self) -> None:
         """Standby -> primary: adopt the shared persisted state (grace
@@ -430,6 +432,10 @@ class HAClient:
             if self._sock is None:
                 self._sock = socket.create_connection(
                     self.addrs[self._cur], timeout=2)
+                # molint: disable=deadline-propagation -- control-plane
+                # heartbeat: runs on its own thread with no statement
+                # deadline in scope; the fixed 2s bound IS the liveness
+                # contract (a heartbeat slower than that is a miss)
                 self._sock.settimeout(2)
             _send_msg(self._sock, header)
             resp, _ = _recv_msg(self._sock)
@@ -469,7 +475,7 @@ class HAClient:
         while not self._stop.wait(self.interval_s):
             try:
                 stats = self.stats_fn() if self.stats_fn else None
-            except Exception:
+            except Exception:   # noqa: BLE001 — user stats callback:
                 # a metrics read must never kill the heartbeat thread —
                 # that would read as a service failure and trigger repair
                 stats = None

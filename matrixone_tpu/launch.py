@@ -130,7 +130,8 @@ class Launcher:
     def start(self) -> "Launcher":
         try:
             return self._start()
-        except BaseException:       # incl. KeyboardInterrupt mid-launch
+        except BaseException:       # noqa: BLE001 — incl.
+            # KeyboardInterrupt mid-launch; re-raised after cleanup
             # a half-started cluster must not leak orphans holding the
             # ports and the data dir
             self.stop()

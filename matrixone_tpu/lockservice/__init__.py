@@ -112,7 +112,9 @@ class LockService:
                     # lk object identity is stable: our queued ticket keeps
                     # it alive in _locks (unlock_all only deletes entries
                     # with no owners AND no waiters)
-            except BaseException:
+            except BaseException:   # noqa: BLE001 — waiter-ticket
+                # cleanup (incl. KeyboardInterrupt): a leaked ticket
+                # deadlocks every later acquirer; always re-raised
                 try:
                     lk.waiters.remove(ticket)
                 except ValueError:

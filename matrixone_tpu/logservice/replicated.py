@@ -406,8 +406,11 @@ class ReplicatedLog:
         """Standby loop: poll-campaign until the primary's lease lapses
         (the automatic-successor half the VERDICT asked for)."""
         import time as _t
+
+        from matrixone_tpu.cluster.rpc import backoff_delay
         deadline = _t.monotonic() + timeout
         last: Exception = NotLeader("never campaigned")
+        attempt = 0
         while _t.monotonic() < deadline:
             try:
                 return cls(addrs, campaign=True, **kwargs)
@@ -415,7 +418,12 @@ class ReplicatedLog:
                 last = e
             except ConnectionError as e:
                 last = e
-            _t.sleep(poll_s)
+            # jittered, growing poll: rival standbys campaigning in
+            # lockstep re-collide on every lease check; never sleep
+            # past the election deadline
+            attempt += 1
+            _t.sleep(max(0.0, min(max(poll_s, backoff_delay(attempt)),
+                                  deadline - _t.monotonic())))
         raise last
 
     # ---- transport

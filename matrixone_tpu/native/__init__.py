@@ -36,7 +36,8 @@ def _compile() -> bool:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(_SO + ".tmp", _SO)
         return True
-    except Exception:
+    except (subprocess.SubprocessError, OSError):
+        # compiler missing/failed/timed out: numpy fallback paths apply
         return False
 
 

@@ -1127,7 +1127,8 @@ def _dist_shuffle_join(split: _Split, catalog, snap,
         results = peers.run(headers)
         _check_sigs(results[:n], peers.addrs)
         _check_sigs(results[n:], peers.addrs)
-    except Exception:
+    except Exception:   # noqa: BLE001 — peer-side shuffle-state GC for
+        # ANY phase-1 failure (transport, sig mismatch); re-raised
         _shuffle_cleanup(peers, sid)
         raise
     # phase 2: every peer joins its bucket
@@ -1140,8 +1141,8 @@ def _dist_shuffle_join(split: _Split, catalog, snap,
                 for i in range(n)]
     try:
         jres = peers.run(jheaders)
-    except Exception:
-        _shuffle_cleanup(peers, sid)
+    except Exception:   # noqa: BLE001 — peer-side shuffle-state GC,
+        _shuffle_cleanup(peers, sid)    # then re-raised
         raise
     parts = {i: blob for i, (resp, blob) in enumerate(jres)
              if resp.get("n", 0) > 0}

@@ -35,6 +35,8 @@ import time
 from collections import deque
 from typing import Optional
 
+from matrixone_tpu.queryservice import QueryKilled
+
 #: wait-slice granularity: KILL/deadline reaction time while queued
 _SLICE_S = 0.05
 
@@ -192,7 +194,10 @@ class AdmissionController:
                     if registry is not None and conn_id is not None:
                         try:
                             registry.check_killed(conn_id)
-                        except Exception:
+                        except QueryKilled:
+                            # only a REAL kill counts as outcome=killed;
+                            # an internal registry error must surface
+                            # as itself, not skew the shed accounting
                             M.admission_total.inc(lane=lane,
                                                   outcome="killed")
                             raise
@@ -207,7 +212,9 @@ class AdmissionController:
                             f"server busy, retry later")
                     self._cv.wait(min(remaining, _SLICE_S))
                     self._dispatch()
-            except BaseException:
+            except BaseException:    # noqa: BLE001 — cleanup-only,
+                # re-raised below; incl. KeyboardInterrupt so an
+                # interrupted waiter never leaks its queue ticket.
                 # not admitted: leave the queue; admitted mid-exception
                 # (can't happen once removed, but belt and braces):
                 # release the slot

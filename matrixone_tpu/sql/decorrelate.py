@@ -71,8 +71,8 @@ def _collect_locals(from_, catalog, ctes: Dict[str, ast.Select]) -> _Locals:
                 return
             try:
                 meta = catalog.get_table(f.name)
-            except Exception:
-                env.tables[alias] = set()
+            except (KeyError, ValueError):   # unknown table: binder
+                env.tables[alias] = set()    # reports it, not us
                 return
             env.tables[alias] = {c for c, _ in meta.schema}
         elif isinstance(f, ast.SubqueryRef):

@@ -125,7 +125,7 @@ class StatsProvider:
     def table(self, name: str) -> Optional[TableStats]:
         try:
             t = self.catalog.get_table(name)
-        except Exception:
+        except (KeyError, ValueError):   # unknown/concurrently-dropped
             return None
         if getattr(t, "is_external", False):
             return None     # no segment stats for scan-in-place files
@@ -167,6 +167,6 @@ def provider_for(catalog) -> StatsProvider:
         sp = StatsProvider(catalog)
         try:
             catalog._stats_provider = sp
-        except Exception:
+        except AttributeError:    # slotted/proxy catalogs refuse attrs
             pass
     return sp

@@ -809,6 +809,9 @@ class Engine:
                 raise ValueError(
                     f"cannot publish external table {t!r}")
         self.publications[name] = list(tables)
+        # publications are catalog shape: SHOW PUBLICATIONS / subscriber
+        # binds must not serve a cached pre-publication view
+        self.ddl_gen += 1
         if log:
             self.wal.append({"op": "create_publication", "name": name,
                              "tables": list(tables), "ts": self.hlc.now()})
@@ -817,12 +820,14 @@ class Engine:
         if name not in self.publications:
             raise ValueError(f"no such publication {name}")
         del self.publications[name]
+        self.ddl_gen += 1
         if log:
             self.wal.append({"op": "drop_publication", "name": name,
                              "ts": self.hlc.now()})
 
     def mark_source(self, name: str, log: bool = True) -> None:
         self.sources.add(name)
+        self.ddl_gen += 1      # SOURCE flag changes stream-DDL binding
         if log:
             self.wal.append({"op": "mark_source", "name": name,
                              "ts": self.hlc.now()})
@@ -830,6 +835,7 @@ class Engine:
     def register_dynamic(self, name: str, sql: str,
                          log: bool = True) -> None:
         self.dynamic_tables[name] = sql
+        self.ddl_gen += 1
         if log:
             self.wal.append({"op": "create_dynamic", "name": name,
                              "sql": sql, "ts": self.hlc.now()})
@@ -837,6 +843,9 @@ class Engine:
     def create_stage(self, name: str, url: str, log: bool = True) -> None:
         """Durable named external location (pkg/stage analogue)."""
         self.stages[name] = url
+        # stage URLs are resolved at bind time: a cached plan built
+        # against the old mapping would scan the wrong location
+        self.ddl_gen += 1
         if log:
             self.wal.append({"op": "create_stage", "name": name,
                              "url": url, "ts": self.hlc.now()})
@@ -845,6 +854,7 @@ class Engine:
         if name not in self.stages:
             raise ValueError(f"no such stage {name}")
         del self.stages[name]
+        self.ddl_gen += 1
         if log:
             self.wal.append({"op": "drop_stage", "name": name,
                              "ts": self.hlc.now()})
@@ -1422,22 +1432,38 @@ class WalApplier:
                                 header["location"], header["fmt"],
                                 log=False, if_not_exists=True,
                                 snapshot=header.get("snapshot"))
+        # catalog-shape ops route through the Engine methods (log=False)
+        # so the replica's ddl_gen advances exactly like the TN's — a
+        # direct container write here left CN plan/result caches
+        # serving plans pinned to the pre-DDL shape (molint
+        # cache-invalidation's replica-path hole, review round 4)
         elif op == "create_stage":
-            eng.stages[header["name"]] = header["url"]
+            eng.create_stage(header["name"], header["url"], log=False)
         elif op == "drop_stage":
-            eng.stages.pop(header["name"], None)
+            if header["name"] in eng.stages:     # replay-idempotent
+                eng.drop_stage(header["name"], log=False)
         elif op == "create_publication":
             eng.publications[header["name"]] = list(header["tables"])
+            eng.ddl_gen += 1     # direct: the method re-validates
+            #                      member tables, which replay skips
         elif op == "drop_publication":
-            eng.publications.pop(header["name"], None)
+            if header["name"] in eng.publications:   # replay-idempotent
+                del eng.publications[header["name"]]
+                eng.ddl_gen += 1
         elif op == "mark_source":
-            eng.sources.add(header["name"])
+            eng.mark_source(header["name"], log=False)
         elif op == "create_dynamic":
-            eng.dynamic_tables[header["name"]] = header["sql"]
+            eng.register_dynamic(header["name"], header["sql"],
+                                 log=False)
         elif op == "create_snapshot":
+            # direct: create_snapshot() mints a fresh ts and appends
+            # WAL unconditionally; replay must keep the recorded ts
             eng.snapshots[header["name"]] = header["ts"]
+            eng.ddl_gen += 1
         elif op == "drop_snapshot":
-            eng.snapshots.pop(header["name"], None)
+            if header["name"] in eng.snapshots:
+                del eng.snapshots[header["name"]]
+                eng.ddl_gen += 1
         elif op == "insert":
             self.pending.append(("insert", header, blob))
         elif op == "delete":

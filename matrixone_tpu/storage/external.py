@@ -337,7 +337,9 @@ class ExternalTable:
                         return None
                     decoded += step
                     chunks.append((arrays, validity, n))
-            except BaseException:
+            except BaseException:   # noqa: BLE001 — byte-accounting
+                # rollback only (incl. KeyboardInterrupt mid-decode),
+                # always re-raised
                 with ExternalTable._cache_acct_lock:
                     ExternalTable._cache_used -= decoded
                 raise

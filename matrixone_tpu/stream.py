@@ -260,7 +260,8 @@ def refresh_dynamic_table(session, name: str) -> int:
                 f"insert into {name} ({', '.join(cols)}) values "
                 + ", ".join(rows))
         session.execute("commit")
-    except Exception:
+    except Exception:   # noqa: BLE001 — rollback for ANY mid-batch
+        # failure (bind, constraint, transport), then re-raised
         session.execute("rollback")
         raise
     return n

@@ -62,8 +62,10 @@ class TxnHandle:
             if self.state == TxnState.ACTIVE:
                 self.engine.locks.unlock_all(self._txn_id)
                 self._close()
-        except Exception:
-            pass
+        except Exception:   # noqa: BLE001 — __del__ runs during GC /
+            pass            # interpreter teardown; raising here aborts
+                            # unrelated code and half-torn modules make
+                            # any exception type possible
 
     def ws(self, table: str) -> TableWorkspace:
         return self.workspace.setdefault(table, TableWorkspace())
@@ -114,7 +116,9 @@ class TxnHandle:
         try:
             affected = self.engine.commit_txn(self.snapshot_ts, inserts,
                                               deletes)
-        except Exception:
+        except Exception:   # noqa: BLE001 — abort/unlock cleanup for
+            # ANY commit failure (conflict, constraint, transport,
+            # injected fault); always re-raised
             self.state = TxnState.ABORTED
             self.engine.locks.unlock_all(self.txn_id)
             self._close()

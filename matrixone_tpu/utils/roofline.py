@@ -61,8 +61,9 @@ def cost_of(fn: Callable, *args, static_argnames=(), **kwargs) -> dict:
     compiled = jitted.lower(*args, **kwargs).compile()
     try:
         ca = _as_dict(compiled.cost_analysis())
-    except Exception:                        # backend without cost model
-        ca = {}
+    except Exception:   # noqa: BLE001 — backend without cost model:
+        ca = {}         # XLA raises backend-specific types we cannot
+                        # enumerate; diagnostics degrade to zeros
     return {
         "flops": float(ca.get("flops", 0.0)),
         "bytes": float(ca.get("bytes accessed", 0.0)),

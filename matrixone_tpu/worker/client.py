@@ -41,13 +41,32 @@ class WorkerClient:
             else 1
         op = str(header.get("op", ""))
         payload = pack(header, blob)     # once: retries re-send as-is
+        dl = _rpc.current_deadline()
         for attempt in range(attempts):
             if attempt:
                 M.rpc_retries.inc(op=op)
-                _time.sleep(_rpc.backoff_delay(attempt))
+                delay = _rpc.backoff_delay(attempt)
+                if dl is not None:
+                    # never sleep the budget away: keep at least half
+                    # the remaining time for the retry itself (sleeping
+                    # exactly `remaining` converts a recoverable blip
+                    # into sleep-until-deadline-then-fail)
+                    delay = min(delay, max(0.0, dl.remaining() * 0.5))
+                _time.sleep(delay)
+            if dl is not None and dl.expired():
+                M.rpc_errors.inc(kind="deadline", op=op)
+                raise _rpc.DeadlineExceeded(
+                    f"worker {self.address}: caller deadline exhausted "
+                    f"after {attempt} attempt(s)")
             M.rpc_attempts.inc(op=op)
             try:
-                resp = self._run(payload)
+                # the gRPC timeout re-enters the caller's remaining
+                # budget — without it a wedged worker holds the CN
+                # thread past every deadline upstream
+                resp = self._run(
+                    payload,
+                    timeout=(max(0.001, dl.remaining())
+                             if dl is not None else None))
                 break
             except grpc.RpcError as e:
                 code = e.code() if hasattr(e, "code") else None

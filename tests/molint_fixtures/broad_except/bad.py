@@ -1,0 +1,15 @@
+"""broad-except fixture: unjustified broad handlers.  AST-only."""
+
+
+def swallow(fn):
+    try:
+        return fn()
+    except Exception:
+        return None
+
+
+def swallow_bare(fn):
+    try:
+        return fn()
+    except:  # bare, no justification comment
+        return None

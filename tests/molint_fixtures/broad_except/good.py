@@ -1,0 +1,15 @@
+"""broad-except fixture (clean): narrowed types, justified broads."""
+
+
+def narrow(fn):
+    try:
+        return fn()
+    except (OSError, ValueError):
+        return None
+
+
+def justified(fn):
+    try:
+        return fn()
+    except Exception:   # noqa: BLE001 — user callback: any failure
+        return None     # degrades to the fallback path, never crashes

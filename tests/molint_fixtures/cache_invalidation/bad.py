@@ -1,0 +1,23 @@
+"""cache-invalidation fixture: catalog mutations with no ddl_gen bump;
+an index_obj swap with a stale dirty flag.  AST-only."""
+
+
+class Engine:
+    def __init__(self):
+        self.ddl_gen = 0
+        self.tables = {}
+        self.stages = {}
+        self.sources = set()
+
+    def drop_table(self, name):
+        del self.tables[name]              # no bump: caches go stale
+
+    def create_stage(self, name, url):
+        self.stages[name] = url            # no bump
+
+    def mark_source(self, name):
+        self.sources.add(name)             # no bump
+
+
+def swap_index(ix, new_obj):
+    ix.index_obj = new_obj                 # .dirty never written

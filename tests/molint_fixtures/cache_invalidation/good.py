@@ -1,0 +1,27 @@
+"""cache-invalidation fixture (clean): every catalog mutation bumps
+ddl_gen; index_obj swaps update the dirty flag."""
+
+
+class Engine:
+    def __init__(self):
+        self.ddl_gen = 0
+        self.tables = {}
+        self.stages = {}
+        self.sources = set()
+
+    def drop_table(self, name):
+        del self.tables[name]
+        self.ddl_gen += 1
+
+    def create_stage(self, name, url):
+        self.stages[name] = url
+        self.ddl_gen += 1
+
+    def mark_source(self, name):
+        self.sources.add(name)
+        self.ddl_gen += 1
+
+
+def swap_index(ix, new_obj):
+    ix.index_obj = new_obj
+    ix.dirty = False

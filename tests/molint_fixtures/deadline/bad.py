@@ -1,0 +1,22 @@
+"""deadline-propagation fixture: hardcoded socket timeout, flat retry
+sleep, dropped deadline_ms.  AST-only."""
+
+import time
+
+
+def fetch(sock):
+    sock.settimeout(5)                     # hardcoded deadline
+    return sock.recv(4096)
+
+
+def retry(fn):
+    for _attempt in range(5):
+        try:
+            return fn()
+        except ConnectionError:
+            time.sleep(0.5)                # flat sleep in a retry loop
+    raise ConnectionError("out of attempts")
+
+
+def offload(client, u, args, valid):
+    return client.udf_eval(u, args, valid)   # deadline_ms dropped

@@ -1,0 +1,28 @@
+"""deadline-propagation fixture (clean): derived timeouts, jittered
+backoff, threaded deadline_ms."""
+
+import time
+
+from matrixone_tpu.cluster.rpc import backoff_delay, current_deadline
+
+
+def fetch(sock):
+    dl = current_deadline()
+    sock.settimeout(max(0.001, dl.remaining()) if dl else None)
+    return sock.recv(4096)
+
+
+def retry(fn):
+    for attempt in range(5):
+        try:
+            return fn()
+        except ConnectionError:
+            time.sleep(backoff_delay(attempt + 1))
+    raise ConnectionError("out of attempts")
+
+
+def offload(client, u, args, valid):
+    dl = current_deadline()
+    return client.udf_eval(
+        u, args, valid,
+        deadline_ms=dl.remaining() * 1000 if dl else None)

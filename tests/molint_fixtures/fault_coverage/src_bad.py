@@ -1,0 +1,10 @@
+"""fault-coverage fixture source: a live site no test arms.
+AST-only."""
+
+from matrixone_tpu.utils.fault import INJECTOR
+
+
+def read_block(path):
+    if INJECTOR.trigger("cover.me") == "fail":
+        raise IOError(f"fault injected: {path}")
+    return b"ok"

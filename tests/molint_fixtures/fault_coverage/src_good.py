@@ -1,0 +1,10 @@
+"""fault-coverage fixture source (clean): the site is armed by
+arm_good.py in tests_good/."""
+
+from matrixone_tpu.utils.fault import INJECTOR
+
+
+def read_block(path):
+    if INJECTOR.trigger("cover.me") == "fail":
+        raise IOError(f"fault injected: {path}")
+    return b"ok"

@@ -1,0 +1,9 @@
+"""fault-coverage fixture drill (bad): arms a site that does not exist
+— and never arms 'cover.me'.  Not named test_* so pytest never
+collects it; molint scans every .py in the tests corpus."""
+
+from matrixone_tpu.utils.fault import INJECTOR
+
+
+def drill():
+    INJECTOR.add("no.such", "return", "fail", times=1)

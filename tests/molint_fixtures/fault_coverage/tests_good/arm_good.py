@@ -1,0 +1,9 @@
+"""fault-coverage fixture drill (clean): arms the live site both by
+API and by SQL spec literal."""
+
+from matrixone_tpu.utils.fault import INJECTOR
+
+
+def drill(session):
+    INJECTOR.add("cover.me", "return", "fail", times=1)
+    session.execute("set fault_point = 'cover.me:return:fail'")

@@ -1,0 +1,44 @@
+"""jit-purity fixture: every impurity class in one reachable graph.
+AST-only — never imported or executed."""
+
+import random
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_SCRATCH = {}
+
+
+class Shadow:
+    # same bare name as the free helper below: the index must keep
+    # BOTH definitions, not let this one shadow the impure helper
+    def _helper(self):
+        return 0
+
+
+def _helper(x):
+    # reachable from the jitted kernel below: wall-clock read
+    return x * time.perf_counter()
+
+
+@partial(jax.jit, static_argnames=("k",))
+def kernel(x, k):
+    y = _helper(x)
+    r = random.random()            # stateful RNG draw
+    s = np.random.rand()           # numpy global RNG
+    _SCRATCH["last"] = k           # module-global mutation
+    v = float(x)                   # concretization of a traced value
+    h = x.item()                   # host sync
+    return y + r + s + v + h
+
+
+def _inner(x):
+    global _MODE                   # module-global declaration
+    _MODE = "fast"
+    return jnp.sum(x)
+
+
+_inner_jit = jax.jit(_inner)

@@ -1,0 +1,27 @@
+"""jit-purity fixture (clean): pure jitted kernels; impure host code
+that is NOT reachable from any jit root."""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _pure_helper(x):
+    return jnp.where(x > 0, x, -x)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def kernel(x, k):
+    y = _pure_helper(x)
+    key = jax.random.PRNGKey(0)            # functional RNG is fine
+    noise = jax.random.normal(key, y.shape)
+    return jnp.sum(y + noise) * k
+
+
+def host_bench(x):
+    # host side: calls INTO the jit root, is not reachable FROM it
+    t0 = time.perf_counter()
+    out = kernel(x, 2)
+    return out, time.perf_counter() - t0

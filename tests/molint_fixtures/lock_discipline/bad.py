@@ -1,0 +1,39 @@
+"""lock-discipline fixture: unscoped acquire, blocking under the commit
+lock, and a lock-order cycle.  AST-only."""
+
+import threading
+import time
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+
+class Engine:
+    def __init__(self):
+        self._commit_lock = threading.RLock()
+        self._lock = threading.Lock()
+
+    def leaky(self):
+        self._lock.acquire()           # unscoped: leaks on exception
+        try:
+            pass
+        finally:
+            self._lock.release()
+
+    def stalls_writers(self, client, sock):
+        with self._commit_lock:
+            time.sleep(0.1)            # blocking under the commit lock
+            client.call({"op": "x"})
+            sock.sendall(b"x")
+
+
+def ab():
+    with a_lock:
+        with b_lock:
+            pass
+
+
+def ba():
+    with b_lock:
+        with a_lock:                    # cycle with ab(): deadlock
+            pass

@@ -1,0 +1,56 @@
+"""lock-discipline fixture (clean): with-scoped locks, one global
+acquisition order, nothing blocking under the commit lock."""
+
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+
+class Engine:
+    def __init__(self):
+        self._commit_lock = threading.RLock()
+        self._lock = threading.Lock()
+
+    def scoped(self):
+        with self._lock:
+            return 1
+
+    def commit(self, rows):
+        with self._commit_lock:
+            total = sum(rows)      # pure compute under the lock is fine
+            return total
+
+
+def ab():
+    with a_lock:
+        with b_lock:
+            pass
+
+
+def also_ab():
+    with a_lock:                    # same order everywhere: no cycle
+        with b_lock:
+            pass
+
+
+class PoolA:
+    def close(self):
+        with self._pool_lock:
+            self.flush()
+
+    def flush(self):
+        with self._io_lock:
+            pass
+
+
+class PoolB:
+    # same method NAMES as PoolA but its own locks in the opposite
+    # order — distinct classes must not union into a phantom cycle
+    def close(self):
+        with self._io2_lock:
+            self.flush()
+
+    def flush(self):
+        with self._pool2_lock:
+            pass

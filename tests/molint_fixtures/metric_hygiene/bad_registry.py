@@ -1,0 +1,12 @@
+"""metric-hygiene fixture registry: duplicate + badly-named + dead
+registrations.  AST-only."""
+
+from matrixone_tpu.utils.metrics import Registry
+
+REGISTRY = Registry()
+
+mo_good = REGISTRY.counter("mo_good_total", "driven, fine")
+mo_dup = REGISTRY.counter("mo_dup_total", "first registration")
+mo_dup2 = REGISTRY.counter("mo_dup_total", "second: duplicate")
+mo_dead = REGISTRY.gauge("mo_dead_gauge", "registered, never driven")
+bad_name = REGISTRY.counter("notMoPrefixed", "violates mo_* naming")

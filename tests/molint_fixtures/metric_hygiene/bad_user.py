@@ -1,0 +1,10 @@
+"""metric-hygiene fixture user module: f-string labels, forked label
+sets, out-of-registry registration.  AST-only."""
+
+from tests.molint_fixtures.metric_hygiene import bad_registry as M
+
+
+def record(peer, registry):
+    M.mo_good.inc(kind=f"peer-{peer}")       # f-string label value
+    M.mo_good.inc()                          # differing label key set
+    M.REGISTRY.counter("mo_inline_total")    # registered outside registry

@@ -1,0 +1,8 @@
+"""metric-hygiene fixture registry (clean)."""
+
+from matrixone_tpu.utils.metrics import Registry
+
+REGISTRY = Registry()
+
+mo_ok = REGISTRY.counter("mo_ok_total", "lookups by outcome")
+mo_depth = REGISTRY.gauge("mo_ok_depth", "resident entries")

@@ -1,0 +1,10 @@
+"""metric-hygiene fixture user module (clean): literal labels, one
+stable key set per metric, everything driven."""
+
+from tests.molint_fixtures.metric_hygiene import good_registry as M
+
+
+def record(outcome_name, n):
+    M.mo_ok.inc(outcome="hit")
+    M.mo_ok.inc(outcome=outcome_name)    # a pre-bound name is fine
+    M.mo_depth.set(n)

@@ -323,6 +323,36 @@ def test_object_write_fault_checkpoint_retries(rig):
     assert [(int(a), int(b)) for a, b in rows] == [(1, 1), (2, 2)]
 
 
+def test_object_read_fault_fails_scan_cleanly():
+    """A storage read failure during a COLD scan (object-backed lazy
+    segments, empty block cache) surfaces as a clean error — no hang,
+    no partial rows — and the identical scan succeeds once the fault
+    clears.  Covers the object.read degrade path of the out-of-core
+    read seam (molint fault-coverage flagged it as never drilled)."""
+    from matrixone_tpu.storage import blockcache
+    from matrixone_tpu.storage.engine import Engine
+    from matrixone_tpu.storage.fileservice import LocalFS
+    d = tempfile.mkdtemp(prefix="mo_objread_")
+    s = Session(catalog=Engine(LocalFS(d)))
+    s.execute("create table orf (id bigint primary key, v bigint)")
+    s.execute("insert into orf values (1, 10), (2, 20)")
+    s.catalog.checkpoint()
+    # reopen: segments reference objects lazily, nothing in RAM
+    s2 = Session(catalog=Engine.open(LocalFS(d)))
+    blockcache.CACHE.clear()
+    INJECTOR.add("object.read", "return", "fail")
+    try:
+        with pytest.raises(Exception) as ei:
+            s2.execute("select id, v from orf order by id").rows()
+        assert "object.read" in str(ei.value)
+    finally:
+        INJECTOR.clear()     # an assertion failure must not leak the
+        #                      armed fault into every later cold read
+    blockcache.CACHE.clear()
+    rows = s2.execute("select id, v from orf order by id").rows()
+    assert [(int(a), int(b)) for a, b in rows] == [(1, 10), (2, 20)]
+
+
 # ------------------------------------------------ operational surfacing
 def test_fault_and_breaker_status_builtins(rig):
     """Satellite: FaultInjector + breaker state are queryable in SQL

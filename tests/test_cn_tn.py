@@ -48,6 +48,29 @@ def _sync(*cats):
         c.consumer.wait_ts(ts)
 
 
+def test_replica_ddl_gen_tracks_catalog_shape_ops(tn_pair):
+    """Stage/publication/source/dynamic/snapshot DDL must advance the
+    REPLICA's ddl_gen through the logtail apply path, not only the
+    TN's — a CN plan/result cache pinned to a stale gen would keep
+    resolving the pre-DDL stage URL / publication set (the replica-side
+    hole molint's cache-invalidation rule flagged)."""
+    tn, cat1, cat2 = tn_pair
+    s1 = Session(catalog=cat1)
+    s1.execute("create table pt (id bigint primary key)")
+    _sync(cat1, cat2)
+    for ddl in ("create stage st9 url='file:///tmp/st9'",
+                "drop stage st9",
+                "create publication p9 table pt",
+                "drop publication p9",
+                "create snapshot sn9"):
+        g2 = cat2.ddl_gen
+        s1.execute(ddl)
+        # _ddl blocks until CN1's replica applied; CN2 may lag behind
+        cat2.consumer.wait_ts(cat1.consumer.applied_ts)
+        assert cat2.ddl_gen > g2, \
+            f"replica ddl_gen did not advance on {ddl!r}"
+
+
 def test_cross_cn_visibility_and_snapshots(tn_pair):
     tn, cat1, cat2 = tn_pair
     s1, s2 = Session(catalog=cat1), Session(catalog=cat2)

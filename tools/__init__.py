@@ -1,0 +1,1 @@
+# repo tooling package (`python -m tools.molint`, `python -m tools.precheck`)

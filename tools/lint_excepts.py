@@ -1,62 +1,39 @@
 #!/usr/bin/env python
-"""Fail on new broad exception swallowing in the cluster/frontend lanes.
+"""Thin shim over `tools.molint`'s broad-except checker (the original
+standalone linter was folded into the molint suite, which now covers
+the WHOLE package rather than four hand-picked lanes).
 
-A bare `except Exception`/`except BaseException`/`except:` in the RPC or
-wire-protocol layers is how partial failures turn into silent data loss —
-every broad catch there must either narrow its type or carry a
-`# noqa: BLE001` comment with a justification (the convention the
-existing annotated sites follow).
+Kept so existing invocations and CI wiring don't break:
 
 Usage: python tools/lint_excepts.py [repo_root]
 Exit 0 = clean, 1 = findings (printed one per line as path:lineno).
+
+New code should run `python -m tools.molint` (all rules) or
+`python -m tools.molint --rule broad-except`.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-#: lanes where broad catches must be justified — the RPC/wire layers,
-#: plus UDF execution and the worker service (user code runs there: a
-#: silent broad except is exactly where a body error becomes wrong rows)
-LINT_DIRS = ("matrixone_tpu/cluster", "matrixone_tpu/frontend",
-             "matrixone_tpu/udf", "matrixone_tpu/worker")
-
-#: bare `except:` or any except clause naming Exception/BaseException —
-#: including tuple forms like `except (Exception, ValueError):`
-_BROAD = re.compile(
-    r"^\s*except\s*(:|[^:]*\b(Exception|BaseException)\b)")
-_NOQA = re.compile(r"#\s*noqa")
-
-
-def scan_file(path: str):
-    findings = []
-    with open(path, encoding="utf-8") as f:
-        lines = f.readlines()
-    for i, line in enumerate(lines, 1):
-        if not _BROAD.match(line):
-            continue
-        # the noqa may sit on the except line itself or (for short
-        # lines) be the sole content of the line directly above
-        prev = lines[i - 2] if i >= 2 else ""
-        if _NOQA.search(line) or _NOQA.search(prev):
-            continue
-        findings.append((path, i, line.strip()))
-    return findings
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))   # script-mode: find tools/
 
 
 def main(root: str = ".") -> int:
-    findings = []
-    for d in LINT_DIRS:
-        base = os.path.join(root, d)
-        for dirpath, _dirs, files in os.walk(base):
-            for fn in sorted(files):
-                if fn.endswith(".py"):
-                    findings.extend(scan_file(os.path.join(dirpath, fn)))
-    for path, lineno, text in findings:
-        print(f"{path}:{lineno}: unjustified broad except "
-              f"(add a narrower type or '# noqa: BLE001 — why'): {text}")
+    from tools import molint
+    root = os.path.abspath(root)
+    findings, _stats = molint.run_checks(
+        root, src_paths=[os.path.join(root, "matrixone_tpu")],
+        rules=["broad-except"], record=False)
+    # the runner also surfaces parse/suppression meta-findings; this
+    # legacy surface reports ONLY its own rule (run the full
+    # `python -m tools.molint` for everything else)
+    findings = [f for f in findings if f.rule == "broad-except"]
+    for f in findings:
+        # f.message already carries the full guidance text
+        print(f"{f.path}:{f.lineno}: {f.message}")
     if findings:
         print(f"{len(findings)} finding(s)", file=sys.stderr)
         return 1

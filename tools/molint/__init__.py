@@ -1,0 +1,415 @@
+"""molint — AST-driven invariant checkers for the cross-cutting
+conventions this codebase is built on.
+
+The correctness of the engine rests on rules no type system sees:
+"never block under the commit lock", "every RPC carries the caller's
+deadline", "a catalog write bumps ddl_gen in the same function",
+"jitted bodies are trace-pure", "every mo_* metric is registered
+exactly once", "every fault site has a chaos drill".  The reference
+system holds its 1.94M lines together with `go vet`, the race detector
+and bespoke linters; this is the Python analogue: a shared file walker,
+one checker per invariant, and a tier-1 gate (tests/test_molint.py)
+that fails the build when a new subsystem re-breaks an old rule.
+
+Findings print as `path:lineno rule message`.  A finding is silenced by
+a suppression comment on the offending line (or a standalone comment on
+the line directly above):
+
+    # molint: disable=<rule>[,<rule>] -- <justification, required>
+
+The justification text is mandatory — an unexplained suppression is
+itself a finding (rule `suppression`).  `# molint: disable-file=<rule>
+-- why` anywhere in a file's first 20 lines suppresses the rule for the
+whole file.  The broad-except checker additionally honours the legacy
+`# noqa` convention inherited from tools/lint_excepts.py.
+
+Programmatic surface (used by mo_ctl('lint', ...) and the tests):
+
+    findings, stats = molint.run_checks(root)        # scan <root>/matrixone_tpu
+    molint.last_run_status()                         # ops introspection
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: directories never scanned (as path components)
+SKIP_DIRS = {"__pycache__", ".git", ".claude", "node_modules",
+             "molint_fixtures"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*molint:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_,-]+)\s*(?P<rest>.*)$")
+#: the justification follows the rule list after any dash/em-dash/colon
+_JUST_STRIP = re.compile(r"^[\s:;—-]+")
+
+
+class Finding:
+    """One invariant violation at a source location."""
+
+    __slots__ = ("rule", "path", "lineno", "message")
+
+    def __init__(self, rule: str, path: str, lineno: int, message: str):
+        self.rule = rule
+        self.path = path
+        self.lineno = int(lineno)
+        self.message = message
+
+    def format(self) -> str:
+        return f"{self.path}:{self.lineno} {self.rule} {self.message}"
+
+    def sort_key(self):
+        return (self.path, self.lineno, self.rule)
+
+    def as_dict(self) -> dict:
+        return {"path": self.path, "lineno": self.lineno,
+                "rule": self.rule, "message": self.message}
+
+    def __repr__(self):
+        return f"<Finding {self.format()}>"
+
+
+class Suppression:
+    __slots__ = ("lineno", "rules", "justification", "target_line",
+                 "file_level", "wants_file_level", "used")
+
+    def __init__(self, lineno: int, rules: List[str], justification: str,
+                 target_line: int, file_level: bool,
+                 wants_file_level: bool = False):
+        self.lineno = lineno
+        self.rules = rules
+        self.justification = justification
+        #: the code line this suppression covers: its own line, or (for
+        #: a standalone comment, possibly wrapped over several comment
+        #: lines) the next non-comment line below it
+        self.target_line = target_line
+        self.file_level = file_level
+        #: a disable-file= comment past the line-20 window: inert as
+        #: file-level — surfaced by the meta-rule instead of silently
+        #: downgrading to a one-line suppression
+        self.wants_file_level = wants_file_level
+        self.used = False
+
+    def covers(self, rule: str, lineno: int) -> bool:
+        if rule not in self.rules and "all" not in self.rules:
+            return False
+        if self.file_level:
+            return True
+        return lineno in (self.lineno, self.target_line)
+
+
+class PyModule:
+    """One parsed source file: path (repo-relative when possible), text,
+    lines, AST, suppressions.  `tree` is None when the file does not
+    parse — the runner reports that as a `parse` finding."""
+
+    def __init__(self, abspath: str, relpath: str):
+        self.abspath = abspath
+        self.path = relpath
+        try:
+            with open(abspath, encoding="utf-8") as f:
+                self.text = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            # unreadable/mis-encoded file: a `parse` finding, not a
+            # crashed gate
+            self.text = ""
+            self.lines = []
+            self.tree: Optional[ast.AST] = None
+            self.parse_error: Optional[str] = str(e)
+            self.modname = ""
+            self.suppressions: List[Suppression] = []
+            return
+        self.lines = self.text.splitlines()
+        try:
+            self.tree = ast.parse(self.text)
+            self.parse_error = None
+        except SyntaxError as e:
+            self.tree = None
+            self.parse_error = f"{e.msg} (line {e.lineno})"
+        #: dotted module name guess (for import resolution)
+        mod = relpath[:-3] if relpath.endswith(".py") else relpath
+        mod = mod.replace(os.sep, ".").replace("/", ".")
+        if mod.endswith(".__init__"):
+            mod = mod[: -len(".__init__")]
+        self.modname = mod
+        self.suppressions = self._parse_suppressions()
+
+    def _parse_suppressions(self) -> List[Suppression]:
+        out = []
+        for i, line in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = [r.strip() for r in m.group("rules").split(",")
+                     if r.strip()]
+            just = _JUST_STRIP.sub("", m.group("rest")).strip()
+            standalone = line[: m.start()].strip() == ""
+            target = i
+            if standalone:
+                # a wrapped justification continues on comment lines;
+                # the suppression covers the first code line below
+                j = i
+                while j < len(self.lines) and (
+                        not self.lines[j].strip()
+                        or self.lines[j].strip().startswith("#")):
+                    j += 1
+                target = j + 1
+            wants_file = bool(m.group("file"))
+            file_level = wants_file and i <= 20
+            out.append(Suppression(i, rules, just, target, file_level,
+                                   wants_file_level=wants_file))
+        return out
+
+
+class Project:
+    """Everything the checkers see: parsed source modules plus (for the
+    coverage-style checkers) parsed test modules.  `complete` says the
+    scan covers the whole default package — corpus-global sub-rules
+    (armed-spec resolution, dead metrics) only make sense then, and
+    skip themselves on partial scans of a few files."""
+
+    def __init__(self, root: str, src_paths: List[str],
+                 tests_dir: Optional[str] = None,
+                 complete: bool = True):
+        self.root = os.path.abspath(root)
+        self.complete = complete
+        self.modules: List[PyModule] = []
+        self.test_modules: List[PyModule] = []
+        for p in src_paths:
+            self.modules.extend(self._load_tree(p))
+        if tests_dir and os.path.isdir(tests_dir):
+            self.test_modules = self._load_tree(tests_dir)
+
+    def _load_tree(self, path: str) -> List[PyModule]:
+        path = os.path.abspath(path)
+        mods: List[PyModule] = []
+        if os.path.isfile(path):
+            mods.append(PyModule(path, self._rel(path)))
+            return mods
+        for dirpath, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs if d not in SKIP_DIRS)
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    ap = os.path.join(dirpath, fn)
+                    mods.append(PyModule(ap, self._rel(ap)))
+        return mods
+
+    def _rel(self, abspath: str) -> str:
+        rel = os.path.relpath(abspath, self.root)
+        return abspath if rel.startswith("..") else rel
+
+    def module_by_suffix(self, suffix: str) -> Optional[PyModule]:
+        for m in self.modules:
+            if m.path.endswith(suffix):
+                return m
+        return None
+
+
+class Checker:
+    """Base class.  Subclasses set `rule` + `description` and implement
+    check(project, config) -> iterable of Finding.  `config` is the
+    rule's entry from the merged config dict (overridable per run — the
+    fixture tests point registry/tests paths at snippets)."""
+
+    rule = "?"
+    description = "?"
+    default_config: dict = {}
+
+    def check(self, project: Project,
+              config: dict) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+def all_checkers() -> List[Checker]:
+    from tools.molint import checkers
+    return [cls() for cls in checkers.ALL]
+
+
+def rule_table() -> List[Tuple[str, str]]:
+    return [(c.rule, c.description) for c in all_checkers()]
+
+
+def _apply_suppressions(project: Project, findings: List[Finding]):
+    """Drop findings covered by a valid suppression; emit `suppression`
+    findings for disable comments with no justification.  Returns
+    (kept_findings, suppressed_count)."""
+    by_path: Dict[str, PyModule] = {m.path: m for m in
+                                    project.modules + project.test_modules}
+    known = {c.rule for c in all_checkers()} | {"all", "parse"}
+    kept: List[Finding] = []
+    suppressed = 0
+    for f in findings:
+        mod = by_path.get(f.path)
+        sup = None
+        if mod is not None:
+            for s in mod.suppressions:
+                if s.justification and s.covers(f.rule, f.lineno):
+                    sup = s
+                    break
+        if sup is not None:
+            sup.used = True
+            suppressed += 1
+        else:
+            kept.append(f)
+    # meta-rule: every disable comment must carry a justification and
+    # name real rules (an unexplained or misspelled suppression silently
+    # rots — the next reader cannot tell intent from typo).  Test files
+    # are covered too: their suppressions are honored above, so their
+    # malformations must be reported symmetrically
+    for mod in project.modules + project.test_modules:
+        for s in mod.suppressions:
+            if not s.justification:
+                kept.append(Finding(
+                    "suppression", mod.path, s.lineno,
+                    "suppression comment has no justification text "
+                    "(write `# molint: disable=<rule> -- why`)"))
+            if s.wants_file_level and not s.file_level:
+                kept.append(Finding(
+                    "suppression", mod.path, s.lineno,
+                    "disable-file= only works within a file's first "
+                    "20 lines — this one is inert as file-level "
+                    "(it covers only its own line)"))
+            for r in s.rules:
+                if r not in known:
+                    kept.append(Finding(
+                        "suppression", mod.path, s.lineno,
+                        f"unknown rule {r!r} in suppression comment"))
+    return kept, suppressed
+
+
+#: last completed run, for mo_ctl('lint','status') introspection
+LAST_RUN: Optional[dict] = None
+
+
+def run_checks(root: str, src_paths: Optional[List[str]] = None,
+               tests_dir: Optional[str] = None,
+               rules: Optional[List[str]] = None,
+               config: Optional[Dict[str, dict]] = None,
+               record: bool = True):
+    """Run the suite.  `root` anchors relative finding paths; scan
+    defaults to <root>/matrixone_tpu with <root>/tests as the test
+    corpus.  Returns (findings, stats)."""
+    global LAST_RUN
+    root = os.path.abspath(root)
+    default_pkg = os.path.join(root, "matrixone_tpu")
+    if src_paths is None:
+        src_paths = [default_pkg]
+    if tests_dir is None:
+        cand = os.path.join(root, "tests")
+        tests_dir = cand if os.path.isdir(cand) else None
+    # scanning the whole default package (implicitly or by naming it)
+    # gives the corpus-global sub-rules their full context; a partial
+    # file/dir scan does not, and they skip themselves (checkers read
+    # project.complete) instead of mass-reporting false gaps
+    complete = [os.path.normpath(os.path.abspath(p))
+                for p in src_paths] == [os.path.normpath(default_pkg)]
+    project = Project(root, src_paths, tests_dir, complete=complete)
+    checkers = all_checkers()
+    if rules:
+        want = set(rules)
+        unknown = want - {c.rule for c in checkers}
+        if unknown:
+            raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+        checkers = [c for c in checkers if c.rule in want]
+    findings: List[Finding] = []
+    # test modules included: an unparseable test file silently drops
+    # its armed fault specs, and fault-coverage would then blame
+    # healthy source sites as "never armed"
+    for mod in project.modules + project.test_modules:
+        if mod.tree is None:
+            findings.append(Finding("parse", mod.path, 1,
+                                    f"file does not parse: "
+                                    f"{mod.parse_error}"))
+    for c in checkers:
+        cfg = dict(c.default_config)
+        cfg.update((config or {}).get(c.rule, {}))
+        findings.extend(c.check(project, cfg))
+    findings, suppressed = _apply_suppressions(project, findings)
+    if rules:
+        findings = [f for f in findings
+                    if f.rule in set(rules) | {"parse", "suppression"}]
+    findings.sort(key=Finding.sort_key)
+    stats = {"checkers": len(checkers),
+             "files": len(project.modules),
+             "findings": len(findings),
+             "suppressions_used": suppressed,
+             "rules": sorted(c.rule for c in checkers)}
+    if record:
+        LAST_RUN = dict(stats)
+        LAST_RUN["ts"] = time.time()
+        LAST_RUN["findings_list"] = [f.format() for f in findings[:50]]
+    return findings, stats
+
+
+def last_run_status() -> dict:
+    """mo_ctl('lint','status') payload: suite shape + last-run summary."""
+    st = {"checkers": len(all_checkers()),
+          "rules": sorted(c.rule for c in all_checkers())}
+    if LAST_RUN is None:
+        st["last_run"] = None
+    else:
+        st["last_run"] = {k: LAST_RUN[k]
+                          for k in ("findings", "files",
+                                    "suppressions_used", "ts")}
+        st["last_run"]["findings_list"] = LAST_RUN["findings_list"]
+    return st
+
+
+def repo_root() -> str:
+    """The repo this tools/ package sits in."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import json
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.molint",
+        description="AST-driven invariant checkers (see README "
+                    "'Static analysis').")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: matrixone_tpu/)")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule (repeatable, or comma-"
+                         "separated)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as a JSON array")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: inferred from tools/)")
+    ap.add_argument("--tests", default=None,
+                    help="test corpus dir for the coverage checkers "
+                         "(default: <root>/tests)")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for rule, desc in rule_table():
+            print(f"{rule:22s} {desc}")
+        return 0
+    root = os.path.abspath(args.root or repo_root())
+    src = [os.path.abspath(p) for p in args.paths] or None
+    rules = None
+    if args.rule:
+        rules = [r for part in args.rule for r in part.split(",") if r]
+    try:
+        findings, stats = run_checks(root, src_paths=src,
+                                     tests_dir=args.tests, rules=rules)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps([f.as_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+    if findings:
+        print(f"{len(findings)} finding(s) across {stats['files']} "
+              f"file(s); {stats['suppressions_used']} suppressed",
+              file=sys.stderr)
+        return 1
+    return 0

@@ -1,0 +1,10 @@
+"""`python -m tools.molint [paths...] [--rule X] [--json]` — run the
+invariant checker suite standalone (CI wires it through
+`python -m tools.precheck`)."""
+
+import sys
+
+from tools.molint import main
+
+if __name__ == "__main__":
+    sys.exit(main())

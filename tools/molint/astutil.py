@@ -1,0 +1,104 @@
+"""Small shared AST helpers for the molint checkers."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def aliases_of(mod) -> Dict[str, str]:
+    """Cached import_aliases for a PyModule (walking the whole tree per
+    function turns the suite O(n^2) — the 12s hot spot the first
+    profile found)."""
+    cached = getattr(mod, "_molint_aliases", None)
+    if cached is None:
+        cached = import_aliases(mod.tree) if mod.tree is not None else {}
+        mod._molint_aliases = cached
+    return cached
+
+
+def import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """local name -> dotted module/symbol it refers to, from every
+    import statement in the file (module-level and nested)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+class FuncInfo:
+    __slots__ = ("node", "name", "qualname", "classname", "module")
+
+    def __init__(self, node, name, qualname, classname, module):
+        self.node = node
+        self.name = name
+        self.qualname = qualname
+        self.classname = classname
+        self.module = module            # PyModule
+
+
+def iter_functions(mod) -> Iterator[FuncInfo]:
+    """Every function/method in a module with its enclosing class (one
+    level — nested defs inherit the outer function's class)."""
+    if mod.tree is None:
+        return
+
+    def walk(node, classname: Optional[str], prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                yield FuncInfo(child, child.name, qn, classname, mod)
+                yield from walk(child, classname, qn + ".")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, child.name, child.name + ".")
+            else:
+                yield from walk(child, classname, prefix)
+
+    yield from walk(mod.tree, None, "")
+
+
+def walk_skip_nested_funcs(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested function
+    definitions (their bodies run at another time)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def str_literals(tree: ast.AST) -> Iterator[Tuple[str, int]]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node.value, node.lineno
+
+
+def first_arg_str(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None

@@ -1,0 +1,21 @@
+"""Checker registry.  Each module contributes one rule; ALL is the
+ordered suite (`python -m tools.molint --list-rules`)."""
+
+from tools.molint.checkers.jit_purity import JitPurityChecker
+from tools.molint.checkers.lock_discipline import LockDisciplineChecker
+from tools.molint.checkers.deadline import DeadlineChecker
+from tools.molint.checkers.cache_invalidation import \
+    CacheInvalidationChecker
+from tools.molint.checkers.metric_hygiene import MetricHygieneChecker
+from tools.molint.checkers.fault_coverage import FaultCoverageChecker
+from tools.molint.checkers.broad_except import BroadExceptChecker
+
+ALL = [
+    JitPurityChecker,
+    LockDisciplineChecker,
+    DeadlineChecker,
+    CacheInvalidationChecker,
+    MetricHygieneChecker,
+    FaultCoverageChecker,
+    BroadExceptChecker,
+]

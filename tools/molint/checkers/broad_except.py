@@ -1,0 +1,76 @@
+"""broad-except: no unjustified `except Exception` / `except
+BaseException` / bare `except:` anywhere in the package.
+
+A broad catch in the RPC or wire layers is how partial failures turn
+into silent data loss; in the engine it is how a constraint error
+becomes wrong rows.  Every broad handler must either narrow its type or
+carry a justification — either the molint suppression syntax or the
+legacy `# noqa: BLE001 — why` convention from tools/lint_excepts.py
+(which is now a thin shim over this checker).
+
+The noqa may sit on the `except` line itself or be the sole content of
+the line directly above (the layout long lines use).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from tools.molint import Checker, Finding, Project
+
+_NOQA = re.compile(r"#\s*noqa")
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in _BROAD:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _BROAD:
+            return True
+    return False
+
+
+class BroadExceptChecker(Checker):
+    rule = "broad-except"
+    description = ("`except Exception`/`except:` must narrow its type "
+                   "or carry a justification comment")
+    default_config = {
+        #: restrict to these path prefixes; None = every scanned file
+        "dirs": None,
+    }
+
+    def check(self, project: Project, config: dict) -> Iterable[Finding]:
+        dirs = config.get("dirs")
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            if dirs is not None and not any(
+                    mod.path.startswith(d) for d in dirs):
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not _is_broad(node):
+                    continue
+                line = mod.lines[node.lineno - 1] \
+                    if node.lineno <= len(mod.lines) else ""
+                prev = mod.lines[node.lineno - 2] if node.lineno >= 2 \
+                    else ""
+                if _NOQA.search(line) or (
+                        prev.strip().startswith("#")
+                        and _NOQA.search(prev)):
+                    continue
+                yield Finding(
+                    self.rule, mod.path, node.lineno,
+                    "unjustified broad except (narrow the type or add "
+                    "'# noqa: BLE001 -- why' / "
+                    "'# molint: disable=broad-except -- why'): "
+                    + line.strip())

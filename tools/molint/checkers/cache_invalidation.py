@@ -1,0 +1,210 @@
+"""cache-invalidation: catalog-shape mutations bump `ddl_gen` in the
+same function; index state stays self-consistent.
+
+The PR-4 serving caches pin every plan and result to the engine's
+`ddl_gen`.  That only works if EVERY code path that changes catalog
+shape bumps it — the PR-4/5 review rounds each caught a path that
+didn't (logtail replay, UDF drop).  Encoded:
+
+  * a function that mutates a catalog container — subscript/del/pop/
+    clear/rebind on `.tables`, `.indexes`, `.snapshots`, `.stages`,
+    `.publications`, `.dynamic_tables`, or add/discard on `.sources` —
+    must also bump `ddl_gen` (`x.ddl_gen += 1`, an assignment to it, or
+    a call to a method that bumps, e.g. `register_index`) in the SAME
+    function, or carry a suppression saying why the shape didn't
+    change.  `__init__` constructors are exempt (there is no cache to
+    invalidate before the engine exists).
+  * a function that replaces `IndexMeta.index_obj` must also write
+    `.dirty` in the same function — the pair is the index's version:
+    an `index_obj` swap with a stale dirty flag either re-serves the
+    old index or rebuilds forever.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from tools.molint import Checker, Finding, Project
+from tools.molint.astutil import dotted, iter_functions, \
+    walk_skip_nested_funcs
+
+_CATALOG_ATTRS = ("tables", "indexes", "snapshots", "stages",
+                  "publications", "dynamic_tables")
+_SET_ATTRS = ("sources",)
+#: receiver names that denote an engine/catalog object when the
+#: mutation happens outside the Engine class itself
+_ENGINE_RECEIVERS = {"rep", "eng", "engine", "catalog", "replica",
+                     "cat"}
+
+
+def _container_attr(node: ast.AST, catalogish: bool) -> Optional[str]:
+    """'tables' when node is an attr chain ending in a catalog
+    container on an engine-shaped receiver: `self.tables` inside a
+    class that knows ddl_gen (catalogish=True), or `rep.tables`/
+    `engine.tables`/... anywhere.  A planner helper's `env.tables` or a
+    worker's private `self.indexes` is not the catalog."""
+    d = dotted(node)
+    if d is None:
+        return None
+    parts = d.split(".")
+    term = parts[-1]
+    if term not in _CATALOG_ATTRS or len(parts) < 2:
+        return None
+    recv = parts[-2]
+    if recv == "self":
+        return term if catalogish else None
+    if recv in _ENGINE_RECEIVERS:
+        return term
+    return None
+
+
+class CacheInvalidationChecker(Checker):
+    rule = "cache-invalidation"
+    description = ("catalog container mutations bump ddl_gen in the "
+                   "same function; index_obj swaps update .dirty")
+    default_config = {
+        #: method calls that bump ddl_gen on the callee's behalf
+        #: (Engine.create_table/create_external/register_index each
+        #: contain the bump; a function routing through them is covered)
+        "bumping_calls": ("register_index", "create_table",
+                          "create_external"),
+        #: function names exempt (constructors build, not mutate)
+        "exempt_functions": ("__init__",),
+    }
+
+    def check(self, project: Project, config: dict) -> Iterable[Finding]:
+        bumping = set(config["bumping_calls"])
+        exempt = set(config["exempt_functions"])
+        # classes whose `self.` IS the catalog: any class whose body
+        # mentions ddl_gen (Engine and its replica/tenant wrappers)
+        catalog_classes = set()
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    for sub in ast.walk(node):
+                        if (isinstance(sub, ast.Attribute)
+                                and sub.attr == "ddl_gen"):
+                            catalog_classes.add(node.name)
+                            break
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            for fi in iter_functions(mod):
+                if fi.name in exempt:
+                    continue
+                yield from self._check_func(
+                    fi, bumping, fi.classname in catalog_classes)
+
+    def _check_func(self, fi, bumping, catalogish: bool
+                    ) -> Iterable[Finding]:
+        # Branch-aware: a bump covers a mutation only when it sits in
+        # the SAME if/elif/else arm or an enclosing one.  Function-wide
+        # satisfaction let one bumping branch of a dispatcher (e.g. a
+        # WAL-replay apply()) whitelist every other branch's mutation —
+        # the exact shape the replica staleness hole hid in.  Regions
+        # are if-arms; loops/with/try are transparent.
+        mutations: List[tuple] = []      # (lineno, description, region)
+        index_obj_writes: List[int] = []
+        dirty_writes = False
+        bump_regions: List[tuple] = []
+
+        def visit(node, region):
+            nonlocal dirty_writes
+            if isinstance(node, (ast.AugAssign, ast.Assign)):
+                targets = [node.target] \
+                    if isinstance(node, ast.AugAssign) else node.targets
+                for t in targets:
+                    d = dotted(t)
+                    if d and d.split(".")[-1] == "ddl_gen":
+                        bump_regions.append(region)
+                    if d and d.split(".")[-1] == "dirty":
+                        dirty_writes = True
+                    if d and d.split(".")[-1] == "index_obj":
+                        index_obj_writes.append(node.lineno)
+                    # rebinding a whole container: rep.tables = {}
+                    if isinstance(t, ast.Attribute):
+                        term = _container_attr(t, catalogish)
+                        if term:
+                            mutations.append(
+                                (node.lineno, f"rebinds .{term}",
+                                 region))
+                    # subscript store: self.tables[name] = t
+                    if isinstance(t, ast.Subscript):
+                        term = _container_attr(t.value, catalogish)
+                        if term:
+                            mutations.append(
+                                (node.lineno, f"writes .{term}[...]",
+                                 region))
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        term = _container_attr(t.value, catalogish)
+                        if term:
+                            mutations.append(
+                                (node.lineno, f"deletes from .{term}",
+                                 region))
+            elif isinstance(node, ast.Call):
+                d = dotted(node.func) or ""
+                parts = d.split(".")
+                term = parts[-1]
+                if term in bumping:
+                    bump_regions.append(region)
+                if term in ("pop", "clear", "popitem", "setdefault",
+                            "update") and len(parts) >= 2:
+                    cont = _container_attr(node.func.value, catalogish)
+                    if cont:
+                        mutations.append(
+                            (node.lineno, f".{cont}.{term}(...)",
+                             region))
+                if term in ("add", "discard", "remove") \
+                        and len(parts) >= 2:
+                    d2 = dotted(node.func.value) or ""
+                    p2 = d2.split(".")
+                    if p2[-1] in _SET_ATTRS and len(p2) >= 2 and (
+                            (p2[-2] == "self" and catalogish)
+                            or p2[-2] in _ENGINE_RECEIVERS):
+                        mutations.append(
+                            (node.lineno, f".{p2[-1]}.{term}(...)",
+                             region))
+
+        def walk(node, region):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                if isinstance(child, ast.If):
+                    visit(child.test, region)
+                    walk(child.test, region)
+                    for arm, block in ((0, child.body),
+                                       (1, child.orelse)):
+                        sub = region + ((id(child), arm),)
+                        for stmt in block:
+                            visit(stmt, sub)
+                            walk(stmt, sub)
+                    continue
+                visit(child, region)
+                walk(child, region)
+
+        walk(fi.node, ())
+
+        def covered(region) -> bool:
+            return any(region[: len(b)] == b for b in bump_regions)
+
+        for lineno, what, region in mutations:
+            if not covered(region):
+                yield Finding(
+                    self.rule, fi.module.path, lineno,
+                    f"{fi.qualname} {what} but this branch never "
+                    f"bumps ddl_gen — cached plans/results outlive "
+                    f"the catalog shape")
+        if index_obj_writes and not dirty_writes:
+            for lineno in index_obj_writes:
+                yield Finding(
+                    self.rule, fi.module.path, lineno,
+                    f"{fi.qualname} replaces IndexMeta.index_obj "
+                    f"without updating .dirty — index version and "
+                    f"freshness flag desync")

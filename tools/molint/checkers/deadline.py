@@ -1,0 +1,146 @@
+"""deadline-propagation: time budgets follow the call chain; nobody
+invents a private timeout or a flat retry sleep.
+
+The PR-2 fabric made deadlines ambient (`deadline_scope` /
+`current_deadline`, with the wire carrying `deadline_ms` so servers
+re-enter the caller's budget).  The conventions that keep that true:
+
+  * no `sock.settimeout(<numeric constant>)` — a hardcoded socket
+    timeout either outlives the caller's budget (the call hangs past
+    the deadline) or truncates it.  Derive from
+    `current_deadline().remaining()` / a computed budget, or suppress
+    with a justification when the value is a poll TICK on a loop that
+    `continue`s on timeout (a tick is a wakeup interval, not a
+    deadline).  `settimeout(None)` and computed expressions pass.
+  * retry loops back off with jitter: a `time.sleep(...)` inside a
+    loop that also catches exceptions (the retry shape) must use the
+    shared `backoff_delay` helper — a flat sleep synchronizes
+    thundering-herd retries across callers.
+  * call sites of worker methods that accept a `deadline_ms` parameter
+    must pass it (config `must_thread`) — dropping it silently detaches
+    the worker call from the statement budget.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from tools.molint import Checker, Finding, Project
+from tools.molint.astutil import dotted, walk_skip_nested_funcs
+
+
+def _is_numeric_const(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float)) \
+            and not isinstance(node.value, bool)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return _is_numeric_const(node.operand)
+    return False
+
+
+class DeadlineChecker(Checker):
+    rule = "deadline-propagation"
+    description = ("no hardcoded socket timeouts, jittered backoff in "
+                   "retry loops, deadline_ms threaded to worker calls")
+    default_config = {
+        #: method names whose call sites must pass deadline_ms (keyword
+        #: or enough positionals to reach it); (name, min_positional)
+        "must_thread": (("udf_eval", 4),),
+        #: helper whose presence in a retry loop marks backoff as shared
+        "backoff_helper": "backoff_delay",
+    }
+
+    def check(self, project: Project, config: dict) -> Iterable[Finding]:
+        must_thread = dict(config["must_thread"])
+        helper = config["backoff_helper"]
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            # ---- hardcoded settimeout
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "settimeout" and node.args and \
+                        _is_numeric_const(node.args[0]):
+                    yield Finding(
+                        self.rule, mod.path, node.lineno,
+                        "hardcoded socket timeout "
+                        f"settimeout({ast.unparse(node.args[0])}) — "
+                        "derive it from current_deadline().remaining() "
+                        "(or suppress: poll ticks that continue on "
+                        "timeout are not deadlines)")
+                # ---- deadline_ms threading at worker seams
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in must_thread:
+                    min_pos = must_thread[node.func.attr]
+                    kws = {kw.arg for kw in node.keywords}
+                    if "deadline_ms" not in kws and \
+                            len(node.args) < min_pos:
+                        yield Finding(
+                            self.rule, mod.path, node.lineno,
+                            f".{node.func.attr}(...) call drops "
+                            f"deadline_ms — the worker call detaches "
+                            f"from the statement budget")
+            # ---- flat sleeps in retry loops
+            yield from self._retry_sleeps(mod, helper)
+
+    def _retry_sleeps(self, mod, helper: str) -> Iterable[Finding]:
+        from tools.molint.astutil import aliases_of
+        aliases = aliases_of(mod)
+        time_mods = {a for a, target in aliases.items()
+                     if target == "time"}
+        sleep_names = {a for a, target in aliases.items()
+                       if target == "time.sleep"}
+
+        def is_time_sleep(call: ast.Call) -> bool:
+            d = dotted(call.func) or ""
+            parts = d.split(".")
+            if len(parts) == 2 and parts[0] in time_mods \
+                    and parts[1] == "sleep":
+                return True
+            return len(parts) == 1 and parts[0] in sleep_names
+
+        def subtree_has_helper(node: ast.AST) -> bool:
+            for n in ast.walk(node):
+                if (isinstance(n, ast.Name) and n.id == helper) or \
+                        (isinstance(n, ast.Attribute)
+                         and n.attr == helper):
+                    return True
+            return False
+
+        for loop in ast.walk(mod.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            body_nodes = list(walk_skip_nested_funcs(loop))
+            has_except = any(isinstance(n, ast.ExceptHandler)
+                             for n in body_nodes)
+            if not has_except:
+                continue
+            # names bound (anywhere in the loop) to a backoff-derived
+            # expression: `delay = min(backoff_delay(a), rem)` makes
+            # time.sleep(delay) legitimate
+            backoff_names = set()
+            for n in body_nodes:
+                if isinstance(n, ast.Assign) and \
+                        subtree_has_helper(n.value):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            backoff_names.add(t.id)
+            for n in body_nodes:
+                if not (isinstance(n, ast.Call) and is_time_sleep(n)):
+                    continue
+                # EACH sleep must derive from the helper — one jittered
+                # sleep elsewhere in the loop must not excuse a flat one
+                args_ok = n.args and (
+                    subtree_has_helper(n.args[0])
+                    or (isinstance(n.args[0], ast.Name)
+                        and n.args[0].id in backoff_names))
+                if not args_ok:
+                    yield Finding(
+                        self.rule, mod.path, n.lineno,
+                        "flat time.sleep in a retry loop — derive the "
+                        f"delay from the shared {helper}() (jittered "
+                        "exponential backoff) so concurrent retries "
+                        "don't synchronize")

@@ -1,0 +1,138 @@
+"""fault-coverage: the chaos surface and the chaos drills stay in sync.
+
+Two directions, both of which have rotted in real systems:
+
+  * a LIVE fault site (`INJECTOR.trigger("name")` in the package) that
+    no test ever arms is a degrade path that has never executed — the
+    next refactor breaks it silently;
+  * an ARMED spec in a test whose name matches no live site is a drill
+    that silently stopped drilling (the site was renamed or deleted and
+    `trigger()` of an unknown name is a no-op).
+
+Also enforced: trigger names are string literals (coverage analysis is
+impossible otherwise) and every live site is listed in the fault
+module's docstring — the docstring is the operator-facing catalogue
+(`mo_ctl('fault','arm:<spec>')` users read it, not the code).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Tuple
+
+from tools.molint import Checker, Finding, Project
+from tools.molint.astutil import dotted, first_arg_str, str_literals
+
+#: 'name:action[...]' literals in tests — the SQL/mo_ctl arming surface
+_SPEC_RE = re.compile(
+    r"(?:^|arm:|['\"=\s])([a-z][a-z0-9_]*(?:\.[a-z][a-z0-9_]*)+)"
+    r":(?:return|sleep|panic|wait)\b")
+
+
+def _trigger_sites(mod) -> List[Tuple[str, int]]:
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr == "trigger"):
+            continue
+        recv = (dotted(fn.value) or "").split(".")[-1]
+        if recv != "INJECTOR":
+            continue
+        name = first_arg_str(node)
+        out.append((name if name is not None else "", node.lineno))
+    return out
+
+
+def _armed_names(mod) -> List[Tuple[str, int]]:
+    out = []
+    if mod.tree is None:
+        return out
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "add" and \
+                    (dotted(fn.value) or "").split(".")[-1] == "INJECTOR":
+                name = first_arg_str(node)
+                if name is None:
+                    for kw in node.keywords:
+                        if kw.arg == "name" and isinstance(
+                                kw.value, ast.Constant):
+                            name = kw.value.value
+                if name:
+                    out.append((name, node.lineno))
+    for text, lineno in str_literals(mod.tree):
+        for m in _SPEC_RE.finditer(text):
+            out.append((m.group(1), lineno))
+    return out
+
+
+class FaultCoverageChecker(Checker):
+    rule = "fault-coverage"
+    description = ("every fault.trigger site is armed by a chaos test "
+                   "and every armed spec resolves to a live site")
+    default_config = {
+        #: path suffix of the injector module (its own trigger() impl
+        #: and docstring catalogue live there)
+        "fault_module_suffix": "utils/fault.py",
+        #: require live sites to be listed in the fault module docstring
+        "require_docstring": True,
+        #: None = follow project.complete; the armed-spec->live-site
+        #: direction needs the FULL site corpus, so a partial scan of a
+        #: few files skips it (fixture tests force True)
+        "corpus_complete": None,
+    }
+
+    def check(self, project: Project, config: dict) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        fault_suffix = config["fault_module_suffix"]
+        sites: Dict[str, Tuple[str, int]] = {}
+        for mod in project.modules:
+            if mod.tree is None or mod.path.endswith(fault_suffix):
+                continue
+            for name, lineno in _trigger_sites(mod):
+                if not name:
+                    findings.append(Finding(
+                        self.rule, mod.path, lineno,
+                        "fault trigger name must be a string literal "
+                        "(coverage analysis needs the site name)"))
+                    continue
+                sites.setdefault(name, (mod.path, lineno))
+
+        armed: Dict[str, Tuple[str, int]] = {}
+        for mod in project.test_modules:
+            for name, lineno in _armed_names(mod):
+                armed.setdefault(name, (mod.path, lineno))
+
+        for name, (path, lineno) in sorted(sites.items()):
+            if name not in armed:
+                findings.append(Finding(
+                    self.rule, path, lineno,
+                    f"fault site {name!r} is never armed by any test — "
+                    f"its degrade path has never executed"))
+        complete = config.get("corpus_complete")
+        if complete is None:
+            complete = project.complete
+        if complete:
+            for name, (path, lineno) in sorted(armed.items()):
+                if name not in sites:
+                    findings.append(Finding(
+                        self.rule, path, lineno,
+                        f"test arms fault spec {name!r} but no live "
+                        f"INJECTOR.trigger site has that name — the "
+                        f"drill is a no-op"))
+
+        if config.get("require_docstring"):
+            fmod = project.module_by_suffix(fault_suffix)
+            if fmod is not None and fmod.tree is not None:
+                doc = ast.get_docstring(fmod.tree) or ""
+                for name, (path, lineno) in sorted(sites.items()):
+                    if name not in doc:
+                        findings.append(Finding(
+                            self.rule, path, lineno,
+                            f"fault site {name!r} missing from the "
+                            f"{fault_suffix} docstring catalogue "
+                            f"(operators arm from that list)"))
+        return findings

@@ -1,0 +1,248 @@
+"""jit-purity: functions reachable from `jax.jit` / `shard_map` are
+trace-pure.
+
+A jitted body executes its Python exactly once per (shape, dtype)
+signature at trace time; everything it does besides building the traced
+computation is either silently frozen into the compiled program
+(wall-clock reads, stateful RNG draws) or a host sync that stalls the
+device pipeline (`.item()`, `device_get`, `block_until_ready`).  The
+UDF tier enforces this dynamically through its sandbox; engine kernels
+get it enforced here, statically.
+
+Roots: functions decorated with `jax.jit` / `partial(jax.jit, ...)` /
+`shard_map` (or wrapped via `x = jax.jit(f)` / `shard_map(f, ...)`),
+plus everything transitively reachable from them through same-module
+calls, `self.` method calls, and one level of project-module attribute
+calls (`kmeans.assign(...)`).
+
+Impure operations flagged in reachable functions:
+
+  * `time.*` calls — wall-clock frozen at trace time;
+  * stdlib `random.*` and `np.random.*` — stateful RNG draws trace to
+    constants (`jax.random` with explicit keys is the pure path and is
+    allowed);
+  * `.item()`, `float(x)`/`int(x)`/`bool(x)` on non-literals,
+    `np.asarray` of a traced value is not detectable — but
+    `jax.device_get` / `.block_until_ready()` are and force host sync;
+  * `global` declarations and subscript-stores into module-level
+    objects — mutating module state from a traced body runs once, at
+    trace time, then never again.
+
+A helper shared by a host path and a jitted path that needs host-only
+impurity behind a flag should be split, or suppressed with a
+justification explaining why the impure branch cannot trace.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.molint import Checker, Finding, Project
+from tools.molint.astutil import (aliases_of, dotted, iter_functions,
+                                  walk_skip_nested_funcs)
+
+_JIT_NAMES = {"jit", "shard_map", "pmap"}
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    """Does this expression refer to jax.jit/shard_map/pmap?"""
+    d = dotted(node)
+    if d is None:
+        return False
+    return d.split(".")[-1] in _JIT_NAMES
+
+
+def _jit_wrap_target(call: ast.Call) -> Optional[str]:
+    """'f' when call is jit(f, ...) / partial(jit, ...)(f)? — only the
+    direct `jit(f)` / `shard_map(f, ...)` shape, f a plain Name."""
+    if _is_jit_ref(call.func) and call.args and \
+            isinstance(call.args[0], ast.Name):
+        return call.args[0].id
+    return None
+
+
+def _decorated_as_jit(fn) -> bool:
+    for dec in fn.decorator_list:
+        if _is_jit_ref(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            # @partial(jax.jit, ...) / @jax.jit(...) /
+            # @partial(shard_map, mesh=...)
+            if _is_jit_ref(dec.func):
+                return True
+            f = dec.func
+            if isinstance(f, (ast.Name, ast.Attribute)) and \
+                    (dotted(f) or "").split(".")[-1] == "partial" and \
+                    dec.args and _is_jit_ref(dec.args[0]):
+                return True
+    return False
+
+
+class JitPurityChecker(Checker):
+    rule = "jit-purity"
+    description = ("functions reachable from jax.jit/shard_map do not "
+                   "read clocks/stateful RNG, sync the host, or mutate "
+                   "module globals")
+    default_config = {
+        #: extra impure dotted-call denylist (terminal match)
+        "host_sync_attrs": ("item", "block_until_ready", "device_get",
+                            "tolist"),
+    }
+
+    def check(self, project: Project, config: dict) -> Iterable[Finding]:
+        # ---- index every function and module-level name.  The index
+        # maps (module, bare name) -> EVERY function with that name
+        # (methods included): bare-name call resolution cannot tell
+        # same-named definitions apart, and keeping only the first
+        # would let a method silently shadow the helper a kernel
+        # actually calls.  Over-approximating scans all of them.
+        funcs: Dict[Tuple[str, str], List["FuncEntry"]] = {}
+        mod_globals: Dict[str, Set[str]] = {}
+        roots: Set[Tuple[str, str]] = set()
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            g = set()
+            for node in mod.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            g.add(t.id)
+                elif isinstance(node, ast.AnnAssign) and \
+                        isinstance(node.target, ast.Name):
+                    g.add(node.target.id)
+            mod_globals[mod.modname] = g
+            for fi in iter_functions(mod):
+                key = (mod.modname, fi.name)
+                funcs.setdefault(key, []).append(FuncEntry(fi))
+                if _decorated_as_jit(fi.node):
+                    roots.add(key)
+            # x = jax.jit(f) / jit-wrapped call expressions
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    tgt = _jit_wrap_target(node)
+                    if tgt and (mod.modname, tgt) in funcs:
+                        roots.add((mod.modname, tgt))
+
+        # ---- reachability closure over the call graph
+        reach: Set[Tuple[str, str]] = set()
+        stack = list(roots)
+        while stack:
+            key = stack.pop()
+            if key in reach or key not in funcs:
+                continue
+            reach.add(key)
+            for entry in funcs[key]:
+                for callee in entry.callees():
+                    if callee in funcs and callee not in reach:
+                        stack.append(callee)
+
+        # ---- impurity scan of every reachable function
+        findings: List[Finding] = []
+        for key in sorted(reach):
+            for entry in funcs[key]:
+                findings.extend(self._impurities(
+                    entry, key in roots, mod_globals, config))
+        return findings
+
+    def _impurities(self, entry, is_root: bool, mod_globals,
+                    config) -> Iterable[Finding]:
+        fi = entry.fi
+        mod = fi.module
+        aliases = entry.aliases
+        host_sync = set(config["host_sync_attrs"])
+
+        def root_module(d: str) -> str:
+            head = d.split(".")[0]
+            return aliases.get(head, head)
+
+        for node in walk_skip_nested_funcs(fi.node):
+            if isinstance(node, ast.Global):
+                yield Finding(
+                    self.rule, mod.path, node.lineno,
+                    f"{fi.qualname} (reachable from jit) declares "
+                    f"`global {', '.join(node.names)}` — module state "
+                    f"mutates at trace time only")
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d is None:
+                    continue
+                parts = d.split(".")
+                rm = root_module(d)
+                if rm == "time" and len(parts) >= 2:
+                    yield Finding(
+                        self.rule, mod.path, node.lineno,
+                        f"{fi.qualname} (reachable from jit) calls "
+                        f"{d}() — wall clock freezes at trace time")
+                elif (rm == "random" and len(parts) >= 2) or \
+                        (len(parts) >= 3 and parts[-2] == "random"
+                         and root_module(d) in ("numpy", "np")):
+                    yield Finding(
+                        self.rule, mod.path, node.lineno,
+                        f"{fi.qualname} (reachable from jit) calls "
+                        f"stateful RNG {d}() — draws freeze at trace "
+                        f"time; use jax.random with an explicit key")
+                elif is_root and len(parts) == 1 and \
+                        parts[0] in ("float", "int", "bool") and \
+                        node.args and not isinstance(node.args[0],
+                                                     ast.Constant):
+                    yield Finding(
+                        self.rule, mod.path, node.lineno,
+                        f"{fi.qualname} (jitted) calls {parts[0]}() on "
+                        f"a traced value — concretization forces a "
+                        f"host sync (ConcretizationTypeError on "
+                        f"abstract tracers)")
+                elif parts[-1] in host_sync and len(parts) >= 2:
+                    yield Finding(
+                        self.rule, mod.path, node.lineno,
+                        f"{fi.qualname} (reachable from jit) calls "
+                        f".{parts[-1]}() — host sync stalls the device "
+                        f"pipeline (and fails on tracers)")
+            # subscript-store into a module-level object
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id in mod_globals.get(mod.modname,
+                                                          ()):
+                        yield Finding(
+                            self.rule, mod.path, node.lineno,
+                            f"{fi.qualname} (reachable from jit) "
+                            f"stores into module-level "
+                            f"{t.value.id!r} — runs once at trace "
+                            f"time, never per call")
+
+
+class FuncEntry:
+    def __init__(self, fi):
+        self.fi = fi
+        self.aliases = aliases_of(fi.module)
+        self._callees: Optional[List[Tuple[str, str]]] = None
+
+    def callees(self) -> List[Tuple[str, str]]:
+        if self._callees is not None:
+            return self._callees
+        out: List[Tuple[str, str]] = []
+        modname = self.fi.module.modname
+        for node in walk_skip_nested_funcs(self.fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d is None:
+                continue
+            parts = d.split(".")
+            if len(parts) == 1:
+                out.append((modname, parts[0]))
+            elif parts[0] == "self" and len(parts) == 2:
+                out.append((modname, parts[1]))
+            elif len(parts) == 2:
+                target = self.aliases.get(parts[0])
+                if target:
+                    out.append((target, parts[1]))
+            # also: functions passed by name as call arguments
+            for a in node.args:
+                if isinstance(a, ast.Name):
+                    out.append((modname, a.id))
+        self._callees = out
+        return out

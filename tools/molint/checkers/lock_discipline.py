@@ -1,0 +1,275 @@
+"""lock-discipline: locks are `with`-scoped, the commit lock never
+covers blocking I/O, and the static lock-order graph is acyclic.
+
+Three sub-rules:
+
+  * **with-scoping** — `<something>lock.acquire()` outside a `with`
+    statement leaks the lock on any exception path between acquire and
+    release.  Receivers are matched by name (terminal attribute/name
+    containing "lock" or "mutex", case-insensitive), so condition
+    variables and admission tickets are out of scope.
+  * **no blocking under `_commit_lock`** — the engine commit lock
+    serializes every writer and the logtail apply path; a network call
+    under it turns one slow peer into a cluster-wide write stall.
+    Flagged inside any `with *._commit_lock:` body: socket operations,
+    RPC-fabric/worker client calls, `time.sleep`, and blob-frame
+    send/recv helpers.  `wal.append` is deliberately ABSENT from the
+    denylist (there is no per-function exemption mechanism): WAL-then-
+    apply under one critical section IS the commit protocol, and adding
+    ("append", "wal") to `blocking_attrs` would flag `Engine.commit_txn`
+    itself; the quorum WAL's blocking is bounded by the deadline
+    conventions instead.
+  * **lock-order graph** — every lexically nested `with lockA: ...
+    with lockB:` and every `with lockA:` body calling a same-project
+    function that acquires lockB contributes an edge A→B.  A cycle in
+    that graph is a potential deadlock even if today's schedules never
+    interleave.  Lock identity: `_commit_lock` is normalized to the one
+    engine commit lock regardless of receiver; other `self._x` locks
+    are class-qualified; module-level locks are module-qualified.
+    Same-identity nesting is ignored (RLock re-entry is a supported
+    pattern here — `_commit_lock` is an RLock by design).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.molint import Checker, Finding, Project
+from tools.molint.astutil import (FuncInfo, aliases_of, dotted,
+                                  iter_functions, walk_skip_nested_funcs)
+
+_LOCKISH = ("lock", "mutex")
+
+
+def _lock_identity(expr: ast.AST, classname: Optional[str],
+                   modname: str) -> Optional[str]:
+    """Normalized lock id for a with-item context expr, or None when the
+    expr doesn't look like a lock."""
+    d = dotted(expr)
+    if d is None:
+        return None
+    term = d.split(".")[-1]
+    if not any(k in term.lower() for k in _LOCKISH):
+        return None
+    if term == "_commit_lock":
+        return "Engine._commit_lock"     # one engine-wide commit lock
+    parts = d.split(".")
+    if parts[0] == "self" and len(parts) == 2:
+        return f"{classname or modname}.{term}"
+    if len(parts) == 1:                   # module-level lock object
+        return f"{modname}.{term}"
+    # foreign attribute (other._lock): receiver identity is unknown
+    # statically — keep it distinct per receiver name
+    return f"?{parts[-2]}.{term}"
+
+
+class LockDisciplineChecker(Checker):
+    rule = "lock-discipline"
+    description = ("with-scoped acquires, no blocking calls under the "
+                   "commit lock, acyclic static lock-order graph")
+    default_config = {
+        #: method names that block on the network/disk when called under
+        #: the commit lock (matched on the call's terminal attr together
+        #: with a receiver-name hint, or bare function names)
+        "blocking_attrs": (
+            ("sendall", None), ("recv", None),
+            ("create_connection", None), ("settimeout", None),
+            ("sleep", "time"),
+            ("call", "client"), ("call", "rpc"),
+            ("run", "worker"), ("run", "client"),
+            ("udf_eval", None), ("search_index", None),
+        ),
+        "blocking_funcs": ("_send_msg", "_recv_msg", "urlopen"),
+        #: attribute name identifying the engine commit lock in a
+        #: with-item (NB: the wal.append exemption is by OMISSION from
+        #: the denylists above, not a function whitelist — see the
+        #: module docstring before extending blocking_attrs)
+        "commit_lock_name": "_commit_lock",
+    }
+
+    # ------------------------------------------------------------ check
+    def check(self, project: Project, config: dict) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        # lock-order edges: id -> {target_id: (path, lineno)}
+        edges: Dict[str, Dict[str, Tuple[str, int]]] = {}
+        # (modname, classname-or-None, funcname) -> locks the function
+        # acquires anywhere in its body.  Class-qualified on purpose:
+        # merging same-named methods of unrelated classes manufactures
+        # phantom edges (two `close()`s each taking their own lock must
+        # not union into one node that cycles)
+        acquires: Dict[Tuple[str, Optional[str], str], Set[str]] = {}
+        funcs: List[FuncInfo] = []
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            funcs.extend(iter_functions(mod))
+            findings.extend(self._unscoped_acquires(mod))
+        for fi in funcs:
+            ids = set()
+            for node in walk_skip_nested_funcs(fi.node):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        lid = _lock_identity(item.context_expr,
+                                             fi.classname,
+                                             fi.module.modname)
+                        if lid:
+                            ids.add(lid)
+            key = (fi.module.modname, fi.classname, fi.name)
+            acquires[key] = acquires.get(key, set()) | ids
+
+        for fi in funcs:
+            findings.extend(self._scan_func(fi, config, edges, acquires))
+        findings.extend(self._cycles(edges))
+        return findings
+
+    # ----------------------------------------------- unscoped .acquire
+    def _unscoped_acquires(self, mod) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"):
+                continue
+            recv = dotted(node.func.value) or ""
+            term = recv.split(".")[-1].lower()
+            if not any(k in term for k in _LOCKISH):
+                continue
+            yield Finding(
+                self.rule, mod.path, node.lineno,
+                f"explicit {recv}.acquire() — use `with {recv}:` so "
+                f"every exception path releases the lock")
+
+    # --------------------------------------- per-function with-analysis
+    def _scan_func(self, fi: FuncInfo, config: dict,
+                   edges, acquires) -> Iterable[Finding]:
+        mod = fi.module
+        aliases = aliases_of(mod)
+        commit_name = config["commit_lock_name"]
+        blocking_attrs = tuple(config["blocking_attrs"])
+        blocking_funcs = set(config["blocking_funcs"])
+
+        def record_edge(a: str, b: str, lineno: int):
+            if a == b:
+                return
+            tgt = edges.setdefault(a, {})
+            tgt.setdefault(b, (mod.path, lineno))
+
+        def resolve_call_acquires(call: ast.Call) -> Set[str]:
+            """Locks acquired by a directly-called project function
+            (one hop): `self.f()` -> the caller's own class, bare
+            `f()` -> a module-level function, `mod.f()` -> a module-
+            level function of an imported project module."""
+            d = dotted(call.func)
+            if d is None:
+                return set()
+            parts = d.split(".")
+            name = parts[-1]
+            if parts[0] == "self" and len(parts) == 2:
+                return acquires.get(
+                    (mod.modname, fi.classname, name), set())
+            if len(parts) == 1:
+                return acquires.get((mod.modname, None, name), set())
+            # imported project module: mod_alias.func
+            target = aliases.get(parts[0])
+            if target and len(parts) == 2:
+                got = acquires.get((target, None, name))
+                if got is not None:
+                    return got
+                # `from matrixone_tpu import indexing` style: alias maps
+                # to the dotted module; try suffix match
+                for (mn, cls, fn2), ids in acquires.items():
+                    if fn2 == name and cls is None and (
+                            mn == target
+                            or mn.endswith("." + parts[0])):
+                        return ids
+            return set()
+
+        def is_blocking(call: ast.Call) -> Optional[str]:
+            d = dotted(call.func) or ""
+            parts = d.split(".")
+            term = parts[-1]
+            if term in blocking_funcs and len(parts) <= 2:
+                return d
+            for attr, hint in blocking_attrs:
+                if term != attr or len(parts) < 2:
+                    continue
+                if hint is None:
+                    return d
+                recv = ".".join(parts[:-1]).lower()
+                if hint in recv:
+                    return d
+            return None
+
+        findings: List[Finding] = []
+
+        def walk(node: ast.AST, held: Tuple[str, ...],
+                 under_commit: bool):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(child, ast.With):
+                    new_held = held
+                    commit_here = under_commit
+                    for item in child.items:
+                        lid = _lock_identity(item.context_expr,
+                                             fi.classname, mod.modname)
+                        if lid is None:
+                            continue
+                        # edges from everything already held, INCLUDING
+                        # earlier items of this same multi-item with —
+                        # `with a, b:` acquires a then b
+                        for h in new_held:
+                            record_edge(h, lid, child.lineno)
+                        new_held = new_held + (lid,)
+                        ce = dotted(item.context_expr) or ""
+                        if ce.split(".")[-1] == commit_name:
+                            commit_here = True
+                    walk(child, new_held, commit_here)
+                    continue
+                if isinstance(child, ast.Call):
+                    if under_commit:
+                        blocked = is_blocking(child)
+                        if blocked:
+                            findings.append(Finding(
+                                self.rule, mod.path, child.lineno,
+                                f"blocking call {blocked}(...) under "
+                                f"the commit lock — one slow peer "
+                                f"stalls every writer"))
+                    if held:
+                        for lid in resolve_call_acquires(child):
+                            for h in held:
+                                record_edge(h, lid, child.lineno)
+                walk(child, held, under_commit)
+
+        walk(fi.node, (), False)
+        return findings
+
+    # ------------------------------------------------------ cycle check
+    def _cycles(self, edges) -> Iterable[Finding]:
+        state: Dict[str, int] = {}      # 0 visiting, 1 done
+        reported: Set[frozenset] = set()
+
+        def dfs(n: str, stack: List[str]):
+            state[n] = 0
+            stack.append(n)
+            for m in sorted(edges.get(n, {})):
+                if state.get(m) == 0:
+                    cyc = stack[stack.index(m):] + [m]
+                    key = frozenset(cyc)
+                    if key not in reported:
+                        reported.add(key)
+                        path, lineno = edges[n][m]
+                        yield Finding(
+                            self.rule, path, lineno,
+                            "lock-order cycle: "
+                            + " -> ".join(cyc)
+                            + " — acquisition orders can deadlock")
+                elif m not in state:
+                    yield from dfs(m, stack)
+            stack.pop()
+            state[n] = 1
+
+        for n in sorted(edges):
+            if n not in state:
+                yield from dfs(n, [])

@@ -1,0 +1,220 @@
+"""metric-hygiene: the mo_* metric namespace is registered exactly once,
+centrally, with stable label sets.
+
+Conventions encoded (utils/metrics.py is the single registry):
+
+  * every `REGISTRY.counter/gauge/histogram("mo_...")` call lives in the
+    registry module — an inline registration elsewhere creates a second
+    source of truth for help text and makes the dashboard inventory
+    ungreppable;
+  * a metric name is registered exactly once, and matches
+    `mo_[a-z0-9_]+`;
+  * every registered metric is actually driven somewhere (a registered-
+    but-never-incremented gauge reads as a healthy zero on dashboards —
+    dead metrics mislead);
+  * label VALUES passed to .inc()/.set()/.observe() are literals or
+    pre-bound names, never inline f-strings/format calls (an f-string
+    label is unbounded cardinality at one call site, invisible in the
+    registry);
+  * one metric keeps ONE label key set across all its call sites —
+    prometheus series with differing label sets under a name silently
+    fork the time series.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Tuple
+
+from tools.molint import Checker, Finding, Project
+from tools.molint.astutil import dotted, first_arg_str
+
+_KINDS = ("counter", "gauge", "histogram")
+_NAME_RE = re.compile(r"^mo_[a-z0-9_]+$")
+#: positional/keyword args to inc/set/observe that are the VALUE,
+#: not labels
+_VALUE_KW = {"value", "v"}
+
+
+def _registration_calls(tree) -> List[Tuple[ast.Call, str, str]]:
+    """(call, kind, var) for every REGISTRY.<kind>(...) call; var is the
+    assigned module-level name or '' for inline use."""
+    out = []
+    consumed = set()        # Call nodes owned by an Assign we also walk
+    for node in ast.walk(tree):
+        target = ""
+        call = None
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            call = node.value
+            consumed.add(id(call))
+            if len(node.targets) == 1 and isinstance(node.targets[0],
+                                                     ast.Name):
+                target = node.targets[0].id
+        elif isinstance(node, ast.Call):
+            if id(node) in consumed:
+                continue
+            call = node
+        if call is None or not isinstance(call.func, ast.Attribute):
+            continue
+        if call.func.attr not in _KINDS:
+            continue
+        recv = dotted(call.func.value) or ""
+        if not recv.split(".")[-1] == "REGISTRY" and recv != "self":
+            # only the canonical registry object counts; method defs on
+            # the Registry class itself (self.counter) are the factory
+            continue
+        if recv == "self":
+            continue
+        out.append((node if target else call, call.func.attr, target))
+    return out
+
+
+class MetricHygieneChecker(Checker):
+    rule = "metric-hygiene"
+    description = ("mo_* metrics registered exactly once in the registry "
+                   "module, driven somewhere, literal label sets")
+    default_config = {
+        #: path suffix identifying the single registry module
+        "registry_suffix": "utils/metrics.py",
+        #: metric names allowed to be registered without a module-level
+        #: var (none today)
+        "allow_inline": (),
+        #: root-relative files OUTSIDE the scan roots whose call sites
+        #: still count as "driving" a metric (the bench harness fills
+        #: the diagnostic stage counters)
+        "extra_driver_paths": ("bench.py",),
+        #: None = follow project.complete; the dead-metric check needs
+        #: the FULL driver corpus, so a partial scan skips it (fixture
+        #: tests force True)
+        "corpus_complete": None,
+    }
+
+    def check(self, project: Project, config: dict) -> Iterable[Finding]:
+        reg_mod = project.module_by_suffix(config["registry_suffix"])
+        findings: List[Finding] = []
+        registered: Dict[str, Tuple[str, int, str]] = {}  # name->(path,line,var)
+        var_names: Dict[str, str] = {}                    # var -> metric name
+        if reg_mod is not None and reg_mod.tree is not None:
+            for node, kind, var in _registration_calls(reg_mod.tree):
+                call = node.value if isinstance(node, ast.Assign) else node
+                name = first_arg_str(call)
+                if name is None:
+                    findings.append(Finding(
+                        self.rule, reg_mod.path, node.lineno,
+                        "metric name must be a string literal"))
+                    continue
+                if not _NAME_RE.match(name):
+                    findings.append(Finding(
+                        self.rule, reg_mod.path, node.lineno,
+                        f"metric name {name!r} does not match "
+                        f"mo_[a-z0-9_]+"))
+                if name in registered:
+                    findings.append(Finding(
+                        self.rule, reg_mod.path, node.lineno,
+                        f"metric {name!r} registered twice (first at "
+                        f"line {registered[name][1]})"))
+                else:
+                    registered[name] = (reg_mod.path, node.lineno, var)
+                if var:
+                    var_names[var] = name
+                elif name not in config["allow_inline"]:
+                    findings.append(Finding(
+                        self.rule, reg_mod.path, node.lineno,
+                        f"metric {name!r} registered without a module-"
+                        f"level variable (callers cannot drive it)"))
+
+        # ---- scan every other module: stray registrations, label
+        # hygiene, and which metric vars are actually driven
+        driven: Dict[str, bool] = {v: False for v in var_names}
+        label_sets: Dict[str, Dict[frozenset, Tuple[str, int]]] = {}
+        import os
+
+        from tools.molint import PyModule
+        extra_mods = []
+        for rel in config.get("extra_driver_paths", ()):
+            ap = os.path.join(project.root, rel)
+            if os.path.isfile(ap):
+                extra_mods.append(PyModule(ap, rel))
+        for mod in list(project.modules) + extra_mods:
+            if mod.tree is None:
+                continue
+            is_extra = mod in extra_mods   # drive-detection only
+            in_registry = reg_mod is not None and mod.path == reg_mod.path
+            if not in_registry and not is_extra:
+                for node, kind, var in _registration_calls(mod.tree):
+                    call = node.value if isinstance(node, ast.Assign) \
+                        else node
+                    name = first_arg_str(call) or "?"
+                    findings.append(Finding(
+                        self.rule, mod.path, node.lineno,
+                        f"metric {name!r} registered outside the "
+                        f"registry module ({config['registry_suffix']}) "
+                        f"— register it there and import the variable"))
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                if node.func.attr not in ("inc", "set", "observe",
+                                          "time"):
+                    continue
+                recv = dotted(node.func.value) or ""
+                term = recv.split(".")[-1]
+                if term not in var_names:
+                    continue
+                if not in_registry:
+                    driven[term] = True
+                if is_extra:
+                    continue
+                # label literalness + key-set stability
+                keys = []
+                for kw in node.keywords:
+                    if kw.arg is None or kw.arg in _VALUE_KW:
+                        continue
+                    keys.append(kw.arg)
+                    v = kw.value
+                    if isinstance(v, ast.JoinedStr):
+                        findings.append(Finding(
+                            self.rule, mod.path, node.lineno,
+                            f"f-string label value for "
+                            f"{var_names[term]!r}.{kw.arg} — bind the "
+                            f"value to a name first (label cardinality "
+                            f"must be auditable)"))
+                    elif isinstance(v, ast.Call) and \
+                            isinstance(v.func, ast.Attribute) and \
+                            v.func.attr == "format":
+                        findings.append(Finding(
+                            self.rule, mod.path, node.lineno,
+                            f".format() label value for "
+                            f"{var_names[term]!r}.{kw.arg}"))
+                if node.func.attr in ("inc", "set", "observe"):
+                    ks = frozenset(keys)
+                    seen = label_sets.setdefault(var_names[term], {})
+                    if ks not in seen:
+                        seen[ks] = (mod.path, node.lineno)
+
+        for metric, sets in sorted(label_sets.items()):
+            if len(sets) > 1:
+                detail = "; ".join(
+                    f"{{{','.join(sorted(ks)) or 'no labels'}}} at "
+                    f"{p}:{ln}" for ks, (p, ln) in sorted(
+                        sets.items(), key=lambda kv: kv[1]))
+                path, lineno = sorted(sets.values())[0]
+                findings.append(Finding(
+                    self.rule, path, lineno,
+                    f"metric {metric!r} driven with differing label "
+                    f"key sets ({detail}) — series fork silently"))
+        complete = config.get("corpus_complete")
+        if complete is None:
+            complete = project.complete
+        for var, used in sorted(driven.items()):
+            if not used and reg_mod is not None and complete:
+                name = var_names[var]
+                path, lineno, _ = registered[name]
+                findings.append(Finding(
+                    self.rule, path, lineno,
+                    f"metric {name!r} ({var}) is registered but never "
+                    f"driven by any .inc/.set/.observe call site — dead "
+                    f"gauges mislead dashboards"))
+        return findings

@@ -1,0 +1,54 @@
+"""`python -m tools.precheck` — the repo's one-shot static gate:
+molint (invariant checkers, tools/molint/) + bench_guard (scoreboard
+regression floors, tools/bench_guard.py).  This is what CI and the
+tier-1 suite run; see README "Static analysis".
+
+Exit 0 = both gates green; 1 = findings/regressions (details printed).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(prog="python -m tools.precheck")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: inferred from tools/)")
+    ap.add_argument("--skip-bench", action="store_true",
+                    help="run only molint (no BENCH_*.json history "
+                         "needed)")
+    args = ap.parse_args(argv)
+
+    from tools import bench_guard, molint
+    root = os.path.abspath(args.root or molint.repo_root())
+    rc = 0
+
+    findings, stats = molint.run_checks(root)
+    if findings:
+        for f in findings:
+            print(f.format())
+        print(f"molint: {len(findings)} finding(s) across "
+              f"{stats['files']} file(s)", file=sys.stderr)
+        rc = 1
+    else:
+        print(f"molint: ok ({stats['checkers']} checkers, "
+              f"{stats['files']} files, "
+              f"{stats['suppressions_used']} suppressions)")
+
+    if not args.skip_bench:
+        ok, report = bench_guard.check(root)
+        for ln in report:
+            print(ln)
+        if not ok:
+            print("bench_guard: REGRESSION", file=sys.stderr)
+            rc = 1
+        else:
+            print("bench_guard: ok")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
