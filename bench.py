@@ -124,11 +124,53 @@ def bench_q1(n: int = None) -> dict:
     t_cold = time.time() - t0
     exact = tpch.q1_check(rows, oracle)
     blockcache.CACHE.reset_stats()            # warm loop accounting
+    # ---- warm fused loop (MO_PLAN_FUSION default on): one compiled
+    # device program per fragment per batch; dispatch + trace deltas
+    # ride the JSON line as the fusion evidence
+    disp0 = M.fusion_dispatch.get(kind="step")
+    trace0 = M.fusion_trace_seconds.get()
     best = 0.0
     for _ in range(3):
         t0 = time.time()
         s.execute(tpch.Q1_SQL)
         best = max(best, n / (time.time() - t0))
+    fused_dispatches = M.fusion_dispatch.get(kind="step") - disp0
+    trace_seconds = M.fusion_trace_seconds.get() - trace0
+    # ---- per-stage device vs host split: one diagnostic re-execution
+    # with the fragment's profile hooks armed (block_until_ready around
+    # the compiled step, host bookkeeping timed separately)
+    dev0 = M.fusion_step_seconds.get(kind="device")
+    host0 = M.fusion_step_seconds.get(kind="host")
+    profile_was = os.environ.get("MO_FUSION_PROFILE")
+    os.environ["MO_FUSION_PROFILE"] = "1"
+    try:
+        s.execute(tpch.Q1_SQL)
+    finally:
+        if profile_was is None:
+            os.environ.pop("MO_FUSION_PROFILE", None)
+        else:
+            os.environ["MO_FUSION_PROFILE"] = profile_was
+    stage_device_s = round(
+        M.fusion_step_seconds.get(kind="device") - dev0, 4)
+    stage_host_s = round(
+        M.fusion_step_seconds.get(kind="host") - host0, 4)
+    # ---- the pre-fusion per-operator path, kept as its own
+    # non-comparable metric family (same convention as the r04->r05
+    # object-backed methodology split): trends continue for both
+    fusion_was = os.environ.get("MO_PLAN_FUSION")
+    os.environ["MO_PLAN_FUSION"] = "0"
+    try:
+        s.execute(tpch.Q1_SQL)                # re-warm the unfused jits
+        best_unfused = 0.0
+        for _ in range(2):
+            t0 = time.time()
+            s.execute(tpch.Q1_SQL)
+            best_unfused = max(best_unfused, n / (time.time() - t0))
+    finally:
+        if fusion_was is None:
+            os.environ.pop("MO_PLAN_FUSION", None)
+        else:
+            os.environ["MO_PLAN_FUSION"] = fusion_was
     cache = blockcache.CACHE.stats()
     # roofline-style evidence for the scan+agg path: Q1 touches 7
     # columns (l_quantity/extendedprice/discount/tax as decimal64,
@@ -153,14 +195,30 @@ def bench_q1(n: int = None) -> dict:
             udf_entry = {"metric": "udf_qps", "value": 0,
                          "unit": "error", "vs_baseline": None,
                          "error": f"{type(e).__name__}: {e}"}
-    extras = [m for m in (serving, udf_entry) if m]
+    unfused_entry = {
+        # the per-operator path's own family: the absolute floor for it
+        # stays in BENCH_FLOORS.json, the fused family gets its own
+        "metric": f"tpch_q1_rows_per_sec_{n}",
+        "value": round(best_unfused, 1),
+        "unit": "rows/s",
+        "vs_baseline": None,
+        "plan_fusion": 0,
+        "backend": jax.default_backend(),
+    }
+    extras = [m for m in (unfused_entry, serving, udf_entry) if m]
     return {
         **({"extra_metrics": extras} if extras else {}),
-        "metric": f"tpch_q1_rows_per_sec_{n}",
+        "metric": f"tpch_q1_fused_rows_per_sec_{n}",
         "value": round(best, 1),
         "unit": "rows/s",
         "vs_baseline": None,
         "exact_vs_oracle": exact,
+        "fused_dispatches": int(fused_dispatches),
+        "trace_seconds": round(trace_seconds, 4),
+        "stage_device_seconds": stage_device_s,
+        "stage_host_seconds": stage_host_s,
+        "fused_over_unfused": (round(best / best_unfused, 2)
+                               if best_unfused else None),
         "load_seconds": round(t_load, 2),
         "cold_run_seconds": round(t_cold, 2),
         "object_backed": True,

@@ -358,7 +358,8 @@ class Session:
                 skip_tables=self._index_skip_tables())
             if stmt.analyze:
                 return Result(text=self._explain_analyze(node))
-            return Result(text=P.explain(node))
+            return Result(text=P.explain(
+                node, annotate=self._fragment_annotator(node)))
         if isinstance(stmt, ast.CreatePublication):
             self.catalog.create_publication(stmt.name, stmt.tables)
             return Result()
@@ -578,6 +579,19 @@ class Session:
             return Result()
         raise BindError(f"unsupported statement {type(stmt).__name__}")
 
+    def _fragment_annotator(self, node):
+        """EXPLAIN decoration: compile the operator tree (cheap, no
+        execution) and mark which plan nodes fused into which fragment."""
+        from matrixone_tpu.vm import fusion
+        if not fusion.enabled(self._ctx()):
+            return None
+        op = compile_plan(node, self._ctx())
+        fmap = fusion.fragment_map(op)
+        if not fmap:
+            return None
+        return lambda n: (f" fragment=f{fmap[id(n)]}"
+                          if id(n) in fmap else "")
+
     def _explain_analyze(self, node) -> str:
         """Run the plan, recording per-operator batches/rows/time
         (reference: EXPLAIN ANALYZE via process.Analyzer/OpAnalyzer,
@@ -628,12 +642,21 @@ class Session:
 
         def render(o, indent=0):
             from matrixone_tpu.sql.plan import _udf_call_notes
+            from matrixone_tpu.vm.fusion import FusedFragmentOp
             st = stats[id(o)]
             notes = _udf_call_notes(getattr(o, "node", None)) \
                 if getattr(o, "node", None) is not None else ""
             line = ("  " * indent + f"{st['op']}{notes}  rows={st['rows']} "
                     f"batches={st['batches']} time={st['seconds']*1000:.1f}ms")
             out = [line]
+            if isinstance(o, FusedFragmentOp):
+                fs = o.last_stats
+                out.append(
+                    "  " * (indent + 1)
+                    + f"fragment f{o.fragment_id} [{o.describe()}] "
+                      f"mode={fs['mode']} dispatches={fs['dispatches']} "
+                      f"trace_ms={fs['trace_ms']:.1f} "
+                      f"compile_cache={fs['cache']}")
             if notes:
                 # the UdfCall rides the operator's pull loop: its
                 # rows/batches ARE the operator's (EXPLAIN ANALYZE
@@ -901,6 +924,20 @@ class Session:
             else:
                 raise BindError(f"unknown udf subcommand {arg!r}; "
                                 "use status | clear")
+        elif cmd == "fusion":
+            # whole-plan fusion ops surface (vm/fusion.py): fragment
+            # compile-cache + execution-mode counters, matching the
+            # mo_ctl('udf'|'serving') pattern
+            import json as _json
+            from matrixone_tpu.vm import fusion
+            if arg in ("", "status"):
+                out = _json.dumps(fusion.stats(), sort_keys=True)
+            elif arg == "clear":
+                fusion.CACHE.clear()
+                out = "fusion compile cache cleared"
+            else:
+                raise BindError(f"unknown fusion subcommand {arg!r}; "
+                                "use status | clear")
         elif cmd == "lint":
             # static-analysis ops surface (tools/molint): checker
             # inventory, last-run findings, suppression count —
@@ -1027,14 +1064,43 @@ class Session:
         if sv is not None and sv.result_enabled():
             versions, frozen = self._capture_versions(node)
         ctx = self._ctx(frozen_ts=frozen)
-        node = self._maybe_distribute(node, ctx)
-        op = compile_plan(node, ctx)
+        node2 = self._maybe_distribute(node, ctx)
+        # ---- compiled-tree reuse: a plan-cache hit used to rebuild the
+        # full operator tree anyway; the tree of the last completed
+        # execution rides the plan-cache entry (identity-guard POP: a
+        # concurrent execution finds None and compiles its own)
+        op = None
+        tree_cacheable = (sv is not None and sv.template_mode
+                          and sv.plan_enabled() and node2 is node)
+        tree_vars = self._tree_vars_sig() if tree_cacheable else None
+        if tree_cacheable:
+            cached = sv.state.plan_cache.take_tree(
+                sv.plan_key(), gens[0], gens[1], tree_vars)
+            if cached is not None:
+                op = sv.state.plan_cache.rebind_tree(cached, sv.full)
+                if op is not None:
+                    from matrixone_tpu.vm.compile import retarget_tree
+                    retarget_tree(op, ctx)
+                    # the tree's plan nodes are the authoritative ones
+                    # for this execution (params patched in place)
+                    node = cached["plan"]
+        built = None
+        if op is None:
+            op = compile_plan(node2, ctx)
+            node = node2
+            if tree_cacheable:
+                built = {"op": op, "plan": node2}
+        else:
+            built = cached
         out_batches = []
         for ex in op.execute():
             # KILL lands between device batches (queryservice): the pull
             # loop is the engine's natural preemption point
             self._procs.check_killed(self.conn_id)
             out_batches.append(self._to_host(ex, node.schema))
+        if tree_cacheable and built is not None:
+            sv.state.plan_cache.put_tree(sv.plan_key(), built, gens[0],
+                                         gens[1], tree_vars)
         if not out_batches:
             empty = {n: Vector.from_values([], d) for n, d in node.schema}
             result = Result(batch=Batch(empty))
@@ -1053,6 +1119,16 @@ class Session:
             sv.state.result_cache.put(sv.result_key(), result.batch,
                                       versions)
         return result
+
+    def _tree_vars_sig(self) -> tuple:
+        """Session state BAKED into a compiled operator tree at build
+        time (everything else is re-read through the ExecContext at
+        execute time): pallas kernel selection and the fusion gate."""
+        from matrixone_tpu.ops import pallas_kernels as PK
+        from matrixone_tpu.vm import fusion
+        return (bool(PK.effective_use_pallas(
+                    self.variables.get("use_pallas"))),
+                fusion.enabled(self._ctx()))
 
     # ------------------------------------------------- serving versions
     def _serving_gens(self):

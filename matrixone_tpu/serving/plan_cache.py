@@ -295,7 +295,7 @@ def plan_is_cacheable(plan, n_params: int) -> bool:
 
 class _Entry:
     __slots__ = ("plan", "n_params", "ddl_gen", "stats_gen", "cacheable",
-                 "tables")
+                 "tables", "tree", "tree_vars")
 
     def __init__(self, plan, n_params, ddl_gen, stats_gen,
                  cacheable=True, tables=()):
@@ -305,6 +305,12 @@ class _Entry:
         self.stats_gen = stats_gen
         self.cacheable = cacheable
         self.tables = tuple(tables)
+        #: compiled operator tree of the LAST completed execution (the
+        #: {"op", "plan"} pair) — popped on take, stored back after a
+        #: successful run, same identity-guard discipline as the result
+        #: cache: a concurrent taker finds None and rebuilds
+        self.tree = None
+        self.tree_vars = None
 
 
 class PlanCache:
@@ -441,6 +447,70 @@ class PlanCache:
                     return None     # type signature drift: full re-bind
                 lit.value = fresh.value
         return plan
+
+    # ---------------------------------------------- compiled op trees
+    def take_tree(self, key: tuple, ddl_gen: int, stats_gen: int,
+                  vars_sig) -> Optional[dict]:
+        """Pop the cached compiled operator tree for this plan key.
+        POP semantics (not peek): operator trees hold per-execution
+        state and must never run concurrently — a second taker finds
+        None and compiles its own tree.  Gen or session-variable drift
+        drops the tree (the plan entry itself is invalidated by the
+        ordinary lookup path)."""
+        from matrixone_tpu.utils import metrics as M
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.tree is None:
+                return None
+            tree, e.tree = e.tree, None
+            if e.ddl_gen != ddl_gen or e.stats_gen != stats_gen \
+                    or e.tree_vars != vars_sig:
+                return None           # stale: dropped, caller rebuilds
+        M.plan_cache_ops.inc(outcome="tree_hit")
+        return tree
+
+    def put_tree(self, key: tuple, tree: dict, ddl_gen: int,
+                 stats_gen: int, vars_sig) -> None:
+        """Store a compiled tree back after a successful execution —
+        only onto the entry it was built against (same gens); a raced
+        DDL orphans the tree along with the plan."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or not e.cacheable \
+                    or e.ddl_gen != ddl_gen or e.stats_gen != stats_gen:
+                return
+            e.tree = tree
+            e.tree_vars = vars_sig
+
+    @staticmethod
+    def rebind_tree(tree: dict, params: list):
+        """Patch fresh parameter values into a cached compiled tree's
+        tagged literals IN PLACE (the operator tree references the same
+        BoundLiteral objects as its plan).  Returns the operator root,
+        or None when the tree cannot be safely re-parameterized (the
+        caller rebuilds; the popped tree is discarded)."""
+        from matrixone_tpu.sql import ast
+        from matrixone_tpu.sql.binder import BindError, _bind_literal
+        op, plan = tree["op"], tree["plan"]
+        if not params:
+            return op
+        from matrixone_tpu.frontend.session import _param_literal
+        found = tagged_literals(plan)
+        if set(found) != set(range(len(params))):
+            return None
+        for idx, v in enumerate(params):
+            try:
+                src = _param_literal(v)
+                if not isinstance(src, ast.Literal):
+                    return None       # date params re-bind the long way
+                fresh = _bind_literal(src)
+            except BindError:
+                return None
+            for lit in found[idx]:
+                if lit.dtype != fresh.dtype:
+                    return None       # dtype drift: full rebuild
+                lit.value = fresh.value
+        return op
 
     def store(self, key: tuple, plan, n_params: int, ddl_gen: int,
               stats_gen: int, tables=()) -> None:

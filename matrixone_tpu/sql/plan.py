@@ -230,7 +230,9 @@ def _udf_call_notes(node: PlanNode) -> str:
     return " " + " ".join(seen)
 
 
-def explain(node: PlanNode, indent: int = 0) -> str:
+def explain(node: PlanNode, indent: int = 0, annotate=None) -> str:
+    """Render a plan tree.  `annotate(node) -> str` appends per-node
+    decorations (the session uses it to mark fusion fragment ids)."""
     pad = "  " * indent
     name = type(node).__name__
     extra = ""
@@ -249,11 +251,13 @@ def explain(node: PlanNode, indent: int = 0) -> str:
     elif isinstance(node, FulltextTopK):
         extra = f" index={node.index_name} k={node.k} query={node.query!r}"
     extra += _udf_call_notes(node)
+    if annotate is not None:
+        extra += annotate(node)
     lines = [f"{pad}{name}{extra}  -> {[n for n, _ in node.schema]}"]
     for attr in ("child", "left", "right"):
         c = getattr(node, attr, None)
         if c is not None:
-            lines.append(explain(c, indent + 1))
+            lines.append(explain(c, indent + 1, annotate))
     for c in getattr(node, "children", []) or []:
-        lines.append(explain(c, indent + 1))
+        lines.append(explain(c, indent + 1, annotate))
     return "\n".join(lines)

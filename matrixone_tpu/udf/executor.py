@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import os
 import threading
-from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -66,33 +65,25 @@ class UdfCompileCache:
     signature.  `mo_ctl('udf', 'status'|'clear')` exposes it."""
 
     def __init__(self, max_entries: Optional[int] = None):
+        from matrixone_tpu.utils.lru import LruCache, env_entries
         if max_entries is None:
-            try:
-                max_entries = int(os.environ.get(
-                    "MO_UDF_COMPILE_CACHE", "") or 256)
-            except ValueError:
-                max_entries = 256
-        self.max_entries = max(max_entries, 8)
-        self._lock = threading.Lock()
-        self._entries: "OrderedDict[tuple, dict]" = OrderedDict()
+            max_entries = env_entries("MO_UDF_COMPILE_CACHE", 256)
+        self._lru = LruCache(max_entries)
+
+    @property
+    def max_entries(self) -> int:
+        return self._lru.max_entries
 
     def entry(self, key: tuple, name: str, body: str,
               arg_names: List[str]) -> dict:
-        with self._lock:
-            e = self._entries.get(key)
-            if e is not None:
-                self._entries.move_to_end(key)
-                M.udf_compile.inc(outcome="hit")
-                return e
+        e = self._lru.lookup(key)
+        if e is not None:
+            M.udf_compile.inc(outcome="hit")
+            return e
         M.udf_compile.inc(outcome="miss")
         fn = compile_body(name, body, arg_names)   # UdfError on bad body
-        e = {"py": fn, "jit": None, "name": name}
-        with self._lock:
-            e = self._entries.setdefault(key, e)
-            self._entries.move_to_end(key)
-            while len(self._entries) > self.max_entries:
-                self._entries.popitem(last=False)
-        return e
+        return self._lru.insert(key, {"py": fn, "jit": None,
+                                      "name": name})
 
     def jitted(self, e: dict):
         """Jitted wrapper for an entry (created once; _JIT_FAILED after a
@@ -109,15 +100,17 @@ class UdfCompileCache:
     def jit_failed(self, e: dict) -> bool:
         return e["jit"] is _JIT_FAILED
 
+    def peek(self, key: tuple) -> Optional[dict]:
+        """Resident entry or None (EXPLAIN's tier prediction)."""
+        return self._lru.lookup(key)
+
     def clear(self) -> None:
-        with self._lock:
-            self._entries.clear()
+        self._lru.clear()
 
     def stats(self) -> dict:
-        with self._lock:
-            n = len(self._entries)
-            failed = sum(1 for e in self._entries.values()
-                         if e["jit"] is _JIT_FAILED)
+        entries = self._lru.snapshot()
+        n = len(entries)
+        failed = sum(1 for e in entries if e["jit"] is _JIT_FAILED)
         return {"entries": n, "jit_failed": failed,
                 "max_entries": self.max_entries,
                 "hits": int(M.udf_compile.get(outcome="hit")),
@@ -156,7 +149,7 @@ def expected_tier(e) -> str:
         return "remote"
     if not (_jit_enabled() and e.vectorized):
         return "row"
-    ce = COMPILE_CACHE._entries.get(_cache_key(e))
+    ce = COMPILE_CACHE.peek(_cache_key(e))
     if ce is not None and ce["jit"] is _JIT_FAILED:
         return "row"
     return "jit"

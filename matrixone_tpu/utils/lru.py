@@ -1,0 +1,59 @@
+"""Shared thread-safe LRU for the compile caches.
+
+The UDF body cache (udf/executor.UdfCompileCache) and the fused-fragment
+cache (vm/fusion.FragmentCompileCache) need the same discipline — lock +
+recency refresh + eviction past a budget, with an env-tunable size — so
+the machinery lives once, here; the callers keep their own entry shapes
+and metric accounting."""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+
+def env_entries(var: str, default: int) -> int:
+    """Cache-size knob: the env var when it parses, else the default."""
+    try:
+        return int(os.environ.get(var, "") or default)
+    except ValueError:
+        return default
+
+
+class LruCache:
+    def __init__(self, max_entries: int):
+        self.max_entries = max(int(max_entries), 8)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict" = OrderedDict()
+
+    def lookup(self, key):
+        """-> resident entry or None, refreshing recency."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                self._entries.move_to_end(key)
+            return e
+
+    def insert(self, key, value):
+        """Idempotent insert (a concurrently-created entry wins) +
+        eviction past the budget; returns the resident entry."""
+        with self._lock:
+            e = self._entries.setdefault(key, value)
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+            return e
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def snapshot(self) -> list:
+        """Point-in-time list of entries (stats introspection)."""
+        with self._lock:
+            return list(self._entries.values())

@@ -240,6 +240,25 @@ admission_running = REGISTRY.gauge(
 admission_queued = REGISTRY.gauge(
     "mo_admission_queued", "statements waiting in the admission queue")
 
+# ---- whole-plan XLA fusion (vm/fusion.py)
+fusion_dispatch = REGISTRY.counter(
+    "mo_fusion_dispatch_total",
+    "fused-fragment step executions by kind (step = one compiled "
+    "device program per batch; eager = degraded per-op evaluation)")
+fusion_compile = REGISTRY.counter(
+    "mo_fusion_compile_total",
+    "fragment compile-cache lookups by outcome (hit/miss/trace_fail)")
+fusion_trace_seconds = REGISTRY.counter(
+    "mo_fusion_trace_seconds_total",
+    "seconds spent tracing+compiling fused fragment programs")
+fusion_exec = REGISTRY.counter(
+    "mo_fusion_exec_total",
+    "fragment executions by mode (fused/eager/fallback/degraded)")
+fusion_step_seconds = REGISTRY.counter(
+    "mo_fusion_step_seconds_total",
+    "fused step wall seconds by kind (device vs host bookkeeping; "
+    "filled under MO_FUSION_PROFILE=1 diagnostic runs, bench.py)")
+
 # ---- Python/JAX UDF subsystem (udf/, reference: pkg/udf/pythonservice)
 udf_calls = REGISTRY.counter(
     "mo_udf_calls_total",

@@ -1,6 +1,13 @@
 """Plan -> operator tree (reference: pkg/sql/compile/compile.go:670
 compileScope, collapsed: one process, one pipeline per plan for now;
-ParallelRun/RemoteRun equivalents live in matrixone_tpu.parallel)."""
+ParallelRun/RemoteRun equivalents live in matrixone_tpu.parallel).
+
+After the tree is built, the whole-plan fusion pass (vm/fusion.py)
+replaces maximal jit-traceable operator chains with FusedFragmentOp
+nodes — one compiled XLA program per (plan-shape, dtype-signature,
+padded-batch-bucket) instead of per-operator dispatches.  `MO_PLAN_FUSION=0`
+(or `SET plan_fusion = 0`) preserves the per-operator path unchanged.
+"""
 
 from __future__ import annotations
 
@@ -12,6 +19,48 @@ from matrixone_tpu.vm.process import ExecContext
 def compile_plan(node: P.PlanNode, ctx) -> ops.Operator:
     if not isinstance(ctx, ExecContext):
         ctx = ExecContext(catalog=ctx)
+    op = _compile_node(node, ctx)
+    from matrixone_tpu.vm import fusion
+    if fusion.enabled(ctx):
+        op = fusion.fuse_operator_tree(op, ctx)
+    return op
+
+
+def iter_ops(root: ops.Operator):
+    """Every operator reachable through the standard tree attributes
+    (fragments expose their source as `child`, so this walks through
+    them)."""
+    stack = [root]
+    while stack:
+        op = stack.pop()
+        yield op
+        for attr in ("child", "left", "right"):
+            c = getattr(op, attr, None)
+            if isinstance(c, ops.Operator):
+                stack.append(c)
+        for c in getattr(op, "children", None) or []:
+            if isinstance(c, ops.Operator):
+                stack.append(c)
+
+
+def retarget_tree(root: ops.Operator, ctx: ExecContext) -> None:
+    """Prepare a cached compiled operator tree for a fresh execution:
+    point every operator at the new ExecContext (snapshot ts, session
+    variables) and clear per-execution state that would otherwise leak
+    across runs (runtime filters injected by joins, union-wide string
+    dictionaries)."""
+    from matrixone_tpu.vm.operators import ScanOp, UnionOp
+    for op in iter_ops(root):
+        if hasattr(op, "ctx"):
+            op.ctx = ctx
+        if isinstance(op, ScanOp):
+            op.runtime_filters = []
+        if isinstance(op, UnionOp):
+            op._union_dicts = {}
+            op._union_lut = {}
+
+
+def _compile_node(node: P.PlanNode, ctx: ExecContext) -> ops.Operator:
     catalog = ctx.catalog
     if isinstance(node, P.Scan):
         rel = catalog.get_table(node.table)
@@ -21,33 +70,33 @@ def compile_plan(node: P.PlanNode, ctx) -> ops.Operator:
     if isinstance(node, P.Materialized):
         return ops.MaterializedOp(node)
     if isinstance(node, P.Filter):
-        return ops.FilterOp(node, compile_plan(node.child, ctx))
+        return ops.FilterOp(node, _compile_node(node.child, ctx))
     if isinstance(node, P.Project):
-        return ops.ProjectOp(node, compile_plan(node.child, ctx))
+        return ops.ProjectOp(node, _compile_node(node.child, ctx))
     if isinstance(node, P.UdfAggregate):
-        return ops.UdfAggregateOp(node, compile_plan(node.child, ctx))
+        return ops.UdfAggregateOp(node, _compile_node(node.child, ctx))
     if isinstance(node, P.Aggregate):
         from matrixone_tpu.ops import pallas_kernels as PK
-        return ops.AggOp(node, compile_plan(node.child, ctx),
+        return ops.AggOp(node, _compile_node(node.child, ctx),
                          use_pallas=PK.effective_use_pallas(
                              (ctx.variables or {}).get("use_pallas")))
     if isinstance(node, P.Sort):
-        return ops.SortOp(node, compile_plan(node.child, ctx))
+        return ops.SortOp(node, _compile_node(node.child, ctx))
     if isinstance(node, P.TopK):
-        return ops.TopKOp(node, compile_plan(node.child, ctx))
+        return ops.TopKOp(node, _compile_node(node.child, ctx))
     if isinstance(node, P.Limit):
-        return ops.LimitOp(node, compile_plan(node.child, ctx))
+        return ops.LimitOp(node, _compile_node(node.child, ctx))
     if isinstance(node, P.Window):
         from matrixone_tpu.vm.window import WindowOp
-        return WindowOp(node, compile_plan(node.child, ctx))
+        return WindowOp(node, _compile_node(node.child, ctx))
     if isinstance(node, P.Distinct):
-        return ops.DistinctOp(node, compile_plan(node.child, ctx))
+        return ops.DistinctOp(node, _compile_node(node.child, ctx))
     if isinstance(node, P.Sample):
-        return ops.SampleOp(node, compile_plan(node.child, ctx))
+        return ops.SampleOp(node, _compile_node(node.child, ctx))
     if isinstance(node, P.Fill):
-        return ops.FillOp(node, compile_plan(node.child, ctx))
+        return ops.FillOp(node, _compile_node(node.child, ctx))
     if isinstance(node, P.Union):
-        return ops.UnionOp(node, [compile_plan(c, ctx)
+        return ops.UnionOp(node, [_compile_node(c, ctx)
                                   for c in node.children])
     if isinstance(node, P.FulltextTopK):
         from matrixone_tpu.vm.fulltext_scan import FulltextTopKOp
@@ -57,6 +106,6 @@ def compile_plan(node: P.PlanNode, ctx) -> ops.Operator:
         return VectorTopKOp(node, ctx)
     if isinstance(node, P.Join):
         from matrixone_tpu.vm.join import JoinOp
-        return JoinOp(node, compile_plan(node.left, ctx),
-                      compile_plan(node.right, ctx), ctx=ctx)
+        return JoinOp(node, _compile_node(node.left, ctx),
+                      _compile_node(node.right, ctx), ctx=ctx)
     raise NotImplementedError(f"compile: {type(node).__name__}")

@@ -58,6 +58,31 @@ class EvalError(ValueError):
     pass
 
 
+#: trace-time literal lifting (vm/fusion.py): inside a fused-fragment
+#: trace, selected numeric literals evaluate to traced input scalars
+#: instead of baked constants, so one compiled program serves every
+#: parameter value of the same plan shape.  The binding is thread-local
+#: and only ever active while a fragment trace is being built.
+_LIFT_TLS = __import__("threading").local()
+
+
+class lifted_literal_scope:
+    """Bind {id(BoundLiteral): traced 0-d array} for the duration of a
+    fragment trace; nests (the previous map is restored on exit)."""
+
+    def __init__(self, mapping: Dict[int, object]):
+        self._mapping = mapping
+
+    def __enter__(self):
+        self._prev = getattr(_LIFT_TLS, "map", None)
+        _LIFT_TLS.map = self._mapping
+        return self
+
+    def __exit__(self, *exc):
+        _LIFT_TLS.map = self._prev
+        return False
+
+
 def _is_varchar(dtype: DType) -> bool:
     return dtype.is_varlen
 
@@ -87,6 +112,13 @@ def eval_expr(e: BoundExpr, ex: ExecBatch) -> DeviceColumn:
     if isinstance(e, BoundCol):
         return ex.batch.columns[e.name]
     if isinstance(e, BoundLiteral):
+        lifted = getattr(_LIFT_TLS, "map", None)
+        if lifted is not None:
+            v = lifted.get(id(e))
+            if v is not None:
+                # fused-fragment trace: the literal is a traced input
+                return DeviceColumn(jnp.reshape(v, (1,)),
+                                    jnp.ones((1,), jnp.bool_), e.dtype)
         if e.value is None:
             return DeviceColumn.const_null(e.dtype)
         if e.dtype.is_vector:

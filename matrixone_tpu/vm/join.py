@@ -44,8 +44,17 @@ def _probe_scans(op, name: str):
     (Filter: conjunctive; Project: plain column renames)."""
     from matrixone_tpu.sql.expr import BoundCol
     from matrixone_tpu.vm import operators as O
+    from matrixone_tpu.vm.fusion import FusedFragmentOp
     if isinstance(op, O.FilterOp):
         return _probe_scans(op.child, name)
+    if isinstance(op, FusedFragmentOp):
+        # walk the fragment's fused project renames down to its source;
+        # the fragment reads runtime_filters off the scan at execute
+        # time and folds them into its traced predicate
+        src_name = op.resolve_column(name)
+        if src_name is None:
+            return []
+        return _probe_scans(op.child, src_name)
     if isinstance(op, O.ProjectOp):
         for (n, _), e in zip(op.node.schema, op.node.exprs):
             if n == name:

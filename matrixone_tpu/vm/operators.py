@@ -156,6 +156,13 @@ class ScanOp(Operator):
         self.runtime_filters: List[BoundExpr] = []
 
     def execute(self) -> Iterator[ExecBatch]:
+        return self._batches(apply_mask=True)
+
+    def _batches(self, apply_mask: bool = True) -> Iterator[ExecBatch]:
+        """Chunk iterator.  With apply_mask=False the pushed filters are
+        still handed to iter_chunks (zonemap pruning) but NOT evaluated
+        as an early row mask — a fused fragment (vm/fusion.py) folds
+        them into its single traced program instead."""
         from matrixone_tpu.utils import metrics as M
         from matrixone_tpu.utils.fault import INJECTOR
         INJECTOR.trigger("scan.before")
@@ -205,9 +212,11 @@ class ScanOp(Operator):
                 # evaluate pushed filters as an early mask (zonemap
                 # pruning already dropped fully-excluded chunks
                 # host-side)
-                for f in filters:
-                    pred = eval_expr(f, ex)
-                    ex.mask = ex.mask & F.predicate_mask(pred, ex.batch)
+                if apply_mask:
+                    for f in filters:
+                        pred = eval_expr(f, ex)
+                        ex.mask = ex.mask & F.predicate_mask(pred,
+                                                             ex.batch)
                 yield ex
         finally:
             if prefetcher is not None:
@@ -556,7 +565,13 @@ class AggOp(Operator):
         for ex in self.child.execute():
             tracker.observe(ex)
             for i, a in enumerate(self.node.aggs):
-                states[i] = _scalar_step(a, ex, states[i])
+                states[i] = _scalar_step_host(a, ex, states[i])
+        yield self._scalar_result(states, tracker)
+
+    def _scalar_result(self, states, tracker) -> ExecBatch:
+        """Finalize scalar-agg states -> the single output batch (shared
+        by the pull loop above and the fused-fragment path, which folds
+        the per-batch `_scalar_step` into one traced program)."""
         cols, n1 = {}, jnp.asarray(1, jnp.int32)
         out_dicts: Dict[str, list] = {}
         for (name, dtype), a, st in zip(self.node.schema[len(self.node.group_keys):],
@@ -568,25 +583,34 @@ class AggOp(Operator):
                 out_dicts[name] = d
             cols[name] = col
         db = DeviceBatch(columns=cols, n_rows=n1)
-        yield ExecBatch(batch=db, dicts=out_dicts,
-                        mask=jnp.ones((1,), jnp.bool_))
+        return ExecBatch(batch=db, dicts=out_dicts,
+                         mask=jnp.ones((1,), jnp.bool_))
 
     # ---- grouped
-    def _grouped_agg(self):
+    def _grouped_agg(self, seed=None, seed_dicts=None):
+        """`seed`/`seed_dicts`: a partial group-table state handed over
+        by a fused fragment that had to degrade mid-stream (a key
+        dictionary grew); the remaining batches continue on the general
+        path with the fused partials already folded in."""
         nkeys = len(self.node.group_keys)
-        key_dicts: List[Optional[List[str]]] = [None] * nkeys
-        self._agg_tracker = _AggDictTracker(self.node.aggs)
+        key_dicts: List[Optional[List[str]]] = \
+            list(seed_dicts) if seed_dicts is not None else [None] * nkeys
+        if not hasattr(self, "_agg_tracker") or seed is None:
+            self._agg_tracker = _AggDictTracker(self.node.aggs)
         try:
-            yield from self._grouped_agg_inner(nkeys, key_dicts)
+            yield from self._grouped_agg_inner(nkeys, key_dicts,
+                                               seed=seed)
         finally:
             if self._spill is not None:     # exception escaped mid-spill
                 self._spill.cleanup()
                 self._spill = None
 
-    def _grouped_agg_inner(self, nkeys, key_dicts):
-        state = None   # dict: keys:[arrays], kvalid:[arrays], partials per agg
+    def _grouped_agg_inner(self, nkeys, key_dicts, seed=None):
+        state = seed   # dict: keys:[arrays], kvalid:[arrays], partials per agg
         dense = None       # small-key dense accumulator (no hash, no sort)
-        dense_checked = False
+        # a seeded state is already in general form: the dense fast path
+        # cannot absorb it, so it stays off for the remaining stream
+        dense_checked = seed is not None
         for ex in self.child.execute():
             self._agg_tracker.observe(ex)
             keys = [eval_expr(k, ex) for k in self.node.group_keys]
@@ -1117,6 +1141,27 @@ def _grouped_final(a: AggCall, part, dtype: DType) -> DeviceColumn:
     raise EvalError(a.func)
 
 
+def _scalar_step_host(a: AggCall, ex: ExecBatch, state):
+    """Per-batch scalar partial including the host-side families
+    (bitwise aggregates reduce via numpy ufuncs).  The pull loop uses
+    this; fused fragments trace `_scalar_step`, which must stay pure —
+    the fusion planner never fuses BIT_AGGS."""
+    if a.func in BIT_AGGS:
+        col = _agg_value(a, ex)
+        m = ex.mask & col.validity
+        d = np.asarray(jax.device_get(col.data)).astype(np.int64)
+        mm = np.asarray(jax.device_get(m))
+        v = _BIT_UFUNC[a.func].reduce(d[mm]) if mm.any() \
+            else _BIT_IDENT[a.func]
+        c = A.scalar_count(m)
+        if state is None:
+            return (jnp.asarray(np.int64(v)), c)
+        merged = _BIT_UFUNC[a.func](
+            np.int64(jax.device_get(state[0])), np.int64(v))
+        return (jnp.asarray(merged), state[1] + c)
+    return _scalar_step(a, ex, state)
+
+
 def _scalar_step(a: AggCall, ex: ExecBatch, state):
     if a.func == "count" and a.arg is None:
         v = A.scalar_count(ex.mask)
@@ -1152,17 +1197,6 @@ def _scalar_step(a: AggCall, ex: ExecBatch, state):
         if state is None:
             return (s, s2, c)
         return (state[0] + s, state[1] + s2, state[2] + c)
-    if a.func in BIT_AGGS:
-        d = np.asarray(jax.device_get(col.data)).astype(np.int64)
-        mm = np.asarray(jax.device_get(m))
-        v = _BIT_UFUNC[a.func].reduce(d[mm]) if mm.any() \
-            else _BIT_IDENT[a.func]
-        c = A.scalar_count(m)
-        if state is None:
-            return (jnp.asarray(np.int64(v)), c)
-        merged = _BIT_UFUNC[a.func](
-            np.int64(jax.device_get(state[0])), np.int64(v))
-        return (jnp.asarray(merged), state[1] + c)
     raise EvalError(a.func)
 
 

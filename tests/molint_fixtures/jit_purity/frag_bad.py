@@ -1,0 +1,21 @@
+"""jit-purity fixture: a fused-fragment-style class whose traced step
+is wrapped via an ATTRIBUTE reference (`jax.jit(self._traced_step)`) —
+the root must be discovered even though no decorator or plain-Name wrap
+names it.  AST-only — never imported or executed."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+class BadFragment:
+    def _traced_step(self, datas, mask):
+        # reachable from jit through the attribute wrap below:
+        # wall-clock read freezes at trace time
+        scale = time.perf_counter()
+        return jnp.sum(jnp.where(mask, datas, 0.0)) * scale
+
+    def compile_step(self, datas, mask):
+        compiled = jax.jit(self._traced_step)
+        return compiled(datas, mask)

@@ -85,6 +85,18 @@ def test_jit_purity_fixtures():
     assert ".item()" in msgs
 
 
+def test_jit_purity_attribute_wrapped_roots():
+    """Fused-fragment-style trace roots wrapped via an attribute
+    reference (`jax.jit(self._traced_step)`) are discovered and walked;
+    the same shape with a pure body stays quiet."""
+    d = os.path.join(FIX, "jit_purity")
+    bad = _fixture_pair("jit-purity",
+                        [os.path.join(d, "frag_bad.py")],
+                        [os.path.join(d, "frag_good.py")])
+    assert any("_traced_step" in f.message
+               and "time.perf_counter" in f.message for f in bad)
+
+
 def test_lock_discipline_fixtures():
     d = os.path.join(FIX, "lock_discipline")
     bad = _fixture_pair("lock-discipline",

@@ -54,11 +54,19 @@ def _is_jit_ref(node: ast.AST) -> bool:
 
 
 def _jit_wrap_target(call: ast.Call) -> Optional[str]:
-    """'f' when call is jit(f, ...) / partial(jit, ...)(f)? — only the
-    direct `jit(f)` / `shard_map(f, ...)` shape, f a plain Name."""
-    if _is_jit_ref(call.func) and call.args and \
-            isinstance(call.args[0], ast.Name):
-        return call.args[0].id
+    """'f' when call is jit(f, ...) / partial(jit, ...)(f)? — the
+    direct `jit(f)` / `shard_map(f, ...)` shape, f a plain Name OR an
+    attribute reference (`jax.jit(self._traced_step)` — how fused
+    fragments and other class-held trace roots wrap their callables:
+    the terminal attribute name resolves against the function index,
+    which keeps every same-named definition)."""
+    if not (_is_jit_ref(call.func) and call.args):
+        return None
+    tgt = call.args[0]
+    if isinstance(tgt, ast.Name):
+        return tgt.id
+    if isinstance(tgt, ast.Attribute):
+        return tgt.attr
     return None
 
 
