@@ -29,6 +29,9 @@ Sinks:
 from __future__ import annotations
 
 import threading
+import time
+
+from matrixone_tpu.utils import san
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -128,7 +131,12 @@ class CdcTask:
         self.watermark = from_ts
         # RLock: a sink that writes back into the same engine re-enters
         # _on_commit on this thread and must not self-deadlock
-        self._lock = threading.RLock()
+        self._lock = san.rlock("CdcTask._lock")
+        self._cv = san.condition(self._lock)
+        #: live deliveries currently running OUTSIDE the lock (see
+        #: _apply_event); backfill waits for them to drain before its
+        #: replay so the sink never sees two concurrent callers
+        self._inflight = 0
         # backfill-in-progress queue: live events arriving mid-backfill
         # are deferred, NOT applied (a live DELETE applied before its
         # row's backfill INSERT replays would be resurrected by that
@@ -171,7 +179,7 @@ class CdcTask:
     def _on_commit(self, commit_ts: int, table: str, kind: str, payload):
         if not self._active or table != self.table:
             return
-        with self._lock:
+        with self._cv:
             if self._buffering:
                 self._buffer.append((commit_ts, kind, payload))
                 return     # backfill drains the queue after its replay
@@ -180,10 +188,24 @@ class CdcTask:
             # all and makes restart delivery at-least-once
             if commit_ts < self.watermark:
                 return     # already shipped (restart replay)
+            self._inflight += 1
+        try:
             self._apply_event(commit_ts, kind, payload)
+        finally:
+            with self._cv:
+                self._inflight -= 1
+                self._cv.notify_all()
 
     def _apply_event(self, commit_ts: int, kind: str, payload) -> None:
-        """Deliver one event to the sink; caller holds self._lock."""
+        """Deliver one event to the sink, WITHOUT holding self._lock: a
+        sink that writes into an engine takes that engine's commit lock,
+        and holding the task lock across it closes the ABBA mosan's
+        dynamic lock-order graph caught (committer: commit lock -> task
+        lock in _on_commit; sink: task lock -> commit lock).  Sink calls
+        stay SERIAL without the lock: live events arrive under the
+        SOURCE engine's commit lock (one at a time), and a backfill
+        first arms buffering (queueing new arrivals) then waits out any
+        delivery already in flight (_inflight) before replaying."""
         if kind == "insert":
             pk = self.engine.get_table(self.table).meta.primary_key
             self.sink.on_insert(self.table, self._decode_segment(payload),
@@ -191,7 +213,8 @@ class CdcTask:
         elif kind == "delete":
             self.sink.on_delete(self.table, self._decode_pk_rows(
                 np.asarray(payload, np.int64)))
-        self.watermark = max(self.watermark, commit_ts)
+        with self._lock:
+            self.watermark = max(self.watermark, commit_ts)
 
     def _decode_pk_rows(self, gids: "np.ndarray") -> List[dict]:
         """PK values for deleted rows (segments still hold the data —
@@ -243,8 +266,14 @@ class CdcTask:
         lock taken per event, and arrivals queue in _buffer — drained in
         arrival order once the replay finishes.  Duplicates between the
         list and the queue are fine (at-least-once, PK sinks upsert)."""
-        with self._lock:
+        with self._cv:
             self._buffering = True
+            # wait out any live delivery that passed its buffering check
+            # before we armed it — the sink must never see two callers
+            # (bounded wait: a wedged sink must not wedge backfill too)
+            deadline = time.monotonic() + 30.0
+            while self._inflight > 0 and time.monotonic() < deadline:
+                self._cv.wait(timeout=1.0)
             t = self.engine.get_table(self.table)
             events = []
             for seg in t.segments:
@@ -257,18 +286,29 @@ class CdcTask:
             for ts, _, kind, payload in sorted(events, key=lambda e: e[:2]):
                 self._replay_event(ts, kind, payload)
         finally:
-            while True:
-                with self._lock:
-                    if not self._buffer:
-                        self._buffering = False
-                        break
-                    queued = self._buffer
-                    self._buffer = []
+            try:
+                while True:
+                    with self._cv:
+                        if not self._buffer:
+                            break
+                        queued = self._buffer
+                        self._buffer = []
+                    # apply OUTSIDE the lock (see _apply_event):
+                    # arrivals during this batch keep queueing
+                    # (_buffering is still True), so the next loop turn
+                    # picks them up
                     for ts, kind, payload in queued:
                         self._apply_event(ts, kind, payload)
+            finally:
+                # ANY exit — including a sink error mid-drain — must
+                # unbuffer, or every future live event queues forever;
+                # events stranded in _buffer stay recoverable because
+                # the watermark never advanced past them (re-backfill
+                # replays them, at-least-once)
+                with self._cv:
+                    self._buffering = False
 
     def _replay_event(self, commit_ts: int, kind: str, payload) -> None:
         """Deliver one backfill event regardless of the current watermark
         (which a live commit may have advanced past this event)."""
-        with self._lock:
-            self._apply_event(commit_ts, kind, payload)
+        self._apply_event(commit_ts, kind, payload)

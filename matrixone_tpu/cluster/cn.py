@@ -21,6 +21,9 @@ from __future__ import annotations
 
 import socket
 import threading
+
+from matrixone_tpu.utils import san
+from matrixone_tpu.utils.lifecycle import ServiceThreads
 import time
 from typing import Dict, List, Optional
 
@@ -71,7 +74,7 @@ class LogtailConsumer:
         self.strikes = 0
         self.broken = False
         self._healed_once = False
-        self._cv = threading.Condition()
+        self._cv = san.condition("LogtailConsumer._cv")
         self._caught_up = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -328,7 +331,7 @@ class RemoteCatalog:
         self.active_txns = 0
         self._txn_lease = txn_lease
         self._txn_tokens: Dict[int, str] = {}     # txn_id -> TN token
-        self._txn_mu = threading.Lock()
+        self._txn_mu = san.lock("RemoteCatalog._txn_mu")
         self._closed = threading.Event()
         self._renewer = threading.Thread(target=self._renew_loop,
                                          daemon=True)
@@ -568,23 +571,17 @@ class FragmentServer:
         self.port = self._sock.getsockname()[1]
         self._sock.listen(32)
         self._stopping = threading.Event()
+        self._svc = ServiceThreads("mo-frag")
 
     def start(self) -> "FragmentServer":
-        threading.Thread(target=self._serve, daemon=True).start()
+        self._svc.spawn_accept(self._serve)
         return self
 
     def stop(self) -> None:
         self._stopping.set()
-        try:
-            # close() alone does not wake a thread blocked in accept();
-            # the zombie listener would keep accepting connections
-            self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        # interrupt blocked accept/recv and JOIN everything with a
+        # deadline (mosan leak checker gates abandoned threads)
+        self._svc.shutdown(self._sock)
 
     def _serve(self) -> None:
         while not self._stopping.is_set():
@@ -592,8 +589,7 @@ class FragmentServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            threading.Thread(target=self._handle, args=(conn,),
-                             daemon=True).start()
+            self._svc.spawn_handler(self._handle, conn)
 
     def _handle(self, conn: socket.socket) -> None:
         from matrixone_tpu.parallel.fragments import (execute_fragment,

@@ -38,6 +38,8 @@ import random
 import socket
 import struct
 import threading
+
+from matrixone_tpu.utils import san
 import time
 from collections import OrderedDict
 from contextlib import contextmanager
@@ -188,7 +190,7 @@ class CircuitBreaker:
         self.failures = 0
         self.opened_at = 0.0
         self._probe_in_flight = False
-        self._lock = threading.Lock()
+        self._lock = san.lock("CircuitBreaker._lock")
 
     def allow(self) -> bool:
         with self._lock:
@@ -250,7 +252,7 @@ class CircuitBreaker:
 
 
 _breakers: Dict[tuple, CircuitBreaker] = {}
-_breakers_lock = threading.Lock()
+_breakers_lock = san.lock("matrixone_tpu.cluster.rpc._breakers_lock")
 
 
 def breaker_for(addr) -> CircuitBreaker:
@@ -298,7 +300,7 @@ class RequestDedup:
     def __init__(self, cap: int = 4096):
         self.cap = cap
         self._d: "OrderedDict[str, object]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = san.lock("RequestDedup._lock")
 
     def claim(self, rid: str, timeout: float = 30.0):
         """-> ("mine", None): caller must execute then complete(rid).
@@ -366,7 +368,7 @@ class RpcClient:
         self.pool_size = pool_size if pool_size is not None else POOL_SIZE
         self.retries = retries if retries is not None else RETRIES
         self._idle: List[socket.socket] = []
-        self._lock = threading.Lock()
+        self._lock = san.lock("RpcClient._lock")
         self._closed = False
         self.breaker = breaker_for(self.addr)
 
@@ -392,6 +394,9 @@ class RpcClient:
     # ---- call
     def call(self, header: dict, blob: bytes = b"",
              retryable: Optional[bool] = None) -> Tuple[dict, bytes]:
+        # mosan choke point: an RPC (with retries + backoff sleeps)
+        # under the commit lock or a cache lock stalls every writer
+        san.check_blocking("rpc.call")
         on = resilience_enabled()
         op = str(header.get("op", ""))
         if retryable is None:

@@ -13,6 +13,9 @@ import itertools
 import queue
 import socket
 import threading
+
+from matrixone_tpu.utils import san
+from matrixone_tpu.utils.lifecycle import ServiceThreads
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -49,7 +52,7 @@ class LogtailHub:
         self.wal = wal
         self.last_ts = 0
         self._subs: List[queue.Queue] = []
-        self._lock = threading.Lock()
+        self._lock = san.lock("LogtailHub._lock")
         self._backlog: List[tuple] = []      # (lsn, header, blob)
         self._next_lsn = 1
         for h, b in wal.replay():            # seed: one disk read, ever
@@ -88,6 +91,9 @@ class LogtailHub:
 
     def stop(self) -> None:
         self._stop.set()
+        # join with a deadline: the dispatch loop wakes within its 0.5s
+        # queue-poll tick (mosan's leak checker gates abandoned threads)
+        self._thread.join(timeout=5)
 
     def _dispatch_loop(self) -> None:
         while not self._stop.is_set():
@@ -155,7 +161,7 @@ class TNService:
         # open txn; merge defers while any live token exists.  Leases
         # expire so a kill -9'd CN cannot block merges forever.
         self._remote_txns: Dict[str, float] = {}     # token -> deadline
-        self._txn_lock = threading.Lock()
+        self._txn_lock = san.lock("TNService._txn_lock")
         self._txn_ids = itertools.count(1)
         # idempotency: retried CN calls (same rid, any connection) replay
         # the recorded response instead of re-executing the mutation
@@ -166,10 +172,11 @@ class TNService:
         self.port = self._sock.getsockname()[1]
         self._sock.listen(64)
         self._stopping = threading.Event()
+        self._svc = ServiceThreads("mo-tn")
 
     # ------------------------------------------------------------- serve
     def start(self) -> "TNService":
-        threading.Thread(target=self.serve_forever, daemon=True).start()
+        self._svc.spawn_accept(self.serve_forever)
         return self
 
     def serve_forever(self) -> None:
@@ -178,20 +185,14 @@ class TNService:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            threading.Thread(target=self._handle, args=(conn,),
-                             daemon=True).start()
+            self._svc.spawn_handler(self._handle, conn)
 
     def stop(self) -> None:
         self._stopping.set()
         self.hub.stop()
-        try:
-            self._sock.shutdown(socket.SHUT_RDWR)  # wake blocked accept
-        except OSError:
-            pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        # interrupt blocked accept/recv (incl. live logtail pushes) and
+        # join every thread this service started, with a deadline
+        self._svc.shutdown(self._sock)
 
     # ------------------------------------------------- remote txn leases
     def live_remote_txns(self) -> int:

@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+
+from matrixone_tpu.utils import san
 from typing import Dict, List, Optional, Set
 
 import numpy as np
@@ -71,7 +73,7 @@ class AccountManager:
         """`seed_users` maps sys-account usernames to stage2 hashes (the
         MOServer `users` config); 'root' defaults to an empty password."""
         self.engine = engine
-        self._lock = threading.Lock()
+        self._lock = san.lock("AccountManager._lock")
         self._mirror = None
         self._gen = 0          # bumped on every auth-table change
         self._bootstrap(dict(seed_users or {}))

@@ -22,6 +22,9 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+
+from matrixone_tpu.utils import san
+from matrixone_tpu.utils.lifecycle import ServiceThreads
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -54,8 +57,11 @@ class MOProxy:
         self.max_conns = max_conns or int(
             os.environ.get("MO_PROXY_MAX_CONNS", "0") or 0)
         self._sock: Optional[socket.socket] = None
-        self._lock = threading.Lock()
+        self._lock = san.lock("MOProxy._lock")
         self._stopping = threading.Event()
+        #: track + interrupt + deadline-join every thread this proxy
+        #: starts (shared service discipline; mosan leak checker gates)
+        self._svc = ServiceThreads("moproxy")
 
     # ----------------------------------------------------------- routing
     def _pick(self, exclude=()) -> Optional[Backend]:
@@ -103,16 +109,16 @@ class MOProxy:
         self._sock.bind((self.host, self.port))
         self.port = self._sock.getsockname()[1]
         self._sock.listen(64)
-        threading.Thread(target=self._accept_loop, daemon=True).start()
+        self._svc.spawn_accept(self._accept_loop)
         return self
 
-    def stop(self):
+    def stop(self, grace: float = 5.0):
+        """Stop serving and JOIN every thread this proxy started, with a
+        deadline: the accept loop (shutdown() — close() alone does not
+        wake a blocked accept) and the per-connection relays (their
+        sockets are shut down so blocked recv()s return)."""
         self._stopping.set()
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
+        self._svc.shutdown(self._sock, grace=grace)
 
     def _accept_loop(self):
         while not self._stopping.is_set():
@@ -122,8 +128,7 @@ class MOProxy:
                 if self._stopping.is_set():
                     return
                 continue   # transient (e.g. ECONNABORTED): keep serving
-            threading.Thread(target=self._serve_conn, args=(client,),
-                             daemon=True).start()
+            self._svc.spawn_handler(self._serve_conn, client)
 
     def _connect(self, exclude=()):
         """Pick a backend and open an upstream socket, retrying others

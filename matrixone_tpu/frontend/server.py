@@ -26,6 +26,7 @@ import threading
 from typing import Dict, Optional
 
 from matrixone_tpu.container.dtypes import DType, TypeOid
+from matrixone_tpu.utils.lifecycle import ServiceThreads
 from matrixone_tpu.frontend.session import Result, Session
 
 # MySQL protocol constants
@@ -515,8 +516,8 @@ class MOServer:
         self._sock.bind((self.host, self.port))
         self.port = self._sock.getsockname()[1]
         self._sock.listen(64)
-        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
-        self._thread.start()
+        self._svc = ServiceThreads("mo-server")
+        self._thread = self._svc.spawn_accept(self._accept_loop)
         return self
 
     def _accept_loop(self):
@@ -526,16 +527,11 @@ class MOServer:
             except OSError:
                 return
             conn = _Conn(sock, self)
-            threading.Thread(target=conn.run, daemon=True).start()
+            self._svc.spawn_handler(lambda s, c=conn: c.run(), sock)
 
     def stop(self):
         self._stopping.set()
         if self._sock is not None:
-            try:
-                self._sock.shutdown(socket.SHUT_RDWR)  # wake accept
-            except OSError:
-                pass
-            try:
-                self._sock.close()
-            except OSError:
-                pass
+            # interrupt blocked accept + session recv()s and JOIN with a
+            # deadline (mosan leak checker gates abandoned threads)
+            self._svc.shutdown(self._sock)

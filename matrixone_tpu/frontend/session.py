@@ -622,6 +622,8 @@ class Session:
                     # sync a heavy projection's time would be billed to
                     # whichever DOWNSTREAM operator first touches the
                     # arrays (lazy-dispatch skew)
+                    from matrixone_tpu.utils import san as _san
+                    _san.check_blocking("device.sync")
                     for c in ex.batch.columns.values():
                         _jax.block_until_ready(c.data)
                     st["seconds"] += _time.perf_counter() - t0
@@ -958,6 +960,20 @@ class Session:
             else:
                 raise BindError(f"unknown lint subcommand {arg!r}; "
                                 "use status | run")
+        elif cmd == "san":
+            # runtime concurrency sanitizer ops surface (utils/san.py):
+            # findings/edges/daemon report + clear — mirrors the
+            # mo_ctl('fault'|'lint') pattern
+            import json as _json
+            from matrixone_tpu.utils import san as _san
+            if arg in ("", "status"):
+                out = _json.dumps(_san.report(), sort_keys=True)
+            elif arg == "clear":
+                _san.clear()
+                out = "san findings cleared"
+            else:
+                raise BindError(f"unknown san subcommand {arg!r}; "
+                                "use status | clear")
         elif cmd == "rpc":
             # per-peer circuit breaker state + the CN's logtail breaker
             import json as _json

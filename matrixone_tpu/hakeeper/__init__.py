@@ -23,6 +23,9 @@ from __future__ import annotations
 
 import socket
 import threading
+
+from matrixone_tpu.utils import san
+from matrixone_tpu.utils.lifecycle import ServiceThreads
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -69,10 +72,9 @@ class HAKeeper:
             self.keeper_gen = max(self.keeper_gen, self._stored_gen())
         self.operators: List[dict] = []     # repair audit log
         self._repair: Dict[str, Callable[[dict], None]] = {}
-        self._lock = threading.Lock()
+        self._lock = san.lock("HAKeeper._lock")
         self._stopping = threading.Event()
-        self._conns: set = set()
-        self._conns_lock = threading.Lock()
+        self._svc = ServiceThreads("mo-ha")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("127.0.0.1", port))
@@ -104,12 +106,11 @@ class HAKeeper:
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "HAKeeper":
-        threading.Thread(target=self._serve, daemon=True).start()
+        self._svc.spawn_accept(self._serve)
         if self.role == "primary":
-            threading.Thread(target=self._tick_loop, daemon=True).start()
+            self._svc.spawn_loop(self._tick_loop, "tick")
         else:
-            threading.Thread(target=self._watch_primary,
-                             daemon=True).start()
+            self._svc.spawn_loop(self._watch_primary, "watch")
         return self
 
     # ------------------------------------------------------- standby mode
@@ -172,30 +173,13 @@ class HAKeeper:
 
     def stop(self) -> None:
         self._stopping.set()
-        try:
-            # close() alone does not wake a thread blocked in accept();
-            # the zombie listener would keep accepting connections
-            self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
         # a stopped keeper must look dead to CONNECTED clients too, so
         # their heartbeats fail over to the standby instead of landing
-        # on a zombie's accepted sockets
-        with self._conns_lock:
-            conns, self._conns = list(self._conns), set()
-        for c in conns:
-            try:
-                c.shutdown(socket.SHUT_RDWR)   # interrupts blocked recv
-            except OSError:
-                pass
-            try:
-                c.close()
-            except OSError:
-                pass
+        # on a zombie's accepted sockets: ServiceThreads shuts down the
+        # listener + every tracked conn (shutdown() — close() alone does
+        # not wake a blocked accept/recv) and joins serve/tick/watch
+        # loops + handlers with a deadline
+        self._svc.shutdown(self._sock)
 
     def on_down(self, kind: str, fn: Callable[[dict], None]) -> None:
         """Register a repair hook for a service kind (checkers analogue):
@@ -349,10 +333,7 @@ class HAKeeper:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            with self._conns_lock:
-                self._conns.add(conn)
-            threading.Thread(target=self._handle, args=(conn,),
-                             daemon=True).start()
+            self._svc.spawn_handler(self._handle, conn)
 
     def _handle(self, conn: socket.socket) -> None:
         try:
@@ -390,8 +371,6 @@ class HAKeeper:
         except (ConnectionError, OSError):
             pass
         finally:
-            with self._conns_lock:
-                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
@@ -425,7 +404,7 @@ class HAClient:
         self._sock: Optional[socket.socket] = None
         # serialize frames: stop()'s deregister must not interleave with
         # an in-flight heartbeat on the shared socket
-        self._call_lock = threading.Lock()
+        self._call_lock = san.lock("HAClient._call_lock")
 
     def _call_one(self, header: dict) -> Optional[dict]:
         try:
@@ -468,7 +447,9 @@ class HAClient:
     def start(self) -> "HAClient":
         self._call({"op": "register", "kind": self.kind, "sid": self.sid,
                     "addr": self.service_addr, "meta": self.meta})
-        threading.Thread(target=self._loop, daemon=True).start()
+        self._hb_thread = threading.Thread(target=self._loop, daemon=True,
+                                           name="mo-ha-heartbeat")
+        self._hb_thread.start()
         return self
 
     def _loop(self) -> None:
@@ -495,6 +476,11 @@ class HAClient:
                 self._sock.close()
             except OSError:
                 pass
+        # the heartbeat loop wakes from its interval wait on _stop; join
+        # it with a deadline instead of abandoning it
+        hb = getattr(self, "_hb_thread", None)
+        if hb is not None:
+            hb.join(timeout=5)
 
 
 def details_via_tcp(addr, kind: Optional[str] = None) -> List[dict]:

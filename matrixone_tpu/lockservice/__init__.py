@@ -16,6 +16,8 @@ detection sound under queue ordering.
 from __future__ import annotations
 
 import threading
+
+from matrixone_tpu.utils import san
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional, Set, Tuple
@@ -49,7 +51,7 @@ class LockService:
         #: edges are DERIVED fresh at cycle-check time (stored edge sets go
         #: stale the moment an owner releases, producing false deadlocks)
         self._waiting_on: Dict[int, Tuple[Tuple[str, int], str]] = {}
-        self._cond = threading.Condition()
+        self._cond = san.condition("LockService._cond")
 
     # ------------------------------------------------------------- locking
     def lock(self, txn_id: int, table: str, rows, mode: str = EXCLUSIVE,

@@ -43,10 +43,16 @@ import os
 import socket
 import struct
 import threading
+
+from matrixone_tpu.utils import san
+from matrixone_tpu.utils.lifecycle import ServiceThreads
 from typing import Dict, Iterator, List, Optional, Tuple
 
 
 def _send_msg(sock: socket.socket, header: dict, blob: bytes = b"") -> None:
+    # mosan choke point: every fabric lane frames through here — a send
+    # while holding the commit lock or a cache lock is a stall bug
+    san.check_blocking("socket.send")
     hj = json.dumps(header).encode()
     sock.sendall(struct.pack("<I", len(hj)) + hj
                  + struct.pack("<I", len(blob)) + blob)
@@ -63,6 +69,7 @@ def _recv_n(sock: socket.socket, n: int) -> bytes:
 
 
 def _recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
+    san.check_blocking("socket.recv")
     (hlen,) = struct.unpack("<I", _recv_n(sock, 4))
     header = json.loads(_recv_n(sock, hlen).decode())
     (blen,) = struct.unpack("<I", _recv_n(sock, 4))
@@ -91,15 +98,14 @@ class LogReplica:
         self.truncated_upto = 0
         self.entries: Dict[int, Tuple[int, bytes]] = {}   # seq -> (epoch, payload)
         self._load()
-        self._lock = threading.Lock()
+        self._lock = san.lock("LogReplica._lock")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("127.0.0.1", port))
         self.port = self._sock.getsockname()[1]
         self._sock.listen(16)
         self._stopping = threading.Event()
-        self._conns: set = set()
-        self._conns_lock = threading.Lock()
+        self._svc = ServiceThreads("mo-log")
 
     def _load(self) -> None:
         if os.path.exists(self.meta_path):
@@ -204,40 +210,19 @@ class LogReplica:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            with self._conns_lock:
-                self._conns.add(conn)
-            threading.Thread(target=self._handle, args=(conn,),
-                             daemon=True).start()
+            self._svc.spawn_handler(self._handle, conn)
 
     def start(self) -> "LogReplica":
-        threading.Thread(target=self.serve_forever, daemon=True).start()
+        self._svc.spawn_accept(self.serve_forever)
         return self
 
     def stop(self) -> None:
         self._stopping.set()
-        try:
-            # close() alone does not wake a thread blocked in accept();
-            # the zombie listener would keep accepting connections
-            self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
         # a stopped replica must look DEAD to connected writers, like a
-        # killed process would — close the accepted connections too
-        with self._conns_lock:
-            conns, self._conns = list(self._conns), set()
-        for c in conns:
-            try:
-                c.shutdown(socket.SHUT_RDWR)   # interrupts blocked recv
-            except OSError:
-                pass
-            try:
-                c.close()
-            except OSError:
-                pass
+        # killed process would: ServiceThreads shuts down the listener +
+        # every tracked conn (interrupting blocked accept/recv) and
+        # joins the accept loop + handlers with a deadline
+        self._svc.shutdown(self._sock)
 
     def _handle(self, conn: socket.socket) -> None:
         try:
@@ -284,8 +269,6 @@ class LogReplica:
         except (ConnectionError, OSError):
             pass
         finally:
-            with self._conns_lock:
-                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
@@ -326,7 +309,7 @@ class ReplicatedLog:
         # per-replica sockets: without serialization their
         # request/response frames would cross and an append could read
         # a renew reply as its (non-)ack
-        self._io_lock = threading.Lock()
+        self._io_lock = san.lock("ReplicatedLog._io_lock")
         self._socks: Dict[int, Optional[socket.socket]] = {}
         self.seq = 0
         # fence any previous writer: adopt max(epochs) + 1
@@ -359,7 +342,10 @@ class ReplicatedLog:
             # reachable (laggards adopt it on their first append)
             for i in range(len(self.addrs)):
                 self._call(i, {"op": "hello", "epoch": self.epoch})
-            threading.Thread(target=self._renew_loop, daemon=True).start()
+            self._renew_thread = threading.Thread(
+                target=self._renew_loop, daemon=True,
+                name="mo-log-renew")
+            self._renew_thread.start()
         else:
             for i in range(len(self.addrs)):
                 self._call(i, {"op": "hello", "epoch": self.epoch})
@@ -478,13 +464,19 @@ class ReplicatedLog:
         self.seq += 1
         acks = 0
         errs = []
-        for i in range(len(self.addrs)):
-            r = self._call(i, {"op": "append", "epoch": self.epoch,
-                               "seq": self.seq}, payload)
-            if r is not None and r[0].get("ok"):
-                acks += 1
-            elif r is not None:
-                errs.append(r[0].get("err"))
+        # WAL-then-apply under ONE commit critical section IS the commit
+        # protocol (same exemption molint's lock-discipline makes by
+        # omitting wal.append from its denylist); the quorum I/O is
+        # bounded by the deadline conventions in _call
+        with san.allow_blocking("wal.append quorum round is the commit "
+                                "protocol under the commit lock"):
+            for i in range(len(self.addrs)):
+                r = self._call(i, {"op": "append", "epoch": self.epoch,
+                                   "seq": self.seq}, payload)
+                if r is not None and r[0].get("ok"):
+                    acks += 1
+                elif r is not None:
+                    errs.append(r[0].get("err"))
         if acks < self.quorum:
             raise ConnectionError(
                 f"WAL append seq={self.seq}: {acks} acks < quorum "
@@ -542,6 +534,10 @@ class ReplicatedLog:
                     s.close()
                 except OSError:
                     pass
+        t = getattr(self, "_renew_thread", None)
+        if t is not None:
+            # wakes from Event.wait within lease_s/3; join, don't abandon
+            t.join(timeout=5)
 
 
 def main() -> None:          # replica process entry

@@ -15,6 +15,8 @@ import os
 import subprocess
 import threading
 
+from matrixone_tpu.utils import san
+
 import numpy as np
 
 _here = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -24,7 +26,7 @@ _BUILD_DIR = os.path.join(_here, "native", "build")
 _SO = os.path.join(_BUILD_DIR, "libmo_native.so")
 
 _lib = None
-_lock = threading.Lock()
+_lock = san.lock("matrixone_tpu.native._lock")
 _tried = False
 
 

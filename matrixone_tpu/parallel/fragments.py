@@ -439,7 +439,9 @@ def _rebuild_uppers(uppers: List[P.PlanNode], leaf: P.PlanNode):
 
 import threading
 
-_pool_guard = threading.Lock()
+from matrixone_tpu.utils import san
+
+_pool_guard = san.lock("matrixone_tpu.parallel.fragments._pool_guard")
 
 
 def pool_for(catalog) -> "FragmentPeers":
@@ -845,9 +847,8 @@ class ShuffleStore:
     recipient's buckets separate."""
 
     def __init__(self):
-        import threading as _t
-        self._lock = _t.Lock()
-        self._cond = _t.Condition(self._lock)
+        self._lock = san.lock("ShuffleStore._lock")
+        self._cond = san.condition(self._lock)
         self._buckets: Dict[tuple, Dict[int, bytes]] = {}
         self._born: Dict[tuple, float] = {}
 

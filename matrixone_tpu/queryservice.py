@@ -12,6 +12,8 @@ natural preemption point of the batch-at-a-time XLA execution model
 from __future__ import annotations
 
 import threading
+
+from matrixone_tpu.utils import san
 import time
 from typing import Dict, Optional
 
@@ -29,7 +31,7 @@ def account_of(user: str) -> str:
 
 class ProcessRegistry:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = san.lock("ProcessRegistry._lock")
         self._next_id = 1
         # conn_id -> record
         self._procs: Dict[int, dict] = {}

@@ -31,6 +31,8 @@ admitted | shed_capacity | shed_timeout | shed_deadline | killed.
 from __future__ import annotations
 
 import threading
+
+from matrixone_tpu.utils import san
 import time
 from collections import deque
 from typing import Optional
@@ -79,7 +81,8 @@ class AdmissionController:
     def __init__(self, slots: int = 0, queue_ms: float = 5000.0,
                  bg_queue_ms: float = 500.0, account_slots: int = 0,
                  max_queue: int = 256):
-        self._cv = threading.Condition()
+        self._cv = san.condition("AdmissionController._cv")
+        san.guard(self, self._cv, name="AdmissionController")
         self.slots = slots                  # 0 = admission disabled
         self.queue_ms = queue_ms
         self.bg_queue_ms = bg_queue_ms
@@ -104,6 +107,7 @@ class AdmissionController:
         eligible — but interactive waiters stuck on their account quota
         must not starve other work while global slots sit free (after
         the interactive scan, anyone still queued is quota-blocked)."""
+        san.mutating(self)
         for lane in LANES:
             q = self._queues[lane]
             for w in list(q):
@@ -120,6 +124,7 @@ class AdmissionController:
     def _release(self, account: str) -> None:
         from matrixone_tpu.utils import metrics as M
         with self._cv:
+            san.mutating(self)
             self.running -= 1
             n = self._by_account.get(account, 1) - 1
             if n <= 0:
@@ -182,6 +187,7 @@ class AdmissionController:
                     f"admission: queue full ({self.max_queue} waiting); "
                     f"server overloaded, retry later")
             w = _Waiter(account, lane)
+            san.mutating(self)
             self._queues[lane].append(w)
             M.admission_queued.set(
                 sum(len(q) for q in self._queues.values()))

@@ -42,6 +42,8 @@ from __future__ import annotations
 import copy
 import dataclasses
 import threading
+
+from matrixone_tpu.utils import san
 from collections import OrderedDict
 from typing import List, Optional, Tuple
 
@@ -319,7 +321,8 @@ class PlanCache:
     def __init__(self, max_entries: int = 256, enabled: bool = True):
         self.max_entries = max_entries
         self.enabled = enabled
-        self._lock = threading.Lock()
+        self._lock = san.lock("PlanCache._lock", category="cache")
+        san.guard(self, self._lock, name="PlanCache")
         self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
         self._norm_cache: "OrderedDict[str, Optional[Normalized]]" = \
             OrderedDict()
@@ -409,6 +412,7 @@ class PlanCache:
             # with a fresh current-gen plan
             with self._lock:
                 if self._entries.get(key) is e:
+                    san.mutating(self)
                     self._entries.pop(key)
             M.plan_cache_ops.inc(outcome="invalidated")
             return "miss", None
@@ -521,6 +525,7 @@ class PlanCache:
         entry = _Entry(copy.deepcopy(plan), n_params, ddl_gen,
                        stats_gen, tables=tables)
         with self._lock:
+            san.mutating(self)
             self._entries[key] = entry
             self._entries.move_to_end(key)
             while len(self._entries) > self.max_entries:
@@ -531,6 +536,7 @@ class PlanCache:
                          stats_gen: int = 0) -> None:
         from matrixone_tpu.utils import metrics as M
         with self._lock:
+            san.mutating(self)
             self._entries[key] = _Entry(None, 0, ddl_gen, stats_gen,
                                         cacheable=False)
             while len(self._entries) > self.max_entries:
@@ -540,6 +546,7 @@ class PlanCache:
     def clear(self) -> None:
         from matrixone_tpu.utils import metrics as M
         with self._lock:
+            san.mutating(self)
             self._entries.clear()
             self._norm_cache.clear()
             self._ast_cache.clear()

@@ -34,6 +34,8 @@ which is the default — enable per deployment or via
 from __future__ import annotations
 
 import threading
+
+from matrixone_tpu.utils import san
 from collections import OrderedDict
 from typing import Optional
 
@@ -65,7 +67,8 @@ class ResultCache:
 
     def __init__(self, max_bytes: int = 0):
         self.max_bytes = max_bytes
-        self._lock = threading.Lock()
+        self._lock = san.lock("ResultCache._lock", category="cache")
+        san.guard(self, self._lock, name="ResultCache")
         self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
         self._bytes = 0
 
@@ -95,6 +98,7 @@ class ResultCache:
                 # popping that would both drop a live result and subtract
                 # the wrong nbytes from the budget
                 if self._entries.get(key) is e:
+                    san.mutating(self)
                     self._entries.pop(key)
                     self._bytes -= e.nbytes
                 M.result_cache_entries.set(len(self._entries))
@@ -110,6 +114,7 @@ class ResultCache:
         if nb > self.max_bytes // 4 or nb > self.max_bytes:
             return                      # one giant result must not wipe
         with self._lock:                # the whole working set
+            san.mutating(self)
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old.nbytes
@@ -128,6 +133,7 @@ class ResultCache:
         would hold the old budget's memory indefinitely)."""
         from matrixone_tpu.utils import metrics as M
         with self._lock:
+            san.mutating(self)
             self.max_bytes = nb
             while self._bytes > self.max_bytes and self._entries:
                 _, ev = self._entries.popitem(last=False)
@@ -139,6 +145,7 @@ class ResultCache:
     def clear(self) -> None:
         from matrixone_tpu.utils import metrics as M
         with self._lock:
+            san.mutating(self)
             self._entries.clear()
             self._bytes = 0
             M.result_cache_entries.set(0)

@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+
+from matrixone_tpu.utils import san
 from typing import Dict, Optional
 
 import numpy as np
@@ -115,7 +117,7 @@ class StatsProvider:
         self.catalog = catalog
         # name -> (fingerprint, stats, live_rows_at_collect)
         self._cache: Dict[str, tuple] = {}
-        self._lock = threading.Lock()
+        self._lock = san.lock("StatsProvider._lock")
 
     @staticmethod
     def _fingerprint(table) -> tuple:

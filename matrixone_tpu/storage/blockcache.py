@@ -30,6 +30,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from matrixone_tpu.utils import san
 import time
 from collections import OrderedDict
 from typing import Dict, Iterator, Mapping, Optional, Tuple
@@ -58,7 +60,8 @@ class BlockCache:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = san.lock("BlockCache._lock", category="cache")
+        san.guard(self, self._lock, name="BlockCache")
         self._entries: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
         self._sizes: Dict[tuple, int] = {}
         self.used_bytes = 0
@@ -91,6 +94,7 @@ class BlockCache:
     def put(self, key: tuple, value: np.ndarray) -> None:
         nb = int(value.nbytes)
         with self._lock:
+            san.mutating(self)
             if key in self._entries:
                 return
             budget = _budget_bytes()
@@ -107,12 +111,14 @@ class BlockCache:
         """Invalidate every column of one object (GC after merge) —
         across all FS tokens: the path is dead everywhere."""
         with self._lock:
+            san.mutating(self)
             for k in [k for k in self._entries if k[1] == path]:
                 del self._entries[k]
                 self.used_bytes -= self._sizes.pop(k)
 
     def clear(self) -> None:
         with self._lock:
+            san.mutating(self)
             self._entries.clear()
             self._sizes.clear()
             self.used_bytes = 0
@@ -164,7 +170,7 @@ def _to_device(a: np.ndarray):
 #: objects at the SAME path (objects/t/seg0.obj) on different backends —
 #: a path-only key would serve one engine's bytes to the other
 _fs_tokens: "Dict[int, int]" = {}
-_fs_token_lock = threading.Lock()
+_fs_token_lock = san.lock("matrixone_tpu.storage.blockcache._fs_token_lock")
 _next_token = iter(range(1, 1 << 62))
 
 
@@ -193,7 +199,7 @@ class _ObjectSource:
         self.path = path
         self.columns = columns
         self._tok = _fs_token(fs)
-        self._load_lock = threading.Lock()
+        self._load_lock = san.lock("_ObjectSource._load_lock")
         self._raw = None          # parsed object header, fetched once
 
     def _header(self):
@@ -250,6 +256,7 @@ class _ObjectSource:
     def _account(self, data, valid) -> None:
         nb = int(data.nbytes) + int(valid.nbytes)
         with CACHE._lock:
+            san.mutating(CACHE)
             CACHE.bytes_fetched += nb
         from matrixone_tpu.utils import metrics as M
         M.blockcache_bytes.inc(nb)
@@ -257,6 +264,7 @@ class _ObjectSource:
     def _account_time(self, t0: float, M) -> None:
         dt = time.perf_counter() - t0
         with CACHE._lock:
+            san.mutating(CACHE)
             CACHE.decode_seconds += dt
         M.decode_seconds.inc(dt)
 

@@ -26,6 +26,8 @@ import itertools
 import json
 import os
 import threading
+
+from matrixone_tpu.utils import san
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -353,6 +355,10 @@ class MVCCTable:
         return seg
 
     def apply_segment(self, seg: Segment) -> None:
+        # the single version funnel (commits, WAL replay, CN logtail,
+        # trace recorder): PR-4's result-cache correctness pins on every
+        # mutation here running under the engine commit lock
+        san.mutating(self)
         self.segments.append(seg)
         self.last_commit_ts = max(self.last_commit_ts, seg.commit_ts)
 
@@ -378,6 +384,7 @@ class MVCCTable:
 
     def apply_tombstones(self, commit_ts: int, gids: np.ndarray) -> None:
         if len(gids):
+            san.mutating(self)
             self.tombstones.append((commit_ts, np.asarray(gids, np.int64)))
             self.last_commit_ts = max(self.last_commit_ts, commit_ts)
 
@@ -698,7 +705,7 @@ class Engine:
         # that take the lock themselves, and the CN logtail consumer
         # applies whole commit groups under it — same-thread
         # re-acquisition must not deadlock
-        self._commit_lock = threading.RLock()
+        self._commit_lock = san.rlock("Engine._commit_lock", category="commit")
         self._subscribers: List[Callable] = []   # logtail analogue
         #: catalog-shape generation: bumped on every DDL (create/drop
         #: table, index, snapshot, partition change). Serving caches key
@@ -734,6 +741,7 @@ class Engine:
             raise ValueError(f"table {meta.name} already exists")
         t = MVCCTable(meta)
         t.engine = self
+        san.guard(t, self._commit_lock, name=f"MVCCTable[{meta.name}]")
         self.tables[meta.name] = t
         self.ddl_gen += 1
         if log:
@@ -1263,8 +1271,18 @@ class Engine:
         """Restart path: load last checkpoint then replay the WAL tail
         (tae/db/replay.go analogue)."""
         eng = cls(fs, wal=wal)
-        eng._load_checkpoint()
-        eng._replay_wal()
+        # restart replay is one big commit-group apply: run it under the
+        # commit lock like every other writer through the version funnel.
+        # Reading the quorum WAL tail does socket I/O — that is the
+        # restart protocol itself (nobody else can hold this brand-new
+        # engine's lock yet), not a blocking-under-lock hazard
+        with eng._commit_lock:
+            with san.allow_blocking(
+                    "startup WAL replay: quorum reads under the commit "
+                    "lock ARE the restart protocol; the engine is not "
+                    "yet shared"):
+                eng._load_checkpoint()
+                eng._replay_wal()
         eng.committed_ts = eng.hlc.now()
         # rolling catalog upgrades (pkg/bootstrap/versions role): an
         # old data dir gains the newer system tables in place
@@ -1280,7 +1298,8 @@ class Engine:
         (disttae/logtail_consumer.go:296 subscribes from the replayed
         checkpoint ts). The replica never appends: its wal is a no-op."""
         eng = cls(fs, wal=_NullWal())
-        eng._load_checkpoint()
+        with eng._commit_lock:
+            eng._load_checkpoint()
         eng.committed_ts = max(eng._ckpt_ts, eng.committed_ts)
         return eng
 

@@ -19,6 +19,8 @@ from __future__ import annotations
 import io
 import os
 import threading
+
+from matrixone_tpu.utils import san
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -156,14 +158,14 @@ class ExternalTable:
         # scans encode strings at READ time (internal tables only encode
         # in the serialized write path) — concurrent scans must not race
         # the append-only dictionary
-        self._dict_lock = threading.Lock()
+        self._dict_lock = san.lock("ExternalTable._dict_lock")
         # decoded-chunk cache (VERDICT r3 weak #10: external tables used
         # to re-read + re-parse + re-encode the file on EVERY query):
         # (stat_sig, arrays, validity, n) for local files under the byte
         # budget, invalidated by mtime/size
         self._cache: Optional[tuple] = None
-        self._cache_lock = threading.Lock()
-        self._populate_lock = threading.Lock()
+        self._cache_lock = san.lock("ExternalTable._cache_lock", category="cache")
+        self._populate_lock = san.lock("ExternalTable._populate_lock")
 
     # ------------------------------------------------------------- plumbing
     @property
@@ -239,7 +241,7 @@ class ExternalTable:
     #: PROCESS-WIDE decoded-bytes budget across every external table
     #: (read at call time so the env var works whenever it is set)
     _cache_used = 0
-    _cache_acct_lock = threading.Lock()
+    _cache_acct_lock = san.lock("ExternalTable._cache_acct_lock")
 
     @staticmethod
     def _cache_budget() -> int:

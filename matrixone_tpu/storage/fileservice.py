@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from matrixone_tpu.utils import san
 from typing import Dict, List, Optional
 
 
@@ -42,7 +44,7 @@ class FileService:
 class MemoryFS(FileService):
     def __init__(self):
         self._files: Dict[str, bytearray] = {}
-        self._lock = threading.Lock()
+        self._lock = san.lock("MemoryFS._lock")
 
     def write(self, path, data):
         with self._lock:

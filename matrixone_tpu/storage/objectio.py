@@ -70,6 +70,11 @@ _POOL: Optional[ThreadPoolExecutor] = None
 def _pool() -> ThreadPoolExecutor:
     global _POOL
     if _POOL is None:
+        from matrixone_tpu.utils import san
+        san.daemon("mo-objw",
+                   "process-global object-write serializer pool shared "
+                   "by every engine in the process; lives for the "
+                   "process lifetime by design")
         _POOL = ThreadPoolExecutor(
             max_workers=int(os.environ.get(
                 "MO_OBJECT_WRITE_THREADS",

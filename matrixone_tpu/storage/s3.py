@@ -30,6 +30,8 @@ import hmac
 import http.server
 import os
 import threading
+
+from matrixone_tpu.utils import san
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -93,7 +95,7 @@ class S3FS(FileService):
         self.access_key = access_key
         self.secret_key = secret_key
         self.prefix = prefix.strip("/")
-        self._lock = threading.Lock()   # append emulation serialization
+        self._lock = san.lock("S3FS._lock")   # append emulation serialization
 
     def _url(self, path: str = "", query: str = "") -> str:
         key = f"{self.prefix}/{path}" if self.prefix else path
@@ -232,7 +234,7 @@ class MemCacheFS(FileService):
     def __init__(self, base: FileService, budget_bytes: int = 256 << 20):
         self.base = base
         self.cache = _LRUBytes(budget_bytes)
-        self._lock = threading.Lock()
+        self._lock = san.lock("MemCacheFS._lock")
 
     def read(self, path):
         with self._lock:
@@ -296,7 +298,7 @@ class DiskCacheFS(FileService):
         self.dir = cache_dir
         os.makedirs(cache_dir, exist_ok=True)
         self.budget = budget_bytes
-        self._lock = threading.Lock()
+        self._lock = san.lock("DiskCacheFS._lock")
         self._lru: "OrderedDict[str, int]" = OrderedDict()
         self._used = 0
         self.hits = 0
@@ -392,7 +394,7 @@ class FakeS3Server:
 
     def __init__(self, port: int = 0):
         objects: Dict[Tuple[str, str], bytes] = {}
-        lock = threading.Lock()
+        lock = san.lock("FakeS3Server._lock")
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def log_message(self, *a):   # noqa: N802

@@ -27,6 +27,8 @@ driven by the task framework). Redesign:
 from __future__ import annotations
 
 import threading
+
+from matrixone_tpu.utils import san
 import time
 from typing import Dict, Iterator, List, Optional
 
@@ -41,7 +43,7 @@ class SourceWriter:
         self.flush_rows = flush_rows
         self.flush_interval_s = flush_interval_s
         self._buf: List[dict] = []
-        self._lock = threading.Lock()
+        self._lock = san.lock("SourceWriter._lock")
         self._last_flush = time.monotonic()
 
     def write(self, row: dict) -> None:

@@ -17,6 +17,8 @@ runner (tae/db/checkpoint/runner.go) as a cron task.
 from __future__ import annotations
 
 import threading
+
+from matrixone_tpu.utils import san
 import time
 from typing import Callable, Dict, Optional
 
@@ -62,8 +64,8 @@ class TaskService:
         }
         self._tasks: Dict[int, dict] = {}
         self._next_id = 1
-        self._lock = threading.Lock()
-        self._persist_lock = threading.Lock()   # serializes table writes
+        self._lock = san.lock("TaskService._lock")
+        self._persist_lock = san.lock("TaskService._persist_lock")   # serializes table writes
         self._last_gid: Dict[int, int] = {}     # task_id -> latest row gid
         self._runner: Optional[threading.Thread] = None
         self._stop = threading.Event()

@@ -8,6 +8,8 @@ message receipt the usual HLC way.
 from __future__ import annotations
 
 import threading
+
+from matrixone_tpu.utils import san
 import time
 
 _LOGICAL_BITS = 20
@@ -17,7 +19,7 @@ _LOGICAL_MASK = (1 << _LOGICAL_BITS) - 1
 class HLC:
     def __init__(self):
         self._last = 0
-        self._lock = threading.Lock()
+        self._lock = san.lock("HLC._lock")
 
     def now(self) -> int:
         with self._lock:

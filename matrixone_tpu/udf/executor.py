@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from matrixone_tpu.utils import san
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -336,7 +338,7 @@ def eval_udf_aggregate(e, arg_arrays: List[np.ndarray]):
 # --------------------------------------------------------------- remote
 
 _clients: Dict[str, object] = {}
-_clients_lock = threading.Lock()
+_clients_lock = san.lock("matrixone_tpu.udf.executor._clients_lock")
 
 
 def _client_for(addr: str):

@@ -1,7 +1,23 @@
-from matrixone_tpu.utils import fault, metrics, tpch, trace
+"""utils package.  `tpch` and `trace` are lazy (PEP 562): they import
+`storage.engine`, and engine-side modules import `utils.san` at module
+level for the sanitizer lock factories — an eager tpch import here
+would re-enter a partially-initialized engine module."""
 
-__all__ = ["fault", "metrics", "tpch", "trace",
+from matrixone_tpu.utils import fault, metrics, san, sync  # noqa: F401
+
+__all__ = ["fault", "metrics", "san", "sync", "tpch", "trace",
            "enable_compilation_cache"]
+
+_LAZY = ("tpch", "tpch_full", "trace", "bvt", "lru", "roofline")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(f"matrixone_tpu.utils.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def enable_compilation_cache(min_compile_seconds: float = 0.05) -> bool:

@@ -34,6 +34,8 @@ from __future__ import annotations
 
 import random
 import threading
+
+from matrixone_tpu.utils import san
 import time
 from typing import Dict, Optional
 
@@ -71,7 +73,7 @@ class FaultPoint:
 class FaultInjector:
     def __init__(self):
         self._points: Dict[str, FaultPoint] = {}
-        self._lock = threading.Lock()
+        self._lock = san.lock("FaultInjector._lock")
         #: lock-free fast path: hot seams (object reads, rpc sends) call
         #: trigger() per operation — when nothing is armed the cost must
         #: be one attribute read, not a lock acquisition

@@ -1,0 +1,130 @@
+"""Service thread lifecycle: track, interrupt, JOIN.
+
+Every socket service in the tree (TN, fragment server, MO server, HA
+keeper, log replica, proxy) follows the same shape — an accept loop
+spawning one daemon handler thread per connection — and before mosan's
+leak checker existed, every one of them "stopped" by closing the
+listener and abandoning the rest.  `ServiceThreads` is the shared fix:
+
+  * `spawn_accept()` / `spawn_handler(conn=...)` name and remember the
+    threads (and the live sockets) a service starts;
+  * `shutdown()` interrupts blocked I/O (socket shutdown() — close()
+    alone does not wake a blocked accept/recv) and joins everything
+    WITH A DEADLINE;
+  * handler threads are registered as `san.daemon("<prefix>-conn", …)`
+    with a justification: their lifetime is the CLIENT's pooled
+    connection, which legitimately spans tests when the client is a
+    module-scoped session — the accept thread stays NON-exempt, so a
+    service started and abandoned inside one test is still a
+    thread-leak finding.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+import time
+from typing import List, Optional
+
+from matrixone_tpu.utils import san
+
+
+class ServiceThreads:
+    def __init__(self, prefix: str):
+        self.prefix = prefix
+        self._mu = san.lock("ServiceThreads._mu")
+        self._conns: set = set()
+        self._threads: List[threading.Thread] = []
+        self._seq = itertools.count(1)
+        self._stopped = False
+        san.daemon(
+            f"{prefix}-conn",
+            f"per-connection handler of the {prefix} service: lives "
+            f"as long as the peer's pooled socket (legitimately spans "
+            f"tests under a module-scoped client); interrupted and "
+            f"joined by the service's stop() via "
+            f"ServiceThreads.shutdown()")
+
+    # ------------------------------------------------------------ spawn
+    def spawn_accept(self, target) -> threading.Thread:
+        """The accept loop: tracked, joined at shutdown, NOT exempt from
+        the leak checker (a service abandoned mid-test must surface).
+        Re-arms a previously shut-down tracker, so a service restarted
+        in place serves connections again."""
+        t = threading.Thread(target=target, daemon=True,
+                             name=f"{self.prefix}-accept")
+        with self._mu:
+            self._stopped = False
+            self._threads.append(t)
+        t.start()
+        return t
+
+    def spawn_loop(self, target, role: str) -> threading.Thread:
+        """A service-lifetime background loop (ticker, watcher): same
+        contract as the accept loop."""
+        t = threading.Thread(target=target, daemon=True,
+                             name=f"{self.prefix}-{role}")
+        with self._mu:
+            self._threads.append(t)
+        t.start()
+        return t
+
+    def spawn_handler(self, target, conn: socket.socket,
+                      args: tuple = ()) -> Optional[threading.Thread]:
+        """One per-connection handler; the socket is tracked so
+        shutdown() can interrupt a blocked recv.  A connection accepted
+        concurrently with shutdown() (raced past the snapshot) is
+        CLOSED instead of served — spawning it would leave a handler
+        nobody interrupts or joins."""
+        def run():
+            try:
+                target(conn, *args)
+            finally:
+                with self._mu:
+                    self._conns.discard(conn)
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"{self.prefix}-conn-{next(self._seq)}")
+        with self._mu:
+            if self._stopped:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return None
+            self._conns.add(conn)
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+        t.start()
+        return t
+
+    # --------------------------------------------------------- shutdown
+    def shutdown(self, listener: Optional[socket.socket] = None,
+                 grace: float = 5.0) -> List[str]:
+        """Interrupt + join every tracked thread within `grace` seconds.
+        Returns the names of threads still alive at the deadline (the
+        caller's tests assert it empty)."""
+        socks = [listener] if listener is not None else []
+        with self._mu:
+            self._stopped = True
+            socks += list(self._conns)
+            self._conns = set()
+            threads, self._threads = self._threads, []
+        for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)   # wakes blocked accept/recv
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        deadline = time.monotonic() + grace
+        me = threading.current_thread()
+        for t in threads:
+            if t is me:
+                continue       # stop() invoked from a tracked thread
+            t.join(max(0.0, deadline - time.monotonic()))
+        return [t.name for t in threads
+                if t is not me and t.is_alive()]

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from matrixone_tpu.utils import san
 from collections import OrderedDict
 
 
@@ -24,8 +26,9 @@ def env_entries(var: str, default: int) -> int:
 class LruCache:
     def __init__(self, max_entries: int):
         self.max_entries = max(int(max_entries), 8)
-        self._lock = threading.Lock()
+        self._lock = san.lock("LruCache._lock", category="cache")
         self._entries: "OrderedDict" = OrderedDict()
+        san.guard(self, self._lock, name="LruCache")
 
     def lookup(self, key):
         """-> resident entry or None, refreshing recency."""
@@ -39,6 +42,7 @@ class LruCache:
         """Idempotent insert (a concurrently-created entry wins) +
         eviction past the budget; returns the resident entry."""
         with self._lock:
+            san.mutating(self)
             e = self._entries.setdefault(key, value)
             self._entries.move_to_end(key)
             while len(self._entries) > self.max_entries:
@@ -47,6 +51,7 @@ class LruCache:
 
     def clear(self) -> None:
         with self._lock:
+            san.mutating(self)
             self._entries.clear()
 
     def __len__(self) -> int:

@@ -6,10 +6,16 @@ trace pipeline as statement_info).
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import defaultdict
 from typing import Dict, List, Tuple
+
+from matrixone_tpu.utils import san
+
+# metric primitives are leaf locks acquired INSIDE the sanitizer's own
+# reporting path, so they are san.lock(internal=True): adopted (the
+# san-adoption rule sees the factory) but never tracked (tracking them
+# would recurse into the tracker)
 
 
 class Counter:
@@ -17,7 +23,7 @@ class Counter:
         self.name = name
         self.help = help_
         self._values: Dict[Tuple, float] = defaultdict(float)
-        self._lock = threading.Lock()
+        self._lock = san.lock("Counter._lock", internal=True)
 
     def inc(self, value: float = 1.0, **labels):
         key = tuple(sorted(labels.items()))
@@ -35,7 +41,7 @@ class Gauge:
         self.name = name
         self.help = help_
         self._values: Dict[Tuple, float] = {}
-        self._lock = threading.Lock()
+        self._lock = san.lock("Gauge._lock", internal=True)
 
     def set(self, value: float, **labels):
         key = tuple(sorted(labels.items()))
@@ -60,7 +66,7 @@ class Histogram:
         self.counts = [0] * (len(self._BUCKETS) + 1)
         self.sum = 0.0
         self.total = 0
-        self._lock = threading.Lock()
+        self._lock = san.lock("Histogram._lock", internal=True)
 
     def observe(self, v: float):
         with self._lock:
@@ -88,23 +94,27 @@ class Histogram:
 class Registry:
     def __init__(self):
         self._metrics: Dict[str, object] = {}
-        self._lock = threading.Lock()
+        self._lock = san.lock("Registry._lock")
+        san.guard(self, self._lock, name="metrics.Registry")
 
     def counter(self, name: str, help_: str = "") -> Counter:
         with self._lock:
             if name not in self._metrics:
+                san.mutating(self)
                 self._metrics[name] = Counter(name, help_)
             return self._metrics[name]
 
     def histogram(self, name: str, help_: str = "") -> Histogram:
         with self._lock:
             if name not in self._metrics:
+                san.mutating(self)
                 self._metrics[name] = Histogram(name, help_)
             return self._metrics[name]
 
     def gauge(self, name: str, help_: str = "") -> Gauge:
         with self._lock:
             if name not in self._metrics:
+                san.mutating(self)
                 self._metrics[name] = Gauge(name, help_)
             return self._metrics[name]
 
@@ -278,3 +288,12 @@ udf_batch_rows = REGISTRY.counter(
 udf_batch_coalesced = REGISTRY.counter(
     "mo_udf_batch_coalesced_total",
     "remote UDF requests that rode another request's dispatch")
+
+# ---- runtime concurrency sanitizer (utils/san.py, tools/mosan)
+san_findings = REGISTRY.counter(
+    "mo_san_findings_total",
+    "sanitizer findings by rule (lock-order-cycle/blocking-under-lock/"
+    "unguarded-mutation/thread-leak)")
+san_lock_edges = REGISTRY.gauge(
+    "mo_san_lock_edges",
+    "distinct lock-order edges observed by the armed sanitizer")

@@ -1,0 +1,708 @@
+"""mosan — runtime concurrency sanitizer (the dynamic half of the
+molint lock-discipline story; reference analogue: the Go race detector
++ `GODEBUG=lockcheck` the paper's system leans on).
+
+molint (tools/molint) proves lock invariants *statically*, but its
+lock-order graph is lexical-nesting + one-hop call-through and its
+blocking-under-commit-lock rule is a pattern list.  This module watches
+the real schedules: every lockish object in `matrixone_tpu/` is built
+through the `san.lock()` / `san.rlock()` / `san.condition()` factories
+(molint rule `san-adoption` keeps it that way), and while ARMED the
+sanitizer maintains per-thread held-lock stacks and
+
+  * a **dynamic lock-order graph** — a cycle across the whole run is a
+    finding carrying the acquisition stacks of every edge in the cycle;
+    the observed edge set is exported (tools/molint/
+    observed_lock_edges.json) so the static checker validates against
+    real runtime edges instead of lexical guesses;
+  * **blocking-under-lock** checks at the PR-2 fabric's choke points
+    (`RpcClient.call`, worker calls, `_send_msg`/`_recv_msg`,
+    `sync.wait_until`, EXPLAIN-ANALYZE device syncs): any of them
+    reached while the thread holds the commit lock or a cache lock is a
+    finding — the WAL-under-commit-lock protocol is exempted where it
+    IS the protocol (`san.allow_blocking`);
+  * a **shared-state write auditor**: hot shared structures register
+    with `san.guard(obj, lock)` and their mutation helpers call
+    `san.mutating(obj)` — a mutation on a thread that does not hold the
+    owning lock is a finding with the mutator's stack AND the lock's
+    last-acquire stack (the PR-4 ResultCache eviction race, three times
+    over, is exactly this bug class);
+  * a per-test **thread/resource leak checker** (tests/conftest.py):
+    threads alive after a test that were not alive before it, minus
+    `san.daemon()`-registered immortals, are findings.
+
+Arming: `MO_SAN=1` (tests/conftest.py arms by default under pytest;
+`MO_SAN=0` keeps it off).  Disarmed cost is ONE attribute read on the
+lock fast path — the same discipline as `utils/fault.py`.  Findings
+accumulate into a process-global report surfaced by
+`mo_ctl('san','status'|'clear')`, `mo_san_*` metrics, and the tier-1
+gate `tests/test_mosan.py::test_suite_runs_sanitizer_clean`.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+#: lock categories whose critical sections must never cover blocking
+#: calls (see check_blocking)
+BLOCK_SENSITIVE = ("commit", "cache")
+
+#: findings kept verbatim; later duplicates only bump `count`
+MAX_FINDINGS = 200
+
+
+def _env_armed() -> bool:
+    return os.environ.get("MO_SAN", "0").lower() not in (
+        "0", "", "false", "off")
+
+
+# --------------------------------------------------------------- frames
+def _frames(skip: int = 2, limit: int = 14) -> List[str]:
+    """Lightweight stack summary: (file:line func) strings, innermost
+    first.  No source-line reads — this runs on guarded-lock acquire."""
+    out: List[str] = []
+    try:
+        f = sys._getframe(skip)
+    except ValueError:
+        return out
+    while f is not None and len(out) < limit:
+        co = f.f_code
+        fn = co.co_filename
+        # repo-relative paths keep the report readable and stable
+        idx = fn.rfind("matrixone_tpu")
+        if idx < 0:
+            idx = fn.rfind("tests")
+        if idx < 0:
+            idx = fn.rfind("tools")
+        if idx > 0:
+            fn = fn[idx:]
+        out.append(f"{fn}:{f.f_lineno} {co.co_name}")
+        f = f.f_back
+    return out
+
+
+def _thread_live_stack(ident: int) -> List[str]:
+    frames = sys._current_frames().get(ident)
+    out: List[str] = []
+    f = frames
+    while f is not None and len(out) < 14:
+        out.append(f"{f.f_code.co_filename}:{f.f_lineno} "
+                   f"{f.f_code.co_name}")
+        f = f.f_back
+    out.reverse()
+    return out[-14:]
+
+
+# -------------------------------------------------------------- finding
+class Finding:
+    """One sanitizer violation.  `stacks` maps a role name (mutator /
+    owner / edge "a->b") to a frame-summary list."""
+
+    __slots__ = ("rule", "key", "message", "stacks", "thread", "ts",
+                 "count")
+
+    def __init__(self, rule: str, key: tuple, message: str,
+                 stacks: Dict[str, List[str]]):
+        self.rule = rule
+        self.key = key
+        self.message = message
+        self.stacks = stacks
+        self.thread = threading.current_thread().name
+        self.ts = time.time()
+        self.count = 1
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "message": self.message,
+                "thread": self.thread, "count": self.count,
+                "stacks": self.stacks}
+
+    def format(self) -> str:
+        lines = [f"[{self.rule}] x{self.count} ({self.thread}) "
+                 f"{self.message}"]
+        for role, st in self.stacks.items():
+            lines.append(f"  {role}:")
+            lines.extend(f"    {fr}" for fr in st[:10])
+        return "\n".join(lines)
+
+
+class _State:
+    def __init__(self):
+        self.armed = _env_armed()
+        #: internal lock — a RAW lock on purpose: the sanitizer must not
+        #: observe itself
+        self._mu = threading.Lock()
+        #: finding key -> Finding (insertion-ordered report)
+        self.findings: "Dict[tuple, Finding]" = {}
+        self.dropped = 0
+        #: (holder_name, acquired_name) -> {count, stack, thread}
+        self.edges: Dict[Tuple[str, str], dict] = {}
+        #: name-prefix -> justification for deliberately-immortal threads
+        self.daemons: Dict[str, str] = {}
+
+
+_STATE = _State()
+_TLS = threading.local()
+
+
+def armed() -> bool:
+    return _STATE.armed
+
+
+def arm() -> None:
+    _STATE.armed = True
+
+
+def disarm() -> None:
+    _STATE.armed = False
+
+
+def _record_finding(rule: str, key: tuple, message: str,
+                    stacks: Dict[str, List[str]]) -> None:
+    with _STATE._mu:
+        f = _STATE.findings.get((rule,) + key)
+        if f is not None:
+            f.count += 1
+            return
+        if len(_STATE.findings) >= MAX_FINDINGS:
+            _STATE.dropped += 1
+            return
+        _STATE.findings[(rule,) + key] = Finding(rule, key, message,
+                                                 stacks)
+    from matrixone_tpu.utils import metrics as M
+    M.san_findings.inc(rule=rule)
+
+
+# ----------------------------------------------------- held-lock stacks
+def _held() -> list:
+    h = getattr(_TLS, "held", None)
+    if h is None:
+        h = _TLS.held = []
+    return h
+
+
+def _note_acquire(lock: "SanLock", record_edges: bool = True) -> None:
+    held = _held()
+    for e in held:
+        if e[0] is lock:
+            e[1] += 1            # RLock re-entry: no new edge
+            return
+    if held and record_edges:
+        # a trylock (blocking=False) can never deadlock — utils.sync's
+        # notify_waiters acquires the shared condition non-blocking from
+        # inside component locks for exactly this reason — so it joins
+        # the held stack but contributes no lock-order edge
+        name = lock.name
+        seen = set()
+        for e in held:
+            hn = e[0].name
+            if hn != name and hn not in seen:
+                seen.add(hn)
+                _record_edge(hn, name)
+    held.append([lock, 1])
+    lock._owner = threading.get_ident()
+    if lock._record:
+        lock._last_acquire = (threading.current_thread().name,
+                              _frames(3))
+
+
+def _note_release(lock: "SanLock") -> None:
+    held = getattr(_TLS, "held", None)
+    if not held:
+        return
+    for i in range(len(held) - 1, -1, -1):
+        e = held[i]
+        if e[0] is lock:
+            e[1] -= 1
+            if e[1] <= 0:
+                del held[i]
+                lock._owner = None
+            return
+
+
+def held_locks() -> List[str]:
+    """Names of locks the current thread holds (diagnostics)."""
+    return [e[0].name for e in getattr(_TLS, "held", ())]
+
+
+# ------------------------------------------------------ lock-order graph
+def _record_edge(a: str, b: str) -> None:
+    key = (a, b)
+    e = _STATE.edges.get(key)     # racy read: fine, slow path re-checks
+    if e is not None:
+        e["count"] += 1           # lossy under races; counts are advisory
+        return
+    with _STATE._mu:
+        e = _STATE.edges.get(key)
+        if e is not None:
+            e["count"] += 1
+            return
+        _STATE.edges[key] = {"count": 1, "stack": _frames(4),
+                             "thread": threading.current_thread().name}
+        cycle = _find_cycle(a, b)
+    from matrixone_tpu.utils import metrics as M
+    M.san_lock_edges.set(len(_STATE.edges))
+    if cycle:
+        stacks = {}
+        for x, y in zip(cycle, cycle[1:]):
+            info = _STATE.edges.get((x, y))
+            if info:
+                stacks[f"acquire {y} while holding {x}"] = info["stack"]
+        _record_finding(
+            "lock-order-cycle", (frozenset(cycle),),
+            "lock-order cycle observed at runtime: "
+            + " -> ".join(cycle)
+            + " — these acquisition orders can deadlock", stacks)
+
+
+def _find_cycle(a: str, b: str) -> Optional[List[str]]:
+    """Path b ->* a in the observed graph closes a cycle through the new
+    edge a->b.  Called with _STATE._mu held; the graph is small."""
+    stack = [(b, [a, b])]
+    seen = {b}
+    while stack:
+        node, path = stack.pop()
+        for (x, y) in _STATE.edges:
+            if x != node:
+                continue
+            if y == a:
+                return path + [a]
+            if y not in seen:
+                seen.add(y)
+                stack.append((y, path + [y]))
+    return None
+
+
+def observed_edges() -> List[dict]:
+    """The dynamic lock-order edge set, sorted — the export molint's
+    lock-discipline checker reconciles against its static graph."""
+    with _STATE._mu:
+        items = sorted(_STATE.edges.items())
+    return [{"from": a, "to": b, "count": e["count"],
+             "site": (e["stack"][0] if e["stack"] else "?")}
+            for (a, b), e in items]
+
+
+def export_edges(path: str) -> int:
+    """Write the observed edge set as JSON (regeneration:
+    `MO_SAN_EXPORT=1 pytest` or `python -m tools.mosan --export-edges`).
+    Returns the edge count."""
+    import json
+    edges = observed_edges()
+    payload = {"comment": "runtime lock-order edges observed by mosan "
+                          "(matrixone_tpu/utils/san.py); consumed by "
+                          "tools/molint lock-discipline. Regenerate: "
+                          "MO_SAN_EXPORT=1 python -m pytest, or "
+                          "python -m tools.mosan --export-edges",
+               "edges": edges}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return len(edges)
+
+
+# ----------------------------------------------------------- lock types
+class SanLock:
+    """Wrapper over threading.Lock/RLock: one attribute read when
+    disarmed, held-stack + lock-order bookkeeping when armed."""
+
+    __slots__ = ("_inner", "name", "category", "_record", "_owner",
+                 "_last_acquire", "_internal")
+
+    def __init__(self, name: str, category: Optional[str] = None,
+                 reentrant: bool = False, internal: bool = False):
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+        self.name = name
+        self.category = category
+        #: guards attached (san.guard): record last-acquire stacks so an
+        #: unguarded-mutation finding can show who owned the lock
+        self._record = False
+        self._owner: Optional[int] = None
+        self._last_acquire: Optional[tuple] = None
+        #: no bookkeeping even when armed — ONLY for leaf locks the
+        #: sanitizer's own reporting path acquires (metrics primitives):
+        #: tracking those would recurse into the tracker itself
+        self._internal = internal
+
+    # acquire/release keep the stdlib signatures so SanLock is a drop-in
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok and _STATE.armed and not self._internal:
+            _note_acquire(self, record_edges=blocking)
+        return ok
+
+    def release(self) -> None:
+        if _STATE.armed and not self._internal:
+            _note_release(self)
+        self._inner.release()
+
+    def __enter__(self) -> "SanLock":
+        self._inner.acquire()
+        if _STATE.armed and not self._internal:
+            _note_acquire(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if _STATE.armed and not self._internal:
+            _note_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        inner = self._inner
+        if hasattr(inner, "locked"):
+            return inner.locked()
+        # _thread.RLock grows locked() only in 3.13; emulate: held by me
+        # (reentrant ownership) or unobtainable via a trylock probe
+        if inner._is_owned():
+            return True
+        if inner.acquire(blocking=False):
+            inner.release()
+            return False
+        return True
+
+    def held_by_me(self) -> bool:
+        ident = threading.get_ident()
+        for e in getattr(_TLS, "held", ()):
+            if e[0] is self:
+                return True
+        # locks acquired before arming have no TLS entry; fall back to
+        # the owner field so mid-run arming cannot manufacture findings
+        return self._owner == ident
+
+    def __repr__(self) -> str:
+        return f"<san.lock {self.name}>"
+
+
+class SanCondition:
+    """Condition variable whose lock is a SanLock (possibly shared with
+    callers, `threading.Condition(self._lock)` style)."""
+
+    __slots__ = ("_sl", "_cond")
+
+    def __init__(self, sanlock: SanLock):
+        self._sl = sanlock
+        self._cond = threading.Condition(sanlock._inner)
+
+    @property
+    def name(self) -> str:
+        return self._sl.name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        return self._sl.acquire(blocking, timeout)
+
+    def release(self) -> None:
+        self._sl.release()
+
+    def __enter__(self) -> "SanCondition":
+        self._sl.__enter__()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._sl.__exit__(*exc)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if not _STATE.armed:
+            return self._cond.wait(timeout)
+        # a cv-wait parks the thread: flag it like any blocking call if
+        # OTHER block-sensitive locks are held across it
+        _check_blocking_site(f"condition.wait({self._sl.name})",
+                             exclude=self._sl)
+        entry = self._pop_held()
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            self._push_held(entry)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        """threading.Condition.wait_for, routed through our wait()."""
+        endtime = None
+        waittime = timeout
+        result = predicate()
+        while not result:
+            if waittime is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + waittime
+                else:
+                    waittime = endtime - time.monotonic()
+                    if waittime <= 0:
+                        break
+            self.wait(waittime)
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def _pop_held(self):
+        held = getattr(_TLS, "held", None)
+        if held:
+            for i in range(len(held) - 1, -1, -1):
+                if held[i][0] is self._sl:
+                    self._sl._owner = None
+                    return held.pop(i)
+        return None
+
+    def _push_held(self, entry) -> None:
+        if entry is not None:
+            _held().append(entry)
+            self._sl._owner = threading.get_ident()
+        elif _STATE.armed:
+            # armed mid-run: the wait re-acquired a lock we never saw
+            _note_acquire(self._sl)
+
+    def __repr__(self) -> str:
+        return f"<san.condition {self._sl.name}>"
+
+
+# ------------------------------------------------------------ factories
+def lock(name: str, category: Optional[str] = None,
+         internal: bool = False) -> SanLock:
+    """Instrumented threading.Lock.  `name` follows molint's lock
+    identity scheme ("ClassName._attr" for instance locks, the dotted
+    module path for module-level ones) so runtime edges reconcile with
+    the static graph.  `category` in {"commit","cache"} marks locks
+    whose critical sections must never cover blocking calls.
+    `internal` is reserved for the metrics primitives the sanitizer's
+    own reporting acquires (tracking them would self-recurse)."""
+    return SanLock(name, category=category, reentrant=False,
+                   internal=internal)
+
+
+def rlock(name: str, category: Optional[str] = None) -> SanLock:
+    """Instrumented threading.RLock (re-entry never records an edge)."""
+    return SanLock(name, category=category, reentrant=True)
+
+
+def condition(name_or_lock, category: Optional[str] = None
+              ) -> SanCondition:
+    """Instrumented threading.Condition.  Pass a SanLock to share it
+    (`threading.Condition(self._lock)` style) or a name to own a fresh
+    re-entrant one (stdlib default)."""
+    if isinstance(name_or_lock, SanLock):
+        return SanCondition(name_or_lock)
+    return SanCondition(SanLock(str(name_or_lock), category=category,
+                                reentrant=True))
+
+
+# --------------------------------------------------- blocking-under-lock
+@contextmanager
+def allow_blocking(why: str):
+    """Exempt a protocol-mandated blocking region (e.g. WAL append under
+    the commit lock — WAL-then-apply in ONE critical section IS the
+    commit protocol).  `why` is a required justification string, same
+    discipline as molint suppressions."""
+    if not why or not str(why).strip():
+        raise ValueError("san.allow_blocking requires a justification")
+    depth = getattr(_TLS, "exempt", 0)
+    _TLS.exempt = depth + 1
+    try:
+        yield
+    finally:
+        _TLS.exempt = depth
+
+
+def _check_blocking_site(site: str, exclude=None) -> None:
+    held = getattr(_TLS, "held", None)
+    if not held or getattr(_TLS, "exempt", 0):
+        return
+    bad = [e[0] for e in held
+           if e[0].category in BLOCK_SENSITIVE and e[0] is not exclude]
+    if not bad:
+        return
+    lk = bad[-1]
+    stacks = {"blocking call": _frames(3)}
+    if lk._last_acquire is not None:
+        stacks[f"last acquire of {lk.name}"] = lk._last_acquire[1]
+    _record_finding(
+        "blocking-under-lock", (site, lk.name),
+        f"blocking call at {site!r} while holding {lk.name} "
+        f"(category={lk.category}) — one slow peer stalls every "
+        f"{lk.category}-path thread", stacks)
+
+
+def check_blocking(site: str) -> None:
+    """Call at a fabric choke point (rpc call, socket send/recv, device
+    sync, cv-wait helper): a finding if the thread holds any commit- or
+    cache-category lock and no allow_blocking() exemption is active."""
+    if not _STATE.armed:
+        return
+    _check_blocking_site(site)
+
+
+# --------------------------------------------------- shared-state guard
+def guard(obj, owning_lock, name: Optional[str] = None):
+    """Register `obj` (a hot shared structure) as guarded by
+    `owning_lock`: every san.mutating(obj) call must run on a thread
+    holding that lock.  Returns obj for chaining."""
+    lk = owning_lock._sl if isinstance(owning_lock, SanCondition) \
+        else owning_lock
+    if not isinstance(lk, SanLock):
+        raise TypeError(f"san.guard needs a san lock, got {type(lk)}")
+    lk._record = True
+    obj._san_guard = (lk, name or type(obj).__name__)
+    return obj
+
+
+def mutating(obj) -> None:
+    """Assert (when armed) that the mutating thread holds the guarded
+    object's owning lock.  A violation records the mutator's stack AND
+    the lock's last-acquire stack — both sides of the race."""
+    if not _STATE.armed:
+        return
+    g = getattr(obj, "_san_guard", None)
+    if g is None:
+        return
+    lk, gname = g
+    if lk.held_by_me():
+        return
+    stacks = {"unguarded mutator": _frames(2)}
+    last = lk._last_acquire
+    if last is not None:
+        stacks[f"last acquire of {lk.name} (thread {last[0]})"] = last[1]
+    _record_finding(
+        "unguarded-mutation", (gname, lk.name),
+        f"mutation of {gname} without holding {lk.name} — the exact "
+        f"bug class behind the PR-4 result-cache eviction races",
+        stacks)
+
+
+# ------------------------------------------------------- leak checking
+def daemon(name_prefix: str, why: str) -> None:
+    """Register a deliberately-immortal thread-name prefix with a
+    REQUIRED justification (molint-suppression discipline): the leak
+    checker skips threads whose name starts with a registered prefix."""
+    if not why or not str(why).strip():
+        raise ValueError("san.daemon requires a justification string")
+    with _STATE._mu:
+        _STATE.daemons[str(name_prefix)] = str(why)
+
+
+def daemons() -> Dict[str, str]:
+    with _STATE._mu:
+        return dict(_STATE.daemons)
+
+
+def thread_snapshot() -> set:
+    # Thread OBJECTS, not idents: CPython recycles identifiers, and a
+    # leaked thread reusing a dead pre-test thread's ident would be
+    # silently excluded from the leak check
+    return set(threading.enumerate())
+
+
+def check_thread_leaks(before: set, context: str,
+                       grace: float = 1.0) -> List[str]:
+    """Per-test leak check: threads alive now that were not in `before`,
+    given `grace` seconds to finish, minus registered daemons.  Each
+    leaked thread is a finding carrying its live stack.  Returns the
+    leaked thread names (tests use it directly)."""
+    if not _STATE.armed:
+        return []
+
+    def _leaked():
+        me = threading.current_thread()
+        out = []
+        for t in threading.enumerate():
+            if t in before or t is me or not t.is_alive():
+                continue
+            if any(t.name.startswith(p) for p in _STATE.daemons):
+                continue
+            out.append(t)
+        return out
+
+    leaked = _leaked()
+    deadline = time.monotonic() + grace
+    while leaked and time.monotonic() < deadline:
+        time.sleep(0.02)
+        leaked = _leaked()
+    names = []
+    for t in leaked:
+        names.append(t.name)
+        stacks = {}
+        if t.ident is not None:
+            stacks["leaked thread (live stack)"] = \
+                _thread_live_stack(t.ident)
+        # normalize autonumbered names so one leaky service dedups into
+        # one finding across the whole run
+        norm = "".join(c for c in t.name if not c.isdigit())
+        _record_finding(
+            "thread-leak", (context, norm),
+            f"thread {t.name!r} leaked by {context} (still alive "
+            f"{grace:.1f}s after the test; join it in the service's "
+            f"stop()/close(), or register san.daemon() with a "
+            f"justification)", stacks)
+    return names
+
+
+# ------------------------------------------------------------ reporting
+def findings() -> List[Finding]:
+    with _STATE._mu:
+        return list(_STATE.findings.values())
+
+
+def clear() -> None:
+    """Drop findings + observed edges (mo_ctl('san','clear'))."""
+    with _STATE._mu:
+        _STATE.findings.clear()
+        _STATE.edges.clear()
+        _STATE.dropped = 0
+
+
+def report() -> dict:
+    """mo_ctl('san','status') payload."""
+    with _STATE._mu:
+        fs = list(_STATE.findings.values())
+        n_edges = len(_STATE.edges)
+        dropped = _STATE.dropped
+        daems = dict(_STATE.daemons)
+    return {"armed": _STATE.armed,
+            "findings": len(fs),
+            "dropped": dropped,
+            "edges": n_edges,
+            "daemons": daems,
+            "by_rule": _count_by_rule(fs),
+            "findings_list": [f.as_dict() for f in fs[:20]]}
+
+
+def _count_by_rule(fs: List[Finding]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for f in fs:
+        out[f.rule] = out.get(f.rule, 0) + f.count
+    return out
+
+
+@contextmanager
+def isolated():
+    """Swap in fresh finding/edge sinks for a planted-violation drill so
+    the plant pollutes neither the process-global report nor the edge
+    export (a deliberately-planted cycle exported to
+    observed_lock_edges.json would fail molint's reconciliation);
+    yields a probe with .findings() / .edges().  Arms for the
+    duration."""
+    class _Probe:
+        def findings(self):
+            with _STATE._mu:
+                return list(_STATE.findings.values())
+
+        def edges(self):
+            return observed_edges()
+
+    with _STATE._mu:
+        saved = (_STATE.findings, _STATE.edges, _STATE.dropped,
+                 _STATE.armed)
+        _STATE.findings = {}
+        _STATE.edges = {}
+        _STATE.dropped = 0
+    _STATE.armed = True
+    try:
+        yield _Probe()
+    finally:
+        with _STATE._mu:
+            (_STATE.findings, _STATE.edges, _STATE.dropped,
+             _STATE.armed) = saved

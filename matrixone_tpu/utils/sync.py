@@ -13,10 +13,12 @@ suspenders, not the mechanism).
 from __future__ import annotations
 
 import threading
+
+from matrixone_tpu.utils import san
 import time
 from typing import Any, Callable, Optional
 
-_COND = threading.Condition()
+_COND = san.condition("matrixone_tpu.utils.sync._COND")
 
 #: safety net for transitions that happen outside notify_waiters() — a
 #: bounded cv-wait, not the wake mechanism
@@ -40,11 +42,25 @@ def notify_waiters() -> None:
 
 
 def wait_until(predicate: Callable[[], Any], timeout: float = 10.0,
-               message: Optional[str] = None) -> Any:
+               message: Optional[str] = None,
+               raise_on_timeout: bool = True) -> Any:
     """Block until `predicate()` is truthy and return its value.
 
     Condition-variable based: wakes on notify_waiters() (no polling
-    sleeps in callers). Raises TimeoutError after `timeout` seconds."""
+    sleeps in callers). Raises TimeoutError after `timeout` seconds —
+    or returns False instead with `raise_on_timeout=False` (poll-style
+    callers like the sanitizer drills).
+
+    Contract edges (pinned by tests/test_sync_edges.py):
+      * the predicate runs BEFORE the first wait, so a notify that
+        happened before entry is never a lost wakeup;
+      * a deadline already expired at entry still evaluates the
+        predicate once and returns/raises immediately — no wait;
+      * a raising predicate propagates its own exception, never
+        swallowed into a TimeoutError."""
+    # mosan choke point: parking a thread that holds the commit lock or
+    # a cache lock stalls every peer of that lock for up to `timeout`
+    san.check_blocking("sync.wait_until")
     deadline = time.monotonic() + timeout
     with _COND:
         while True:
@@ -53,6 +69,8 @@ def wait_until(predicate: Callable[[], Any], timeout: float = 10.0,
                 return value
             remaining = deadline - time.monotonic()
             if remaining <= 0:
+                if not raise_on_timeout:
+                    return False
                 raise TimeoutError(
                     message or f"wait_until: predicate still false "
                                f"after {timeout}s")

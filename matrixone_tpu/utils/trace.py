@@ -14,6 +14,8 @@ works out of the box.
 from __future__ import annotations
 
 import threading
+
+from matrixone_tpu.utils import san
 import time
 from typing import List, Optional
 
@@ -43,7 +45,7 @@ class StatementRecorder:
         self.flush_every = flush_every
         self._buf: List[tuple] = []
         self._next_id = 1
-        self._lock = threading.Lock()
+        self._lock = san.lock("StatementRecorder._lock")
         self._ensure_table()
 
     def _ensure_table(self):

@@ -10,6 +10,8 @@ next query rebuilds (or reloads) on demand.
 from __future__ import annotations
 
 import threading
+
+from matrixone_tpu.utils import san
 from collections import OrderedDict
 from typing import Optional
 
@@ -33,7 +35,7 @@ class IndexCache:
 
     def __init__(self, budget_bytes: int = 8 << 30):
         self.budget = budget_bytes
-        self._lock = threading.Lock()
+        self._lock = san.lock("IndexCache._lock", category="cache")
         self._lru: "OrderedDict[str, tuple]" = OrderedDict()  # name -> (meta, nbytes)
         self.used = 0
         self.evictions = 0

@@ -46,6 +46,8 @@ import dataclasses
 import itertools
 import os
 import threading
+
+from matrixone_tpu.utils import san
 import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
@@ -373,7 +375,7 @@ def _project_dict_ok(e: BoundExpr) -> bool:
 
 # ---- dictionary content keys (the LUT-staleness guard) ---------------
 
-_DICT_KEY_LOCK = threading.Lock()
+_DICT_KEY_LOCK = san.lock("matrixone_tpu.vm.fusion._DICT_KEY_LOCK")
 _DICT_KEYS: "OrderedDict[int, tuple]" = OrderedDict()  # id -> (ref, len, key)
 
 
@@ -1075,6 +1077,7 @@ class FusedFragmentOp(O.Operator):
                     M.fusion_dispatch.inc(kind="step")
                     self.last_stats["dispatches"] += 1
                     if profile:
+                        san.check_blocking("device.sync")
                         jax.block_until_ready(out)
                         M.fusion_step_seconds.inc(
                             time.perf_counter() - t_dev0, kind="device")

@@ -135,6 +135,9 @@ class _ChunkPrefetcher:
                 self._q.get_nowait()
             except Exception:                         # noqa: BLE001
                 break
+        # the worker notices _stop within its 0.1s put tick; join it
+        # with a deadline instead of abandoning it (mosan leak checker)
+        self._thread.join(timeout=5)
 
 
 class ScanOp(Operator):

@@ -37,6 +37,8 @@ class WorkerClient:
 
         from matrixone_tpu.cluster import rpc as _rpc
         from matrixone_tpu.utils import metrics as M
+        from matrixone_tpu.utils import san
+        san.check_blocking("worker.run")
         attempts = max(1, _rpc.RETRIES) if _rpc.resilience_enabled() \
             else 1
         op = str(header.get("op", ""))

@@ -23,6 +23,8 @@ from __future__ import annotations
 import json
 import struct
 import threading
+
+from matrixone_tpu.utils import san
 import time
 from concurrent import futures
 from typing import Dict, Optional
@@ -90,8 +92,8 @@ class _MicroBatcher:
         self.max_batch = max_batch
         self.linger_s = (float(os.environ.get("MO_BATCH_LINGER_MS", "4"))
                          / 1e3) if linger_s is None else linger_s
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = san.lock("_MicroBatcher._lock")
+        self._cv = san.condition(self._lock)
         self._pending: Dict[tuple, list] = {}
         self._busy: Dict[tuple, bool] = {}
         self._inflight = 0         # entered run(), not yet dispatch-grabbed
@@ -220,7 +222,7 @@ class WorkerCore:
         self.indexes: Dict[str, object] = {}
         self.started = time.time()
         self.stages_run = 0
-        self._lock = threading.Lock()
+        self._lock = san.lock("WorkerCore._lock")
         self.batcher = _MicroBatcher()
         self.udf_batcher = _UdfMicroBatcher()
 
@@ -534,8 +536,16 @@ class TpuWorkerServer:
                 response_serializer=None),
         }
         handler = grpc.method_handlers_generic_handler(self.SERVICE, rpcs)
+        from matrixone_tpu.utils import san
+        san.daemon("mo-worker-grpc",
+                   "gRPC handler pool workers spawn lazily per request "
+                   "and live for the server's lifetime (legitimately "
+                   "spans tests under a module-scoped worker fixture); "
+                   "joined by stop() via executor.shutdown(wait=True)")
+        self._executor = futures.ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="mo-worker-grpc")
         self.server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=max_workers),
+            self._executor,
             options=[("grpc.max_receive_message_length", 256 << 20),
                      ("grpc.max_send_message_length", 256 << 20)])
         self.server.add_generic_rpc_handlers((handler,))
@@ -546,4 +556,16 @@ class TpuWorkerServer:
         return self
 
     def stop(self, grace: float = 0.5):
-        self.server.stop(grace)
+        import threading
+        import time
+        ev = self.server.stop(grace)
+        ev.wait(grace + 5.0)
+        # gRPC's stop() leaves the handler executor's worker threads
+        # alive forever; join them too — with a DEADLINE (wait=True
+        # would hang stop() on a handler wedged in uninterruptible
+        # blocking work, e.g. a recv to a stuck peer)
+        self._executor.shutdown(wait=False)
+        deadline = time.monotonic() + grace + 5.0
+        for t in threading.enumerate():
+            if t.name.startswith("mo-worker-grpc"):
+                t.join(max(0.0, deadline - time.monotonic()))

@@ -41,6 +41,45 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: fault-injected resilience drill (runs in "
                    "tier-1; each drill must stay under 30s)")
+    # mosan: the runtime concurrency sanitizer is ON by default under
+    # pytest (MO_SAN=0 opts out); its findings gate tier-1 via
+    # tests/test_mosan.py::test_suite_runs_sanitizer_clean
+    if os.environ.get("MO_SAN", "1").lower() not in ("0", "false", "off"):
+        from matrixone_tpu.utils import san
+        san.arm()
+
+
+def pytest_collection_modifyitems(session, config, items):
+    # the mosan gate must see the WHOLE run: move it to the end of the
+    # collection (file order would leave every test after test_mosan.py
+    # outside its coverage)
+    gate = [i for i in items
+            if i.nodeid.endswith("test_suite_runs_sanitizer_clean")]
+    for g in gate:
+        items.remove(g)
+        items.append(g)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    from matrixone_tpu.utils import san
+    if not san.armed():
+        return
+    # regenerate the checked-in runtime lock-order edge export that
+    # molint's lock-discipline checker reconciles against (see README
+    # "Concurrency sanitizer"); opt-in so ordinary runs never dirty the
+    # working tree
+    if os.environ.get("MO_SAN_EXPORT", "").lower() in ("1", "true", "on"):
+        path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                            "molint", "observed_lock_edges.json")
+        n = san.export_edges(os.path.abspath(path))
+        print(f"\n[mosan] exported {n} lock-order edges -> {path}")
+    leftover = san.findings()
+    if leftover:
+        print(f"\n[mosan] {len(leftover)} finding(s) accumulated this "
+              f"run (the gate test runs last and fails on these when "
+              f"tests/test_mosan.py is part of the selection):")
+        for f in leftover[:10]:
+            print(f.format())
 
 
 @pytest.fixture(scope="session")
@@ -54,3 +93,18 @@ def _disarm_faults():
     yield
     from matrixone_tpu.utils.fault import INJECTOR
     INJECTOR.clear()
+
+
+@pytest.fixture(autouse=True)
+def _san_thread_leaks(request):
+    """mosan per-test leak check: threads alive after a test that were
+    not alive before it (minus san.daemon()-registered immortals) are
+    findings — a service that never joins its workers surfaces at the
+    test that leaked it, not as a mystery slowdown three PRs later."""
+    from matrixone_tpu.utils import san
+    if not san.armed():
+        yield
+        return
+    before = san.thread_snapshot()
+    yield
+    san.check_thread_leaks(before, request.node.nodeid)

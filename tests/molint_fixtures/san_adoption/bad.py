@@ -1,0 +1,17 @@
+"""san-adoption fixture: raw threading lock primitives the runtime
+sanitizer cannot see.  AST-only — never imported."""
+
+import threading
+import threading as t
+from threading import Lock, RLock
+
+
+class RawLocks:
+    def __init__(self):
+        self._lock = threading.Lock()             # finding
+        self._rlock = threading.RLock()           # finding
+        self._cond = threading.Condition()        # finding
+        self._aliased = t.Lock()                  # finding (module alias)
+        self._from_import = Lock()                # finding (from-import)
+        self._from_rlock = RLock()                # finding
+        self._ok_event = threading.Event()        # events stay free

@@ -1,0 +1,25 @@
+"""san-adoption fixture: factory-built locks + non-lock primitives.
+AST-only — never imported."""
+
+import threading
+
+from matrixone_tpu.utils import san
+
+
+class FactoryLocks:
+    def __init__(self):
+        self._lock = san.lock("FactoryLocks._lock")
+        self._rlock = san.rlock("FactoryLocks._rlock", category="cache")
+        self._cond = san.condition(self._lock)
+        self._stop = threading.Event()            # not a lock primitive
+        self._gate = threading.Semaphore(2)       # not tracked either
+
+
+class NotThreading:
+    """A user class named Lock is not the threading primitive."""
+
+    class Lock:
+        pass
+
+    def __init__(self):
+        self._lock = self.Lock()

@@ -17,7 +17,8 @@ def star():
     s.execute("insert into dim values (1,'a'),(2,'b'),(3,'c')")
     vals = ",".join(f"({i},{i % 3 + 1},{i * 2})" for i in range(2000))
     s.execute(f"insert into fact values {vals}")
-    return s
+    yield s
+    c.close()          # join the task runner + server accept thread
 
 
 def _col(r, name):

@@ -134,6 +134,7 @@ class _StuckPeer:
     `respond` is flipped — a persistently-slow peer."""
 
     def __init__(self):
+        from matrixone_tpu.utils.lifecycle import ServiceThreads
         self.respond = False
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -141,7 +142,8 @@ class _StuckPeer:
         self.port = self._sock.getsockname()[1]
         self._sock.listen(16)
         self._stop = threading.Event()
-        threading.Thread(target=self._serve, daemon=True).start()
+        self._svc = ServiceThreads("tst-stuckpeer")
+        self._svc.spawn_accept(self._serve)
 
     def _serve(self):
         from matrixone_tpu.logservice.replicated import (_recv_msg,
@@ -166,15 +168,13 @@ class _StuckPeer:
                         c.close()
                     except OSError:
                         pass
-            threading.Thread(target=handle, args=(conn,),
-                             daemon=True).start()
+            self._svc.spawn_handler(handle, conn)
 
     def stop(self):
         self._stop.set()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        # shut down the listener + live conns and JOIN everything (the
+        # mosan leak checker gates abandoned drill threads)
+        self._svc.shutdown(self._sock)
 
 
 def test_breaker_opens_on_slow_peer_then_half_open_recovers():

@@ -11,7 +11,9 @@ from matrixone_tpu.embed import Cluster
 
 @pytest.fixture()
 def s():
-    return Cluster(wire=False).session()
+    c = Cluster(wire=False)
+    yield c.session()
+    c.close()          # join the task runner thread
 
 
 def _col(r, name):

@@ -55,7 +55,7 @@ def test_suite_shape():
     assert sorted(rules) == [
         "broad-except", "cache-invalidation", "deadline-propagation",
         "fault-coverage", "jit-purity", "lock-discipline",
-        "metric-hygiene"]
+        "metric-hygiene", "san-adoption"]
 
 
 # ------------------------------------------------- per-checker fixtures
@@ -256,6 +256,82 @@ def test_broad_except_fixtures():
                         [os.path.join(d, "bad.py")],
                         [os.path.join(d, "good.py")])
     assert len(bad) == 2                 # except Exception + bare except
+
+
+def test_san_adoption_fixtures():
+    d = os.path.join(FIX, "san_adoption")
+    bad = _fixture_pair("san-adoption",
+                        [os.path.join(d, "bad.py")],
+                        [os.path.join(d, "good.py")])
+    # direct + RLock + Condition + module-alias + two from-imports
+    assert len(bad) == 6
+    msgs = " | ".join(f.message for f in bad)
+    assert "san.lock" in msgs
+    assert "san.rlock" in msgs
+    assert "san.condition" in msgs
+
+
+def test_san_adoption_planted_violation(tmp_path):
+    """Planted raw lock in a temp tree fires; a justified suppression
+    silences it (the escape hatch stays disciplined)."""
+    p = tmp_path / "svc.py"
+    p.write_text("import threading\n"
+                 "class Svc:\n"
+                 "    def __init__(self):\n"
+                 "        self._mu = threading.Lock()\n")
+    findings, _ = _run([str(p)], rules=["san-adoption"],
+                       tests_dir=str(tmp_path))
+    assert len(findings) == 1 and findings[0].rule == "san-adoption"
+    p2 = tmp_path / "svc2.py"
+    p2.write_text(
+        "import threading\n"
+        "class Svc:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()  # mol"
+        "int: disable=san-adoption -- bootstraps before san imports\n")
+    findings2, stats2 = _run([str(p2)], rules=["san-adoption"],
+                             tests_dir=str(tmp_path))
+    assert not findings2 and stats2["suppressions_used"] == 1
+
+
+def test_lock_discipline_reconciles_runtime_edges(tmp_path):
+    """The mosan handshake: a static lexical edge unioned with the
+    OPPOSITE edge observed at runtime (observed_lock_edges.json) closes
+    a mixed cycle and fails the gate; a runtime edge AGREEING with the
+    static order stays clean."""
+    p = tmp_path / "mod.py"
+    p.write_text("import threading\n"
+                 "class C:\n"
+                 "    def f(self):\n"
+                 "        with self._a_lock:\n"
+                 "            with self._b_lock:\n"
+                 "                pass\n")
+    contradicting = tmp_path / "observed.json"
+    contradicting.write_text(json.dumps({"edges": [
+        {"from": "C._b_lock", "to": "C._a_lock",
+         "count": 3, "site": "runtime drill"}]}))
+    cfg = {"lock-discipline":
+           {"runtime_edges_path": str(contradicting)}}
+    findings, _ = _run([str(p)], rules=["lock-discipline"], config=cfg)
+    assert any("lock-order cycle" in f.message for f in findings), \
+        [f.format() for f in findings]
+
+    agreeing = tmp_path / "observed2.json"
+    agreeing.write_text(json.dumps({"edges": [
+        {"from": "C._a_lock", "to": "C._b_lock",
+         "count": 3, "site": "runtime drill"}]}))
+    cfg2 = {"lock-discipline": {"runtime_edges_path": str(agreeing)}}
+    findings2, _ = _run([str(p)], rules=["lock-discipline"],
+                        config=cfg2)
+    assert not findings2, [f.format() for f in findings2]
+
+    # unreadable export: static graph only, never a crashed gate
+    broken = tmp_path / "broken.json"
+    broken.write_text("{not json")
+    cfg3 = {"lock-discipline": {"runtime_edges_path": str(broken)}}
+    findings3, _ = _run([str(p)], rules=["lock-discipline"],
+                        config=cfg3)
+    assert not findings3
 
 
 # ------------------------------------------------- suppression machinery

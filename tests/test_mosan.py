@@ -1,0 +1,470 @@
+"""mosan — the runtime concurrency sanitizer (utils/san.py, tools/mosan).
+
+Layers:
+
+  * **tier-1 gate** — `test_suite_runs_sanitizer_clean`: the armed
+    sanitizer must have accumulated ZERO findings over every test that
+    ran before this file (lock-order cycles, blocking-under-lock,
+    unguarded mutations, thread leaks).  A finding here is a real
+    concurrency bug — fix it, never suppress it (PR-6 standard).
+  * **directed stress drill** — N writers vs M cached readers over
+    engine + serving caches + admission, sanitizer armed: clean; and
+    the PR-4 result-cache eviction race, re-planted, is caught with
+    both stacks (tools/mosan.plant_eviction_race, reverted after).
+  * **mechanism units** — dynamic lock-order graph, choke-point
+    blocking checks + allow_blocking exemption, the shared-state write
+    auditor, the per-test thread-leak checker, condition held-stack
+    bookkeeping, the disarmed fast path, mo_ctl('san', ...).
+  * **satellites** — shared LruCache / ResultCache concurrent hammers
+    (byte/entry accounting must never drift — the bug class PR 4 hit
+    three times).
+"""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from matrixone_tpu.utils import san  # noqa: E402
+
+
+# ------------------------------------------------------------ tier-1 gate
+def test_suite_runs_sanitizer_clean():
+    """THE gate: the sanitizer armed over the whole run must be clean.
+    conftest.pytest_collection_modifyitems moves this test to the END
+    of the collection, so it covers every test in the session."""
+    if not san.armed():
+        pytest.skip("MO_SAN=0: sanitizer disarmed for this run")
+    found = san.findings()
+    assert not found, (
+        f"{len(found)} sanitizer finding(s) — real concurrency bugs; "
+        "fix them (never suppress):\n"
+        + "\n\n".join(f.format() for f in found))
+
+
+# ------------------------------------------------------- stress drill
+@pytest.mark.chaos
+def test_stress_drill_clean():
+    from tools import mosan
+    rep = mosan.run_stress(seconds=1.2)
+    assert not rep["errors"], rep["errors"]
+    assert not rep["findings"], "\n".join(rep["findings_formatted"])
+    assert rep["reads"] > 50 and rep["writes"] >= 2, rep
+
+
+@pytest.mark.chaos
+def test_stress_drill_catches_planted_eviction_race():
+    """Re-introduce the PR-4 eviction race (stale-path pop outside the
+    cache lock): the drill must produce an unguarded-mutation finding
+    carrying BOTH stacks — the racing mutator and the owning lock's
+    last acquirer — and the plant must be reverted afterwards."""
+    from matrixone_tpu.serving.result_cache import ResultCache
+    from tools import mosan
+    original_get = ResultCache.get
+    rep = mosan.run_stress(seconds=1.0, plant="eviction-race")
+    # the plant is reverted: the live class serves the fixed code again
+    assert ResultCache.get is original_get
+    hits = [f for f in rep["findings"]
+            if f["rule"] == "unguarded-mutation"
+            and "ResultCache" in f["message"]]
+    assert hits, ("planted race not caught:\n"
+                  + "\n".join(rep["findings_formatted"]))
+    stacks = hits[0]["stacks"]
+    assert len(stacks) == 2, stacks         # mutator + last lock owner
+    for role, frames in stacks.items():
+        assert frames, f"stack {role!r} is empty"
+    mutator = stacks["unguarded mutator"]
+    assert any("racy_get" in fr for fr in mutator), mutator
+    # and the process-global report is untouched (isolated sink)
+    assert not [f for f in san.findings()
+                if f.rule == "unguarded-mutation"]
+
+
+# ------------------------------------------------- lock-order mechanism
+def test_lock_order_cycle_has_both_stacks():
+    with san.isolated() as probe:
+        a = san.lock("TstA._lock")
+        b = san.lock("TstB._lock")
+        with a:
+            with b:
+                pass
+        assert not probe.findings()         # one order: no cycle yet
+        with b:
+            with a:
+                pass
+        found = [f for f in probe.findings()
+                 if f.rule == "lock-order-cycle"]
+        assert len(found) == 1
+        assert "TstA._lock" in found[0].message
+        assert len(found[0].stacks) == 2    # both acquisition stacks
+        for frames in found[0].stacks.values():
+            assert any("test_mosan" in fr for fr in frames), frames
+
+
+def test_trylock_records_no_edge():
+    """notify_waiters-style non-blocking acquires cannot deadlock, so
+    they must not contribute lock-order edges (the sync._COND <->
+    component-lock pattern is a cycle by design, made safe by
+    blocking=False)."""
+    with san.isolated() as probe:
+        a = san.lock("TstTry._a")
+        b = san.lock("TstTry._b")
+        with a:
+            with b:
+                pass
+        with b:
+            assert a.acquire(blocking=False)
+            a.release()
+        assert not probe.findings()
+        assert not [e for e in probe.edges()
+                    if e["from"] == "TstTry._b"]
+
+
+def test_rlock_reentry_records_no_edge():
+    with san.isolated() as probe:
+        r = san.rlock("TstR._lock")
+        with r:
+            with r:                          # re-entry, not an edge
+                pass
+        assert not [e for e in probe.edges()
+                    if e["from"] == "TstR._lock"]
+        assert not probe.findings()
+
+
+def test_transitive_cycle_detected():
+    with san.isolated() as probe:
+        a, b, c = (san.lock(f"TstT{x}._lock") for x in "abc")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:
+            with a:
+                pass
+        found = [f for f in probe.findings()
+                 if f.rule == "lock-order-cycle"]
+        assert found and "->" in found[0].message
+
+
+# -------------------------------------------- blocking-under-lock checks
+def test_blocking_under_cache_lock_is_a_finding():
+    with san.isolated() as probe:
+        lk = san.lock("TstCache._lock", category="cache")
+        san.check_blocking("rpc.call")       # no lock held: clean
+        assert not probe.findings()
+        with lk:
+            san.check_blocking("rpc.call")
+        found = [f for f in probe.findings()
+                 if f.rule == "blocking-under-lock"]
+        assert len(found) == 1
+        assert "TstCache._lock" in found[0].message
+
+
+def test_allow_blocking_exempts_the_protocol():
+    with san.isolated() as probe:
+        lk = san.rlock("TstCommit._lock", category="commit")
+        with lk:
+            with san.allow_blocking("commit protocol drill"):
+                san.check_blocking("socket.send")
+        assert not probe.findings()
+    with pytest.raises(ValueError):
+        with san.allow_blocking(""):         # justification REQUIRED
+            pass
+
+
+def test_uncategorized_locks_do_not_flag_blocking():
+    with san.isolated() as probe:
+        lk = san.lock("TstPlain._lock")
+        with lk:
+            san.check_blocking("rpc.call")
+        assert not probe.findings()
+
+
+# ---------------------------------------------- shared-state write audit
+class _Box:
+    pass
+
+
+def test_guard_catches_unlocked_mutation_with_owner_stack():
+    with san.isolated() as probe:
+        lk = san.lock("TstBox._lock")
+        box = san.guard(_Box(), lk, name="TstBox")
+        with lk:
+            san.mutating(box)                # held: clean
+        assert not probe.findings()
+        san.mutating(box)                    # not held: finding
+        found = [f for f in probe.findings()
+                 if f.rule == "unguarded-mutation"]
+        assert len(found) == 1
+        assert "TstBox" in found[0].message
+        # guard attachment turned on last-acquire recording: both sides
+        assert any("last acquire" in role for role in found[0].stacks)
+
+
+def test_guard_sees_lock_held_via_shared_condition():
+    with san.isolated() as probe:
+        lk = san.lock("TstCv._lock")
+        cv = san.condition(lk)
+        box = san.guard(_Box(), cv, name="TstCvBox")
+        with cv:
+            san.mutating(box)
+        assert not probe.findings()
+
+
+def test_condition_wait_releases_and_reacquires_held_stack():
+    lk = san.lock("TstWait._lock")
+    cv = san.condition(lk)
+    state = {"during_wait": None}
+
+    def waker():
+        time.sleep(0.05)
+        state["during_wait"] = "TstWait._lock" in san.held_locks()
+        with cv:
+            cv.notify_all()
+
+    with san.isolated() as probe:
+        t = threading.Thread(target=waker)
+        t.start()
+        with cv:
+            assert "TstWait._lock" in san.held_locks()
+            cv.wait(timeout=5)
+            # re-acquired on wake: the held stack is restored
+            assert "TstWait._lock" in san.held_locks()
+        t.join(5)
+        assert state["during_wait"] is False  # waker never saw it held
+        assert not probe.findings()
+
+
+# --------------------------------------------------- thread-leak checker
+def test_leak_checker_flags_unjoined_thread_and_honors_daemons():
+    stop = threading.Event()
+
+    def linger():
+        stop.wait(20)
+
+    with san.isolated() as probe:
+        before = san.thread_snapshot()
+        t = threading.Thread(target=linger, name="tst-leaky-svc")
+        t.start()
+        leaked = san.check_thread_leaks(before, "test_mosan::drill",
+                                        grace=0.1)
+        assert "tst-leaky-svc" in leaked
+        found = [f for f in probe.findings() if f.rule == "thread-leak"]
+        assert found and "tst-leaky-svc" in found[0].message
+        # a daemon registration (with justification) exempts the prefix
+        san.daemon("tst-leaky-", "drill: deliberately immortal")
+        before2 = san.thread_snapshot() - {t}
+        assert san.check_thread_leaks(before2, "x", grace=0.05) == []
+    stop.set()
+    t.join(5)
+    with pytest.raises(ValueError):
+        san.daemon("x", "")                  # justification REQUIRED
+
+
+def test_joined_threads_are_not_leaks():
+    with san.isolated() as probe:
+        before = san.thread_snapshot()
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join(5)
+        assert san.check_thread_leaks(before, "x", grace=0.2) == []
+        assert not probe.findings()
+
+
+# ------------------------------------------------- disarmed fast path
+def test_disarmed_lock_records_nothing():
+    was = san.armed()
+    san.disarm()
+    try:
+        with san.isolated() as probe:       # isolated() re-arms...
+            san.disarm()                    # ...so disarm inside
+            a = san.lock("TstOff._a")
+            b = san.lock("TstOff._b")
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+            assert not probe.findings()
+            assert not [e for e in probe.edges()
+                        if e["from"].startswith("TstOff")]
+    finally:
+        if was:
+            san.arm()
+
+
+def test_factory_api_shapes():
+    lk = san.lock("TstApi._lock")
+    assert lk.acquire(blocking=False) is True
+    assert lk.locked()
+    lk.release()
+    assert not lk.locked()
+    cv = san.condition("TstApi._cv")
+    with cv:
+        cv.notify()
+        cv.notify_all()
+    assert san.condition(lk)._sl is lk       # shared-lock form
+    # locked() must work on reentrant locks too (stdlib RLock grows
+    # .locked() only in 3.13 — the wrapper emulates it before that)
+    rl = san.rlock("TstApi._rlock")
+    assert rl.locked() is False
+    with rl:
+        assert rl.locked() is True           # held by me (reentrant)
+        got = {}
+        t = threading.Thread(
+            target=lambda: got.__setitem__("v", rl.locked()))
+        t.start()
+        t.join(5)
+        assert got["v"] is True              # held by someone else
+    assert rl.locked() is False
+
+
+# ------------------------------------------------------- ops surfaces
+def test_mo_ctl_san_status_and_clear():
+    from matrixone_tpu.frontend import Session
+    from matrixone_tpu.storage.engine import Engine
+    import json
+    # isolated(): the 'clear' subcommand wipes the process-global edge
+    # graph, which would empty the MO_SAN_EXPORT edge export for the
+    # whole session
+    with san.isolated():
+        s = Session(catalog=Engine())
+        (out,), = s.execute("select mo_ctl('san','status')").rows()
+        st = json.loads(out)
+        assert {"armed", "findings", "edges", "by_rule", "daemons"} \
+            <= set(st)
+        (msg,), = s.execute("select mo_ctl('san','clear')").rows()
+        assert "cleared" in msg
+        with pytest.raises(Exception):
+            s.execute("select mo_ctl('san','bogus')")
+        s.close()
+
+
+def test_report_and_edge_export(tmp_path):
+    with san.isolated():
+        a = san.lock("TstExp._a")
+        b = san.lock("TstExp._b")
+        with a:
+            with b:
+                pass
+        path = tmp_path / "edges.json"
+        san.export_edges(str(path))
+        import json
+        payload = json.loads(path.read_text())
+        assert any(e["from"] == "TstExp._a" and e["to"] == "TstExp._b"
+                   for e in payload["edges"])
+        rep = san.report()
+        assert rep["armed"] is True
+
+
+# ------------------------------------------- satellite: shared LruCache
+def test_lru_cache_concurrent_hammer_accounting_never_drifts():
+    """UDF + fusion compile caches share one LruCache across session
+    threads (PR 7): hammer get/put/evict/clear concurrently and the
+    entry accounting must stay exact — no budget drift, no negative
+    sizes, no findings from the write auditor."""
+    from matrixone_tpu.utils.lru import LruCache
+    cache = LruCache(max_entries=32)
+    stop = threading.Event()
+    errors = []
+
+    def worker(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            while not stop.is_set():
+                k = int(rng.integers(0, 128))
+                op = int(rng.integers(0, 10))
+                if op < 6:
+                    cache.insert(k, ("v", k))
+                elif op < 9:
+                    got = cache.lookup(k)
+                    if got is not None and got[1] != k:
+                        errors.append(f"wrong value for {k}: {got}")
+                else:
+                    cache.clear()
+                n = len(cache)
+                if n > 32:
+                    errors.append(f"budget exceeded: {n} entries")
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(repr(e))
+
+    with san.isolated() as probe:
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(10)
+        assert not errors, errors[:5]
+        assert not probe.findings(), \
+            "\n".join(f.format() for f in probe.findings())
+    assert len(cache) <= 32
+    assert len(cache.snapshot()) == len(cache)
+
+
+def test_result_cache_concurrent_byte_accounting_never_drifts():
+    """The exact PR-4 bug class, now hammered with the fixed code: the
+    tracked byte budget must equal the recomputed sum of resident
+    entries after concurrent get/put/shrink traffic."""
+    from matrixone_tpu.serving.result_cache import ResultCache, _Entry
+
+    class _B:                       # stable fake batch: 1KB footprint
+        class _V:
+            data = np.zeros(96, np.int64)
+            dict = None
+        columns = {"c": _V()}
+
+    rc = ResultCache(max_bytes=64 << 10)
+    versions = ("v", 1)
+    stop = threading.Event()
+    errors = []
+
+    def worker(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            while not stop.is_set():
+                k = ("q", int(rng.integers(0, 64)))
+                op = int(rng.integers(0, 10))
+                if op < 5:
+                    rc.put(k, _B(), versions)
+                elif op < 8:
+                    # half the gets see a version mismatch -> stale pop
+                    want = versions if op == 5 else ("v", 2)
+                    rc.get(k, lambda stored, w=want: w)
+                elif op < 9:
+                    rc.set_max_bytes((32 + int(rng.integers(0, 64)))
+                                     << 10)
+                else:
+                    rc.stats()
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(repr(e))
+
+    with san.isolated() as probe:
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(10)
+        assert not errors, errors[:5]
+        assert not probe.findings(), \
+            "\n".join(f.format() for f in probe.findings())
+    with rc._lock:
+        recomputed = sum(e.nbytes for e in rc._entries.values())
+        assert rc._bytes == recomputed, (rc._bytes, recomputed)
+        assert rc._bytes >= 0
+        assert isinstance(next(iter(rc._entries.values()), _Entry(
+            None, None, 0)), _Entry)

@@ -10,7 +10,9 @@ from matrixone_tpu.embed import Cluster
 
 @pytest.fixture()
 def s():
-    return Cluster().session()
+    c = Cluster()
+    yield c.session()
+    c.close()          # join the task runner + server accept thread
 
 
 def _col(r, name):

@@ -79,6 +79,13 @@ def test_prune_sql_decimal_literal_correct_rows():
     bounds (found by e2e drive: `k > 18.5` silently dropped k=19 rows)."""
     c = Cluster()
     s = c.session()
+    try:
+        _prune_sql_decimal_body(s)
+    finally:
+        c.close()
+
+
+def _prune_sql_decimal_body(s):
     s.execute("create table pm (k bigint, v bigint) partition by range(k) ("
               "partition p0 values less than (10), "
               "partition p1 values less than (20), "
@@ -133,7 +140,9 @@ def test_build_spec_validation():
 
 @pytest.fixture()
 def s():
-    return Cluster(wire=False).session()
+    c = Cluster(wire=False)
+    yield c.session()
+    c.close()          # join the task runner thread
 
 
 def test_range_partition_end_to_end(s):

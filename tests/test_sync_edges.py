@@ -1,0 +1,99 @@
+"""utils/sync.wait_until contract edges the sanitizer work leans on
+(mosan's drills and the leak checker sit on event-driven waits; a lost
+wakeup or a swallowed predicate error there turns a clean failure into
+a 10s mystery timeout).
+
+Pinned:
+  * timeout expiry: TimeoutError by default, False with
+    raise_on_timeout=False — and NEVER swallows a raising predicate;
+  * notify-before-wait is not a lost wakeup (predicate evaluated before
+    the first cv wait);
+  * a deadline already expired at entry returns/raises immediately,
+    without a wait quantum.
+"""
+
+import threading
+import time
+
+import pytest
+
+from matrixone_tpu.utils.sync import notify_waiters, wait_until
+
+
+def test_timeout_expiry_raises_by_default():
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        wait_until(lambda: False, timeout=0.15)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_timeout_expiry_returns_false_when_asked():
+    assert wait_until(lambda: False, timeout=0.1,
+                      raise_on_timeout=False) is False
+
+
+def test_predicate_exception_propagates_not_swallowed():
+    class Boom(RuntimeError):
+        pass
+
+    def pred():
+        raise Boom("from predicate")
+
+    # both timeout modes: the predicate's OWN error must surface, not a
+    # TimeoutError wrapper and not a silent False
+    with pytest.raises(Boom):
+        wait_until(pred, timeout=0.05)
+    with pytest.raises(Boom):
+        wait_until(pred, timeout=0.05, raise_on_timeout=False)
+    # and a predicate that starts raising only after the deadline is
+    # already gone still surfaces its error (re-check at expiry)
+    calls = {"n": 0}
+
+    def late_boom():
+        calls["n"] += 1
+        raise Boom("immediately")
+
+    with pytest.raises(Boom):
+        wait_until(late_boom, timeout=0.0, raise_on_timeout=False)
+    assert calls["n"] == 1
+
+
+def test_notify_before_wait_is_not_lost():
+    """The transition fires BEFORE the waiter enters wait_until: the
+    predicate-first loop must see it on entry instead of blocking a
+    full wait quantum (or forever on a one-shot notify)."""
+    flag = threading.Event()
+    flag.set()
+    notify_waiters()                     # nobody waiting: no-op, cheap
+    t0 = time.monotonic()
+    assert wait_until(flag.is_set, timeout=10.0) is True
+    assert time.monotonic() - t0 < 1.0   # no wait quantum burned
+
+
+def test_waiter_wakes_on_notify():
+    state = {"ready": False}
+    got = {}
+
+    def waiter():
+        got["v"] = wait_until(lambda: state["ready"], timeout=10.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    state["ready"] = True
+    notify_waiters()
+    t.join(5)
+    assert got.get("v") is True
+
+
+def test_pre_expired_deadline_returns_immediately():
+    # truthy predicate wins even with a dead budget
+    assert wait_until(lambda: 42, timeout=0.0) == 42
+    assert wait_until(lambda: 7, timeout=-1.0) == 7
+    # falsy predicate: immediate verdict, no wait quantum
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        wait_until(lambda: False, timeout=0.0)
+    assert wait_until(lambda: False, timeout=-5.0,
+                      raise_on_timeout=False) is False
+    assert time.monotonic() - t0 < 1.0

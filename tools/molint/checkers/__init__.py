@@ -9,6 +9,7 @@ from tools.molint.checkers.cache_invalidation import \
 from tools.molint.checkers.metric_hygiene import MetricHygieneChecker
 from tools.molint.checkers.fault_coverage import FaultCoverageChecker
 from tools.molint.checkers.broad_except import BroadExceptChecker
+from tools.molint.checkers.san_adoption import SanAdoptionChecker
 
 ALL = [
     JitPurityChecker,
@@ -18,4 +19,5 @@ ALL = [
     MetricHygieneChecker,
     FaultCoverageChecker,
     BroadExceptChecker,
+    SanAdoptionChecker,
 ]

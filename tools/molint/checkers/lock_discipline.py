@@ -28,11 +28,24 @@ Three sub-rules:
     are class-qualified; module-level locks are module-qualified.
     Same-identity nesting is ignored (RLock re-entry is a supported
     pattern here — `_commit_lock` is an RLock by design).
+
+    **Runtime-edge reconciliation (mosan handshake)**: when
+    `tools/molint/observed_lock_edges.json` exists — the dynamic edge
+    set exported by the runtime sanitizer (matrixone_tpu/utils/san.py;
+    regenerate with `MO_SAN_EXPORT=1 python -m pytest`) — the cycle
+    check runs over the UNION of static and observed edges.  The san
+    factories name locks with the same identity scheme this checker
+    normalizes to ("Class._attr" / dotted module path), so a lexical
+    guess that contradicts a real schedule (static A→B, observed B→A)
+    closes a mixed cycle and fails the gate, with each edge labeled by
+    the side that saw it.
 """
 
 from __future__ import annotations
 
 import ast
+import json
+import os
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from tools.molint import Checker, Finding, Project
@@ -86,6 +99,10 @@ class LockDisciplineChecker(Checker):
         #: the denylists above, not a function whitelist — see the
         #: module docstring before extending blocking_attrs)
         "commit_lock_name": "_commit_lock",
+        #: mosan's exported dynamic edge set, unioned into the cycle
+        #: check (path relative to the repo root; missing file = static
+        #: graph only; None disables — fixture runs use that)
+        "runtime_edges_path": "tools/molint/observed_lock_edges.json",
     }
 
     # ------------------------------------------------------------ check
@@ -120,8 +137,37 @@ class LockDisciplineChecker(Checker):
 
         for fi in funcs:
             findings.extend(self._scan_func(fi, config, edges, acquires))
+        runtime = self._load_runtime_edges(project, config)
+        for (a, b), site in runtime.items():
+            # observed-at-runtime edges join the graph; a static guess
+            # contradicted by a real schedule closes a mixed cycle
+            edges.setdefault(a, {}).setdefault(b, site)
         findings.extend(self._cycles(edges))
         return findings
+
+    # ---------------------------------------------- mosan runtime edges
+    @staticmethod
+    def _load_runtime_edges(project: Project, config: dict):
+        out = {}
+        rel = config.get("runtime_edges_path")
+        if not rel:
+            return out
+        path = rel if os.path.isabs(rel) else os.path.join(project.root,
+                                                           rel)
+        if not os.path.exists(path):
+            return out
+        try:
+            with open(path, encoding="utf-8") as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            return out          # unreadable export: static graph only
+        for e in payload.get("edges", []):
+            a, b = e.get("from"), e.get("to")
+            if a and b and a != b:
+                # findings anchor at the export file, line 1: the real
+                # acquisition site lives in the edge's "site" field
+                out[(a, b)] = (rel, 1)
+        return out
 
     # ----------------------------------------------- unscoped .acquire
     def _unscoped_acquires(self, mod) -> Iterable[Finding]:

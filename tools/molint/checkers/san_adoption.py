@@ -1,0 +1,72 @@
+"""san-adoption: lockish objects come from the `san` factories.
+
+The runtime concurrency sanitizer (matrixone_tpu/utils/san.py) can only
+watch locks built through `san.lock()` / `san.rlock()` /
+`san.condition()` — a raw `threading.Lock()` is invisible to the
+held-lock stacks, the dynamic lock-order graph and the write auditor.
+This rule keeps new code from silently opting out: any
+`threading.Lock()`, `threading.RLock()` or `threading.Condition()`
+constructed inside `matrixone_tpu/` (outside utils/san.py itself, which
+wraps the primitives) is a finding.  `threading.Event`/`Semaphore` are
+not lock-order participants and stay free.
+
+Aliased forms are caught too: `import threading as t; t.Lock()` and
+`from threading import Lock; Lock()`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.molint import Checker, Finding, Project
+from tools.molint.astutil import aliases_of, dotted
+
+_LOCKISH = {"Lock": "san.lock", "RLock": "san.rlock",
+            "Condition": "san.condition"}
+
+
+class SanAdoptionChecker(Checker):
+    rule = "san-adoption"
+    description = ("threading.Lock/RLock/Condition must come from the "
+                   "san factories so the runtime sanitizer sees them")
+    default_config = {
+        #: files allowed to touch the raw primitives (path suffixes)
+        "exempt_suffixes": ("utils/san.py",),
+    }
+
+    def check(self, project: Project, config: dict) -> Iterable[Finding]:
+        exempt = tuple(config["exempt_suffixes"])
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            if any(mod.path.endswith(sfx) for sfx in exempt):
+                continue
+            aliases = aliases_of(mod)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = self._raw_lockish(node, aliases)
+                if kind is not None:
+                    yield Finding(
+                        self.rule, mod.path, node.lineno,
+                        f"raw threading.{kind}() is invisible to the "
+                        f"runtime sanitizer — use "
+                        f"{_LOCKISH[kind]}(\"<Class>._<attr>\") "
+                        f"(matrixone_tpu/utils/san.py)")
+
+    @staticmethod
+    def _raw_lockish(call: ast.Call, aliases) -> str:
+        d = dotted(call.func)
+        if d is None:
+            return None
+        parts = d.split(".")
+        term = parts[-1]
+        if term not in _LOCKISH:
+            return None
+        if len(parts) == 1:
+            # bare Lock(): only when imported from threading
+            target = aliases.get(term, "")
+            return term if target == f"threading.{term}" else None
+        recv = aliases.get(parts[0], parts[0])
+        return term if recv == "threading" else None

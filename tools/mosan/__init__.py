@@ -1,0 +1,251 @@
+"""mosan driver — directed concurrency stress drill + ops CLI for the
+runtime sanitizer in `matrixone_tpu/utils/san.py`.
+
+The drill spins N writer threads against M cached-reader threads over
+one engine with the serving layer armed (result cache ON, admission
+slots bounded) while the sanitizer watches: lock-order edges, blocking-
+under-lock choke points, guarded-structure mutations and thread leaks
+all exercise their real schedules.  A clean run returns zero findings;
+`plant="eviction-race"` re-introduces the PR-4 result-cache eviction
+race (stale-path pop outside the cache lock) and the drill must catch
+it — the regression proof tests/test_mosan.py pins.
+
+Used by:
+  * `python -m tools.mosan --stress [secs]` (ops / debugging)
+  * `python -m tools.precheck --san-smoke` (CI smoke, <30s)
+  * tests/test_mosan.py (tier-1 gate + planted-race drill)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+
+def stress_seconds(default: float = 2.0) -> float:
+    """MO_SAN_STRESS_SECS knob (README "Concurrency sanitizer")."""
+    try:
+        return float(os.environ.get("MO_SAN_STRESS_SECS", "") or default)
+    except ValueError:
+        return default
+
+
+@contextmanager
+def plant_eviction_race():
+    """Re-introduce the PR-4 ResultCache eviction race: the stale-path
+    pop runs OUTSIDE the cache lock (a concurrent put() can interleave,
+    evicting the fresh entry and corrupting the byte budget).  The
+    mutation still rides the auditor hook (`san.mutating`) — the
+    discipline the write auditor enforces is exactly that the hook and
+    the mutation stay inside the owning lock's critical section."""
+    from matrixone_tpu.serving.result_cache import ResultCache
+    from matrixone_tpu.utils import san
+
+    original = ResultCache.get
+
+    def racy_get(self, key, current_versions):
+        from matrixone_tpu.utils import metrics as M
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                self._entries.move_to_end(key)
+        if e is None:
+            M.result_cache_ops.inc(outcome="miss")
+            return None
+        now = current_versions(e.versions)
+        if now != e.versions:
+            # THE PLANT: pre-fix PR-4 code shape — evict the stale entry
+            # after releasing the lock, no identity check
+            san.mutating(self)
+            self._entries.pop(key, None)
+            self._bytes -= e.nbytes
+            M.result_cache_ops.inc(outcome="stale")
+            return None
+        M.result_cache_ops.inc(outcome="hit")
+        return e.batch, e.versions
+
+    ResultCache.get = racy_get
+    try:
+        yield
+    finally:
+        ResultCache.get = original
+
+
+def run_stress(seconds: Optional[float] = None, writers: int = 2,
+               readers: int = 3, plant: Optional[str] = None) -> dict:
+    """N writer / M cached-reader threads over engine + serving caches +
+    admission with the sanitizer armed in an isolated sink.  Returns a
+    report dict; `findings` empty == clean."""
+    from matrixone_tpu.frontend import Session
+    from matrixone_tpu.serving import serving_for
+    from matrixone_tpu.storage.engine import Engine
+    from matrixone_tpu.utils import san
+
+    seconds = stress_seconds() if seconds is None else float(seconds)
+    if plant not in (None, "eviction-race"):
+        raise ValueError(f"unknown plant {plant!r}; use 'eviction-race'")
+
+    eng = Engine()
+    s = Session(catalog=eng)
+    s.execute("create table san_ctr (id bigint primary key, v bigint)")
+    s.execute("insert into san_ctr values "
+              + ", ".join(f"({i}, 0)" for i in range(1, writers + 1)))
+    s.execute("select mo_ctl('serving','result:on')")
+    sv = serving_for(eng)
+    sv.admission.slots = max(2, readers)       # bounded, really queueing
+    s.execute("select sum(v) from san_ctr")    # warm compile
+
+    stop = threading.Event()
+    errors: list = []
+    counts = {"reads": 0, "writes": 0}
+
+    def writer(row: int):
+        sw = Session(catalog=eng)
+        try:
+            while not stop.is_set():
+                sw.execute(f"update san_ctr set v = v + 1 "
+                           f"where id = {row}")
+                counts["writes"] += 1
+        except Exception as e:      # noqa: BLE001 — surfaced in report
+            errors.append(f"writer[{row}]: {e!r}")
+        finally:
+            sw.close()
+
+    def reader():
+        sr = Session(catalog=eng)
+        try:
+            last = -1
+            while not stop.is_set():
+                (total,), = sr.execute(
+                    "select sum(v) from san_ctr").rows()
+                if total < last:
+                    errors.append(f"sum went BACK: {last} -> {total}")
+                    return
+                last = total
+                counts["reads"] += 1
+                # yield the GIL: cache-hit reads would otherwise starve
+                # the writers and the drill never exercises stale paths
+                time.sleep(0.0005)
+        except Exception as e:      # noqa: BLE001
+            errors.append(f"reader: {e!r}")
+        finally:
+            sr.close()
+
+    planter = plant_eviction_race() if plant else None
+    t0 = time.monotonic()
+    with san.isolated() as probe:
+        if planter is not None:
+            planter.__enter__()
+        try:
+            threads = ([threading.Thread(target=writer, args=(r,),
+                                         name=f"san-writer-{r}")
+                        for r in range(1, writers + 1)]
+                       + [threading.Thread(target=reader,
+                                           name=f"san-reader-{i}")
+                          for i in range(readers)])
+            for t in threads:
+                t.start()
+            if plant is None:
+                time.sleep(seconds)
+            else:
+                # a planted drill stops the moment the sanitizer catches
+                # the race (bounded by 5x the budget so a broken
+                # detector still terminates)
+                deadline = time.monotonic() + max(5.0, seconds * 5)
+                while time.monotonic() < deadline:
+                    if any(f.rule == "unguarded-mutation"
+                           for f in probe.findings()):
+                        break
+                    time.sleep(0.05)
+            stop.set()
+            for t in threads:
+                t.join(30)
+        finally:
+            if planter is not None:
+                planter.__exit__(None, None, None)
+        found = probe.findings()
+        edges = probe.edges()
+    sv.admission.slots = 0
+    s.execute("select mo_ctl('serving','clear')")
+    s.close()
+    return {"seconds": round(time.monotonic() - t0, 2),
+            "writers": writers, "readers": readers,
+            "plant": plant, "errors": errors,
+            "reads": counts["reads"], "writes": counts["writes"],
+            "edges": len(edges),
+            "edges_detail": edges,
+            "findings": [f.as_dict() for f in found],
+            "findings_formatted": [f.format() for f in found]}
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.mosan",
+        description="runtime concurrency sanitizer driver (see README "
+                    "'Concurrency sanitizer')")
+    ap.add_argument("--stress", nargs="?", const=-1.0, type=float,
+                    default=None, metavar="SECS",
+                    help="run the writer/reader stress drill (default "
+                         "MO_SAN_STRESS_SECS or 2s)")
+    ap.add_argument("--plant", default=None, choices=["eviction-race"],
+                    help="re-introduce a known race; the drill must "
+                         "catch it (exit 0 iff caught)")
+    ap.add_argument("--writers", type=int, default=2)
+    ap.add_argument("--readers", type=int, default=3)
+    ap.add_argument("--export-edges", nargs="?", metavar="PATH",
+                    const="mosan_drill_edges.json", default=None,
+                    help="run the drill and write ITS observed "
+                         "lock-order edges as JSON (debugging aid; the "
+                         "canonical checked-in export comes from a "
+                         "full armed run: MO_SAN_EXPORT=1 pytest)")
+    ap.add_argument("--status", action="store_true",
+                    help="print the process-global sanitizer report")
+    args = ap.parse_args(argv)
+
+    from matrixone_tpu.utils import san
+
+    if args.status:
+        print(json.dumps(san.report(), indent=1, sort_keys=True))
+        return 0
+
+    if args.stress is None and args.export_edges is None:
+        ap.print_help()
+        return 2
+
+    secs = None if (args.stress in (None, -1.0)) else args.stress
+    rep = run_stress(seconds=secs, writers=args.writers,
+                     readers=args.readers, plant=args.plant)
+    for line in rep.pop("findings_formatted"):
+        print(line)
+    edges_detail = rep.pop("edges_detail")
+    print(json.dumps({k: v for k, v in rep.items() if k != "findings"},
+                     sort_keys=True))
+    if args.export_edges is not None:
+        # the DRILL's observed edges (run_stress isolates its sinks, so
+        # the process-global graph would be empty here); the checked-in
+        # file should come from a full armed suite run
+        # (MO_SAN_EXPORT=1 pytest) — this subset is for debugging
+        with open(args.export_edges, "w", encoding="utf-8") as f:
+            json.dump({"comment": "drill-scoped runtime lock-order "
+                                  "edges (python -m tools.mosan); the "
+                                  "canonical export comes from "
+                                  "MO_SAN_EXPORT=1 python -m pytest",
+                       "edges": edges_detail}, f, indent=1,
+                      sort_keys=True)
+            f.write("\n")
+        print(f"exported {len(edges_detail)} drill edges -> "
+              f"{args.export_edges}", file=sys.stderr)
+    if args.plant:
+        caught = any(f["rule"] == "unguarded-mutation"
+                     for f in rep["findings"])
+        print("planted race CAUGHT" if caught
+              else "planted race NOT caught", file=sys.stderr)
+        return 0 if caught else 1
+    return 1 if (rep["findings"] or rep["errors"]) else 0

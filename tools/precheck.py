@@ -1,9 +1,11 @@
 """`python -m tools.precheck` — the repo's one-shot static gate:
 molint (invariant checkers, tools/molint/) + bench_guard (scoreboard
-regression floors, tools/bench_guard.py).  This is what CI and the
-tier-1 suite run; see README "Static analysis".
+regression floors, tools/bench_guard.py), plus an opt-in `--san-smoke`
+stage that runs the mosan concurrency stress drill armed (tools/mosan,
+<30s).  This is what CI and the tier-1 suite run; see README "Static
+analysis" and "Concurrency sanitizer".
 
-Exit 0 = both gates green; 1 = findings/regressions (details printed).
+Exit 0 = all gates green; 1 = findings/regressions (details printed).
 """
 
 from __future__ import annotations
@@ -20,6 +22,10 @@ def main(argv=None) -> int:
     ap.add_argument("--skip-bench", action="store_true",
                     help="run only molint (no BENCH_*.json history "
                          "needed)")
+    ap.add_argument("--san-smoke", action="store_true",
+                    help="also run the mosan stress drill armed "
+                         "(writers vs cached readers + the planted "
+                         "eviction-race regression; <30s)")
     args = ap.parse_args(argv)
 
     from tools import bench_guard, molint
@@ -47,6 +53,29 @@ def main(argv=None) -> int:
             rc = 1
         else:
             print("bench_guard: ok")
+
+    if args.san_smoke:
+        from tools import mosan
+        rep = mosan.run_stress()
+        if rep["findings"] or rep["errors"]:
+            for line in rep["findings_formatted"]:
+                print(line)
+            for e in rep["errors"]:
+                print(e)
+            print("san-smoke: FINDINGS", file=sys.stderr)
+            rc = 1
+        else:
+            print(f"san-smoke: clean drill ok ({rep['reads']} reads / "
+                  f"{rep['writes']} writes, {rep['edges']} edges)")
+        planted = mosan.run_stress(plant="eviction-race")
+        caught = any(f["rule"] == "unguarded-mutation"
+                     for f in planted["findings"])
+        if caught:
+            print("san-smoke: planted eviction race caught ok")
+        else:
+            print("san-smoke: planted eviction race NOT caught",
+                  file=sys.stderr)
+            rc = 1
     return rc
 
 
