@@ -42,6 +42,7 @@ def sql_literal(v) -> str:
     by every SQL-generating sink/writer (SQLSink, SourceWriter, dynamic
     table refresh), so type coverage cannot drift between them."""
     import datetime
+    import math
     if v is None:
         return "null"
     if isinstance(v, bool):
@@ -54,8 +55,41 @@ def sql_literal(v) -> str:
     if isinstance(v, datetime.date):
         return "'" + v.isoformat() + "'"
     if isinstance(v, float):
+        # SQL has no nan/inf literals: repr() would emit bare `nan`,
+        # corrupting every generated statement downstream (SQLSink,
+        # SourceWriter, dynamic-table refresh).  NULL is the only value
+        # every SQL dialect can round-trip for "not a representable
+        # number" — render it explicitly.
+        if math.isnan(v) or math.isinf(v):
+            return "null"
         return repr(v)
     return str(v)
+
+
+def delta_events(engine, table: str, from_ts: int) -> List[tuple]:
+    """The decoded per-commit delta stream of one table, replayed from
+    MVCC state: every (commit_ts, kind, payload) with commit_ts >=
+    from_ts, in commit order with deletes before inserts at equal ts —
+    exactly the live `engine.subscribe` ordering (an UPDATE is
+    delete+insert at one ts).
+
+    This is the ONE commit-delta source shared by CdcTask.backfill, the
+    materialized-view catch-up refresh (matrixone_tpu/mview), and the
+    dynamic-table delta refresh (stream.refresh_dynamic_table): payloads
+    are the same objects the live stream carries (Segment for inserts,
+    gid arrays for deletes), so a consumer written against one surface
+    works against the other."""
+    t = engine.get_table(table)
+    events = []
+    for seg in t.segments:
+        if seg.commit_ts >= from_ts:
+            events.append((seg.commit_ts, 1, "insert", seg))
+    for ts, gids in t.tombstones:
+        if ts >= from_ts:
+            events.append((ts, 0, "delete", gids))
+    return [(ts, kind, payload)
+            for ts, _order, kind, payload in sorted(events,
+                                                    key=lambda e: e[:2])]
 
 
 class CallbackSink:
@@ -275,15 +309,22 @@ class CdcTask:
             while self._inflight > 0 and time.monotonic() < deadline:
                 self._cv.wait(timeout=1.0)
             t = self.engine.get_table(self.table)
-            events = []
-            for seg in t.segments:
-                if seg.commit_ts >= from_ts:
-                    events.append((seg.commit_ts, 1, "insert", seg))
-            for ts, gids in t.tombstones:
-                if ts >= from_ts:
-                    events.append((ts, 0, "delete", gids))
+            merged = getattr(t, "last_merge_ts", 0)
+            if 0 < from_ts <= merged:
+                # merge_table compacted history at or above the resume
+                # point: the deltas between from_ts and the merge are
+                # GONE (tombstones dropped, live rows rewritten into a
+                # post-merge segment whose replay would duplicate the
+                # whole table).  Silent divergence is worse than a loud
+                # stop — the sink must be re-seeded from scratch
+                # (from_ts=0 replays the full live state).
+                raise ValueError(
+                    f"cannot resume CDC on {self.table!r} from "
+                    f"{from_ts}: a merge at {merged} compacted the "
+                    f"deltas away; re-seed the sink (backfill from 0)")
+            events = delta_events(self.engine, self.table, from_ts)
         try:
-            for ts, _, kind, payload in sorted(events, key=lambda e: e[:2]):
+            for ts, kind, payload in events:
                 self._replay_event(ts, kind, payload)
         finally:
             try:

@@ -318,9 +318,15 @@ class TNService:
     def _handle_commit(self, header: dict, blob: bytes) -> dict:
         """tae/rpc/handle.go:547 HandleCommit: rebuild the shipped
         workspace, re-encode strings into TN dictionaries, run the
-        authoritative commit pipeline.  The whole rebuild+commit runs
-        under the commit lock (reentrant) so two CN connection threads
-        cannot interleave dictionary encoding with each other's commit."""
+        authoritative commit pipeline.  The rebuild runs under the
+        commit lock (reentrant) so two CN connection threads cannot
+        interleave dictionary encoding with each other's commit; the
+        commit itself runs OUTSIDE the handler's hold — the encoded
+        codes are table-global and append-only, so they stay valid
+        across the release, and commit_txn's post-commit hook
+        (materialized-view maintenance) must run with the lock free
+        or its state lock inverts against the commit lock (mosan
+        caught the cycle)."""
         eng = self.engine
         with eng._commit_lock:
             blobs = unpack_blobs(blob)
@@ -337,14 +343,14 @@ class TNService:
                 inserts.setdefault(tname, []).append((arrays, validity))
             deletes = {t: np.asarray(g, np.int64)
                        for t, g in header.get("deletes", {}).items()}
-            try:
-                affected = eng.commit_txn(header.get("snapshot_ts"),
-                                          inserts, deletes)
-            except (ConflictError, DuplicateKeyError,
-                    ConstraintError) as e:
-                return {"ok": False, "err": str(e), "etype": _err_name(e)}
-            return {"ok": True, "affected": affected,
-                    "ts": eng.committed_ts}
+        try:
+            affected = eng.commit_txn(header.get("snapshot_ts"),
+                                      inserts, deletes)
+        except (ConflictError, DuplicateKeyError,
+                ConstraintError) as e:
+            return {"ok": False, "err": str(e), "etype": _err_name(e)}
+        return {"ok": True, "affected": affected,
+                "ts": eng.committed_ts}
 
     def _handle_ddl(self, rec: dict) -> dict:
         """Catalog mutation forwarded from a CN. Applied through the

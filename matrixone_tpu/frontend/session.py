@@ -315,10 +315,13 @@ class Session:
             self._check("create")
         elif isinstance(stmt, ast.DropFunction):
             self._check("drop")
+        elif isinstance(stmt, ast.DropMaterializedView):
+            self._check("drop", stmt.name)
         elif isinstance(stmt, (ast.CreateTable, ast.CreateIndex,
                                ast.CreateExternalTable, ast.CreateSource,
                                ast.CreateDynamicTable, ast.CreateStage,
                                ast.CreateSnapshot, ast.CreatePublication,
+                               ast.CreateMaterializedView,
                                ast.AlterPartition, ast.RestoreTable)):
             self._check("create")
 
@@ -332,6 +335,11 @@ class Session:
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt)
         if isinstance(stmt, ast.DropTable):
+            from matrixone_tpu.mview import catalog as vcat
+            if vcat.lookup(self.catalog, stmt.name) is not None:
+                raise BindError(
+                    f"{stmt.name!r} is a materialized view; use DROP "
+                    f"MATERIALIZED VIEW")
             self.catalog.drop_table(stmt.name, stmt.if_exists)
             return Result()
         if isinstance(stmt, ast.CreateIndex):
@@ -358,8 +366,12 @@ class Session:
                 skip_tables=self._index_skip_tables())
             if stmt.analyze:
                 return Result(text=self._explain_analyze(node))
-            return Result(text=P.explain(
-                node, annotate=self._fragment_annotator(node)))
+            anns = [a for a in (self._fragment_annotator(node),
+                                self._mview_annotator())
+                    if a is not None]
+            annotate = (None if not anns else
+                        (lambda pn: "".join(a(pn) for a in anns)))
+            return Result(text=P.explain(node, annotate=annotate))
         if isinstance(stmt, ast.CreatePublication):
             self.catalog.create_publication(stmt.name, stmt.tables)
             return Result()
@@ -388,6 +400,14 @@ class Session:
                 raise BindError(f"no such dynamic table {stmt.name!r}")
             n = refresh_dynamic_table(self, stmt.name)
             return Result(affected=n)
+        if isinstance(stmt, ast.CreateMaterializedView):
+            return self._create_materialized_view(stmt)
+        if isinstance(stmt, ast.DropMaterializedView):
+            return self._drop_materialized_view(stmt)
+        if isinstance(stmt, ast.ShowMaterializedViews):
+            return self._show_materialized_views()
+        if isinstance(stmt, ast.RefreshMaterializedView):
+            return Result(affected=self._refresh_mview(stmt.name))
         if isinstance(stmt, ast.LoadData):
             return self._load_data(stmt)
         if isinstance(stmt, ast.CreateStage):
@@ -974,6 +994,22 @@ class Session:
             else:
                 raise BindError(f"unknown san subcommand {arg!r}; "
                                 "use status | clear")
+        elif cmd == "mview":
+            # materialized-view ops surface: registry + per-view
+            # watermark/mode, on-demand refresh — matching the
+            # mo_ctl('udf'|'fusion'|'serving') pattern
+            import json as _json
+            from matrixone_tpu import mview as MV
+            if arg in ("", "status"):
+                out = _json.dumps(MV.stats(self.catalog),
+                                  sort_keys=True, default=str)
+            elif arg.startswith("refresh:"):
+                name = arg.split(":", 1)[1]
+                n = self._refresh_mview(name)
+                out = f"refreshed {name}: {n} rows"
+            else:
+                raise BindError(f"unknown mview subcommand {arg!r}; "
+                                "use status | refresh:<view>")
         elif cmd == "rpc":
             # per-peer circuit breaker state + the CN's logtail breaker
             import json as _json
@@ -1345,24 +1381,30 @@ class Session:
             if_not_exists=stmt.if_not_exists)
         return Result()
 
+    def _derived_table_schema(self, sel, what: str) -> list:
+        """Bind a stored SELECT and derive its backing-table schema
+        (alias qualifiers stripped, names validated) — ONE validator
+        shared by dynamic tables and materialized views so the two
+        surfaces cannot drift."""
+        import re
+        self._prepare_select(sel)
+        node = Binder(self.catalog).bind_statement(sel)
+        schema = [(n.split(".")[-1], d) for n, d in node.schema]
+        if len({c for c, _ in schema}) != len(schema):
+            raise BindError(f"{what} SELECT has duplicate output names")
+        for c, _ in schema:
+            if not re.match(r"^[A-Za-z_][A-Za-z0-9_]*$", c):
+                raise BindError(
+                    f"{what} output {c!r} is not a valid column "
+                    f"name; alias the expression (AS name)")
+        return schema
+
     def _create_dynamic_table(self, stmt: ast.CreateDynamicTable) -> Result:
         """CREATE DYNAMIC TABLE name AS SELECT ... — materialize once now,
         store the defining SELECT for REFRESH (reference: stream dynamic
         tables driven by the task framework)."""
-        import re
         from matrixone_tpu.stream import refresh_dynamic_table
-        self._prepare_select(stmt.select)
-        node = Binder(self.catalog).bind_statement(stmt.select)
-        # result schema -> backing table (strip alias qualifiers)
-        schema = [(n.split(".")[-1], d) for n, d in node.schema]
-        if len({c for c, _ in schema}) != len(schema):
-            raise BindError(
-                "dynamic table SELECT has duplicate output names")
-        for c, _ in schema:
-            if not re.match(r"^[A-Za-z_][A-Za-z0-9_]*$", c):
-                raise BindError(
-                    f"dynamic table output {c!r} is not a valid column "
-                    f"name; alias the expression (AS name)")
+        schema = self._derived_table_schema(stmt.select, "dynamic table")
         self.catalog.create_table(TableMeta(stmt.name, schema, []))
         self.catalog.register_dynamic(stmt.name, stmt.sql_text)
         try:
@@ -1373,6 +1415,181 @@ class Session:
             self.catalog.drop_table(stmt.name, if_exists=True)
             raise
         return Result(affected=n)
+
+    # ------------------------------------------------- materialized views
+    def _create_materialized_view(self,
+                                  stmt: ast.CreateMaterializedView
+                                  ) -> Result:
+        """CREATE MATERIALIZED VIEW: backing table + one system_mview
+        catalog row (riding the ordinary commit+logtail funnels for
+        durability/restart/replication).  Maintainable shapes run
+        incremental — the catalog row's own post-commit hook initializes
+        the state and first materialization; everything else
+        materializes once here and refreshes fully on demand."""
+        import copy
+        import time as _time
+        from matrixone_tpu import mview as MV
+        from matrixone_tpu.mview import catalog as vcat
+        if self.txn is not None:
+            raise BindError(
+                "CREATE MATERIALIZED VIEW inside an explicit "
+                "transaction is not supported (view DDL is autocommit)")
+        # maintainability first, on a pristine copy (bind errors for
+        # genuinely broken SQL surface from the schema bind below)
+        spec, why = None, "tenant sessions run full refresh"
+        host = getattr(self.catalog, "_inner", self.catalog)
+        if (self.auth is None or self.auth.account == "sys") \
+                and hasattr(host, "commit_txn"):
+            try:
+                spec, why = MV.analyze(copy.deepcopy(stmt.select),
+                                       self.catalog)
+            except BindError:
+                spec = None        # real bind errors re-raise below
+        schema = self._derived_table_schema(stmt.select,
+                                            "materialized view")
+        if vcat.lookup(self.catalog, stmt.name) is not None:
+            raise BindError(
+                f"materialized view {stmt.name!r} already exists")
+        self.catalog.create_table(TableMeta(stmt.name, schema, []))
+        vcat.ensure_table(self.catalog)
+        d = vcat.MViewDef(
+            name=stmt.name.lower(), sql=stmt.sql_text,
+            mode="incremental" if spec is not None else "full",
+            source=spec.source if spec is not None else "")
+        t = self.catalog.get_table(vcat.MVIEW_TABLE)
+        batch = vcat.row_batch(d, _time.time_ns() // 1000)
+        arrays, validity = t.batch_to_arrays(batch)
+        txn = self.txn_client.begin()
+        try:
+            txn.write_batch(vcat.MVIEW_TABLE, arrays, validity)
+            # the commit's post-commit hook syncs the maintenance
+            # service, which initializes incremental state + the first
+            # materialization before this returns
+            txn.commit()
+        except BaseException:  # noqa: BLE001 — compensate, re-raise
+            txn.rollback()
+            self.catalog.drop_table(stmt.name, if_exists=True)
+            raise
+        if spec is None:
+            from matrixone_tpu.stream import rematerialize
+            try:
+                n = rematerialize(self, stmt.name, stmt.sql_text)
+            except Exception:  # noqa: BLE001 — compensating drop, then
+                # re-raised: a failed CREATE leaves no orphan state
+                self._drop_mview_row(stmt.name)
+                self.catalog.drop_table(stmt.name, if_exists=True)
+                raise
+        else:
+            # the post-commit hook swallows maintenance errors (it must
+            # never fail an unrelated writer's commit) — but THIS
+            # statement's own init failure must surface, not report a
+            # registered-yet-permanently-empty view.  Only checkable
+            # where the maintaining engine is local; on a CN the TN
+            # initializes asynchronously.
+            if isinstance(host, Engine):
+                svc = getattr(host, "_mview_service", None)
+                rt = svc.runtime(d.name) if svc is not None else None
+                if rt is None or rt.watermark is None:
+                    self._drop_mview_row(stmt.name)
+                    self.catalog.drop_table(stmt.name, if_exists=True)
+                    raise BindError(
+                        f"materialized view {stmt.name!r} failed to "
+                        f"initialize (see mo_ctl('mview','status'))")
+            n = self.catalog.get_table(stmt.name).n_rows
+        return Result(affected=n)
+
+    def _drop_mview_row(self, name: str) -> None:
+        from matrixone_tpu.mview import catalog as vcat
+        gids = vcat.gids_for_name(self.catalog, name)
+        if not len(gids):
+            return
+        txn = self.txn_client.begin()
+        try:
+            txn.delete_rows(vcat.MVIEW_TABLE, gids)
+            txn.commit()
+        except BaseException:  # noqa: BLE001 — rollback, re-raised
+            txn.rollback()
+            raise
+
+    def _drop_materialized_view(self, stmt: ast.DropMaterializedView
+                                ) -> Result:
+        from matrixone_tpu.mview import catalog as vcat
+        d = vcat.lookup(self.catalog, stmt.name)
+        if d is None:
+            if stmt.if_exists:
+                return Result()
+            raise BindError(f"no such materialized view {stmt.name!r}")
+        # catalog row first: its commit's hook detaches the maintainer
+        # BEFORE the backing table disappears under it
+        self._drop_mview_row(stmt.name)
+        self.catalog.drop_table(stmt.name, if_exists=True)
+        return Result()
+
+    def _show_materialized_views(self) -> Result:
+        from matrixone_tpu.mview import catalog as vcat
+        reg = vcat.registry_for(self.catalog)
+        host = getattr(self.catalog, "_inner", self.catalog)
+        svc = getattr(host, "_mview_service", None)
+        names = sorted(reg)
+        wms, rows = [], []
+        for n in names:
+            rt = svc.runtime(n) if svc is not None else None
+            wms.append(rt.watermark if rt is not None else None)
+            try:
+                rows.append(self.catalog.get_table(n).n_rows)
+            except Exception:  # noqa: BLE001 — backing table dropped
+                rows.append(None)
+        b = Batch.from_pydict(
+            {"Name": names,
+             "Mode": [reg[n].mode for n in names],
+             "Source": [reg[n].source or None for n in names],
+             "Watermark": wms,
+             "Rows": rows,
+             "Definition": [reg[n].sql for n in names]},
+            {"Name": dt.VARCHAR, "Mode": dt.VARCHAR,
+             "Source": dt.VARCHAR, "Watermark": dt.INT64,
+             "Rows": dt.INT64, "Definition": dt.TEXT})
+        return Result(batch=b)
+
+    def _refresh_mview(self, name: str) -> int:
+        """REFRESH MATERIALIZED VIEW: incremental views are maintained
+        continuously (refresh just reports); full views rematerialize."""
+        from matrixone_tpu.mview import catalog as vcat
+        d = vcat.lookup(self.catalog, name)
+        if d is None:
+            raise BindError(f"no such materialized view {name!r}")
+        if d.mode == "incremental":
+            return self.catalog.get_table(name).n_rows
+        from matrixone_tpu.stream import rematerialize
+        return rematerialize(self, name, d.sql)
+
+    def _reject_mview_write(self, table: str) -> None:
+        """Direct DML against a materialized view would be clobbered by
+        the next maintenance/refresh — reject it cleanly.  (Maintenance
+        itself writes through engine.commit_txn, never a session.)"""
+        if getattr(self, "_mview_refresh", 0):
+            return            # the refresh machinery's own writes
+        from matrixone_tpu.mview import catalog as vcat
+        if vcat.lookup(self.catalog, table) is not None:
+            raise BindError(
+                f"{table!r} is a materialized view; it is maintained "
+                f"from its source — write to the source table instead")
+
+    def _mview_annotator(self):
+        """EXPLAIN decoration: mark scans of materialized-view backing
+        tables with their maintenance mode."""
+        from matrixone_tpu.mview import catalog as vcat
+        reg = vcat.registry_for(self.catalog)
+        if not reg:
+            return None
+
+        def ann(n):
+            t = getattr(n, "table", None)
+            if isinstance(n, P.Scan) and isinstance(t, str) \
+                    and t.lower() in reg:
+                return f" mview={reg[t.lower()].mode}"
+            return ""
+        return ann
 
     # --------------------------------------------------------------- udf
     def _create_function(self, stmt: ast.CreateFunction) -> Result:
@@ -1595,6 +1812,7 @@ class Session:
         import pyarrow.csv as pacsv
         import pyarrow.parquet as papq
         from matrixone_tpu.storage.external import open_location
+        self._reject_mview_write(stmt.table)
         fmt = _resolve_format(stmt.fmt, stmt.path)
         if fmt == "iceberg":
             raise BindError(
@@ -1739,6 +1957,7 @@ class Session:
         return proj, binder, scope
 
     def _delete(self, stmt: ast.Delete) -> Result:
+        self._reject_mview_write(stmt.table)
         txn = self.txn or self.txn_client.begin()
         proj, _, _ = self._dml_plan(stmt.table, stmt.where)
 
@@ -1758,6 +1977,7 @@ class Session:
         return Result(affected=len(gids))
 
     def _update(self, stmt: ast.Update) -> Result:
+        self._reject_mview_write(stmt.table)
         txn = self.txn or self.txn_client.begin()
         table = self.catalog.get_table(stmt.table)
         schema = table.meta.schema
@@ -1795,6 +2015,7 @@ class Session:
         return Result(affected=len(gids))
 
     def _insert(self, stmt: ast.Insert) -> Result:
+        self._reject_mview_write(stmt.table)
         table = self.catalog.get_table(stmt.table)
         schema = table.meta.schema
         cols = stmt.columns or [c for c, _ in schema]

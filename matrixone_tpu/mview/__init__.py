@@ -1,0 +1,59 @@
+"""Incremental materialized views (reference analogue: the dynamic-table
+/ CDC surface of pkg/stream + pkg/cdc, maintained from commit deltas
+instead of recomputed).
+
+A materialized view is a real engine table (the *backing table*) whose
+rows are the output of a stored SELECT.  Definitions persist as rows of
+the `system_mview` catalog table, so durability, restart replay, tenant
+scoping and CN replication all ride the existing commit+logtail funnels
+(same design as matrixone_tpu/udf).
+
+Two maintenance modes, chosen by `mview.planner.analyze`:
+
+  * ``incremental`` — the maintainable shapes (single-table
+    scan -> filter -> group-by with SUM/COUNT/AVG/MIN/MAX): per-commit
+    deltas from the engine's version funnel (`apply_segment` /
+    `apply_tombstones`, surfaced through the logtail subscriber + a
+    post-commit hook) feed a partial-aggregate update; the hot path is
+    ONE compiled XLA dispatch per delta (the PR-7 dense-agg step via
+    the shared FragmentCompileCache).  Tombstones retract subtractable
+    aggregates; MIN/MAX deletes fall back to a per-group recompute.
+    State advances atomically to a per-view high-watermark ts and the
+    changed groups land in the backing table as one ordinary commit, so
+    reads are snapshot-consistent at that watermark.
+  * ``full`` — everything else degrades to the dynamic-table full
+    rematerialization (DELETE + INSERT ... SELECT), refreshed on demand
+    (`REFRESH MATERIALIZED VIEW` / `mo_ctl('mview','refresh:<v>')`).
+
+`SHOW MATERIALIZED VIEWS` and EXPLAIN mark which mode a view runs in.
+"""
+
+from matrixone_tpu.mview.catalog import (MVIEW_TABLE, MViewDef,
+                                         ensure_table, is_mview_table,
+                                         registry_for)
+from matrixone_tpu.mview.planner import MaintainSpec, analyze
+from matrixone_tpu.mview.maintain import MViewService, service_for
+
+__all__ = ["MVIEW_TABLE", "MViewDef", "ensure_table", "is_mview_table",
+           "registry_for", "MaintainSpec", "analyze", "MViewService",
+           "service_for", "stats"]
+
+
+def stats(catalog) -> dict:
+    """mo_ctl('mview','status') payload: registry + per-view runtime."""
+    reg = registry_for(catalog)
+    host = getattr(catalog, "_inner", catalog)
+    svc = getattr(host, "_mview_service", None)
+    views = {}
+    for name, d in sorted(reg.items()):
+        entry = {"mode": d.mode, "watermark": None}
+        if svc is not None:
+            rt = svc.runtime(name)
+            if rt is not None:
+                entry["watermark"] = rt.watermark
+                entry["groups"] = rt.n_groups()
+        views[name] = entry
+    out = {"views": views, "n_views": len(reg)}
+    if svc is not None:
+        out.update(svc.stats())
+    return out

@@ -430,6 +430,32 @@ class RefreshDynamicTable(Node):
 
 
 @dataclasses.dataclass
+class CreateMaterializedView(Node):
+    """CREATE MATERIALIZED VIEW name AS SELECT ... — persisted in the
+    system_mview catalog; maintainable shapes update incrementally from
+    commit deltas, the rest full-refresh (matrixone_tpu/mview)."""
+    name: str
+    select: Node
+    sql_text: str            # the defining SELECT, verbatim
+
+
+@dataclasses.dataclass
+class DropMaterializedView(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
+class ShowMaterializedViews(Node):
+    pass
+
+
+@dataclasses.dataclass
+class RefreshMaterializedView(Node):
+    name: str
+
+
+@dataclasses.dataclass
 class CreateFunction(Node):
     """CREATE [OR REPLACE] [AGGREGATE] FUNCTION f(x FLOAT, ...)
     RETURNS FLOAT LANGUAGE PYTHON [PROPERTIES ('k'='v', ...)]

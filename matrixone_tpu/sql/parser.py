@@ -165,8 +165,15 @@ class Parser:
         if t0.kind == "ident" and t0.value.lower() == "refresh":
             self.next()
             w = self.ident()
+            if w.lower() == "materialized":
+                w2 = self.ident()
+                if w2.lower() != "view":
+                    raise ParseError(
+                        "expected REFRESH MATERIALIZED VIEW")
+                return ast.RefreshMaterializedView(self.ident())
             if w.lower() != "dynamic":
-                raise ParseError("expected REFRESH DYNAMIC TABLE")
+                raise ParseError("expected REFRESH DYNAMIC TABLE "
+                                 "or REFRESH MATERIALIZED VIEW")
             self.expect_kw("table")
             return ast.RefreshDynamicTable(self.ident())
         if t0.kind == "ident" and t0.value.lower() == "kill":
@@ -316,6 +323,12 @@ class Parser:
         if nxt.kind == "ident" and nxt.value.lower() == "functions":
             self.next()
             return ast.ShowFunctions()
+        if nxt.kind == "ident" and nxt.value.lower() == "materialized":
+            self.next()
+            w = self.ident()
+            if w.lower() != "views":
+                raise ParseError("expected SHOW MATERIALIZED VIEWS")
+            return ast.ShowMaterializedViews()
         if nxt.kind == "ident" and nxt.value.lower() == "stages":
             self.next()
             return ast.ShowStages()
@@ -640,6 +653,21 @@ class Parser:
                    else len(self.src))
             return ast.CreateDynamicTable(
                 name, sel, self.src[start:end].rstrip().rstrip(";"))
+        if t0.kind == "ident" and t0.value.lower() == "materialized":
+            # CREATE MATERIALIZED VIEW name AS select ...
+            self.next()
+            w = self.ident()
+            if w.lower() != "view":
+                raise ParseError("expected CREATE MATERIALIZED VIEW")
+            name = self.ident()
+            self.expect_kw("as")
+            start = self.peek().pos
+            sel = self.select_or_union() if self.at_kw("select") \
+                else self.with_select()
+            end = (self.peek().pos if self.peek().kind != "eof"
+                   else len(self.src))
+            return ast.CreateMaterializedView(
+                name, sel, self.src[start:end].rstrip().rstrip(";"))
         if t0.kind == "ident" and t0.value.lower() == "external":
             # CREATE EXTERNAL TABLE t (cols) LOCATION 'url' FORMAT fmt
             self.next()
@@ -883,6 +911,16 @@ class Parser:
                 self.expect_kw("exists")
                 if_exists = True
             return ast.DropFunction(self.ident(), if_exists)
+        if t0.kind == "ident" and t0.value.lower() == "materialized":
+            self.next()
+            w = self.ident()
+            if w.lower() != "view":
+                raise ParseError("expected DROP MATERIALIZED VIEW")
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return ast.DropMaterializedView(self.ident(), if_exists)
         if t0.kind == "ident" and t0.value.lower() == "stage":
             self.next()
             return ast.DropStage(self.ident())

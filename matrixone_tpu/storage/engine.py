@@ -129,6 +129,10 @@ class MVCCTable:
         #: funnels through apply_segment/apply_tombstones, including WAL
         #: replay and the CN logtail apply, so replicas stay versioned)
         self.last_commit_ts = 0
+        #: last merge_table compaction: replay-from-MVCC consumers
+        #: (CDC backfill, dynamic-table delta refresh) cannot resume a
+        #: watermark below this — the deltas were compacted away
+        self.last_merge_ts = 0
         self.next_gid = 0
         self.next_seg = 0
         self.dicts: Dict[str, List[str]] = {
@@ -731,6 +735,11 @@ class Engine:
         self.index_cache = IndexCache()   # budgeted device-index residency
         self.active_txns = 0           # open explicit txns (merge guard)
         self._pending_merge_records: Dict[str, int] = {}   # name -> merge ts
+        #: materialized-view maintenance (matrixone_tpu/mview): flag set
+        #: when a system_mview catalog table appears; the service spins
+        #: up lazily on the first commit after that
+        self._has_mview_catalog = False
+        self._mview_service = None
 
     # ----------------------------------------------------------- catalog
     def create_table(self, meta: TableMeta, if_not_exists=False,
@@ -744,6 +753,9 @@ class Engine:
         san.guard(t, self._commit_lock, name=f"MVCCTable[{meta.name}]")
         self.tables[meta.name] = t
         self.ddl_gen += 1
+        if meta.name == "system_mview" \
+                or meta.name.endswith("$system_mview"):
+            self._has_mview_catalog = True
         if log:
             self.wal.append({"op": "create_table", "name": meta.name,
                              "ts": self.hlc.now(),
@@ -1091,19 +1103,52 @@ class Engine:
                         affected += seg.n_rows
                         for fn in self._subscribers:
                             fn(commit_ts, tname, "insert", seg)
-            for tname in set(list(inserts) + list(deletes)):
+            touched = set(list(inserts) + list(deletes))
+            for tname in touched:
                 for ix in self.indexes_on(tname):
                     ix.dirty = True
-                # UDF definitions live in an ordinary table but ARE
-                # catalog shape: a commit touching system_udf is DDL —
-                # serving caches must not outlive the function set they
-                # were planned against (matrixone_tpu/udf)
+                # UDF and materialized-view definitions live in ordinary
+                # tables but ARE catalog shape: a commit touching
+                # system_udf / system_mview is DDL — serving caches must
+                # not outlive the function/view set they were planned
+                # against (matrixone_tpu/udf, matrixone_tpu/mview)
                 from matrixone_tpu.udf.catalog import is_udf_table
                 if is_udf_table(tname):
                     self.ddl_gen += 1
-            self.committed_ts = commit_ts
+                from matrixone_tpu.mview.catalog import is_mview_table
+                if is_mview_table(tname):
+                    self.ddl_gen += 1
+            # max(): a materialized-view maintenance commit nested off a
+            # post-commit hook mints a NEWER ts than the commit that
+            # triggered it — the read frontier must never retreat
+            self.committed_ts = max(self.committed_ts, commit_ts)
             M.txn_commits.inc(outcome="ok")
-            return affected
+        # post-commit hooks run OUTSIDE the commit lock: materialized-
+        # view delta maintenance commits into this SAME engine from
+        # here, and doing that mid-apply would tear reads (committed_ts
+        # advancing past half-applied segments) — see mview/maintain.py
+        self._notify_post_commit(commit_ts, touched)
+        return affected
+
+    def _notify_post_commit(self, commit_ts: int, touched: set) -> None:
+        """Drive the materialized-view maintenance funnel after a commit
+        fully applied.  Lazy: engines without a system_mview catalog pay
+        one attribute read per commit."""
+        svc = self._mview_service
+        if svc is None:
+            if not self._has_mview_catalog:
+                return
+            from matrixone_tpu.mview.maintain import service_for
+            svc = service_for(self)
+        inner = getattr(self._commit_lock, "_inner", None)
+        if inner is not None and inner._is_owned():
+            # a re-entrant caller still holds the commit lock (e.g. a
+            # handler that wrapped commit_txn): driving maintenance now
+            # would invert MViewService._lock against the commit lock
+            # (mosan-caught cycle).  The delta is already queued by the
+            # subscriber — the next unlocked commit drains it.
+            return
+        svc.on_commit(commit_ts, touched)
 
     # ---------------------------------------------------------- compaction
     def merge_table(self, name: str, min_segments: int = 2,
@@ -1163,6 +1208,7 @@ class Engine:
                     blockcache.CACHE.drop_path(p)
             t.tombstones = []
             t.last_commit_ts = max(t.last_commit_ts, merge_ts)
+            t.last_merge_ts = merge_ts
             t._pk_bloom = None     # rebuilt lazily over the merged rows
             self.committed_ts = max(self.committed_ts, merge_ts)
             for ix in self.indexes_on(name):
@@ -1521,11 +1567,17 @@ class WalApplier:
             for tname in touched:
                 for ix in eng.indexes_on(tname):
                     ix.dirty = True
-                # replicas learn UDF DDL as logtail rows on system_udf:
-                # bump ddl_gen the same way the TN's commit pipeline does
-                # so the CN's plan/result caches invalidate in step
+                # replicas learn UDF / materialized-view DDL as logtail
+                # rows on system_udf / system_mview: bump ddl_gen the
+                # same way the TN's commit pipeline does so the CN's
+                # plan/result caches invalidate in step (a replica never
+                # MAINTAINS a view — the backing rows arrive from the
+                # TN's own maintenance commits through this same stream)
                 from matrixone_tpu.udf.catalog import is_udf_table
                 if is_udf_table(tname):
+                    eng.ddl_gen += 1
+                from matrixone_tpu.mview.catalog import is_mview_table
+                if is_mview_table(tname):
                     eng.ddl_gen += 1
             self.pending = []
             return ts

@@ -44,30 +44,49 @@ class SourceWriter:
         self.flush_interval_s = flush_interval_s
         self._buf: List[dict] = []
         self._lock = san.lock("SourceWriter._lock")
+        san.guard(self, self._lock, name="SourceWriter")
+        #: serializes the INSERT side: drains run OUTSIDE _lock (writers
+        #: keep enqueueing), but the shared session is single-threaded
+        self._flush_lock = san.lock("SourceWriter._flush_lock")
         self._last_flush = time.monotonic()
 
     def write(self, row: dict) -> None:
         self.write_many([row])
 
     def write_many(self, rows: List[dict]) -> None:
+        # the drain is atomic with the decision: computing `should`
+        # under the lock but draining in a later flush() let two
+        # concurrent writers both see should=True and interleave —
+        # each now swaps its OWN batch out while still holding the lock
         with self._lock:
+            san.mutating(self)
             self._buf.extend(rows)
             should = (len(self._buf) >= self.flush_rows
                       or time.monotonic() - self._last_flush
                       >= self.flush_interval_s)
-        if should:
-            self.flush()
+            drained: List[dict] = []
+            if should:
+                drained, self._buf = self._buf, []
+                self._last_flush = time.monotonic()
+        if drained:
+            self._insert(drained)
 
     def flush(self) -> int:
         with self._lock:
+            san.mutating(self)
             rows, self._buf = self._buf, []
             self._last_flush = time.monotonic()
         if not rows:
             return 0
-        t = self.session.catalog.get_table(self.source)
-        cols = [c for c, _ in t.meta.schema]
-        self.session.execute(build_insert_sql(self.source, cols, rows))
+        self._insert(rows)
         return len(rows)
+
+    def _insert(self, rows: List[dict]) -> None:
+        with self._flush_lock:
+            t = self.session.catalog.get_table(self.source)
+            cols = [c for c, _ in t.meta.schema]
+            self.session.execute(build_insert_sql(self.source, cols,
+                                                  rows))
 
 
 def build_insert_sql(table: str, columns: List[str],
@@ -237,35 +256,66 @@ def connector_main(argv: Optional[List[str]] = None) -> dict:
 
 
 def refresh_dynamic_table(session, name: str) -> int:
-    """Re-materialize one dynamic table from its stored SELECT."""
+    """Refresh one dynamic table from its stored SELECT.
+
+    Maintainable shapes (mview.planner: single-table scan -> filter ->
+    group-by with SUM/COUNT/AVG/MIN/MAX) silently upgrade from
+    DELETE + INSERT...SELECT to a delta refresh: the same decoded
+    per-commit stream CDC backfill replays (cdc.delta_events) is folded
+    into partial-agg state and only the CHANGED groups are rewritten.
+    Everything else keeps the transactional full rematerialization."""
     dts = getattr(session.catalog, "dynamic_tables", {})
     if name not in dts:
         raise ValueError(f"no such dynamic table {name!r}")
-    from matrixone_tpu.cdc import sql_literal
     sql = dts[name]
+    catalog = session.catalog
+    if getattr(catalog, "_scope", None) is None \
+            and hasattr(catalog, "commit_txn") and session.txn is None:
+        from matrixone_tpu.mview.maintain import service_for
+        try:
+            n = service_for(catalog).refresh_dynamic(name, sql)
+        except Exception:   # noqa: BLE001 — ANY delta-path failure
+            # (shape drift, dropped source, state poisoned) falls back
+            # to the always-correct full rematerialization below
+            n = None
+        if n is not None:
+            return n
+    return rematerialize(session, name, sql)
+
+
+def rematerialize(session, name: str, sql: str) -> int:
+    """Full rematerialization of a stored SELECT into its backing table
+    (shared by dynamic tables and full-refresh materialized views)."""
+    from matrixone_tpu.cdc import sql_literal
     r = session.execute(sql)
     b = r.batch
     cols = list(b.columns)
+    # the refresh's own writes must pass the session's materialized-
+    # view write guard (direct user DML is still rejected)
+    session._mview_refresh = getattr(session, "_mview_refresh", 0) + 1
     # swap contents atomically w.r.t. statement snapshots: a single txn
     # deletes the old materialization and inserts the new one
-    session.execute("begin")
     try:
-        session.execute(f"delete from {name}")
-        rows = []
-        pylists = {c: b.columns[c].to_pylist() for c in cols}
-        n = len(b)
-        for i in range(n):
-            rows.append("(" + ", ".join(sql_literal(pylists[c][i])
-                                        for c in cols) + ")")
-        if rows:
-            session.execute(
-                f"insert into {name} ({', '.join(cols)}) values "
-                + ", ".join(rows))
-        session.execute("commit")
-    except Exception:   # noqa: BLE001 — rollback for ANY mid-batch
-        # failure (bind, constraint, transport), then re-raised
-        session.execute("rollback")
-        raise
+        session.execute("begin")
+        try:
+            session.execute(f"delete from {name}")
+            rows = []
+            pylists = {c: b.columns[c].to_pylist() for c in cols}
+            n = len(b)
+            for i in range(n):
+                rows.append("(" + ", ".join(sql_literal(pylists[c][i])
+                                            for c in cols) + ")")
+            if rows:
+                session.execute(
+                    f"insert into {name} ({', '.join(cols)}) values "
+                    + ", ".join(rows))
+            session.execute("commit")
+        except Exception:   # noqa: BLE001 — rollback for ANY mid-batch
+            # failure (bind, constraint, transport), then re-raised
+            session.execute("rollback")
+            raise
+    finally:
+        session._mview_refresh -= 1
     return n
 
 

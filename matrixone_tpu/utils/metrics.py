@@ -289,6 +289,18 @@ udf_batch_coalesced = REGISTRY.counter(
     "mo_udf_batch_coalesced_total",
     "remote UDF requests that rode another request's dispatch")
 
+# ---- materialized views (matrixone_tpu/mview)
+mview_apply = REGISTRY.counter(
+    "mo_mview_apply_total",
+    "materialized-view maintenance applications by tier "
+    "(dense/general/recompute/init)")
+mview_rows = REGISTRY.counter(
+    "mo_mview_rows_total",
+    "delta rows processed by materialized-view maintenance")
+mview_apply_seconds = REGISTRY.counter(
+    "mo_mview_apply_seconds_total",
+    "seconds spent in view maintenance by kind (delta/full)")
+
 # ---- runtime concurrency sanitizer (utils/san.py, tools/mosan)
 san_findings = REGISTRY.counter(
     "mo_san_findings_total",

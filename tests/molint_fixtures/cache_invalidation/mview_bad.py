@@ -1,0 +1,19 @@
+"""cache-invalidation fixture (mview): view-state mutations with no
+watermark advance.  AST-only."""
+
+
+class ViewRuntime:
+    def __init__(self):
+        self.groups = {}
+        self.watermark = 0
+
+
+class Maintainer:
+    def apply(self, rt, key, delta):
+        rt.groups[key] = delta             # watermark never advances
+
+    def drop_group(self, rt, key):
+        rt.groups.pop(key, None)           # no watermark, no ddl_gen
+
+    def reset(self, state):
+        state.groups = {}                  # rebind with stale stamp

@@ -1,0 +1,30 @@
+"""cache-invalidation fixture (mview, clean): every view-state mutation
+advances the watermark, routes through a state method that does, or
+bumps ddl_gen."""
+
+
+class ViewRuntime:
+    def __init__(self):
+        self.groups = {}
+        self.watermark = 0
+
+    def replace_state(self, groups, ts):
+        self.groups = groups
+        self.watermark = ts
+
+
+class Maintainer:
+    def apply(self, rt, key, delta, ts):
+        rt.groups[key] = delta
+        rt.watermark = ts
+
+    def drop_group(self, rt, key, ts):
+        rt.groups.pop(key, None)
+        rt.watermark = max(rt.watermark, ts)
+
+    def reset(self, rt, groups, ts):
+        rt.replace_state(groups, ts)
+
+    def rebuild(self, eng, rt):
+        rt.groups = {}
+        eng.ddl_gen += 1                   # ddl bump also satisfies

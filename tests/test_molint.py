@@ -165,6 +165,46 @@ def test_cache_invalidation_fixtures():
     assert len(bad) >= 4
 
 
+def test_cache_invalidation_mview_fixtures():
+    """View-state mutations must advance the watermark (or bump
+    ddl_gen) — the mview analogue of the catalog rule."""
+    d = os.path.join(FIX, "cache_invalidation")
+    bad = _fixture_pair("cache-invalidation",
+                        [os.path.join(d, "mview_bad.py")],
+                        [os.path.join(d, "mview_good.py")])
+    msgs = " | ".join(f.message for f in bad)
+    assert "watermark" in msgs
+    # one finding per mutation site: subscript store, pop, rebind
+    assert len(bad) >= 3
+
+
+def test_cache_invalidation_mview_planted_violation(tmp_path):
+    """Planted regression: removing the watermark advance from an
+    otherwise-clean maintainer is caught."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "class ViewRuntime:\n"
+        "    def __init__(self):\n"
+        "        self.groups = {}\n"
+        "        self.watermark = 0\n"
+        "\n"
+        "    def merge(self, key, part, ts):\n"
+        "        self.groups[key] = part\n"
+        "        self.watermark = max(self.watermark, ts)\n")
+    findings, _ = _run([str(p)], rules=["cache-invalidation"])
+    assert not findings
+    p.write_text(
+        "class ViewRuntime:\n"
+        "    def __init__(self):\n"
+        "        self.groups = {}\n"
+        "        self.watermark = 0\n"
+        "\n"
+        "    def merge(self, key, part, ts):\n"
+        "        self.groups[key] = part\n")
+    findings, _ = _run([str(p)], rules=["cache-invalidation"])
+    assert any("watermark" in f.message for f in findings)
+
+
 def test_cache_invalidation_is_branch_aware(tmp_path):
     """A bumping branch of a dispatcher must not whitelist a sibling
     branch's mutation (the WAL-replay apply() shape)."""

@@ -1,0 +1,383 @@
+"""Incremental materialized views (matrixone_tpu/mview): lockstep
+bit-identity with full recompute, snapshot-consistent reads at the view
+watermark (the PR-4 staleness drill pattern), restart rebuild, the
+full-refresh degrade ladder, and the dense one-dispatch delta tier."""
+
+import threading
+
+import pytest
+
+from matrixone_tpu.frontend import Session
+from matrixone_tpu.storage.engine import Engine
+from matrixone_tpu.storage.fileservice import MemoryFS
+from matrixone_tpu.utils import metrics as M
+
+
+def _rows(s, sql):
+    return s.execute(sql).rows()
+
+
+VIEW_SQL = ("select k, count(*) n, sum(v) sv, sum(d) sd, avg(d) ad,"
+            " min(f) lo, max(f) hi from t group by k")
+
+
+def _setup(eng=None):
+    s = Session(catalog=eng if eng is not None else Engine())
+    s.execute("create table t (k varchar(4), v bigint, d decimal(10,2),"
+              " f double)")
+    return s
+
+
+def test_incremental_lockstep_with_full_recompute():
+    """The acceptance bar: after EVERY statement of an
+    insert/delete/update mix — including MIN/MAX retraction and an
+    all-rows-deleted group — the maintained view is bit-identical to
+    recomputing its defining SELECT (exact dtypes: bigint/decimal sums,
+    float extrema)."""
+    s = _setup()
+    s.execute("insert into t values ('a', 1, 1.25, 0.5),"
+              " ('a', 2, 2.50, -1.5), ('b', 3, 0.75, 9.0)")
+    s.execute(f"create materialized view lv as {VIEW_SQL}")
+    script = [
+        "insert into t values ('b', 10, 4.00, 2.0), ('c', 5, 1.00, 7.5)",
+        "insert into t values ('a', null, null, null)",   # NULL measures
+        "insert into t values (null, 7, 0.25, 3.25)",     # NULL key
+        "delete from t where f = 9.0",          # retract b's max
+        "update t set v = v * 10 where k = 'a' and v is not null",
+        "delete from t where k = 'c'",          # all-rows-deleted group
+        "insert into t values ('c', 8, 8.00, -2.0)",   # group reborn
+        "delete from t where f = -1.5",         # retract a's min
+        "update t set d = 9.99 where k = 'b'",
+        "delete from t where k is null",
+    ]
+    order = " order by k, n, sv"
+    assert sorted(_rows(s, "select * from lv"), key=repr) == \
+        sorted(_rows(s, VIEW_SQL), key=repr)
+    for stmt in script:
+        s.execute(stmt)
+        got = sorted(_rows(s, "select * from lv"), key=repr)
+        want = sorted(_rows(s, VIEW_SQL), key=repr)
+        assert got == want, (stmt, got, want)
+
+
+def test_reads_snapshot_consistent_under_concurrent_writers():
+    """The PR-4 staleness drill at the view watermark: 2 writers bump
+    the source while 2 readers loop the VIEW (result cache on) — every
+    observed sum must be one the source actually passed through,
+    monotonically fresh, and the final read must see every commit."""
+    eng = Engine()
+    s = Session(catalog=eng)
+    s.execute("create table ctr (id bigint primary key, v bigint,"
+              " k varchar(2))")
+    s.execute("insert into ctr values (1, 0, 'a'), (2, 0, 'a')")
+    s.execute("create materialized view vc as "
+              "select k, sum(v) sv, count(*) n from ctr group by k")
+    s.execute("select mo_ctl('serving','result:on')")
+    s.execute("select sv from vc")                 # warm compile
+    stop = threading.Event()
+    errors = []
+
+    def writer(row):
+        sw = Session(catalog=eng)
+        try:
+            for _ in range(12):
+                sw.execute(f"update ctr set v = v + 1 where id = {row}")
+        except Exception as e:   # noqa: BLE001 — surfaced below
+            errors.append(f"writer: {e!r}")
+        finally:
+            sw.close()
+
+    def reader():
+        sr = Session(catalog=eng)
+        try:
+            last = -1
+            while not stop.is_set():
+                rows = sr.execute("select sv, n from vc").rows()
+                if not rows:
+                    continue            # mid-rewrite snapshots never
+                (total, n), = rows      # show a torn group
+                if n != 2:
+                    errors.append(f"torn group: n={n}")
+                    return
+                if total < last:
+                    errors.append(f"sum went BACK: {last} -> {total}")
+                    return
+                last = total
+        except Exception as e:   # noqa: BLE001
+            errors.append(f"reader: {e!r}")
+        finally:
+            sr.close()
+
+    writers = [threading.Thread(target=writer, args=(r,))
+               for r in (1, 2)]
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    for t in readers + writers:
+        t.start()
+    for t in writers:
+        t.join(60)
+    stop.set()
+    for t in readers:
+        t.join(30)
+    assert not errors, errors
+    # quiesced: a writer's commit returns only after maintenance, so
+    # the view must already hold every bump — no refresh, no wait
+    (final, n), = s.execute("select sv, n from vc").rows()
+    assert (final, n) == (24, 2)
+    assert sorted(_rows(s, "select * from vc")) == \
+        sorted(_rows(s, "select k, sum(v), count(*) from ctr"
+                        " group by k"))
+
+
+def test_restart_rebuilds_state_and_resumes_incremental():
+    fs = MemoryFS()
+    s = _setup(Engine(fs))
+    s.execute("insert into t values ('a', 1, 1.00, 1.0),"
+              " ('b', 2, 2.00, 2.0)")
+    s.execute(f"create materialized view lv as {VIEW_SQL}")
+    s.catalog.checkpoint()
+    eng2 = Engine.open(fs)
+    s2 = Session(catalog=eng2)
+    # durable backing rows serve reads immediately (no state needed)
+    assert sorted(_rows(s2, "select * from lv"), key=repr) == \
+        sorted(_rows(s2, VIEW_SQL), key=repr)
+    # the first commit lazily rebuilds state and resumes maintenance
+    s2.execute("insert into t values ('a', 5, 3.00, -4.0)")
+    assert sorted(_rows(s2, "select * from lv"), key=repr) == \
+        sorted(_rows(s2, VIEW_SQL), key=repr)
+    svc = eng2._mview_service
+    assert svc is not None and svc.runtime("lv").watermark is not None
+
+
+def test_non_maintainable_shapes_degrade_to_full_refresh():
+    s = _setup()
+    s.execute("create table u (k varchar(4), w bigint)")
+    s.execute("insert into t values ('a', 1, 1.00, 1.0)")
+    s.execute("insert into u values ('a', 7)")
+    s.execute("create materialized view fj as select t.k kk, sum(t.v) s"
+              "v from t join u on t.k = u.k group by t.k")
+    modes = {r[0]: r[1] for r in _rows(s, "show materialized views")}
+    assert modes["fj"] == "full"
+    assert _rows(s, "select * from fj") == [("a", 1)]
+    s.execute("insert into t values ('a', 9, 2.00, 2.0)")
+    assert _rows(s, "select * from fj") == [("a", 1)]   # stale until...
+    s.execute("refresh materialized view fj")
+    assert _rows(s, "select * from fj") == [("a", 10)]
+    # EXPLAIN marks the mode on the backing scan
+    assert "mview=full" in s.execute("explain select * from fj").text
+    # nondeterministic definitions degrade too (rand()/now() would
+    # freeze their bind-time value into the maintained state)
+    s.execute("create materialized view nd as select k, count(*) n "
+              "from t where rand() >= 0 group by k")
+    modes = {r[0]: r[1] for r in _rows(s, "show materialized views")}
+    assert modes["nd"] == "full"
+    # scalar aggregates (no GROUP BY) degrade
+    s.execute("create materialized view sc as select sum(v) sv from t")
+    modes = {r[0]: r[1] for r in _rows(s, "show materialized views")}
+    assert modes["sc"] == "full"
+
+
+def test_explain_marks_incremental_and_show_watermark():
+    s = _setup()
+    s.execute("insert into t values ('a', 1, 1.00, 1.0)")
+    s.execute("create materialized view iv as select k, sum(v) sv "
+              "from t group by k")
+    assert "mview=incremental" in \
+        s.execute("explain select * from iv").text
+    (name, mode, source, wm, rows, _sql), = \
+        _rows(s, "show materialized views")
+    assert (name, mode, source, rows) == ("iv", "incremental", "t", 1)
+    assert wm is not None and wm > 0
+    s.execute("insert into t values ('b', 2, 1.00, 1.0)")
+    (_n, _m, _s, wm2, rows2, _q), = _rows(s, "show materialized views")
+    assert wm2 > wm and rows2 == 2          # watermark advances
+
+
+def test_view_write_protection_and_drop():
+    s = _setup()
+    s.execute("insert into t values ('a', 1, 1.00, 1.0)")
+    s.execute("create materialized view pv as select k, sum(v) sv "
+              "from t group by k")
+    for stmt in ("insert into pv values ('x', 1)",
+                 "update pv set sv = 0",
+                 "delete from pv",
+                 "load data infile '/nonexistent.csv' into table pv",
+                 "drop table pv"):
+        with pytest.raises(Exception, match="materialized view"):
+            s.execute(stmt)
+    with pytest.raises(Exception, match="already exists"):
+        s.execute("create materialized view pv as select k, count(*) c"
+                  " from t group by k")
+    s.execute("drop materialized view pv")
+    assert _rows(s, "show materialized views") == []
+    # name is free again — and the NEW definition is the one maintained
+    s.execute("create materialized view pv as select k, count(*) c "
+              "from t group by k")
+    s.execute("insert into t values ('a', 9, 1.00, 1.0)")
+    assert _rows(s, "select * from pv") == [("a", 2)]
+    s.execute("drop materialized view if exists gone_already")
+
+
+def test_serving_caches_invalidate_on_view_ddl_and_maintenance():
+    """CREATE/DROP bump ddl_gen (plan cache re-binds) and every
+    maintenance commit moves the backing version (result cache
+    re-fetches) — a cached read can never outlive the view state."""
+    eng = Engine()
+    s = Session(catalog=eng)
+    s.execute("create table t (k varchar(4), v bigint)")
+    s.execute("insert into t values ('a', 1)")
+    g0 = eng.ddl_gen
+    s.execute("create materialized view cv as select k, sum(v) sv "
+              "from t group by k")
+    assert eng.ddl_gen > g0            # backing DDL + system_mview row
+    s.execute("select mo_ctl('serving','result:on')")
+    q = "select sv from cv where k = 'a'"
+    assert _rows(s, q) == _rows(s, q) == [(1,)]      # cached
+    s.execute("insert into t values ('a', 41)")
+    assert _rows(s, q) == [(42,)]      # maintenance bumped the version
+    g1 = eng.ddl_gen
+    s.execute("drop materialized view cv")
+    assert eng.ddl_gen > g1
+
+
+def test_dense_tier_is_one_compiled_dispatch():
+    """The Q1 shape rides the dense-agg step through the shared
+    FragmentCompileCache: the second delta is a compile-cache hit and
+    exactly ONE device dispatch."""
+    eng = Engine()
+    s = Session(catalog=eng)
+    s.execute("create table li (flag varchar(1), status varchar(1),"
+              " qty decimal(10,2))")
+    s.execute("insert into li values ('A','F',1.0),('N','O',2.0)")
+    s.execute("create materialized view q1 as select flag, status,"
+              " sum(qty) sq, avg(qty) aq, count(*) n from li"
+              " group by flag, status")
+    d0 = M.mview_apply.get(tier="dense")
+    s.execute("insert into li values ('A','F',3.0)")     # traces once
+    assert M.mview_apply.get(tier="dense") - d0 == 1
+    disp0 = M.fusion_dispatch.get(kind="step")
+    hits0 = M.fusion_compile.get(outcome="hit")
+    s.execute("insert into li values ('N','F',4.0)")   # known strings
+    assert M.mview_apply.get(tier="dense") - d0 == 2
+    assert M.fusion_compile.get(outcome="hit") > hits0   # cache hit
+    assert M.fusion_dispatch.get(kind="step") - disp0 == 1
+    assert sorted(_rows(s, "select * from q1")) == sorted(_rows(
+        s, "select flag, status, sum(qty), avg(qty), count(*) "
+           "from li group by flag, status"))
+    # a NEW dictionary value re-keys (content-addressed) instead of
+    # serving a stale program — and the result still matches
+    s.execute("insert into li values ('Z','Z',9.0)")
+    assert sorted(_rows(s, "select * from q1")) == sorted(_rows(
+        s, "select flag, status, sum(qty), avg(qty), count(*) "
+           "from li group by flag, status"))
+
+
+def test_dynamic_table_delta_refresh_upgrade():
+    """Maintainable dynamic tables silently upgrade from DELETE+INSERT
+    to delta refresh; a merge that compacts history below the watermark
+    forces a rebuild instead of double-counting replayed segments."""
+    eng = Engine()
+    s = Session(catalog=eng)
+    s.execute("create table ticks (sym varchar(8), px bigint)")
+    s.execute("insert into ticks values ('A',10),('A',20),('B',5)")
+    s.execute("create dynamic table px as select sym, count(*) n,"
+              " sum(px) total from ticks group by sym")
+    assert sorted(_rows(s, "select * from px")) == \
+        [("A", 2, 30), ("B", 1, 5)]
+    i0 = M.mview_apply.get(tier="init")
+    s.execute("insert into ticks values ('B',15),('C',1)")
+    s.execute("refresh dynamic table px")
+    assert sorted(_rows(s, "select * from px")) == \
+        [("A", 2, 30), ("B", 2, 20), ("C", 1, 1)]
+    assert M.mview_apply.get(tier="init") == i0    # delta, not rebuild
+    # merge compacts tombstones/segments away: refresh must detect the
+    # watermark is no longer replayable and rebuild
+    s.execute("delete from ticks where sym = 'A'")
+    eng.merge_table("ticks", min_segments=1, checkpoint=False)
+    s.execute("insert into ticks values ('D',2)")
+    s.execute("refresh dynamic table px")
+    assert sorted(_rows(s, "select * from px")) == \
+        [("B", 2, 20), ("C", 1, 1), ("D", 1, 2)]
+    assert M.mview_apply.get(tier="init") > i0
+
+
+def test_mo_ctl_mview_surface():
+    s = _setup()
+    s.execute("insert into t values ('a', 1, 1.00, 1.0)")
+    s.execute("create materialized view mc as select k, sum(v) sv "
+              "from t group by k")
+    import json
+    (out,), = _rows(s, "select mo_ctl('mview','status')")
+    st = json.loads(out)
+    assert st["views"]["mc"]["mode"] == "incremental"
+    assert st["views"]["mc"]["watermark"] is not None
+    (out,), = _rows(s, "select mo_ctl('mview','refresh:mc')")
+    assert "refreshed mc" in out
+    with pytest.raises(Exception, match="unknown mview"):
+        s.execute("select mo_ctl('mview','bogus')")
+
+
+def test_cn_replicas_serve_tn_maintained_views():
+    """CN/TN split: a view created through one CN is maintained by the
+    TN's post-commit hook (replicas never maintain) and its backing
+    rows replicate to every CN through the logtail like any table."""
+    import tempfile
+
+    from matrixone_tpu.cluster import RemoteCatalog, TNService
+    d = tempfile.mkdtemp(prefix="mo_mv_cntn_")
+    tn = TNService(data_dir=d).start()
+    cat1 = RemoteCatalog(("127.0.0.1", tn.port), data_dir=d)
+    cat2 = RemoteCatalog(("127.0.0.1", tn.port), data_dir=d)
+    try:
+        s1, s2 = Session(catalog=cat1), Session(catalog=cat2)
+        s1.execute("create table t (k varchar(4), v bigint)")
+        s1.execute("insert into t values ('a', 1), ('b', 2)")
+        s1.execute("create materialized view cv as "
+                   "select k, sum(v) sv from t group by k")
+        s1.execute("insert into t values ('a', 10)")
+        ts = max(cat1.committed_ts, cat2.committed_ts)
+        cat2.consumer.wait_ts(ts)
+        assert sorted(_rows(s2, "select * from cv")) == \
+            [("a", 11), ("b", 2)]
+        # the definition replicated as a system_mview row
+        (name, mode, source, _wm, _rows_, _sql), = \
+            _rows(s2, "show materialized views")
+        assert (name, mode, source) == ("cv", "incremental", "t")
+    finally:
+        cat1.close()
+        cat2.close()
+        tn.stop()
+
+
+def test_broken_view_never_fails_unrelated_commits():
+    """A view whose source vanished must not surface errors from (or
+    wedge) other writers' commits: maintenance detaches it and the
+    funnel keeps flowing."""
+    fs = MemoryFS()
+    s = _setup(Engine(fs))
+    s.execute("insert into t values ('a', 1, 1.00, 1.0)")
+    s.execute("create materialized view bv as select k, sum(v) sv "
+              "from t group by k")
+    s.catalog.checkpoint()
+    eng2 = Engine.open(fs)
+    s2 = Session(catalog=eng2)
+    s2.execute("drop table t")          # source gone, state unbuilt
+    s2.execute("create table other (x bigint)")
+    s2.execute("insert into other values (1)")      # must not raise
+    s2.execute("insert into other values (2)")
+    assert _rows(s2, "select x from other order by x") == [(1,), (2,)]
+    s2.execute("drop materialized view bv")         # cleanup still works
+
+
+def test_filtered_view_maintained_and_deletes_below_filter_ignored():
+    """The view filter applies to deltas exactly as it does to the full
+    recompute: rows failing the predicate neither enter nor retract."""
+    s = _setup()
+    s.execute(f"create materialized view fv as select k, count(*) n,"
+              f" sum(v) sv from t where v >= 10 group by k")
+    s.execute("insert into t values ('a', 5, 1.00, 1.0),"
+              " ('a', 50, 1.00, 1.0), ('b', 3, 1.00, 1.0)")
+    assert _rows(s, "select * from fv") == [("a", 1, 50)]
+    s.execute("delete from t where v = 5")        # below the filter
+    assert _rows(s, "select * from fv") == [("a", 1, 50)]
+    s.execute("delete from t where v = 50")       # group dies
+    assert _rows(s, "select * from fv") == []

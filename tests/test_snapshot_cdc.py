@@ -138,6 +138,105 @@ def test_cdc_composite_pk_deletes():
     assert got[-1][1] == [{"a": 1, "b": "q"}]
 
 
+def test_sql_literal_nan_inf_render_null():
+    """float('nan')/inf have no SQL literal: repr() emitted bare `nan`,
+    corrupting every SQL-generating sink (SQLSink, SourceWriter,
+    dynamic-table refresh).  They render as NULL — and the generated
+    statement must actually execute."""
+    import math
+
+    from matrixone_tpu.cdc import sql_literal
+    assert sql_literal(float("nan")) == "null"
+    assert sql_literal(float("inf")) == "null"
+    assert sql_literal(float("-inf")) == "null"
+    assert sql_literal(1.5) == "1.5"        # ordinary floats unchanged
+    s = Session()
+    s.execute("create table nf (id bigint, x double)")
+    sink = SQLSink(s)
+    sink.on_insert("nf", [{"id": 1, "x": float("nan")},
+                          {"id": 2, "x": float("inf")},
+                          {"id": 3, "x": 2.5}])
+    rows = s.execute("select id, x from nf order by id").rows()
+    assert rows == [(1, None), (2, None), (3, 2.5)]
+
+
+@pytest.mark.chaos
+def test_cdc_watermark_resume_survives_mid_stream_kill():
+    """Kill a CdcTask mid-stream (injected commit failure on the MIRROR
+    side, riding the PR-2 fault machinery), restart from the watermark,
+    and assert backfill + live delivery is at-least-once with no gap
+    below the watermark."""
+    from matrixone_tpu.utils.fault import INJECTOR
+
+    src = Session()
+    dst = Session()
+    src.execute("create table w (id bigint primary key, v varchar(8))")
+    dst.execute("create table w (id bigint primary key, v varchar(8))")
+    task = CdcTask(src.catalog, "w", SQLSink(dst)).start()
+    src.execute("insert into w values (1, 'a')")
+    src.execute("insert into w values (2, 'b')")
+    assert len(dst.execute("select id from w").rows()) == 2
+    wm_before = task.watermark
+    # every=2 + times=1: the SOURCE commit (hit 1) passes, the sink's
+    # MIRROR commit (hit 2) fails once — delivery dies mid-stream with
+    # the source row durably committed and the watermark NOT advanced
+    INJECTOR.add(name="commit.before", action="return", arg="fail",
+                 every=2, times=1)
+    try:
+        with pytest.raises(Exception):
+            src.execute("insert into w values (3, 'c')")
+    finally:
+        INJECTOR.clear()
+    assert task.watermark == wm_before          # the lost event is
+    task.stop()                                 # still below the mark
+    src.execute("insert into w values (4, 'd')")     # while stopped
+    # restart from the saved watermark: backfill replays everything at
+    # or above it (at-least-once; the PK sink upserts duplicates away)
+    task2 = CdcTask(src.catalog, "w", SQLSink(dst),
+                    from_ts=task.watermark)
+    task2.backfill()
+    task2.start()
+    src.execute("insert into w values (5, 'e')")     # live again
+    got = [(int(a), b) for a, b in
+           dst.execute("select id, v from w order by id").rows()]
+    want = [(int(a), b) for a, b in
+            src.execute("select id, v from w order by id").rows()]
+    assert got == want == [(1, "a"), (2, "b"), (3, "c"), (4, "d"),
+                           (5, "e")]
+    assert task2.watermark > wm_before
+    task2.stop()
+
+
+def test_cdc_backfill_refuses_resume_below_a_merge():
+    """merge_table compacts the deltas a resume would need (tombstones
+    dropped, live rows rewritten): resuming below the merge must stop
+    loudly instead of silently diverging the sink — and a fresh seed
+    (from_ts=0) must still work."""
+    src = Session()
+    dst = Session()
+    src.execute("create table mg (id bigint primary key, v varchar(4))")
+    dst.execute("create table mg (id bigint primary key, v varchar(4))")
+    task = CdcTask(src.catalog, "mg", SQLSink(dst)).start()
+    src.execute("insert into mg values (1, 'a'), (2, 'b')")
+    wm = task.watermark
+    task.stop()
+    src.execute("delete from mg where id = 1")      # unshipped delta...
+    src.catalog.merge_table("mg", min_segments=1,
+                            checkpoint=False)       # ...compacted away
+    task2 = CdcTask(src.catalog, "mg", SQLSink(dst), from_ts=wm)
+    with pytest.raises(ValueError, match="compacted"):
+        task2.backfill()
+    # a fresh sink seeds fine from the merged live state
+    dst2 = Session()
+    dst2.execute("create table mg (id bigint primary key,"
+                 " v varchar(4))")
+    task3 = CdcTask(src.catalog, "mg", SQLSink(dst2))
+    task3.backfill()
+    assert [(int(a), b) for a, b in
+            dst2.execute("select id, v from mg order by id").rows()] \
+        == [(2, "b")]
+
+
 def test_cdc_backfill_replays_insert_idempotently():
     """At-least-once delivery: the event AT the watermark may re-ship; a
     replayed INSERT must not duplicate-key the PK mirror (delete-then-

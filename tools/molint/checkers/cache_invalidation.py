@@ -18,6 +18,15 @@ didn't (logtail replay, UDF drop).  Encoded:
     `.dirty` in the same function — the pair is the index's version:
     an `index_obj` swap with a stale dirty flag either re-serves the
     old index or rebuilds forever.
+  * a function that mutates materialized-view state — subscript/del/
+    pop/clear/rebind on `.groups` of a runtime-shaped receiver
+    (`self.` inside a class that references `watermark`, or an
+    `rt.`/`state.`/`runtime.` receiver anywhere) — must advance the
+    view `watermark` (an assignment, or a call to a state method that
+    does: replace_state / merge_delta / invalidate) or bump `ddl_gen`
+    in the same branch.  The watermark is the view's version: readers
+    and the serving caches pin freshness on it exactly like ddl_gen
+    (matrixone_tpu/mview).
 """
 
 from __future__ import annotations
@@ -36,6 +45,28 @@ _SET_ATTRS = ("sources",)
 #: mutation happens outside the Engine class itself
 _ENGINE_RECEIVERS = {"rep", "eng", "engine", "catalog", "replica",
                      "cat"}
+#: materialized-view state containers + the receivers that denote a
+#: view runtime outside its own class
+_VIEWSTATE_ATTRS = ("groups",)
+_VIEWSTATE_RECEIVERS = {"rt", "state", "runtime", "view"}
+
+
+def _viewstate_attr(node: ast.AST, stateish: bool) -> Optional[str]:
+    """'groups' when node is an attr chain ending in a view-state
+    container on a runtime-shaped receiver (see module docstring)."""
+    d = dotted(node)
+    if d is None:
+        return None
+    parts = d.split(".")
+    term = parts[-1]
+    if term not in _VIEWSTATE_ATTRS or len(parts) < 2:
+        return None
+    recv = parts[-2]
+    if recv == "self":
+        return term if stateish else None
+    if recv in _VIEWSTATE_RECEIVERS:
+        return term
+    return None
 
 
 def _container_attr(node: ast.AST, catalogish: bool) -> Optional[str]:
@@ -69,26 +100,36 @@ class CacheInvalidationChecker(Checker):
         #: contain the bump; a function routing through them is covered)
         "bumping_calls": ("register_index", "create_table",
                           "create_external"),
+        #: view-state methods that advance the watermark on the
+        #: callee's behalf (mview/maintain.ViewRuntime)
+        "watermark_calls": ("replace_state", "merge_delta",
+                            "invalidate"),
         #: function names exempt (constructors build, not mutate)
         "exempt_functions": ("__init__",),
     }
 
     def check(self, project: Project, config: dict) -> Iterable[Finding]:
         bumping = set(config["bumping_calls"])
+        wm_calls = set(config["watermark_calls"])
         exempt = set(config["exempt_functions"])
         # classes whose `self.` IS the catalog: any class whose body
-        # mentions ddl_gen (Engine and its replica/tenant wrappers)
+        # mentions ddl_gen (Engine and its replica/tenant wrappers);
+        # classes whose `self.` IS view state: any class referencing
+        # a watermark attribute (ViewRuntime and test doubles)
         catalog_classes = set()
+        state_classes = set()
         for mod in project.modules:
             if mod.tree is None:
                 continue
             for node in ast.walk(mod.tree):
                 if isinstance(node, ast.ClassDef):
                     for sub in ast.walk(node):
-                        if (isinstance(sub, ast.Attribute)
-                                and sub.attr == "ddl_gen"):
+                        if isinstance(sub, ast.Attribute) \
+                                and sub.attr == "ddl_gen":
                             catalog_classes.add(node.name)
-                            break
+                        if isinstance(sub, ast.Attribute) \
+                                and sub.attr == "watermark":
+                            state_classes.add(node.name)
         for mod in project.modules:
             if mod.tree is None:
                 continue
@@ -96,10 +137,12 @@ class CacheInvalidationChecker(Checker):
                 if fi.name in exempt:
                     continue
                 yield from self._check_func(
-                    fi, bumping, fi.classname in catalog_classes)
+                    fi, bumping, wm_calls,
+                    fi.classname in catalog_classes,
+                    fi.classname in state_classes)
 
-    def _check_func(self, fi, bumping, catalogish: bool
-                    ) -> Iterable[Finding]:
+    def _check_func(self, fi, bumping, wm_calls, catalogish: bool,
+                    stateish: bool) -> Iterable[Finding]:
         # Branch-aware: a bump covers a mutation only when it sits in
         # the SAME if/elif/else arm or an enclosing one.  Function-wide
         # satisfaction let one bumping branch of a dispatcher (e.g. a
@@ -107,9 +150,11 @@ class CacheInvalidationChecker(Checker):
         # the exact shape the replica staleness hole hid in.  Regions
         # are if-arms; loops/with/try are transparent.
         mutations: List[tuple] = []      # (lineno, description, region)
+        vs_mutations: List[tuple] = []   # view-state mutation sites
         index_obj_writes: List[int] = []
         dirty_writes = False
         bump_regions: List[tuple] = []
+        wm_regions: List[tuple] = []     # watermark advances
 
         def visit(node, region):
             nonlocal dirty_writes
@@ -120,6 +165,8 @@ class CacheInvalidationChecker(Checker):
                     d = dotted(t)
                     if d and d.split(".")[-1] == "ddl_gen":
                         bump_regions.append(region)
+                    if d and d.split(".")[-1] == "watermark":
+                        wm_regions.append(region)
                     if d and d.split(".")[-1] == "dirty":
                         dirty_writes = True
                     if d and d.split(".")[-1] == "index_obj":
@@ -131,11 +178,21 @@ class CacheInvalidationChecker(Checker):
                             mutations.append(
                                 (node.lineno, f"rebinds .{term}",
                                  region))
+                        term = _viewstate_attr(t, stateish)
+                        if term:
+                            vs_mutations.append(
+                                (node.lineno, f"rebinds .{term}",
+                                 region))
                     # subscript store: self.tables[name] = t
                     if isinstance(t, ast.Subscript):
                         term = _container_attr(t.value, catalogish)
                         if term:
                             mutations.append(
+                                (node.lineno, f"writes .{term}[...]",
+                                 region))
+                        term = _viewstate_attr(t.value, stateish)
+                        if term:
+                            vs_mutations.append(
                                 (node.lineno, f"writes .{term}[...]",
                                  region))
             elif isinstance(node, ast.Delete):
@@ -146,17 +203,29 @@ class CacheInvalidationChecker(Checker):
                             mutations.append(
                                 (node.lineno, f"deletes from .{term}",
                                  region))
+                        term = _viewstate_attr(t.value, stateish)
+                        if term:
+                            vs_mutations.append(
+                                (node.lineno, f"deletes from .{term}",
+                                 region))
             elif isinstance(node, ast.Call):
                 d = dotted(node.func) or ""
                 parts = d.split(".")
                 term = parts[-1]
                 if term in bumping:
                     bump_regions.append(region)
+                if term in wm_calls:
+                    wm_regions.append(region)
                 if term in ("pop", "clear", "popitem", "setdefault",
                             "update") and len(parts) >= 2:
                     cont = _container_attr(node.func.value, catalogish)
                     if cont:
                         mutations.append(
+                            (node.lineno, f".{cont}.{term}(...)",
+                             region))
+                    cont = _viewstate_attr(node.func.value, stateish)
+                    if cont:
+                        vs_mutations.append(
                             (node.lineno, f".{cont}.{term}(...)",
                              region))
                 if term in ("add", "discard", "remove") \
@@ -194,6 +263,10 @@ class CacheInvalidationChecker(Checker):
         def covered(region) -> bool:
             return any(region[: len(b)] == b for b in bump_regions)
 
+        def wm_covered(region) -> bool:
+            return any(region[: len(b)] == b
+                       for b in wm_regions + bump_regions)
+
         for lineno, what, region in mutations:
             if not covered(region):
                 yield Finding(
@@ -201,6 +274,13 @@ class CacheInvalidationChecker(Checker):
                     f"{fi.qualname} {what} but this branch never "
                     f"bumps ddl_gen — cached plans/results outlive "
                     f"the catalog shape")
+        for lineno, what, region in vs_mutations:
+            if not wm_covered(region):
+                yield Finding(
+                    self.rule, fi.module.path, lineno,
+                    f"{fi.qualname} {what} but this branch never "
+                    f"advances the view watermark (or bumps ddl_gen) "
+                    f"— view state and its freshness stamp desync")
         if index_obj_writes and not dirty_writes:
             for lineno in index_obj_writes:
                 yield Finding(
