@@ -19,6 +19,7 @@ import pyarrow as pa
 from matrixone_tpu.container import device as dev
 from matrixone_tpu.container.dtypes import DType, varchar
 from matrixone_tpu.container.vector import Vector, arrow_type_to_dtype
+from matrixone_tpu.utils import qa
 
 #: host-side dictionaries for device dictionary-encoded varlena columns
 HostDicts = Dict[str, List[str]]
@@ -103,6 +104,20 @@ def from_device(dbatch: dev.DeviceBatch, dicts: Optional[HostDicts] = None,
                     f"varchar column {name!r} reached the host without a "
                     f"dictionary — an operator dropped dict propagation")
             lut = np.asarray(dicts.get(name, []), dtype=object)
+            if qa.armed() and len(data) and val.any():
+                # canary audit for dict codes: a valid visible cell whose
+                # code is outside the dictionary can only be a leaked
+                # poisoned pad row (codes are produced by encode or by
+                # expressions over in-range codes)
+                oob = val & ((data < 0) | (data >= len(lut)))
+                n_oob = int(np.count_nonzero(oob))
+                if n_oob:
+                    qa.record_finding(
+                        "canary-in-result", f"column {name!r}",
+                        f"{n_oob} valid cell(s) carry a dictionary code "
+                        f"outside the LUT — a poisoned pad row leaked")
+                    data = np.where(oob, 0, data)
+                    val = val & ~oob
             strings = pa.array(
                 [lut[c] if v else None for c, v in zip(data, val)],
                 type=pa.string())
@@ -110,6 +125,8 @@ def from_device(dbatch: dev.DeviceBatch, dicts: Optional[HostDicts] = None,
                                 strings=strings,
                                 validity=None if val.all() else val)
         else:
+            if qa.armed():
+                qa.audit_host_column(name, data, val)
             cols[name] = Vector(dtype=dtype, data=data,
                                 validity=None if val.all() else val)
     return Batch(cols)

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from matrixone_tpu.container.dtypes import DType
+from matrixone_tpu.utils import qa
 
 #: batch length buckets — powers of two from 1Ki to 1Mi. A batch of 13_000
 #: rows is padded to 16_384 so every operator's jit cache has at most
@@ -167,7 +168,10 @@ def from_numpy(arrays: Dict[str, np.ndarray],
         pad_n = padded - n_rows
         if pad_n:
             pad_shape = (pad_n,) + arr.shape[1:]
-            arr = np.concatenate([arr, np.zeros(pad_shape, dtype=arr.dtype)])
+            # padded-tail fill: zeros, or canary-poisoned under the moqa
+            # audit (utils/qa.py) — the tail is dead by contract, so the
+            # fill value must never be observable
+            arr = np.concatenate([arr, qa.pad_fill(arr.dtype, pad_shape)])
             val = np.concatenate([val, np.zeros(pad_n, dtype=np.bool_)])
         cols[name] = DeviceColumn(data=jnp.asarray(arr),
                                   validity=jnp.asarray(val),

@@ -994,6 +994,48 @@ class Session:
             else:
                 raise BindError(f"unknown san subcommand {arg!r}; "
                                 "use status | clear")
+        elif cmd == "qa":
+            # differential query-equivalence analyzer ops surface
+            # (tools/moqa + utils/qa.py): pair inventory, canary
+            # report, last corpus run; run:<seed> executes a small
+            # in-process corpus — mirrors the mo_ctl('lint'|'san')
+            # pattern
+            import json as _json
+            try:
+                from tools import moqa
+            except ImportError:
+                raise BindError(
+                    "moqa unavailable: the tools/ package is not on "
+                    "sys.path (run from a repo checkout)")
+            if arg in ("", "status"):
+                out = _json.dumps(moqa.last_run_status(),
+                                  sort_keys=True, default=str)
+            elif arg == "clear":
+                from matrixone_tpu.utils import qa as _qa
+                _qa.clear()
+                out = "qa findings cleared"
+            elif arg.startswith("run:"):
+                try:
+                    seed = int(arg.split(":", 1)[1])
+                except ValueError:
+                    raise BindError(f"bad seed in {arg!r}")
+                # a QUICK in-process probe: env-toggled pairs only
+                # (the heavyweight replay pairs belong to the corpus
+                # gate / CLI, not an ops command)
+                rep = moqa.run_corpus(seed=seed,
+                                      queries_per_scenario=6,
+                                      pairs=["fusion", "dense-groups",
+                                             "plan-cache"],
+                                      reduce_findings=0,
+                                      oracle_fraction=0.34)
+                out = _json.dumps(
+                    {k: rep[k] for k in ("seed", "queries", "pairs",
+                                         "total_checks", "seconds")}
+                    | {"findings": len(rep["findings"])},
+                    sort_keys=True)
+            else:
+                raise BindError(f"unknown qa subcommand {arg!r}; "
+                                "use status | clear | run:<seed>")
         elif cmd == "mview":
             # materialized-view ops surface: registry + per-view
             # watermark/mode, on-demand refresh — matching the

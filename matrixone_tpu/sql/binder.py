@@ -853,8 +853,16 @@ class Binder:
         if isinstance(e, ast.Case):
             whens = [(rec(c), rec(v)) for c, v in e.whens]
             else_ = rec(e.else_) if e.else_ is not None else None
+            # result type promotes across EVERY branch, ELSE included:
+            # `case when p then w else d end` over (int, double) is
+            # double — typing it by the first THEN branch alone made
+            # downstream arithmetic and derived table schemas truncate
+            # the double branch (moqa seed-1 sqlite + mview findings)
             out_t = whens[0][1].dtype
-            for _, v in whens[1:]:
+            branches = [v for _, v in whens[1:]]
+            if else_ is not None:
+                branches.append(else_)
+            for v in branches:
                 out_t = dt.promote(out_t, v.dtype) if v.dtype.is_numeric \
                     and out_t.is_numeric else out_t
             return BoundCase(whens, else_, out_t)

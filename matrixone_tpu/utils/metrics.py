@@ -301,6 +301,19 @@ mview_apply_seconds = REGISTRY.counter(
     "mo_mview_apply_seconds_total",
     "seconds spent in view maintenance by kind (delta/full)")
 
+# ---- differential query-equivalence analyzer (utils/qa.py, tools/moqa)
+qa_queries = REGISTRY.counter(
+    "mo_qa_queries_total",
+    "queries generated and executed by the moqa corpus runner")
+qa_oracle_checks = REGISTRY.counter(
+    "mo_qa_oracle_checks_total",
+    "moqa oracle verdicts by oracle (lockstep/tlp/norec/limit/sqlite/"
+    "mview/staleness)")
+qa_findings = REGISTRY.counter(
+    "mo_qa_findings_total",
+    "moqa findings by kind (lockstep-mismatch/oracle failures/"
+    "canary-in-result/canary-in-carry/error)")
+
 # ---- runtime concurrency sanitizer (utils/san.py, tools/mosan)
 san_findings = REGISTRY.counter(
     "mo_san_findings_total",

@@ -139,11 +139,18 @@ def eval_expr(e: BoundExpr, ex: ExecBatch) -> DeviceColumn:
     if isinstance(e, BoundCase):
         if _is_varchar(e.dtype):
             return _eval_case_strings(e, ex)
-        else_col = (eval_expr(e.else_, ex) if e.else_ is not None
+        # every branch coerces to the CASE's bound result type BEFORE
+        # the select: mixed int/double/decimal branches otherwise flow
+        # raw through jnp.where under the first branch's dtype tag —
+        # scaled decimal ints mix with floats, downstream arithmetic
+        # casts by the wrong claimed type (moqa seed-1 findings)
+        else_col = (S.cast(eval_expr(e.else_, ex), e.dtype)
+                    if e.else_ is not None
                     else DeviceColumn.const_null(e.dtype))
         out = else_col
         for cond, val in reversed(e.whens):
-            out = S.case_when(eval_expr(cond, ex), eval_expr(val, ex), out)
+            out = S.case_when(eval_expr(cond, ex),
+                              S.cast(eval_expr(val, ex), e.dtype), out)
         return out
     if isinstance(e, BoundInList):
         arg = eval_expr(e.arg, ex)

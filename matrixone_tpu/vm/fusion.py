@@ -1295,10 +1295,16 @@ class FusedFragmentOp(O.Operator):
                 "rows": rows}
 
     def _finalize_agg(self, carry, sizes, key_dicts) -> ExecBatch:
+        from matrixone_tpu.utils import qa
         agg = self._agg_op
         agg._agg_tracker = O._AggDictTracker(agg.node.aggs)
         if self._terminal == "agg_scalar":
             return agg._scalar_result(list(carry), agg._agg_tracker)
+        if qa.armed():
+            # moqa padding-canary audit: a poisoned pad row that reached
+            # a float accumulator lane shows up as NaN in the carry
+            qa.audit_carry(carry[0], f"fragment {self.fragment_id} "
+                                     f"({self.describe()})")
         dense = self._grouped_partials(carry, sizes)
         state = agg._dense_to_state(dense)
         return agg._finalize(state, key_dicts)

@@ -1,0 +1,29 @@
+"""knob-doc violating fixture: reads knobs with no README table row."""
+
+import os
+import os as osmod
+from os import getenv
+
+
+def undocumented_reads():
+    a = os.environ.get("MO_FIX_UNDOCUMENTED", "0")          # finding
+    b = getenv("MO_FIX_GETENV")                             # finding
+    c = osmod.environ["MO_FIX_SUBSCRIPT"]                   # finding
+    return a, b, c
+
+
+def helper_read():
+    def env_entries(name, default):
+        return int(os.environ.get(name, default))
+    return env_entries("MO_FIX_HELPER", 16)                 # finding
+
+
+def documented_read():
+    # MO_FIX_DOCUMENTED has a row in README_fixture.md: no finding
+    return os.environ.get("MO_FIX_DOCUMENTED", "1")
+
+
+def not_a_read():
+    # docstring/string mentions are not reads: MO_FIX_PROSE
+    s = "set MO_FIX_PROSE=1 to enable"
+    return s

@@ -1,0 +1,13 @@
+"""knob-doc clean fixture: every read documented, suppression honored."""
+
+import os
+
+
+def documented_read():
+    return os.environ.get("MO_FIX_DOCUMENTED", "1")
+
+
+def suppressed_read():
+    # molint: disable=knob-doc -- internal debug knob, deliberately
+    # undocumented while the feature bakes
+    return os.environ.get("MO_FIX_BAKING", "0")

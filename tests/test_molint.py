@@ -54,7 +54,7 @@ def test_suite_shape():
     rules = [r for r, _ in molint.rule_table()]
     assert sorted(rules) == [
         "broad-except", "cache-invalidation", "deadline-propagation",
-        "fault-coverage", "jit-purity", "lock-discipline",
+        "fault-coverage", "jit-purity", "knob-doc", "lock-discipline",
         "metric-hygiene", "san-adoption"]
 
 
@@ -309,6 +309,65 @@ def test_san_adoption_fixtures():
     assert "san.lock" in msgs
     assert "san.rlock" in msgs
     assert "san.condition" in msgs
+
+
+def test_knob_doc_fixtures():
+    """Read-site side: every undocumented MO_* read fires (environ.get,
+    getenv, subscript, env_* helper); documented reads, justified
+    suppressions and prose mentions stay quiet."""
+    d = os.path.join(FIX, "knob_doc")
+    cfg = {"knob-doc": {"readme": os.path.join(d, "README_fixture.md"),
+                        "extra_src_dirs": (),
+                        "extra_driver_paths": (),
+                        "corpus_complete": False}}
+    bad = _fixture_pair("knob-doc",
+                        [os.path.join(d, "bad.py")],
+                        [os.path.join(d, "good.py")],
+                        config=cfg)
+    knobs = {f.message.split("'")[1] for f in bad}
+    assert knobs == {"MO_FIX_UNDOCUMENTED", "MO_FIX_GETENV",
+                     "MO_FIX_SUBSCRIPT", "MO_FIX_HELPER"}
+
+
+def test_knob_doc_dead_knob():
+    """Inventory side: a documented knob with no read site anywhere in
+    the corpus is a finding anchored at the README table row; the
+    sub-rule needs the full corpus (corpus_complete)."""
+    d = os.path.join(FIX, "knob_doc")
+    cfg = {"knob-doc": {"readme": os.path.join(d, "README_dead.md"),
+                        "extra_src_dirs": (),
+                        "extra_driver_paths": (),
+                        "corpus_complete": True}}
+    findings, _ = _run([os.path.join(d, "good.py")],
+                       rules=["knob-doc"], config=cfg)
+    dead = [f for f in findings if "MO_FIX_DEAD" in f.message]
+    assert len(dead) == 1 and dead[0].path.endswith("README_dead.md")
+    assert not any("MO_FIX_DOCUMENTED" in f.message for f in findings)
+    # partial scan: the dead-knob sub-rule skips itself
+    cfg["knob-doc"]["corpus_complete"] = False
+    findings2, _ = _run([os.path.join(d, "good.py")],
+                        rules=["knob-doc"], config=cfg)
+    assert not findings2, [f.format() for f in findings2]
+
+
+def test_knob_doc_planted_violation(tmp_path):
+    """A knob read planted in a temp tree fires against the real
+    README; a justified suppression silences it."""
+    cfg = {"knob-doc": {"extra_src_dirs": (),
+                        "extra_driver_paths": ()}}
+    p = tmp_path / "feature.py"
+    p.write_text("import os\n"
+                 "N = int(os.environ.get('MO_PLANTED_KNOB', '4'))\n")
+    findings, _ = _run([str(p)], rules=["knob-doc"], config=cfg)
+    assert len(findings) == 1 and "MO_PLANTED_KNOB" in \
+        findings[0].message
+    p2 = tmp_path / "feature2.py"
+    p2.write_text(
+        "import os\n"
+        "N = int(os.environ.get('MO_PLANTED_KNOB', '4'))  # mol"
+        "int: disable=knob-doc -- baking behind a private flag\n")
+    findings2, stats2 = _run([str(p2)], rules=["knob-doc"], config=cfg)
+    assert not findings2 and stats2["suppressions_used"] == 1
 
 
 def test_san_adoption_planted_violation(tmp_path):

@@ -10,6 +10,7 @@ from tools.molint.checkers.metric_hygiene import MetricHygieneChecker
 from tools.molint.checkers.fault_coverage import FaultCoverageChecker
 from tools.molint.checkers.broad_except import BroadExceptChecker
 from tools.molint.checkers.san_adoption import SanAdoptionChecker
+from tools.molint.checkers.knob_doc import KnobDocChecker
 
 ALL = [
     JitPurityChecker,
@@ -20,4 +21,5 @@ ALL = [
     FaultCoverageChecker,
     BroadExceptChecker,
     SanAdoptionChecker,
+    KnobDocChecker,
 ]

@@ -1,0 +1,212 @@
+"""knob-doc: every `MO_*` env knob is documented, every documented
+knob is alive.
+
+The engine's operational surface is its `MO_*` environment knobs, and
+the README's knob tables are the single inventory operators work from.
+Two rot modes, both silent: a new read site ships without a table row
+(the knob is undiscoverable — someone re-implements it under a second
+name), and a table row outlives its last read site (operators tune a
+dead knob and see nothing).  This rule closes the loop both ways:
+
+  * every `MO_[A-Z0-9_]+` knob READ under `matrixone_tpu/` or the
+    configured extra source dirs (`tools/` by default) must appear in
+    a README knob-table row (a markdown table line containing the
+    knob name);
+  * every knob documented in a README table row must have a live read
+    site somewhere in the scanned corpus (sources + tests +
+    `extra_driver_paths`, bench.py by default) — corpus-global, so it
+    skips itself on partial scans exactly like metric-hygiene's
+    dead-metric sub-rule.
+
+A "read" is a string literal naming the knob passed to
+`os.environ.get/pop/setdefault`, `os.getenv`, an `os.environ[...]`
+subscript, or an `env_*`/`_env_*` helper (utils/lru.env_entries,
+serving's `_env_int`).  Docstring/comment mentions do not count — they
+are documentation, not reads.
+
+Findings in extra source dirs honor the standard suppression comment
+syntax (`# molint: disable=knob-doc -- why`, justification required)
+even though those files are outside the default scan roots; dead-knob
+findings anchor at the README table row and are fixed by deleting the
+row (or resurrecting the knob), not suppressed.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Tuple
+
+from tools.molint import Checker, Finding, Project, PyModule
+from tools.molint.astutil import dotted
+
+_KNOB_RE = re.compile(r"^MO_[A-Z0-9_]+$")
+_DOC_ROW_RE = re.compile(r"MO_[A-Z0-9_]+")
+
+#: call terminals that consume a knob-name string literal
+_ENV_GETTERS = {"get", "pop", "setdefault"}
+_HELPER_RE = re.compile(r"^_?env")
+
+
+def _knob_reads(mod: PyModule) -> List[Tuple[str, int]]:
+    """(knob, lineno) for every env-knob read in one module."""
+    out: List[Tuple[str, int]] = []
+    if mod.tree is None:
+        return out
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Subscript):
+            recv = dotted(node.value) or ""
+            if recv.split(".")[-1] != "environ":
+                continue
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value,
+                                                          str) \
+                    and _KNOB_RE.match(sl.value):
+                out.append((sl.value, node.lineno))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d is None:
+            continue
+        parts = d.split(".")
+        term = parts[-1]
+        env_call = (
+            (term in _ENV_GETTERS and len(parts) >= 2
+             and parts[-2] == "environ")
+            or term == "getenv"
+            or _HELPER_RE.match(term) is not None)
+        if not env_call:
+            continue
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        for a in args[:2]:       # knob name is arg 0 (or 1 for odd
+            #                      helpers); defaults never match MO_*
+            if isinstance(a, ast.Constant) and isinstance(a.value, str) \
+                    and _KNOB_RE.match(a.value):
+                out.append((a.value, node.lineno))
+                break
+    return out
+
+
+def _suppressed(mod: PyModule, rule: str, lineno: int) -> bool:
+    """Suppression check for modules OUTSIDE the project scan roots
+    (project-module findings ride the standard pipeline instead)."""
+    for s in mod.suppressions:
+        if s.justification and s.covers(rule, lineno):
+            s.used = True
+            return True
+    return False
+
+
+def _documented(readme_path: str) -> Dict[str, int]:
+    """knob -> first README table-row line documenting it."""
+    out: Dict[str, int] = {}
+    try:
+        with open(readme_path, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return out
+    for i, line in enumerate(lines, 1):
+        if not line.lstrip().startswith("|"):
+            continue
+        for m in _DOC_ROW_RE.finditer(line):
+            out.setdefault(m.group(0), i)
+    return out
+
+
+class KnobDocChecker(Checker):
+    rule = "knob-doc"
+    description = ("every MO_* env knob read has a README knob-table "
+                   "row, and every documented knob has a live read "
+                   "site")
+    default_config = {
+        #: the knob inventory, root-relative
+        "readme": "README.md",
+        #: extra source dirs whose reads must be documented (root-
+        #: relative; scanned in addition to the project modules)
+        "extra_src_dirs": ("tools",),
+        #: root-relative files whose reads count as LIVE sites only
+        #: (not required to be documented — the bench harness reads
+        #: its own private knobs)
+        "extra_driver_paths": ("bench.py",),
+        #: None = follow project.complete (the dead-knob sub-rule
+        #: needs the full corpus; fixture tests force True)
+        "corpus_complete": None,
+    }
+
+    def check(self, project: Project, config: dict) -> Iterable[Finding]:
+        readme_rel = config["readme"]
+        readme_path = readme_rel if os.path.isabs(readme_rel) \
+            else os.path.join(project.root, readme_rel)
+        documented = _documented(readme_path)
+        findings: List[Finding] = []
+
+        extra_mods: List[PyModule] = []
+        for rel in config.get("extra_src_dirs", ()):
+            base = rel if os.path.isabs(rel) \
+                else os.path.join(project.root, rel)
+            if os.path.isfile(base):
+                extra_mods.append(PyModule(base, self._rel(project,
+                                                           base)))
+                continue
+            from tools.molint import SKIP_DIRS
+            for dirpath, dirs, files in os.walk(base):
+                dirs[:] = sorted(d for d in dirs if d not in SKIP_DIRS)
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        ap = os.path.join(dirpath, fn)
+                        extra_mods.append(
+                            PyModule(ap, self._rel(project, ap)))
+        driver_mods: List[PyModule] = []
+        for rel in config.get("extra_driver_paths", ()):
+            ap = rel if os.path.isabs(rel) \
+                else os.path.join(project.root, rel)
+            if os.path.isfile(ap):
+                driver_mods.append(PyModule(ap, self._rel(project, ap)))
+
+        live: Dict[str, Tuple[str, int]] = {}
+
+        # project modules: findings ride the standard suppression path
+        for mod in project.modules:
+            for knob, lineno in _knob_reads(mod):
+                live.setdefault(knob, (mod.path, lineno))
+                if knob not in documented:
+                    findings.append(Finding(
+                        self.rule, mod.path, lineno,
+                        f"env knob {knob!r} is read here but has no "
+                        f"row in a {readme_rel} knob table — document "
+                        f"it (default + one-line meaning)"))
+        # extra source dirs: suppressions handled locally
+        for mod in extra_mods:
+            for knob, lineno in _knob_reads(mod):
+                live.setdefault(knob, (mod.path, lineno))
+                if knob not in documented \
+                        and not _suppressed(mod, self.rule, lineno):
+                    findings.append(Finding(
+                        self.rule, mod.path, lineno,
+                        f"env knob {knob!r} is read here but has no "
+                        f"row in a {readme_rel} knob table — document "
+                        f"it (default + one-line meaning)"))
+        # tests + drivers: live-site evidence only
+        for mod in list(project.test_modules) + driver_mods:
+            for knob, _lineno in _knob_reads(mod):
+                live.setdefault(knob, (mod.path, _lineno))
+
+        complete = config.get("corpus_complete")
+        if complete is None:
+            complete = project.complete
+        if complete and documented:
+            for knob, lineno in sorted(documented.items()):
+                if knob not in live:
+                    findings.append(Finding(
+                        self.rule, readme_rel, lineno,
+                        f"documented knob {knob!r} has no live read "
+                        f"site anywhere in the corpus — delete the "
+                        f"table row or resurrect the knob"))
+        return findings
+
+    @staticmethod
+    def _rel(project: Project, abspath: str) -> str:
+        rel = os.path.relpath(abspath, project.root)
+        return abspath if rel.startswith("..") else rel

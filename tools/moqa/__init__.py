@@ -1,0 +1,445 @@
+"""moqa — differential query-equivalence analyzer.
+
+The third analysis leg next to molint (static invariants, PR 6) and
+mosan (runtime concurrency, PR 8): *result correctness*.  The engine's
+whole architecture stakes on one invariant — every execution
+configuration (fused vs per-operator, cached vs cold, sharded vs
+local, jit vs row UDF tier, materialized view vs base query) returns
+the SAME answer — and moqa is the machine that attacks it:
+
+  * a deterministic seeded generator of schemas/data/queries biased
+    toward the engine's fusable shapes (tools/moqa/generator.py);
+  * metamorphic oracles needing no external truth — TLP, NoREC
+    cardinality, LIMIT/OFFSET algebra — plus a sqlite differential
+    oracle where types allow (tools/moqa/oracles.py);
+  * a config-lattice lockstep runner diffing row-sets exactly across
+    nine configuration pairs (tools/moqa/runner.py);
+  * an armed padding-canary mode (matrixone_tpu/utils/qa.py) that
+    poisons the padded tail of every device buffer and audits results
+    and aggregate carries;
+  * an automatic reducer that shrinks any failing (schema, data,
+    query, config-pair) to a minimal ready-to-paste regression test
+    (tools/moqa/reducer.py);
+  * planted-bug drills re-introducing two historical bug classes to
+    prove the net catches (tools/moqa/plants.py).
+
+Gates: tests/test_moqa.py runs the bounded deterministic corpus in
+tier-1 (zero findings fails the build — same contract as molint and
+mosan); `python -m tools.precheck --qa-smoke` is the CI one-shot;
+`mo_ctl('qa','status'|'run:<seed>')` is the ops surface.  Knobs
+(README "Differential testing"): MO_QA_SEED, MO_QA_QUERIES,
+MO_QA_SECS, MO_QA_CANARY.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Optional, Tuple
+
+from tools.moqa import oracles, plants, reducer, runner
+from tools.moqa.generator import Generator
+from tools.moqa.runner import PAIR_NAMES, run_corpus
+
+
+def corpus_seed(default: int = 2026) -> int:
+    """MO_QA_SEED: the tier-1 corpus seed."""
+    try:
+        return int(os.environ.get("MO_QA_SEED", "") or default)
+    except ValueError:
+        return default
+
+
+def corpus_queries(default: int = 110) -> int:
+    """MO_QA_QUERIES: generated queries per (non-vector) scenario."""
+    try:
+        return int(os.environ.get("MO_QA_QUERIES", "") or default)
+    except ValueError:
+        return default
+
+
+def extended_seconds() -> float:
+    """MO_QA_SECS: >0 unlocks the longer randomized multi-seed run."""
+    try:
+        return float(os.environ.get("MO_QA_SECS", "") or 0.0)
+    except ValueError:
+        return 0.0
+
+
+# =====================================================================
+# single-case replay — the repro primitive every reduced regression
+# test calls (and the reducer probes with)
+# =====================================================================
+
+def replay(create: str, insert: str, query: str, pair: str = "fusion",
+           setup: Tuple[str, ...] = (), ordered: bool = False,
+           partition: Optional[str] = None) -> List[str]:
+    """Replay one (schema, data, query) case under one config pair or
+    oracle on a fresh in-memory engine.  Returns formatted findings
+    (empty list == the invariant held).  `pair` is a runner pair name
+    or `oracle:tlp` / `oracle:norec` / `oracle:limit`."""
+    from matrixone_tpu.frontend import Session
+    from matrixone_tpu.storage.engine import Engine
+    from matrixone_tpu.utils import qa
+
+    R = runner
+
+    def build():
+        eng = Engine()
+        s = Session(catalog=eng)
+        s.execute(create)
+        if insert.strip():
+            s.execute(insert)
+        for ddl in setup:
+            s.execute(ddl)
+        s.execute("select mo_ctl('serving', 'plan:off')")
+        return s
+
+    def rows_of(s, sql):
+        return s.execute(sql).rows()
+
+    out: List[str] = []
+
+    if pair.startswith("oracle:"):
+        oracle = pair.split(":", 1)[1]
+        with R.env_scope(R.ENV_BASELINE):
+            s = build()
+            try:
+                d = _replay_oracle(oracle, s, query, partition,
+                                   ddl=(create, insert))
+            finally:
+                s.close()
+        if d is not None:
+            out.append(f"[oracle-{oracle}] {query}: {d}")
+        return out
+
+    if pair not in R.PAIR_ENV:
+        raise ValueError(f"unknown pair {pair!r}; use "
+                         f"{sorted(R.PAIR_ENV)} or oracle:<name>")
+
+    with R.env_scope(R.ENV_BASELINE):
+        s = build()
+        try:
+            base = rows_of(s, query)
+        finally:
+            s.close()
+
+    tol = pair not in R.EXACT_PAIRS
+    detail = None
+    if pair == "canary":
+        with qa.armed_scope(), qa.capture() as probe, \
+                R._pair_scope(pair):
+            s = build()
+            try:
+                got = rows_of(s, query)
+            finally:
+                s.close()
+        detail = oracles.diff_rows(base, got, ordered=ordered)
+        for f in probe.findings():
+            out.append(f.format())
+    elif pair == "mview":
+        with R.env_scope(R.ENV_BASELINE):
+            s = build()
+            try:
+                s.execute(f"create materialized view qa_replay_mv as "
+                          f"{query}")
+                # full-mode views refresh on demand by design; the
+                # commutation must hold refreshed either way
+                s.execute("select mo_ctl('mview', "
+                          "'refresh:qa_replay_mv')")
+                got = rows_of(s, "select * from qa_replay_mv")
+                detail = oracles.diff_rows(base, got, ordered=False,
+                                           tol_floats=True)
+            finally:
+                s.close()
+    elif pair == "cache-stale":
+        with R._pair_scope(pair):
+            s = build()
+            try:
+                s.execute("select mo_ctl('serving', 'plan:on')")
+                s.execute("select mo_ctl('serving', 'result:on')")
+                rows_of(s, query)                       # warm
+                # shape-preserving rebuild: same table, same row
+                # count and dictionary SIZES, rotated string CONTENT —
+                # every compiled/cached artifact keyed on anything
+                # weaker than content now serves stale answers
+                m = re.search(r"create table\s+(\w+)", create, re.I)
+                table = m.group(1) if m else "t"
+                s.execute(f"drop table {table}")
+                s.execute(create)
+                if insert.strip():
+                    s.execute(rotate_insert_strings(insert))
+                # truth: serving caches disabled AND cleared, unfused
+                # path; the process-global fragment compile cache
+                # stays as warmed — post-rebuild correctness there is
+                # exactly what the content keying must provide
+                with R.env_scope(R.ENV_BASELINE):
+                    s.execute("select mo_ctl('serving', 'clear')")
+                    s.execute("select mo_ctl('serving', 'plan:off')")
+                    s.execute("select mo_ctl('serving', 'result:off')")
+                    truth = rows_of(s, query)
+                    s.execute("select mo_ctl('serving', 'plan:on')")
+                got = rows_of(s, query)
+                detail = oracles.diff_rows(truth, got, ordered=ordered,
+                                           mode="exact")
+            finally:
+                s.close()
+    elif pair in ("plan-cache", "result-cache"):
+        with R._pair_scope(pair):
+            s = build()
+            try:
+                which = "plan:on" if pair == "plan-cache" \
+                    else "result:on"
+                s.execute(f"select mo_ctl('serving', '{which}')")
+                rows_of(s, query)
+                got = rows_of(s, query)
+            finally:
+                s.close()
+        detail = oracles.diff_rows(base, got, ordered=ordered)
+    else:
+        with R._pair_scope(pair):
+            s = build()
+            try:
+                got = rows_of(s, query)
+            finally:
+                s.close()
+        detail = oracles.diff_rows(base, got, ordered=ordered,
+                                   tol_floats=tol)
+    if detail is not None:
+        out.append(f"[lockstep-mismatch:{pair}] {query}: {detail}")
+    return out
+
+
+def rotate_insert_strings(insert_sql: str) -> str:
+    """Rotate the distinct quoted strings of an INSERT among
+    themselves: same count, same dictionary sizes, different content —
+    the content-staleness probe (non-string literals untouched)."""
+    def plain(s: str) -> bool:
+        # leave date/vector literals alone — they are typed values,
+        # not dictionary strings
+        return not (re.match(r"^\d{4}-\d{2}-\d{2}", s)
+                    or s.startswith("["))
+    lits = [s for s in re.findall(r"'((?:[^']|'')*)'", insert_sql)
+            if plain(s)]
+    distinct = sorted(set(lits))
+    if len(distinct) < 2:
+        distinct = distinct + ["qa_rot"]
+    rot = {a: b for a, b in zip(distinct,
+                                distinct[1:] + distinct[:1])}
+    return re.sub(
+        r"'((?:[^']|'')*)'",
+        lambda m: "'" + rot.get(m.group(1), m.group(1)) + "'"
+        if plain(m.group(1)) else m.group(0),
+        insert_sql)
+
+
+def _replay_oracle(oracle: str, s, query: str,
+                   partition: Optional[str],
+                   ddl: Tuple[str, str] = ("", "")) -> Optional[str]:
+    """Textual oracle replays over a raw SQL string (the reduced-repro
+    path; the corpus runner uses the structured versions)."""
+    def ex(sql):
+        return s.execute(sql).rows()
+
+    if oracle == "tlp":
+        if not partition:
+            raise ValueError("oracle:tlp needs partition=")
+        base = ex(query)
+        parts = []
+        for br in (partition, f"not ({partition})",
+                   f"({partition}) is null"):
+            parts.extend(ex(_and_where(query, br)))
+        return oracles.diff_rows(base, parts, ordered=False)
+    if oracle == "norec":
+        if not partition:
+            raise ValueError("oracle:norec needs partition=")
+        m = re.search(r"\bfrom\s+(\w+)", query, re.I)
+        table = m.group(1)
+        wm = re.search(r"\bwhere\b(.*?)(?:\bgroup by\b|\border by\b|"
+                       r"\blimit\b|$)", query, re.I | re.S)
+        where = [wm.group(1).strip()] if wm else []
+        return oracles.norec_check(ex, table, partition, where)
+    if oracle == "limit":
+        lm = re.search(r"\blimit\s+(\d+)(?:\s+offset\s+(\d+))?\s*$",
+                       query, re.I)
+        if not lm:
+            return None
+        k = int(lm.group(1))
+        off = int(lm.group(2) or 0)
+        full = ex(query[:lm.start()].rstrip())
+        got = ex(query)
+        return oracles.diff_rows(got, full[off:off + k], ordered=True)
+    if oracle == "sqlite":
+        import sqlite3
+        conn = sqlite3.connect(":memory:")
+        try:
+            for sql in ddl:
+                if sql.strip():
+                    conn.execute(_sqlite_ddl(sql))
+            want = [tuple(r) for r in conn.execute(query).fetchall()]
+        finally:
+            conn.close()
+        got = ex(query)
+        ordered = bool(re.search(r"\border by\b", query, re.I))
+        return oracles.diff_rows(got, want, ordered=ordered,
+                                 mode="xengine")
+    raise ValueError(f"unknown oracle {oracle!r}")
+
+
+def _sqlite_ddl(sql: str) -> str:
+    """Translate an engine CREATE/INSERT into sqlite's dialect for the
+    mirrorable type subset (int/bigint/double/varchar).  A decimal,
+    bool, date or vector column raises — the reducer's probes then
+    steer toward dropping the unmirrorable columns."""
+    if re.search(r"\b(decimal|numeric|bool|boolean|date|datetime|"
+                 r"timestamp|vecf)", sql, re.I) \
+            and re.match(r"\s*create\b", sql, re.I):
+        raise ValueError("schema has sqlite-unmirrorable columns")
+    out = re.sub(r"\bbigint\b|\bint\b|\binteger\b", "integer", sql,
+                 flags=re.I)
+    out = re.sub(r"\bdouble\b|\bfloat\b", "real", out, flags=re.I)
+    out = re.sub(r"\bvarchar\(\d+\)\b", "text", out, flags=re.I)
+    return out
+
+
+def _and_where(query: str, branch: str) -> str:
+    m = re.search(r"\bwhere\b", query, re.I)
+    if m:
+        return _insert_branch(query, m, branch)
+    mm = re.search(r"\b(group by|order by|limit)\b", query, re.I)
+    at = mm.start() if mm else len(query)
+    return f"{query[:at].rstrip()} where ({branch}) {query[at:]}"
+
+
+def _insert_branch(query: str, where_m, branch: str) -> str:
+    tail = re.search(r"\b(group by|order by|limit)\b",
+                     query[where_m.end():], re.I)
+    end = where_m.end() + (tail.start() if tail else
+                           len(query) - where_m.end())
+    cond = query[where_m.end():end].strip()
+    return (f"{query[:where_m.end()]} ({cond}) and ({branch}) "
+            f"{query[end:]}")
+
+
+# =====================================================================
+# smoke + status + CLI
+# =====================================================================
+
+def run_smoke(seed: Optional[int] = None) -> dict:
+    """The precheck one-shot: a small corpus plus one planted-bug
+    drill; <30s on the tier-1 box."""
+    seed = corpus_seed() if seed is None else seed
+    rep = run_corpus(seed=seed, queries_per_scenario=8,
+                     pairs=["fusion", "dense-groups", "plan-cache",
+                            "result-cache", "canary", "cache-stale"],
+                     reduce_findings=0,
+                     oracle_fraction=0.34, stale_fraction=0.25,
+                     max_views=2)
+    with plants.plant("pad-leak"):
+        # a SCALAR sum: the leaky kernels are the scalar/general-path
+        # sums; grouped dict keys would ride the dense lanes past them
+        caught = replay(
+            create="create table qa_pl (v bigint, d double)",
+            insert="insert into qa_pl values " + ",".join(
+                f"({i}, {i}.25)" for i in range(23)),
+            query="select sum(v) sv, sum(d) sd from qa_pl",
+            pair="canary")
+    rep["plant_caught"] = bool(caught)
+    return rep
+
+
+def last_run_status() -> dict:
+    """mo_ctl('qa','status') payload."""
+    from matrixone_tpu.utils import qa
+    return {"pairs": list(PAIR_NAMES),
+            "canary": qa.report(),
+            "last_run": runner.last_run()}
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.moqa",
+        description="differential query-equivalence analyzer (see "
+                    "README 'Differential testing')")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="corpus seed (default MO_QA_SEED or 2026)")
+    ap.add_argument("--queries", type=int, default=None,
+                    help="queries per scenario (default MO_QA_QUERIES "
+                         "or 110)")
+    ap.add_argument("--pairs", default=None,
+                    help="comma-separated pair subset "
+                         f"(default: all of {','.join(PAIR_NAMES)})")
+    ap.add_argument("--secs", type=float, default=None,
+                    help="randomized multi-seed run for this many "
+                         "seconds (default MO_QA_SECS)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="the precheck smoke (small corpus + planted "
+                         "drill)")
+    ap.add_argument("--plant", default=None,
+                    choices=plants.plant_names(),
+                    help="run the corpus with a planted bug; exit 0 "
+                         "iff moqa catches it")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        rep = run_smoke(args.seed)
+        print(json.dumps({k: rep[k] for k in
+                          ("seed", "queries", "total_checks", "pairs",
+                           "seconds", "plant_caught")},
+                         sort_keys=True))
+        for line in rep["findings_formatted"]:
+            print(line)
+        ok = not rep["findings"] and rep["plant_caught"]
+        return 0 if ok else 1
+
+    seed = corpus_seed() if args.seed is None else args.seed
+    nq = corpus_queries() if args.queries is None else args.queries
+    pairs = args.pairs.split(",") if args.pairs else None
+    secs = extended_seconds() if args.secs is None else args.secs
+
+    def one(seed_i):
+        if args.plant:
+            with plants.plant(args.plant):
+                return run_corpus(seed=seed_i,
+                                  queries_per_scenario=nq,
+                                  pairs=pairs)
+        return run_corpus(seed=seed_i, queries_per_scenario=nq,
+                          pairs=pairs)
+
+    import time as _time
+    reports = []
+    t0 = _time.monotonic()
+    s_i = seed
+    while True:
+        reports.append(one(s_i))
+        s_i += 1
+        if not secs or _time.monotonic() - t0 >= secs:
+            break
+
+    n_findings = sum(len(r["findings"]) for r in reports)
+    if args.json:
+        print(json.dumps(reports if len(reports) > 1 else reports[0],
+                         indent=1, sort_keys=True, default=str))
+    else:
+        for r in reports:
+            for line in r["findings_formatted"]:
+                print(line)
+            for f in r["findings"]:
+                if f.get("repro"):
+                    print("\n--- reduced repro "
+                          "(paste into tests/) ---")
+                    print(f["repro"])
+            print(json.dumps({k: r[k] for k in
+                              ("seed", "queries", "total_checks",
+                               "pairs", "oracle_checks", "seconds")},
+                             sort_keys=True))
+    if args.plant:
+        print("planted bug CAUGHT" if n_findings
+              else "planted bug NOT caught", file=sys.stderr)
+        return 0 if n_findings else 1
+    return 1 if n_findings else 0

@@ -1,0 +1,499 @@
+"""moqa query/schema/data generator — deterministic, seeded, biased
+toward the engine's fusable (and soon-to-be-fusable) shapes.
+
+Everything here is driven by one `numpy.random.default_rng(seed)`:
+the same seed always yields the same scenarios, rows and queries, so
+the tier-1 corpus is reproducible and any finding names the seed that
+produced it.
+
+Scenarios carry their data as host-side python rows (the reducer
+shrinks those row lists); queries are structured (`GenQuery`) so the
+reducer can drop clauses instead of string-munging SQL.  The bias
+knobs the ISSUE names are all here:
+
+  * filters / projections / group-bys / scalar aggregates over
+    NULL-heavy bigint, double, DECIMAL, dict-string, bool and date
+    columns — the shapes vm/fusion.py traces;
+  * ORDER BY (+ deterministic id tiebreak) and LIMIT/OFFSET tails;
+  * odd row counts that straddle the padded-batch buckets
+    (container/device.bucket_length: ..., 1024, 2048, ...) and sit on
+    either side of `MO_FUSION_MIN_ROWS`-style thresholds;
+  * a UDF family (CREATE FUNCTION, jit vs row tiers) and a small
+    vector family (ivfflat + `MO_IVF_SHARDS`) so those lattice axes
+    have queries to disagree on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+# =====================================================================
+# expressions: sql text + metadata the oracles need
+# =====================================================================
+
+@dataclasses.dataclass(frozen=True)
+class Expr:
+    sql: str
+    kind: str                    # 'num' | 'str' | 'bool' | 'other'
+    cols: frozenset              # referenced column names
+    sqlite_ok: bool = True
+    features: frozenset = frozenset()
+
+
+def _e(sql, kind, cols, sqlite_ok=True, features=()):
+    return Expr(sql, kind, frozenset(cols), sqlite_ok,
+                frozenset(features))
+
+
+# =====================================================================
+# scenarios
+# =====================================================================
+
+@dataclasses.dataclass
+class ColumnSpec:
+    name: str
+    sql_type: str
+    kind: str          # int | bigint | float | dec | str | bool | date | vec
+    sqlite_type: Optional[str]   # None = column not mirrored to sqlite
+
+
+@dataclasses.dataclass
+class Scenario:
+    name: str
+    table: str
+    columns: List[ColumnSpec]
+    rows: List[tuple]            # python values, None = NULL
+    #: index splitting rows into wave1/wave2 for the mview / staleness
+    #: procedures (insert wave1, create view, insert wave2)
+    wave_split: int = 0
+    #: extra DDL run after CREATE TABLE + first insert (UDFs, indexes)
+    setup_sql: List[str] = dataclasses.field(default_factory=list)
+    features: frozenset = frozenset()
+
+    # --------------------------------------------------------- rendering
+    def create_sql(self) -> str:
+        cols = ", ".join(f"{c.name} {c.sql_type}" for c in self.columns)
+        return f"create table {self.table} ({cols})"
+
+    def insert_sql(self, rows: Optional[List[tuple]] = None) -> str:
+        rows = self.rows if rows is None else rows
+        return (f"insert into {self.table} values "
+                + ",".join(self.render_row(r) for r in rows))
+
+    def render_row(self, row: tuple) -> str:
+        return "(" + ",".join(
+            render_literal(v, c.kind)
+            for v, c in zip(row, self.columns)) + ")"
+
+    def column(self, name: str) -> ColumnSpec:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise KeyError(name)
+
+
+def render_literal(v, kind: str) -> str:
+    if v is None:
+        return "null"
+    if kind in ("int", "bigint"):
+        return str(int(v))
+    if kind == "float":
+        return repr(float(v))
+    if kind == "dec":
+        return f"{v:.2f}"
+    if kind == "bool":
+        return "true" if v else "false"
+    if kind == "date":
+        return f"date '{v}'"
+    if kind == "vec":
+        return "'[" + ",".join(f"{x:.3f}" for x in v) + "]'"
+    s = str(v).replace("'", "''")
+    return f"'{s}'"
+
+
+# =====================================================================
+# queries
+# =====================================================================
+
+@dataclasses.dataclass
+class GenQuery:
+    table: str
+    select: List[Tuple[str, str]]          # (expr sql, alias)
+    where: List[str] = dataclasses.field(default_factory=list)  # ANDed
+    group_by: List[str] = dataclasses.field(default_factory=list)
+    order_by: List[str] = dataclasses.field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    features: frozenset = frozenset()
+    cols: frozenset = frozenset()
+
+    def sql(self) -> str:
+        items = ", ".join(f"{e} {a}" if a else e for e, a in self.select)
+        s = f"select {items} from {self.table}"
+        if self.where:
+            s += " where " + " and ".join(
+                w if len(self.where) == 1 else f"({w})"
+                for w in self.where)
+        if self.group_by:
+            s += " group by " + ", ".join(self.group_by)
+        if self.order_by:
+            s += " order by " + ", ".join(self.order_by)
+        if self.limit is not None:
+            s += f" limit {self.limit}"
+        if self.offset:
+            s += f" offset {self.offset}"
+        return s
+
+    def has(self, feat: str) -> bool:
+        return feat in self.features
+
+    def clone(self, **patch) -> "GenQuery":
+        return dataclasses.replace(self, **patch)
+
+
+# =====================================================================
+# the generator
+# =====================================================================
+
+_G_VALUES = ["aa", "bb", "cc", "dd", "ee"]
+_S_VALUES = [f"s{i:02d}" for i in range(18)]
+
+
+class Generator:
+    """One seeded stream of scenarios + queries."""
+
+    def __init__(self, seed: int):
+        self.seed = int(seed)
+        self.rng = np.random.default_rng(self.seed)
+
+    # ----------------------------------------------------------- helpers
+    def _choice(self, seq):
+        return seq[int(self.rng.integers(0, len(seq)))]
+
+    def _maybe(self, p: float) -> bool:
+        return float(self.rng.random()) < p
+
+    # --------------------------------------------------------- scenarios
+    def scenarios(self, straddle_rows: int = 1027) -> List[Scenario]:
+        """The corpus scenarios: mixed small, NULL-heavy, a padded-
+        bucket straddler, and a small vector table."""
+        out = [
+            self.mixed_scenario("qa_small", n_rows=149, null_p=0.12),
+            self.mixed_scenario("qa_nulls", n_rows=88, null_p=0.45),
+            self.mixed_scenario("qa_pad", n_rows=straddle_rows,
+                                null_p=0.10),
+            self.vector_scenario("qa_vec", n_rows=72, dim=8),
+        ]
+        return out
+
+    def mixed_scenario(self, table: str, n_rows: int,
+                       null_p: float) -> Scenario:
+        cols = [
+            ColumnSpec("id", "bigint", "bigint", "integer"),
+            ColumnSpec("g", "varchar(8)", "str", "text"),
+            ColumnSpec("s", "varchar(16)", "str", "text"),
+            ColumnSpec("v", "bigint", "bigint", "integer"),
+            ColumnSpec("w", "int", "int", "integer"),
+            ColumnSpec("d", "double", "float", "real"),
+            ColumnSpec("q", "decimal(12,2)", "dec", None),
+            ColumnSpec("b", "bool", "bool", None),
+            ColumnSpec("dt", "date", "date", None),
+        ]
+        rng = self.rng
+        rows = []
+        for i in range(n_rows):
+            def nul(p=null_p):
+                return float(rng.random()) < p
+            g = None if nul() else _G_VALUES[int(rng.integers(0, 5))]
+            s = None if nul(null_p / 2) else \
+                _S_VALUES[int(rng.integers(0, len(_S_VALUES)))]
+            v = None if nul() else int(rng.integers(-40, 120))
+            w = None if nul() else int(rng.integers(-7, 9))
+            # quarters only: exact in binary AND in sqlite REAL, so the
+            # cross-engine oracle compares exactly where sums allow
+            d = None if nul() else float(int(rng.integers(-40, 80))) / 4
+            q = None if nul() else float(int(rng.integers(-9000, 9000))) / 100
+            b = None if nul(null_p / 2) else bool(rng.integers(0, 2))
+            day = 1 + int(rng.integers(0, 28))
+            mon = 1 + int(rng.integers(0, 3))
+            dt_ = None if nul(null_p / 2) else f"1995-{mon:02d}-{day:02d}"
+            rows.append((i, g, s, v, w, d, q, b, dt_))
+        setup = [
+            "create function qa_f(x DOUBLE, y BIGINT) returns DOUBLE "
+            "language python as $$ x * 2.0 + y $$",
+        ]
+        return Scenario(name=table, table=table, columns=cols, rows=rows,
+                        wave_split=max(1, int(n_rows * 0.7)),
+                        setup_sql=setup,
+                        features=frozenset({"mixed"}))
+
+    def vector_scenario(self, table: str, n_rows: int,
+                        dim: int) -> Scenario:
+        cols = [
+            ColumnSpec("id", "bigint", "bigint", None),
+            ColumnSpec("k", "varchar(4)", "str", None),
+            ColumnSpec("emb", f"vecf32({dim})", "vec", None),
+        ]
+        rng = self.rng
+        rows = []
+        for i in range(n_rows):
+            vec = tuple(round(float(x), 3)
+                        for x in rng.normal(0, 1, dim))
+            rows.append((i, _G_VALUES[int(rng.integers(0, 3))], vec))
+        setup = [f"create index qa_iv using ivfflat on {table} (emb) "
+                 f"lists = 4"]
+        return Scenario(name=table, table=table, columns=cols, rows=rows,
+                        wave_split=n_rows, setup_sql=setup,
+                        features=frozenset({"vector"}))
+
+    # ------------------------------------------------------- expressions
+    def _num_expr(self, depth: int = 0) -> Expr:
+        r = float(self.rng.random())
+        if depth >= 2 or r < 0.45:
+            col = self._choice(["v", "w", "d", "q", "id"])
+            return _e(col, "num", [col], sqlite_ok=col != "q")
+        if r < 0.70:
+            a, b = self._num_expr(depth + 1), self._num_expr(depth + 1)
+            op = self._choice(["+", "-", "*"])
+            return _e(f"({a.sql} {op} {b.sql})", "num", a.cols | b.cols,
+                      a.sqlite_ok and b.sqlite_ok,
+                      a.features | b.features)
+        if r < 0.85:
+            a = self._num_expr(depth + 1)
+            c = int(self.rng.integers(-9, 12))
+            op = self._choice(["+", "-", "*"])
+            return _e(f"({a.sql} {op} {c})", "num", a.cols, a.sqlite_ok,
+                      a.features)
+        p = self._pred(depth + 1)
+        a, b = self._num_expr(depth + 1), self._num_expr(depth + 1)
+        return _e(f"case when {p.sql} then {a.sql} else {b.sql} end",
+                  "num", p.cols | a.cols | b.cols,
+                  p.sqlite_ok and a.sqlite_ok and b.sqlite_ok,
+                  p.features | a.features | b.features | {"case"})
+
+    def _pred(self, depth: int = 0) -> Expr:
+        r = float(self.rng.random())
+        if depth >= 2 or r < 0.40:
+            a = self._num_expr(depth + 1)
+            op = self._choice(["<", "<=", ">", ">=", "=", "<>"])
+            c = int(self.rng.integers(-30, 90))
+            return _e(f"{a.sql} {op} {c}", "bool", a.cols, a.sqlite_ok,
+                      a.features)
+        if r < 0.52:
+            col = self._choice(["g", "s", "v", "d", "b"])
+            neg = " not" if self._maybe(0.3) else ""
+            return _e(f"{col} is{neg} null", "bool", [col],
+                      sqlite_ok=col not in ("b", "dt", "q"))
+        if r < 0.64:
+            val = self._choice(_G_VALUES)
+            op = self._choice(["=", "<>", "<", ">="])
+            return _e(f"g {op} '{val}'", "bool", ["g"])
+        if r < 0.72:
+            pat = self._choice(["a%", "%b", "%c%", "s0%", "_a"])
+            neg = "not " if self._maybe(0.25) else ""
+            col = self._choice(["g", "s"])
+            return _e(f"{col} {neg}like '{pat}'", "bool", [col],
+                      features={"like"})
+        if r < 0.80:
+            vals = sorted({self._choice(_G_VALUES) for _ in range(2)})
+            lit = ", ".join(f"'{v}'" for v in vals)
+            neg = "not " if self._maybe(0.25) else ""
+            return _e(f"g {neg}in ({lit})", "bool", ["g"])
+        if r < 0.90:
+            a, b = self._pred(depth + 1), self._pred(depth + 1)
+            op = self._choice(["and", "or"])
+            return _e(f"({a.sql} {op} {b.sql})", "bool", a.cols | b.cols,
+                      a.sqlite_ok and b.sqlite_ok,
+                      a.features | b.features)
+        a = self._pred(depth + 1)
+        return _e(f"not ({a.sql})", "bool", a.cols, a.sqlite_ok,
+                  a.features)
+
+    def partition_pred(self) -> Expr:
+        """A TLP partition predicate: must be three-valued (true / false
+        / NULL) over the data, never error."""
+        r = float(self.rng.random())
+        if r < 0.5:
+            col = self._choice(["v", "w", "d"])
+            op = self._choice(["<", ">", "<=", ">="])
+            c = int(self.rng.integers(-20, 60))
+            return _e(f"{col} {op} {c}", "bool", [col])
+        if r < 0.75:
+            val = self._choice(_G_VALUES)
+            return _e(f"g = '{val}'", "bool", ["g"])
+        return _e(f"b = true", "bool", ["b"], sqlite_ok=False)
+
+    # ----------------------------------------------------------- queries
+    def query(self, scenario: Scenario) -> GenQuery:
+        if "vector" in scenario.features:
+            return self._vector_query(scenario)
+        r = float(self.rng.random())
+        if r < 0.42:
+            return self._plain_query(scenario)
+        if r < 0.58:
+            return self._scalar_agg_query(scenario)
+        return self._grouped_agg_query(scenario)
+
+    def _where(self, p: float = 0.75) -> Tuple[List[str], frozenset,
+                                               frozenset, bool]:
+        parts, cols, feats, lite = [], frozenset(), frozenset(), True
+        n = 0
+        if self._maybe(p):
+            n = 1 + int(self._maybe(0.3))
+        for _ in range(n):
+            w = self._pred()
+            parts.append(w.sql)
+            cols |= w.cols
+            feats |= w.features
+            lite = lite and w.sqlite_ok
+        return parts, cols, feats, lite
+
+    def _plain_query(self, sc: Scenario) -> GenQuery:
+        n_items = 1 + int(self.rng.integers(0, 3))
+        select, cols, feats = [], frozenset(), frozenset({"plain"})
+        lite = True
+        for i in range(n_items):
+            r = float(self.rng.random())
+            if r < 0.5:
+                e = self._num_expr()
+            elif r < 0.7:
+                col = self._choice(["g", "s", "v", "d", "b", "dt", "id"])
+                e = _e(col, "other", [col],
+                       sqlite_ok=col not in ("b", "dt"))
+            elif r < 0.85:
+                p = self._pred()
+                e = _e(f"{p.sql}", "bool", p.cols, p.sqlite_ok,
+                       p.features)
+            else:
+                e = _e(f"qa_f(d, id)", "num", ["d", "id"],
+                       sqlite_ok=False, features=frozenset({"udf"}))
+            select.append((e.sql, f"c{i}"))
+            cols |= e.cols
+            feats |= e.features
+            lite = lite and e.sqlite_ok
+        where, wcols, wfeats, wlite = self._where()
+        cols |= wcols
+        feats |= wfeats
+        lite = lite and wlite
+        q = GenQuery(table=sc.table, select=select, where=where,
+                     cols=cols, features=feats)
+        if self._maybe(0.45):
+            # deterministic total order: trailing unique-id tiebreak
+            keys = [f"c0" if self._maybe(0.5) else "id"]
+            if keys[-1] != "id":
+                keys.append("id")
+            q.order_by = keys
+            q.select.append(("id", "oid"))
+            q.cols = q.cols | {"id"}
+            feats = feats | {"ordered"}
+            if self._maybe(0.6):
+                q.limit = int(self.rng.integers(1, 40))
+                if self._maybe(0.4):
+                    q.offset = int(self.rng.integers(1, 20))
+                feats = feats | {"limited"}
+        if not q.order_by and q.limit is None:
+            feats = feats | {"tlp_ok"}
+        q.features = frozenset(feats)
+        if lite:
+            q.features = q.features | {"sqlite_ok"}
+        return q
+
+    _AGGS = ["count", "sum", "avg", "min", "max"]
+
+    def _scalar_agg_query(self, sc: Scenario) -> GenQuery:
+        n_aggs = 1 + int(self.rng.integers(0, 3))
+        select, cols, feats = [], frozenset(), frozenset({"agg"})
+        lite = True
+        for i in range(n_aggs):
+            fn = self._choice(self._AGGS)
+            if fn == "count" and self._maybe(0.5):
+                e_sql, e_cols, e_lite = "count(*)", frozenset(), True
+            else:
+                a = self._num_expr()
+                e_sql, e_cols, e_lite = f"{fn}({a.sql})", a.cols, \
+                    a.sqlite_ok
+            select.append((e_sql, f"a{i}"))
+            cols |= e_cols
+            lite = lite and e_lite
+        where, wcols, wfeats, wlite = self._where()
+        feats |= wfeats
+        q = GenQuery(table=sc.table, select=select, where=where,
+                     cols=cols | wcols, features=frozenset(feats))
+        if lite and wlite:
+            q.features = q.features | {"sqlite_ok"}
+        return q
+
+    def _grouped_agg_query(self, sc: Scenario) -> GenQuery:
+        keys, kcols, kfeats, klite = [], frozenset(), frozenset(), True
+        r = float(self.rng.random())
+        if r < 0.55:
+            keys = ["g"]
+            kcols = frozenset(["g"])
+        elif r < 0.72:
+            p = self.partition_pred()
+            keys = [p.sql]
+            kcols, klite = p.cols, p.sqlite_ok
+        elif r < 0.88:
+            keys = ["g", "b"]
+            kcols, klite = frozenset(["g", "b"]), False
+        else:
+            thr = int(self.rng.integers(0, 40))
+            keys = [f"case when v > {thr} then 'hi' else 'lo' end"]
+            kcols = frozenset(["v"])
+        n_aggs = 1 + int(self.rng.integers(0, 3))
+        select = [(k, f"k{i}") for i, k in enumerate(keys)]
+        cols, lite = kcols, klite
+        maintainable = True
+        for i in range(n_aggs):
+            fn = self._choice(self._AGGS)
+            if fn == "count" and self._maybe(0.5):
+                select.append(("count(*)", f"a{i}"))
+                continue
+            a = self._num_expr()
+            select.append((f"{fn}({a.sql})", f"a{i}"))
+            cols |= a.cols
+            lite = lite and a.sqlite_ok
+        where, wcols, wfeats, wlite = self._where(p=0.6)
+        cols |= wcols
+        feats = {"agg", "grouped"} | set(wfeats) | set(kfeats)
+        # mview-maintainable shape: plain single-table group-by; keep it
+        # conservative (the planner itself decides — this flag only
+        # nominates candidates for the mview commutation pair)
+        if maintainable and keys == ["g"]:
+            feats.add("maintainable")
+        # group by the select ALIASES (k0, k1, ...): arbitrary key
+        # expressions (predicates, CASE) are only addressable that way
+        q = GenQuery(table=sc.table, select=select, where=where,
+                     group_by=[f"k{i}" for i in range(len(keys))],
+                     cols=cols, features=frozenset(feats))
+        if self._maybe(0.5):
+            q.order_by = [f"k{i}" for i in range(len(keys))]
+            q.features = q.features | {"ordered_keys"}
+        if lite and wlite:
+            q.features = q.features | {"sqlite_ok"}
+        return q
+
+    def _vector_query(self, sc: Scenario) -> GenQuery:
+        dim = len(sc.rows[0][2])
+        vec = "[" + ",".join(
+            f"{float(x):.3f}" for x in self.rng.normal(0, 1, dim)) + "]"
+        k = int(self.rng.integers(2, 9))
+        # ORDER BY distance LIMIT k alone — a second sort key would
+        # defeat the VectorTopK index rewrite and the pair would diff
+        # the brute-force scan against itself (distances over random
+        # normals never tie, so the order is deterministic)
+        q = GenQuery(
+            table=sc.table,
+            select=[("id", None)],
+            order_by=[f"l2_distance(emb, '{vec}')"],
+            limit=k,
+            cols=frozenset(["id", "emb"]),
+            features=frozenset({"vector", "ordered", "limited"}))
+        return q
+
+    def queries(self, scenario: Scenario, n: int) -> List[GenQuery]:
+        return [self.query(scenario) for _ in range(n)]

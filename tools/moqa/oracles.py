@@ -1,0 +1,169 @@
+"""moqa metamorphic + differential oracles.
+
+Oracles need no external source of truth — each derives a second
+answer the engine must agree with from the engine itself (TLP / NoREC
+/ LIMIT-OFFSET algebra, in the SQLancer tradition), or from a stock
+sqlite3 database mirroring the same rows where the type surface allows.
+Row-sets compare as exact multisets (floats exact too: the engine's
+claims for these transformations are bit-identity, not approximation —
+only cross-engine sqlite comparisons get a float tolerance).
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+import math
+import sqlite3
+from typing import List, Optional, Tuple
+
+from tools.moqa.generator import GenQuery, Scenario
+
+
+# =====================================================================
+# row-set comparison
+# =====================================================================
+
+#: float significance per comparison mode.  `exact` (12 digits) still
+#: tolerates last-ulp differences — a whole-plan XLA program may
+#: contract mul-add chains into FMAs that the per-operator path
+#: dispatches separately — while anything structural (truncation,
+#: wrong branch, dropped rows) blows well past 12 digits.  `tol`
+#: (9 digits) additionally absorbs reduction-order noise for pairs
+#: whose sum order differs by design.  Ints/decimals/strings/bools
+#: compare exactly in both modes (the engine's exactness contract
+#: rides int64/decimal, never floats).
+_SIG = {"exact": 12, "tol": 9}
+
+
+def _norm_cell(v, mode: str):
+    if isinstance(v, bool):
+        return int(v)
+    if isinstance(v, decimal.Decimal):
+        return ("d", str(decimal.Decimal(v).normalize()))
+    if isinstance(v, float):
+        if math.isnan(v):
+            return ("f", "nan")
+        if abs(v) < 1e-9:
+            # significant-digit bucketing breaks down at zero: an FMA-
+            # contracted fused program returns 1.7e-15 where the
+            # per-op path returns exactly 0.0 — same answer, every
+            # "significant" digit different.  Snap sub-1e-9 magnitudes
+            # to zero on BOTH sides before formatting.
+            v = 0.0
+        if mode == "xengine" and float(v).is_integer():
+            # cross-engine: sqlite's dynamic typing returns ints where
+            # the engine's static typing returns floats — compare by
+            # value, not host type
+            return int(v)
+        digits = _SIG.get(mode, 9)
+        return ("f", f"{v:.{digits}g}")
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return ("t", str(v))
+    return v
+
+
+def normalize_rows(rows: List[tuple], mode: str = "exact"):
+    return [tuple(_norm_cell(c, mode) for c in r) for r in rows]
+
+
+def diff_rows(a: List[tuple], b: List[tuple], ordered: bool,
+              tol_floats: bool = False,
+              mode: Optional[str] = None) -> Optional[str]:
+    """None when equal; otherwise a compact human-readable diff.
+    mode: 'exact' | 'tol' | 'xengine' (tol + int/float unification);
+    tol_floats=True is shorthand for mode='tol'."""
+    if mode is None:
+        mode = "tol" if tol_floats else "exact"
+    na = normalize_rows(a, mode)
+    nb = normalize_rows(b, mode)
+    if not ordered:
+        na = sorted(na, key=repr)
+        nb = sorted(nb, key=repr)
+    if na == nb:
+        return None
+    only_a = [r for r in na if r not in nb]
+    only_b = [r for r in nb if r not in na]
+    return (f"{len(a)} vs {len(b)} rows; "
+            f"only-left {only_a[:3]!r}; only-right {only_b[:3]!r}")
+
+
+# =====================================================================
+# metamorphic oracles (engine-only)
+# =====================================================================
+
+def tlp_check(execute, q: GenQuery, partition_sql: str
+              ) -> Optional[str]:
+    """Ternary Logic Partitioning: for a plain SELECT,
+    Q == Q[p] ∪ Q[not p] ∪ Q[p is null] as multisets."""
+    base = execute(q.sql())
+    parts: List[tuple] = []
+    for branch in (partition_sql, f"not ({partition_sql})",
+                   f"({partition_sql}) is null"):
+        qb = q.clone(where=q.where + [branch])
+        parts.extend(execute(qb.sql()))
+    return diff_rows(base, parts, ordered=False)
+
+
+def norec_check(execute, table: str, pred_sql: str,
+                where: List[str]) -> Optional[str]:
+    """NoREC-style cardinality: the optimized COUNT under a predicate
+    equals the unoptimizable row-wise sum of the predicate."""
+    wh = (" where " + " and ".join(f"({w})" for w in where)) if where \
+        else ""
+    (n_opt,), = execute(f"select count(*) c from {table}{wh}"
+                        + (" and " if where else " where ")
+                        + f"({pred_sql})")
+    (n_raw,), = execute(
+        f"select sum(case when ({pred_sql}) then 1 else 0 end) c "
+        f"from {table}{wh}")
+    n_raw = n_raw or 0
+    if int(n_opt) != int(n_raw):
+        return f"count(*) where p = {n_opt} but sum(p as int) = {n_raw}"
+    return None
+
+
+def limit_algebra_check(execute, q: GenQuery) -> Optional[str]:
+    """LIMIT/OFFSET algebra over a deterministic total order: the
+    limited query must be an exact slice of the unlimited one."""
+    full = execute(q.clone(limit=None, offset=None).sql())
+    k = q.limit if q.limit is not None else len(full)
+    off = q.offset or 0
+    want = full[off:off + k]
+    got = execute(q.sql())
+    return diff_rows(got, want, ordered=True)
+
+
+# =====================================================================
+# sqlite differential oracle
+# =====================================================================
+
+def sqlite_setup(scenario: Scenario) -> Optional[sqlite3.Connection]:
+    """Mirror the scenario's sqlite-compatible columns into an
+    in-memory sqlite database; None when nothing mirrors."""
+    cols = [c for c in scenario.columns if c.sqlite_type]
+    if not cols:
+        return None
+    conn = sqlite3.connect(":memory:")
+    decl = ", ".join(f"{c.name} {c.sqlite_type}" for c in cols)
+    conn.execute(f"create table {scenario.table} ({decl})")
+    idx = [i for i, c in enumerate(scenario.columns) if c.sqlite_type]
+    data = [tuple(row[i] for i in idx) for row in scenario.rows]
+    ph = ",".join("?" * len(cols))
+    conn.executemany(
+        f"insert into {scenario.table} values ({ph})", data)
+    return conn
+
+
+def sqlite_check(execute, conn: sqlite3.Connection,
+                 q: GenQuery) -> Optional[str]:
+    """Cross-engine diff against sqlite for the type-compatible query
+    subset.  Floats tolerant (reduction order differs by design)."""
+    sql = q.sql()
+    try:
+        want = [tuple(r) for r in conn.execute(sql).fetchall()]
+    except sqlite3.Error as e:
+        return f"sqlite rejected mirrored query: {e}"
+    got = execute(sql)
+    ordered = bool(q.order_by)
+    return diff_rows(got, want, ordered=ordered, mode="xengine")

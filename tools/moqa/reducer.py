@@ -1,0 +1,237 @@
+"""moqa automatic repro reducer.
+
+A corpus finding names a (schema, data, query, config-pair) quadruple;
+this module shrinks it to the minimal quadruple that still fails and
+renders it as a ready-to-paste regression test.  Shrinking is plain
+delta-debugging against a `still_fails` probe that rebuilds a fresh
+in-memory engine per attempt (tools/moqa.replay):
+
+  1. rows:   halves, then quarters, then single-row removal (ddmin);
+  2. query:  drop WHERE parts, ORDER BY, LIMIT/OFFSET, then surplus
+             select items (group keys survive — dropping one changes
+             the shape under test, which is fine IF it still fails);
+  3. columns: drop table columns the reduced query no longer reads.
+
+The probe budget is capped (`max_probes`) so a pathological case costs
+bounded time; the partially-reduced repro is still valid — reduction
+only ever returns quadruples that were re-verified to fail.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional
+
+from tools.moqa.generator import GenQuery, Scenario
+
+
+@dataclasses.dataclass
+class Case:
+    """A reducible failing case.  `pair` names either a config pair
+    (tools/moqa/runner.PAIR_ENV) or an oracle (`oracle:tlp` etc.);
+    `partition` carries the TLP/NoREC predicate when one applies."""
+    scenario: Scenario
+    rows: List[tuple]
+    query: GenQuery
+    pair: str
+    partition: Optional[str] = None
+
+    def replay_args(self):
+        sc = dataclasses.replace(self.scenario, rows=self.rows)
+        return sc, self.query
+
+
+def reduce_case(case: Case, still_fails: Callable[["Case"], bool],
+                max_probes: int = 80) -> Case:
+    """Shrink `case` while `still_fails` keeps returning True."""
+    budget = [max_probes]
+
+    def probe(c: Case) -> bool:
+        if budget[0] <= 0:
+            return False
+        budget[0] -= 1
+        try:
+            return still_fails(c)
+        except Exception:  # noqa: BLE001 — a probe that errors is not
+            # a smaller failing case; keep shrinking elsewhere
+            return False
+
+    # ---- 1. rows: ddmin-style chunk removal
+    rows = list(case.rows)
+    chunk = max(1, len(rows) // 2)
+    while chunk >= 1 and budget[0] > 0:
+        i, shrunk = 0, False
+        while i < len(rows) and budget[0] > 0:
+            trial = rows[:i] + rows[i + chunk:]
+            if trial and probe(dataclasses.replace(case, rows=trial)):
+                rows = trial
+                shrunk = True
+            else:
+                i += chunk
+        if not shrunk:
+            if chunk == 1:
+                break
+            chunk = max(1, chunk // 2)
+    case = dataclasses.replace(case, rows=rows)
+
+    # ---- 2. query clause dropping, to a fixpoint (candidates are
+    # regenerated from the CURRENT query — a later accepted patch must
+    # not resurrect a clause an earlier one already dropped)
+    q = case.query
+    changed = True
+    while changed and budget[0] > 0:
+        changed = False
+        for patch in _query_shrinks(q):
+            trial = dataclasses.replace(case, query=patch)
+            if probe(trial):
+                case = trial
+                q = patch
+                changed = True
+                break
+
+    # ---- 3. drop table columns the query no longer references
+    keep = [c for c in case.scenario.columns
+            if _col_in_query(c.name, q) or _col_in_pred(c.name, case)]
+    if 0 < len(keep) < len(case.scenario.columns):
+        idx = [i for i, c in enumerate(case.scenario.columns)
+               if c in keep]
+        sc2 = dataclasses.replace(
+            case.scenario, columns=keep,
+            rows=[tuple(r[i] for i in idx) for r in case.rows])
+        trial = Case(sc2, sc2.rows, q, case.pair,
+                     partition=case.partition)
+        if probe(trial):
+            case = trial
+    return case
+
+
+def _col_in_query(name: str, q: GenQuery) -> bool:
+    import re
+    pat = re.compile(rf"\b{re.escape(name)}\b")
+    texts = [e for e, _ in q.select] + q.where + q.group_by + q.order_by
+    return any(pat.search(t) for t in texts)
+
+
+def _col_in_pred(name: str, case: "Case") -> bool:
+    import re
+    if not case.partition:
+        return False
+    return bool(re.search(rf"\b{re.escape(name)}\b", case.partition))
+
+
+def _query_shrinks(q: GenQuery):
+    """Candidate simplifications, most aggressive first."""
+    out = []
+    if q.where:
+        out.append(q.clone(where=[]))
+        for i in range(len(q.where)):
+            out.append(q.clone(where=q.where[:i] + q.where[i + 1:]))
+    if q.limit is not None or q.offset:
+        out.append(q.clone(limit=None, offset=None))
+    if q.order_by:
+        out.append(q.clone(order_by=[]))
+    if len(q.select) > 1 and not q.group_by:
+        for i in range(len(q.select)):
+            sel = q.select[:i] + q.select[i + 1:]
+            out.append(q.clone(select=sel))
+    if q.group_by and len(q.select) > len(q.group_by):
+        # drop surplus aggregates (keep the keys + one aggregate)
+        nkeys = len(q.group_by)
+        for i in range(nkeys, len(q.select)):
+            if len(q.select) - 1 > nkeys - 1:
+                sel = q.select[:i] + q.select[i + 1:]
+                out.append(q.clone(select=sel))
+    return out
+
+
+# =====================================================================
+# rendering
+# =====================================================================
+
+def render_repro(case: Case, kind: str, seed) -> str:
+    """A ready-to-paste pytest regression test for the reduced case."""
+    sc, q = case.replay_args()
+    rows_sql = ",".join(sc.render_row(r) for r in case.rows)
+    name = f"test_moqa_repro_{kind.replace('-', '_')}_{seed}"
+    extra = []
+    if q.has("udf") and sc.setup_sql:
+        extra.append(f"        setup={tuple(sc.setup_sql)!r},")
+    if case.partition:
+        extra.append(f"        partition={case.partition!r},")
+    if q.has("ordered"):
+        extra.append("        ordered=True,")
+    lines = [
+        f"def {name}():",
+        f"    # reduced by tools/moqa (seed={seed}, pair="
+        f"{case.pair}, kind={kind})",
+        f"    from tools import moqa",
+        f"    assert moqa.replay(",
+        f"        create={sc.create_sql()!r},",
+        f"        insert="
+        f"{'insert into ' + sc.table + ' values ' + rows_sql!r},",
+        f"        query={q.sql()!r},",
+        *extra,
+        f"        pair={case.pair!r}) == []",
+    ]
+    return "\n".join(lines)
+
+
+# =====================================================================
+# glue: reduce a runner Finding
+# =====================================================================
+
+#: finding kind -> replay mode; kinds not here are not reducible
+#: (canary audits attach to a pair run, error kinds carry no diff)
+_KIND_MODE = {
+    "lockstep-mismatch": "pair",
+    "cache-staleness": "pair",
+    "canary-in-result": "pair",
+    "canary-in-carry": "pair",
+    "oracle-tlp": "oracle:tlp",
+    "oracle-norec": "oracle:norec",
+    "oracle-limit": "oracle:limit",
+    "oracle-sqlite": "oracle:sqlite",
+}
+
+
+def reduce_finding(finding, gen) -> str:
+    """Rebuild the failing case from a runner Finding and shrink it.
+    The probe replays the single (query, pair-or-oracle) through
+    tools/moqa.replay on a fresh engine each attempt."""
+    from tools import moqa
+    from tools.moqa import runner as R
+    from tools.moqa.generator import Generator
+
+    mode = _KIND_MODE.get(finding.kind)
+    if mode is None or finding.query is None:
+        raise ValueError(f"finding kind {finding.kind!r} is not "
+                         f"reducible")
+    # regenerate the scenario deterministically from the seed
+    scenarios = {s.name: s for s in Generator(finding.seed).scenarios()}
+    sc = scenarios.get(finding.scenario)
+    if sc is None:
+        raise ValueError("finding does not name a known scenario")
+    pair = finding.pair if mode == "pair" else mode
+    if mode == "pair" and pair.startswith("mview"):
+        pair = "mview"
+    if mode == "pair" and pair not in R.PAIR_ENV:
+        pair = "fusion"
+
+    def still_fails(c: Case) -> bool:
+        sc2, q2 = c.replay_args()
+        rows_sql = ",".join(sc2.render_row(r) for r in c.rows)
+        out = moqa.replay(
+            create=sc2.create_sql(),
+            insert=f"insert into {sc2.table} values {rows_sql}",
+            query=q2.sql(), pair=c.pair,
+            setup=tuple(sc2.setup_sql),
+            ordered=q2.has("ordered"),
+            partition=c.partition)
+        return bool(out)
+
+    case = Case(sc, list(sc.rows), finding.query, pair,
+                partition=finding.partition)
+    if not still_fails(case):
+        raise ValueError("case does not reproduce in isolation")
+    case = reduce_case(case, still_fails)
+    return render_repro(case, finding.kind, finding.seed)

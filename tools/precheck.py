@@ -1,9 +1,11 @@
 """`python -m tools.precheck` — the repo's one-shot static gate:
 molint (invariant checkers, tools/molint/) + bench_guard (scoreboard
-regression floors, tools/bench_guard.py), plus an opt-in `--san-smoke`
-stage that runs the mosan concurrency stress drill armed (tools/mosan,
-<30s).  This is what CI and the tier-1 suite run; see README "Static
-analysis" and "Concurrency sanitizer".
+regression floors, tools/bench_guard.py), plus opt-in smoke stages:
+`--san-smoke` runs the mosan concurrency stress drill armed
+(tools/mosan, <30s) and `--qa-smoke` runs a small moqa differential
+corpus + a planted-bug drill (tools/moqa, <30s).  This is what CI and
+the tier-1 suite run; see README "Static analysis", "Concurrency
+sanitizer" and "Differential testing".
 
 Exit 0 = all gates green; 1 = findings/regressions (details printed).
 """
@@ -26,6 +28,10 @@ def main(argv=None) -> int:
                     help="also run the mosan stress drill armed "
                          "(writers vs cached readers + the planted "
                          "eviction-race regression; <30s)")
+    ap.add_argument("--qa-smoke", action="store_true",
+                    help="also run the moqa differential smoke (small "
+                         "seeded corpus across the config lattice + "
+                         "the planted pad-leak drill; <30s)")
     args = ap.parse_args(argv)
 
     from tools import bench_guard, molint
@@ -74,6 +80,25 @@ def main(argv=None) -> int:
             print("san-smoke: planted eviction race caught ok")
         else:
             print("san-smoke: planted eviction race NOT caught",
+                  file=sys.stderr)
+            rc = 1
+
+    if args.qa_smoke:
+        from tools import moqa
+        rep = moqa.run_smoke()
+        for line in rep["findings_formatted"]:
+            print(line)
+        if rep["findings"]:
+            print("qa-smoke: FINDINGS", file=sys.stderr)
+            rc = 1
+        else:
+            print(f"qa-smoke: corpus clean ({rep['queries']} queries, "
+                  f"{rep['total_checks']} checks, "
+                  f"{rep['seconds']}s)")
+        if rep["plant_caught"]:
+            print("qa-smoke: planted pad-leak caught ok")
+        else:
+            print("qa-smoke: planted pad-leak NOT caught",
                   file=sys.stderr)
             rc = 1
     return rc
