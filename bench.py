@@ -171,6 +171,32 @@ def bench_q1(n: int = None) -> dict:
             os.environ.pop("MO_PLAN_FUSION", None)
         else:
             os.environ["MO_PLAN_FUSION"] = fusion_was
+    # ---- MO_TRACE_PROFILE=1: one diagnostic rep with motrace armed —
+    # the fused run's full span tree (statement -> fusion.compile /
+    # fusion.dispatch / txn spans) lands as a Perfetto-loadable Chrome
+    # trace artifact next to the JSON line
+    trace_artifact = None
+    trace_spans = 0
+    if os.environ.get("MO_TRACE_PROFILE") == "1":
+        import tempfile as _tf
+        from matrixone_tpu.utils import motrace
+        was_armed, was_sample = (motrace.TRACER.armed,
+                                 motrace.TRACER.sample)
+        motrace.TRACER.arm(sample=1.0)
+        motrace.TRACER.clear()
+        try:
+            s.execute(tpch.Q1_SQL)
+            tids = motrace.TRACER.trace_ids()
+            if tids:
+                trace_spans = len(motrace.TRACER.spans_of(tids[-1]))
+            paths = motrace.dump(_tf.mkdtemp(prefix="mo_q1_trace_"))
+            trace_artifact = paths[-1] if paths else None
+        finally:
+            # restore BOTH armed and sample: an MO_TRACE=1 run at 1%
+            # sampling must not leave later families tracing at 100%
+            motrace.TRACER.armed = was_armed
+            motrace.TRACER.sample = was_sample
+            motrace.TRACER.clear()
     cache = blockcache.CACHE.stats()
     # roofline-style evidence for the scan+agg path: Q1 touches 7
     # columns (l_quantity/extendedprice/discount/tax as decimal64,
@@ -242,6 +268,8 @@ def bench_q1(n: int = None) -> dict:
         "backend": jax.default_backend(),
         "scan_gbps": round(q1_bytes * best / n / 1e9, 2),
         "hbm_util": (round(q1_bytes * best / n / pb, 4) if pb else None),
+        **({"trace_artifact": trace_artifact,
+            "trace_spans": trace_spans} if trace_artifact else {}),
     }
 
 
@@ -395,6 +423,7 @@ def bench_serving(s, n: int) -> dict:
         h0 = M.result_cache_ops.get(outcome="hit")
         m0 = (M.result_cache_ops.get(outcome="miss")
               + M.result_cache_ops.get(outcome="stale"))
+        q_before = M.query_seconds.snapshot()
         t0 = time.time()
         for _ in range(n_rounds):
             one_pass()
@@ -402,6 +431,15 @@ def bench_serving(s, n: int) -> dict:
         rh = M.result_cache_ops.get(outcome="hit") - h0
         rm = (M.result_cache_ops.get(outcome="miss")
               + M.result_cache_ops.get(outcome="stale") - m0)
+        # statement-latency percentiles of the WARM loop only, via the
+        # registry's public snapshot delta API (utils/metrics.py) —
+        # never by poking histogram internals, and never polluted by
+        # the process's earlier Q1/load history (same delta discipline
+        # as the h0/m0 cache counters above)
+        q_after = M.query_seconds.snapshot()
+        p50 = M.histogram_delta_quantile(q_before, q_after, 0.50)
+        p99 = M.histogram_delta_quantile(q_before, q_after, 0.99)
+        q_count = q_after["count"] - q_before["count"]
     finally:
         # restore the caller's configuration even when a pass raises (a
         # deployment-enabled result cache must survive the bench)
@@ -418,6 +456,9 @@ def bench_serving(s, n: int) -> dict:
         "warm_over_cold": round(warm_qps / cold_qps, 1) if cold_qps else None,
         "result_cache_hit_rate": round(rh / (rh + rm), 4) if rh + rm else 0,
         "plan_cache_hit_rate": round(ph / (ph + pm), 4) if ph + pm else 0,
+        "query_p50_s": p50,
+        "query_p99_s": p99,
+        "query_observations": int(q_count),
         "statements": int((3 * n_rounds + 4) * stmts_per_pass),
         "rows": n,
         "backend": jax.default_backend(),

@@ -339,6 +339,11 @@ class RemoteCatalog:
 
     def close(self) -> None:
         self._closed.set()
+        # flush the replica-hosted statement recorder's buffered tail
+        # (sessions hang it off the replica engine; see utils/trace.py)
+        rep_close = getattr(self._replica, "close", None)
+        if rep_close is not None:
+            rep_close()
         self.consumer.stop()
         pool = getattr(self, "_frag_pool", None)
         if pool is not None:

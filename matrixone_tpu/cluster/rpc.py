@@ -47,7 +47,7 @@ from typing import Dict, List, Optional, Tuple
 
 from matrixone_tpu.storage.engine import (ConflictError, ConstraintError,
                                           DuplicateKeyError)
-from matrixone_tpu.utils import metrics as M
+from matrixone_tpu.utils import metrics as M, motrace
 from matrixone_tpu.utils.fault import INJECTOR
 
 
@@ -429,10 +429,16 @@ class RpcClient:
             M.rpc_attempts.inc(op=op)
             t0 = time.perf_counter()
             try:
-                out = self._attempt(header, blob, dl)
+                with motrace.span("rpc.call", op=op,
+                                  peer=self.breaker.peer,
+                                  attempt=attempt):
+                    out = self._attempt(header, blob, dl)
                 if on:
                     self.breaker.record_success()
                 M.rpc_seconds.observe(time.perf_counter() - t0)
+                # spans the server shipped back on the response header
+                # fold into the caller's trace (utils/motrace.py)
+                motrace.merge_remote(out[0])
                 return out
             except DeadlineExceeded:
                 M.rpc_errors.inc(kind="deadline", op=op)
@@ -476,6 +482,9 @@ class RpcClient:
             s.settimeout(max(0.001, min(self.timeout, dl.remaining())))
             wire = dict(header)
             wire["deadline_ms"] = int(max(1.0, dl.remaining() * 1000))
+            # trace context rides the SAME wire header as the deadline
+            # (one attribute read when motrace is disarmed)
+            motrace.inject(wire)
             fault = INJECTOR.trigger("rpc.send")
             if fault == "drop":
                 raise ConnectionError(

@@ -144,6 +144,7 @@ class LogtailHub:
 
 from matrixone_tpu.cluster.rpc import (RequestDedup, deadline_scope,
                                        err_name as _err_name, unpack_blobs)
+from matrixone_tpu.utils import motrace
 
 
 class TNService:
@@ -224,15 +225,23 @@ class TNService:
                         resp, rblob = dict(ent[0], dedup=True), ent[1]
                         _send_msg(conn, resp, rblob)
                         continue
+                # re-enter the caller's trace context from the same
+                # wire header that carries deadline_ms; the TN's spans
+                # ship back to the CN on the response (rs.attach)
+                rs = motrace.remote_session(header, proc="tn",
+                                            name=f"tn.{op}")
                 try:
                     # re-enter the caller's remaining time budget so
                     # nested calls (quorum WAL appends) inherit it
                     with deadline_scope(
                             ms=header.get("deadline_ms") or 30_000):
-                        resp, rblob = self._dispatch(op, header, blob)
+                        with rs:
+                            resp, rblob = self._dispatch(op, header,
+                                                         blob)
                 except Exception as e:        # noqa: BLE001
                     resp, rblob = {"ok": False, "err": str(e),
                                    "etype": _err_name(e)}, b""
+                rs.attach(resp)
                 if rid:
                     # record (and wake waiting duplicates) BEFORE the
                     # send: a disconnect between our apply and the
@@ -487,6 +496,7 @@ def main() -> None:
                                                        timeout=120.0)
         else:
             wal = ReplicatedLog(addrs)
+    motrace.TRACER.proc = "tn"
     tn = TNService(data_dir=args.dir, port=args.port, wal=wal)
     if args.keeper:
         from matrixone_tpu.cluster.rpc import parse_addr

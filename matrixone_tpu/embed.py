@@ -99,6 +99,9 @@ class Cluster:
     def close(self, cleanup: bool = False):
         for s in self.sessions:
             s.close()
+        # flush the statement recorder's buffered tail before teardown
+        # (utils/trace.py buffers flush_every records)
+        self.engine.close()
         for a in self._ha_agents:
             a.stop()
         if self.hakeeper is not None:

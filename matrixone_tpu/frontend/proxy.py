@@ -374,7 +374,14 @@ class SessionProxy(MOProxy):
                     if moved is None:
                         raise
                     from matrixone_tpu.utils import metrics as _M
+                    from matrixone_tpu.utils import motrace as _mt
                     _M.proxy_failovers.inc()
+                    # MySQL wire carries no trace ctx, so the failover
+                    # records as its own head-sampled marker trace in
+                    # the proxy lane (utils/motrace.py)
+                    _new_be = f"{moved[0].host}:{moved[0].port}"
+                    _mt.instant("proxy.failover", proc="proxy",
+                                backend=_new_be)
                     self._swap_upstream(cur, moved)
 
     #: statement prefixes whose re-execution is side-effect free

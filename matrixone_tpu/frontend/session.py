@@ -121,8 +121,19 @@ class Session:
 
     # ------------------------------------------------------------ execute
     def execute(self, sql: str, params: Optional[list] = None) -> Result:
+        from matrixone_tpu.utils import motrace
+        # the statement is the trace boundary: parse, cache lookups,
+        # admission wait, fragment compile/dispatch, RPC hops, worker
+        # offload and TN commit all become children of this root span
+        # (re-entrant executes nest as child spans, not new traces)
+        with motrace.statement_span(sql):
+            return self._execute_traced(sql, params)
+
+    def _execute_traced(self, sql: str,
+                        params: Optional[list] = None) -> Result:
         import time as _time
         from matrixone_tpu.utils import metrics as M
+        from matrixone_tpu.utils import motrace
         from matrixone_tpu.utils.trace import STMT_TABLE, StatementRecorder
         # statement tracing is engine-global (one system table), never
         # tenant-scoped — always hang it off the TRUE engine: unwrap the
@@ -152,7 +163,8 @@ class Session:
                 sv.template_mode = False
                 if not sv.result_enabled():
                     sv = None
-            stmts = parse(sql)
+            with motrace.span("parse"):
+                stmts = parse(sql)
             if params is not None:
                 stmts = [_substitute_params(st, params) for st in stmts]
         _tok = _CURRENT_SESSION.set(self)
@@ -192,8 +204,14 @@ class Session:
         import time as _time
         from matrixone_tpu.serving import serving_for
         from matrixone_tpu.utils import metrics as M
+        from matrixone_tpu.utils import motrace
         adm = serving_for(self.catalog).admission
         results = []
+        # per-statement span attribution in a multi-statement batch:
+        # the shared statement-root trace is one ring sequence; each
+        # statement records only the spans past the previous mark (the
+        # first statement's window starts at 0 so it owns `parse`)
+        tr_mark = 0
         for st in stmts:
             if self._procs.is_terminated(self.conn_id):
                 from matrixone_tpu.queryservice import QueryKilled
@@ -206,24 +224,31 @@ class Session:
             self._exec_ann = ann
             ticket = None
             try:
-                if adm.enabled and self._admission_gated(st):
-                    lane = ("background" if str(self.variables.get(
-                        "query_priority", "")).lower() == "background"
-                        else "interactive")
-                    ticket = adm.acquire(account=self._acct(), lane=lane,
-                                         conn_id=self.conn_id,
-                                         registry=self._procs)
-                    self._admission_depth += 1
-                    ann["queue_wait_ms"] = int(
-                        ticket.queue_wait_s * 1000)
-                r = self._execute_stmt(st, serving)
+                with motrace.span("run", stmt=type(st).__name__):
+                    if adm.enabled and self._admission_gated(st):
+                        lane = ("background" if str(self.variables.get(
+                            "query_priority", "")).lower() == "background"
+                            else "interactive")
+                        ticket = adm.acquire(account=self._acct(),
+                                             lane=lane,
+                                             conn_id=self.conn_id,
+                                             registry=self._procs)
+                        self._admission_depth += 1
+                        ann["queue_wait_ms"] = int(
+                            ticket.queue_wait_s * 1000)
+                    r = self._execute_stmt(st, serving)
+                    motrace.annotate(cache_hit=ann["cache_hit"])
             except Exception as e:   # noqa: BLE001 — recorded, re-raised
                 dt_ = _time.perf_counter() - t0
                 M.query_seconds.observe(dt_)
+                tr_id, n_sp, summ, tree = motrace.statement_record(
+                    dt_ * 1000.0, since=tr_mark)
                 self.catalog.stmt_recorder.record(
                     sql, "error", dt_, 0, error=str(e)[:1024],
                     cache_hit=ann["cache_hit"],
-                    queue_wait_ms=ann["queue_wait_ms"])
+                    queue_wait_ms=ann["queue_wait_ms"],
+                    trace_id=tr_id, span_count=n_sp,
+                    span_summary=summ, span_tree=tree)
                 raise
             finally:
                 if ticket is not None:
@@ -233,9 +258,16 @@ class Session:
             dt_ = _time.perf_counter() - t0
             M.query_seconds.observe(dt_)
             rows_out = len(r.batch) if r.batch is not None else r.affected
+            # slow-query hook: past MO_TRACE_SLOW_MS the FULL span tree
+            # persists into the statement table (motrace.statement_record)
+            tr_id, n_sp, summ, tree = motrace.statement_record(
+                dt_ * 1000.0, since=tr_mark)
+            tr_mark += n_sp
             self.catalog.stmt_recorder.record(
                 sql, "ok", dt_, rows_out, cache_hit=ann["cache_hit"],
-                queue_wait_ms=ann["queue_wait_ms"])
+                queue_wait_ms=ann["queue_wait_ms"],
+                trace_id=tr_id, span_count=n_sp, span_summary=summ,
+                span_tree=tree)
             results.append(r)
         return results[-1] if results else Result()
 
@@ -563,6 +595,21 @@ class Session:
         if isinstance(stmt, ast.DropSnapshot):
             self.catalog.drop_snapshot(stmt.name)
             return Result()
+        if isinstance(stmt, ast.ShowTrace):
+            # recent traces from the motrace ring, oldest first
+            from matrixone_tpu.utils import motrace
+            ts = motrace.TRACER.traces()
+            b = Batch.from_pydict(
+                {"TraceId": [t["trace_id"] for t in ts],
+                 "Root": [t["root"] for t in ts],
+                 "Procs": [t["procs"] for t in ts],
+                 "Spans": [t["spans"] for t in ts],
+                 "StartUs": [t["ts_us"] for t in ts],
+                 "DurationMs": [t["dur_ms"] for t in ts]},
+                {"TraceId": dt.VARCHAR, "Root": dt.VARCHAR,
+                 "Procs": dt.VARCHAR, "Spans": dt.INT64,
+                 "StartUs": dt.INT64, "DurationMs": dt.FLOAT64})
+            return Result(batch=b)
         if isinstance(stmt, ast.ShowSnapshots):
             names = sorted(self.catalog.snapshots)
             b = Batch.from_pydict(
@@ -1052,6 +1099,59 @@ class Session:
             else:
                 raise BindError(f"unknown mview subcommand {arg!r}; "
                                 "use status | refresh:<view>")
+        elif cmd == "trace":
+            # distributed-tracing ops surface (utils/motrace.py):
+            # status | on | off | clear | sample:<f> | slow:<ms> |
+            # dump:<path> — mirrors the mo_ctl('fault'|'san') pattern
+            import json as _json
+            from matrixone_tpu.utils import motrace as _mt
+            if arg in ("", "status"):
+                out = _json.dumps(_mt.TRACER.status(), sort_keys=True)
+            elif arg == "on":
+                _mt.TRACER.arm()
+                out = "trace armed"
+            elif arg == "off":
+                _mt.TRACER.disarm()
+                out = "trace disarmed"
+            elif arg == "clear":
+                _mt.TRACER.clear()
+                out = "trace ring cleared"
+            elif arg.startswith("sample:"):
+                try:
+                    _mt.TRACER.sample = float(arg.split(":", 1)[1])
+                except ValueError:
+                    raise BindError(f"bad sample fraction in {arg!r}")
+                out = f"trace sample = {_mt.TRACER.sample}"
+            elif arg.startswith("slow:"):
+                try:
+                    _mt.TRACER.slow_ms = float(arg.split(":", 1)[1])
+                except ValueError:
+                    raise BindError(f"bad slow threshold in {arg!r}")
+                out = f"trace slow_ms = {_mt.TRACER.slow_ms}"
+            elif arg.startswith("dump:"):
+                paths = _mt.dump(arg.split(":", 1)[1])
+                out = (f"dumped {len(paths)} trace(s) -> "
+                       + (paths[0].rsplit('/', 1)[0] if paths
+                          else "nothing to dump"))
+            else:
+                raise BindError(
+                    f"unknown trace subcommand {arg!r}; use status | "
+                    f"on | off | clear | sample:<f> | slow:<ms> | "
+                    f"dump:<path>")
+        elif cmd == "metrics":
+            # scrape surface: the full registry in Prometheus text
+            # exposition format (also served by `python -m
+            # tools.moscrape`); 'snapshot' returns the structured dict
+            import json as _json
+            from matrixone_tpu.utils import metrics as _m
+            if arg in ("", "dump"):
+                out = _m.REGISTRY.render()
+            elif arg == "snapshot":
+                out = _json.dumps(_m.REGISTRY.snapshot(),
+                                  sort_keys=True)
+            else:
+                raise BindError(f"unknown metrics subcommand {arg!r}; "
+                                "use dump | snapshot")
         elif cmd == "rpc":
             # per-peer circuit breaker state + the CN's logtail breaker
             import json as _json
@@ -1125,17 +1225,19 @@ class Session:
                     and ann["cache_hit"] == "none":
                 ann["cache_hit"] = "plan"
         if node is None:
+            from matrixone_tpu.utils import motrace
             if lazy:
                 # instantiate the template only now: a plan-cache hit
                 # above never pays the AST deepcopy at all
                 sel = sv.instantiate(raise_errors=True)
-            self._prepare_select(sel)
-            node = Binder(self.catalog).bind_statement(sel)
-            node = self._cbo(node)
-            node = apply_indices(
-                node, self.catalog,
-                nprobe=int(self.variables.get("ivf_nprobe", 8)),
-                skip_tables=self._index_skip_tables())
+            with motrace.span("plan"):
+                self._prepare_select(sel)
+                node = Binder(self.catalog).bind_statement(sel)
+                node = self._cbo(node)
+                node = apply_indices(
+                    node, self.catalog,
+                    nprobe=int(self.variables.get("ivf_nprobe", 8)),
+                    skip_tables=self._index_skip_tables())
             if sv is not None and sv.template_mode \
                     and sv.plan_enabled() and plan_missed:
                 # store under the gens captured at LOOKUP time: a DDL

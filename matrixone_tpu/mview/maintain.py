@@ -341,9 +341,16 @@ class MViewService:
         funnel order): merge deltas, recompute MIN/MAX-retracted groups,
         rewrite the changed backing rows, advance the watermark."""
         from matrixone_tpu.utils import metrics as M
+        from matrixone_tpu.utils import motrace
         if rt.groups is None or ts <= rt.watermark:
             return
         t0 = time.perf_counter()
+        with motrace.span("mview.apply", view=rt.name,
+                          events=len(run)):
+            self._apply_run_traced(rt, ts, run, t0, M)
+
+    def _apply_run_traced(self, rt: ViewRuntime, ts: int,
+                          run: List[tuple], t0: float, M) -> None:
         touched: set = set()
         recompute: set = set()
         for _ts, _table, kind, payload in run:

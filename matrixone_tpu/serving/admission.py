@@ -193,31 +193,34 @@ class AdmissionController:
                 sum(len(q) for q in self._queues.values()))
             if registry is not None and conn_id is not None:
                 registry.set_queued(conn_id, True)
+            from matrixone_tpu.utils import motrace
             self._dispatch()     # may admit immediately (e.g. the only
             deadline = time.monotonic() + budget_s   # blockers are
             try:                                     # quota-blocked)
-                while not w.admitted:
-                    if registry is not None and conn_id is not None:
-                        try:
-                            registry.check_killed(conn_id)
-                        except QueryKilled:
-                            # only a REAL kill counts as outcome=killed;
-                            # an internal registry error must surface
-                            # as itself, not skew the shed accounting
+                with motrace.span("admission.queue", lane=lane):
+                    while not w.admitted:
+                        if registry is not None and conn_id is not None:
+                            try:
+                                registry.check_killed(conn_id)
+                            except QueryKilled:
+                                # only a REAL kill counts as
+                                # outcome=killed; an internal registry
+                                # error must surface as itself, not
+                                # skew the shed accounting
+                                M.admission_total.inc(lane=lane,
+                                                      outcome="killed")
+                                raise
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
                             M.admission_total.inc(lane=lane,
-                                                  outcome="killed")
-                            raise
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        M.admission_total.inc(lane=lane,
-                                              outcome="shed_timeout")
-                        raise AdmissionRejected(
-                            f"admission: no {lane} slot within "
-                            f"{budget_s * 1000:.0f} ms "
-                            f"({self.running}/{self.slots} running); "
-                            f"server busy, retry later")
-                    self._cv.wait(min(remaining, _SLICE_S))
-                    self._dispatch()
+                                                  outcome="shed_timeout")
+                            raise AdmissionRejected(
+                                f"admission: no {lane} slot within "
+                                f"{budget_s * 1000:.0f} ms "
+                                f"({self.running}/{self.slots} running); "
+                                f"server busy, retry later")
+                        self._cv.wait(min(remaining, _SLICE_S))
+                        self._dispatch()
             except BaseException:    # noqa: BLE001 — cleanup-only,
                 # re-raised below; incl. KeyboardInterrupt so an
                 # interrupted waiter never leaks its queue ticket.

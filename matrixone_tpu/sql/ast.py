@@ -309,6 +309,12 @@ class ShowSnapshots(Node):
 
 
 @dataclasses.dataclass
+class ShowTrace(Node):
+    """SHOW TRACE — recent motrace span trees (utils/motrace.py)."""
+    pass
+
+
+@dataclasses.dataclass
 class ShowAccounts(Node):
     pass
 

@@ -308,6 +308,9 @@ class Parser:
             return ast.ShowVariables(like)
         if self.accept_kw("snapshots"):
             return ast.ShowSnapshots()
+        if nxt0.kind == "ident" and nxt0.value.lower() == "trace":
+            self.next()
+            return ast.ShowTrace()
         if self.at_ident("accounts"):
             self.next()
             return ast.ShowAccounts()

@@ -1312,6 +1312,15 @@ class Engine:
             self.wal.append({"op": "merge_table", "name": nm, "ts": ts})
         self._pending_merge_records = {}
 
+    def close(self) -> None:
+        """Orderly shutdown hook: flush the statement recorder's tail
+        (flush_every buffering would otherwise silently drop the last
+        <64 statements of a session when the process exits).  Idempotent
+        and safe to call on an engine that never recorded anything."""
+        rec = getattr(self, "stmt_recorder", None)
+        if rec is not None:
+            rec.flush()
+
     @classmethod
     def open(cls, fs: FileService, wal=None) -> "Engine":
         """Restart path: load last checkpoint then replay the WAL tail

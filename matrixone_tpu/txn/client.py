@@ -107,6 +107,7 @@ class TxnHandle:
         return self._txn_id
 
     def commit(self) -> int:
+        from matrixone_tpu.utils import motrace
         assert self.state == TxnState.ACTIVE, "txn not active"
         inserts = {t: [(s.arrays, s.validity) for s in w.segments
                        if s.n_rows > 0]
@@ -114,8 +115,9 @@ class TxnHandle:
         deletes = {t: w.all_deletes() for t, w in self.workspace.items()
                    if w.delete_gids}
         try:
-            affected = self.engine.commit_txn(self.snapshot_ts, inserts,
-                                              deletes)
+            with motrace.span("txn.commit", tables=len(inserts)):
+                affected = self.engine.commit_txn(self.snapshot_ts,
+                                                  inserts, deletes)
         except Exception:   # noqa: BLE001 — abort/unlock cleanup for
             # ANY commit failure (conflict, constraint, transport,
             # injected fault); always re-raised

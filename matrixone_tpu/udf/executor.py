@@ -31,7 +31,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from matrixone_tpu.udf.sandbox import UdfError, compile_body
-from matrixone_tpu.utils import metrics as M
+from matrixone_tpu.utils import metrics as M, motrace
 
 #: sentinel: tracing this (body, sig) failed — row tier from now on
 _JIT_FAILED = object()
@@ -270,8 +270,13 @@ def eval_udf_call(e, ex):
                                 e.dtype)
         except BreakerOpen:
             M.udf_offload.inc(outcome="fallback_breaker")
+            # the degrade is part of the statement's story: a span
+            # event marks WHY this query ran local (utils/motrace.py)
+            motrace.event("udf.fallback", reason="breaker", udf=e.name)
         except TransportError:
             M.udf_offload.inc(outcome="fallback_transport")
+            motrace.event("udf.fallback", reason="transport",
+                          udf=e.name)
         # fall through: local evaluation serves the query
 
     entry = COMPILE_CACHE.entry(_cache_key(e), e.name, e.body,

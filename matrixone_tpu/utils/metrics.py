@@ -18,6 +18,16 @@ from matrixone_tpu.utils import san
 # would recurse into the tracker)
 
 
+def _escape_label(v) -> str:
+    """Prometheus text-format label value escaping (\\ " and newline)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 class Counter:
     def __init__(self, name: str, help_: str = ""):
         self.name = name
@@ -33,9 +43,32 @@ class Counter:
     def get(self, **labels) -> float:
         return self._values.get(tuple(sorted(labels.items())), 0.0)
 
+    kind = "counter"
 
-class Gauge:
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
+                 f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            snapshot = dict(self._values)
+        for key, v in sorted(snapshot.items()):
+            lbl = ",".join(f'{k}="{_escape_label(val)}"'
+                           for k, val in key)
+            lines.append(f"{self.name}{{{lbl}}} {v}" if lbl
+                         else f"{self.name} {v}")
+        return lines
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            snapshot = dict(self._values)
+        return {"type": self.kind, "help": self.help,
+                "values": [{"labels": dict(key), "value": v}
+                           for key, v in sorted(snapshot.items())]}
+
+
+class Gauge(Counter):
     """A value that can go up and down (breaker state, pool occupancy)."""
+
+    kind = "gauge"
 
     def __init__(self, name: str, help_: str = ""):
         self.name = name
@@ -90,6 +123,77 @@ class Histogram:
                 h.observe(time.perf_counter() - self.t0)
         return _Timer()
 
+    def render(self) -> List[str]:
+        """Prometheus text format: cumulative `_bucket` lines (each
+        bucket counts every observation <= le), `+Inf`, `_sum`,
+        `_count` — consistent under the lock so a scrape mid-observe
+        never shows count ahead of the buckets."""
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
+                 f"# TYPE {self.name} histogram"]
+        with self._lock:
+            counts = list(self.counts)
+            total, sum_ = self.total, self.sum
+        acc = 0
+        for b, c in zip(self._BUCKETS, counts):
+            acc += c
+            lines.append(f'{self.name}_bucket{{le="{b}"}} {acc}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
+        lines.append(f"{self.name}_sum {sum_}")
+        lines.append(f"{self.name}_count {total}")
+        return lines
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = list(self.counts)
+            total, sum_ = self.total, self.sum
+        return {"type": "histogram", "help": self.help,
+                "sum": sum_, "count": total,
+                "buckets": [{"le": b, "count": c}
+                            for b, c in zip(self._BUCKETS, counts)]
+                           + [{"le": "+Inf", "count": counts[-1]}]}
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from the bucket histogram (upper bound
+        of the bucket holding the q-th observation) — the public read
+        path for p50/p99 reporting (bench.py), replacing direct pokes
+        at `counts`/`sum`."""
+        with self._lock:
+            counts = list(self.counts)
+            total = self.total
+        if total <= 0:
+            return 0.0
+        target = q * total
+        acc = 0
+        for b, c in zip(self._BUCKETS, counts):
+            acc += c
+            if acc >= target:
+                return b
+        return float(self._BUCKETS[-1])
+
+
+def histogram_delta_quantile(before: dict, after: dict,
+                             q: float) -> float:
+    """Approximate quantile of the observations made BETWEEN two
+    Histogram.snapshot() captures (bucket-count difference), so a
+    bench phase can report its own p50/p99 without the process-global
+    histogram's earlier history polluting the number."""
+    diffs = []
+    b_by_le = {b["le"]: b["count"] for b in before["buckets"]}
+    for b in after["buckets"]:
+        if b["le"] == "+Inf":
+            continue
+        diffs.append((b["le"], b["count"] - b_by_le.get(b["le"], 0)))
+    total = after["count"] - before["count"]
+    if total <= 0:
+        return 0.0
+    target = q * total
+    acc = 0
+    for le, c in diffs:
+        acc += c
+        if acc >= target:
+            return le
+    return float(diffs[-1][0]) if diffs else 0.0
+
 
 class Registry:
     def __init__(self):
@@ -118,29 +222,30 @@ class Registry:
                 self._metrics[name] = Gauge(name, help_)
             return self._metrics[name]
 
-    def expose(self) -> str:
-        """Prometheus text exposition format."""
+    def render(self) -> str:
+        """Prometheus text exposition format (the scrape surface:
+        `mo_ctl('metrics','dump')` and `python -m tools.moscrape`).
+        Every family carries # HELP/# TYPE; histograms emit cumulative
+        `_bucket`/`_sum`/`_count`; label values are escaped — output
+        parses with a standard Prometheus client."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
         lines: List[str] = []
-        for name, m in sorted(self._metrics.items()):
-            if isinstance(m, (Counter, Gauge)):
-                kind = "counter" if isinstance(m, Counter) else "gauge"
-                lines.append(f"# TYPE {name} {kind}")
-                with m._lock:
-                    snapshot = dict(m._values)
-                for key, v in snapshot.items():
-                    lbl = ",".join(f'{k}="{val}"' for k, val in key)
-                    lines.append(f"{name}{{{lbl}}} {v}" if lbl
-                                 else f"{name} {v}")
-            elif isinstance(m, Histogram):
-                lines.append(f"# TYPE {name} histogram")
-                acc = 0
-                for b, c in zip(m._BUCKETS, m.counts):
-                    acc += c
-                    lines.append(f'{name}_bucket{{le="{b}"}} {acc}')
-                lines.append(f'{name}_bucket{{le="+Inf"}} {m.total}')
-                lines.append(f"{name}_sum {m.sum}")
-                lines.append(f"{name}_count {m.total}")
+        for _name, m in metrics:
+            lines.extend(m.render())
         return "\n".join(lines) + "\n"
+
+    def expose(self) -> str:
+        """Back-compat alias for render()."""
+        return self.render()
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Structured point-in-time view of every metric — the public
+        programmatic read API (bench.py, dashboards) so callers never
+        poke `_values`/`counts` internals."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        return {name: m.snapshot() for name, m in metrics}
 
 
 #: process-global registry (reference: metric/v2 package-level vars)
@@ -313,6 +418,19 @@ qa_findings = REGISTRY.counter(
     "mo_qa_findings_total",
     "moqa findings by kind (lockstep-mismatch/oracle failures/"
     "canary-in-result/canary-in-carry/error)")
+
+# ---- distributed tracing plane (utils/motrace.py, tools/moscrape)
+trace_spans = REGISTRY.counter(
+    "mo_trace_spans_total",
+    "completed motrace spans landed in this process's ring, by the "
+    "span's origin process (remote-session spans count once, at the "
+    "trace-owning process that merges them)")
+trace_traces = REGISTRY.counter(
+    "mo_trace_traces_total",
+    "root-span head-sampling decisions (sampled/unsampled)")
+trace_ring_dropped = REGISTRY.counter(
+    "mo_trace_ring_dropped_total",
+    "spans evicted from the bounded trace ring (raise MO_TRACE_RING)")
 
 # ---- runtime concurrency sanitizer (utils/san.py, tools/mosan)
 san_findings = REGISTRY.counter(

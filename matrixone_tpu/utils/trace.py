@@ -36,6 +36,15 @@ _SCHEMA = [
     # queue_wait_ms is true execute time
     ("cache_hit", dt.varchar(8)),
     ("queue_wait_ms", dt.INT64),
+    # motrace span forensics (utils/motrace.py): the statement's trace
+    # id, how many spans closed under it, per-layer milliseconds as
+    # JSON ({"parse": .., "rpc.call": .., ...}), and — for statements
+    # over MO_TRACE_SLOW_MS — the FULL span tree, so a slow query's
+    # breakdown survives in the system table after the ring rotates
+    ("trace_id", dt.varchar(32)),
+    ("span_count", dt.INT64),
+    ("span_summary", dt.TEXT),
+    ("span_tree", dt.TEXT),
 ]
 
 
@@ -57,10 +66,10 @@ class StatementRecorder:
         if STMT_TABLE in self.engine.tables:
             have = [c for c, _ in
                     self.engine.tables[STMT_TABLE].meta.schema]
-            if "cache_hit" not in have:
-                # pre-serving data dir: trace rows are observability
-                # data — recreate with the widened schema rather than
-                # fail every flush
+            if "cache_hit" not in have or "trace_id" not in have:
+                # pre-serving / pre-motrace data dir: trace rows are
+                # observability data — recreate with the widened schema
+                # rather than fail every flush
                 self.engine.drop_table(STMT_TABLE, if_exists=True,
                                        log=False)
         if STMT_TABLE not in self.engine.tables:
@@ -70,12 +79,15 @@ class StatementRecorder:
 
     def record(self, statement: str, status: str, duration_s: float,
                rows_out: int, error: Optional[str] = None,
-               cache_hit: str = "none", queue_wait_ms: int = 0):
+               cache_hit: str = "none", queue_wait_ms: int = 0,
+               trace_id: str = "", span_count: int = 0,
+               span_summary: str = "", span_tree: str = ""):
         with self._lock:
             rec = (self._next_id, statement[:4096], status,
                    int(duration_s * 1e6), rows_out, error or "",
                    time.time_ns() // 1000, cache_hit,
-                   int(queue_wait_ms))
+                   int(queue_wait_ms), trace_id, int(span_count),
+                   span_summary, span_tree)
             self._next_id += 1
             self._buf.append(rec)
             need_flush = len(self._buf) >= self.flush_every
@@ -97,12 +109,18 @@ class StatementRecorder:
             "rows_out": np.asarray(cols[4], np.int64),
             "ts": np.asarray(cols[6], np.int64),
             "queue_wait_ms": np.asarray(cols[8], np.int64),
+            "span_count": np.asarray(cols[10], np.int64),
         }
         strings = {
             "statement": t.encode_strings_list("statement", list(cols[1])),
             "status": t.encode_strings_list("status", list(cols[2])),
             "error": t.encode_strings_list("error", list(cols[5])),
             "cache_hit": t.encode_strings_list("cache_hit", list(cols[7])),
+            "trace_id": t.encode_strings_list("trace_id", list(cols[9])),
+            "span_summary": t.encode_strings_list("span_summary",
+                                                  list(cols[11])),
+            "span_tree": t.encode_strings_list("span_tree",
+                                               list(cols[12])),
         }
         arrays.update(strings)
         validity = {c: np.ones(len(buf), np.bool_) for c in arrays}

@@ -1049,9 +1049,11 @@ class FusedFragmentOp(O.Operator):
                 if compiled is None:
                     t0 = time.perf_counter()
                     try:
+                        from matrixone_tpu.utils import motrace
                         _fragment_step = fn
-                        compiled = jax.jit(_fragment_step).lower(
-                            *args).compile()
+                        with motrace.span("fusion.compile", slot=slot):
+                            compiled = jax.jit(_fragment_step).lower(
+                                *args).compile()
                     except Exception:   # noqa: BLE001 — whatever the
                         # tracer rejected, the eager path below computes
                         # the identical result (and surfaces identical
@@ -1073,14 +1075,21 @@ class FusedFragmentOp(O.Operator):
                         M.fusion_step_seconds.inc(
                             time.perf_counter() - t_host0, kind="host")
                         t_dev0 = time.perf_counter()
-                    out = entry["compiled"][slot](*args)
-                    M.fusion_dispatch.inc(kind="step")
-                    self.last_stats["dispatches"] += 1
-                    if profile:
-                        san.check_blocking("device.sync")
-                        jax.block_until_ready(out)
-                        M.fusion_step_seconds.inc(
-                            time.perf_counter() - t_dev0, kind="device")
+                    from matrixone_tpu.utils import motrace
+                    # span covers dispatch (+ the profile-mode device
+                    # sync, so armed-profile runs attribute TRUE device
+                    # time to the span instead of async-dispatch time)
+                    with motrace.span("fusion.dispatch", slot=slot,
+                                      profiled=profile):
+                        out = entry["compiled"][slot](*args)
+                        M.fusion_dispatch.inc(kind="step")
+                        self.last_stats["dispatches"] += 1
+                        if profile:
+                            san.check_blocking("device.sync")
+                            jax.block_until_ready(out)
+                            M.fusion_step_seconds.inc(
+                                time.perf_counter() - t_dev0,
+                                kind="device")
             if out is None:
                 # eager evaluation of the SAME step function — identical
                 # math, per-op dispatch (the pre-fusion cost model)

@@ -14,9 +14,11 @@ from matrixone_tpu.worker.server import TpuWorkerServer
 
 
 def main() -> None:
+    from matrixone_tpu.utils import motrace
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, default=0)
     args = ap.parse_args()
+    motrace.TRACER.proc = "worker"
     srv = TpuWorkerServer(port=args.port).start()
     print(f"PORT {srv.port}", flush=True)
     sys.stdout.flush()

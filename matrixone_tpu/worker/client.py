@@ -31,17 +31,28 @@ class WorkerClient:
         side-effect free), so transport-level failures (UNAVAILABLE —
         worker restarting, connection reset) retry with the fabric's
         jittered backoff; worker-side errors never do."""
+        from matrixone_tpu.utils import motrace
+        op = str(header.get("op", ""))
+        # the span opens BEFORE injection so the worker-side span
+        # parents under worker.run, then trace ctx rides the request
+        # header like deadline_ms does (one pack; retries re-send as-is)
+        with motrace.span("worker.run", op=op):
+            motrace.inject(header)
+            return self._run_attempts(header, blob, op)
+
+    def _run_attempts(self, header: dict, blob: bytes,
+                      op: str) -> Tuple[dict, bytes]:
         import time as _time
 
         import grpc
 
         from matrixone_tpu.cluster import rpc as _rpc
         from matrixone_tpu.utils import metrics as M
+        from matrixone_tpu.utils import motrace
         from matrixone_tpu.utils import san
         san.check_blocking("worker.run")
         attempts = max(1, _rpc.RETRIES) if _rpc.resilience_enabled() \
             else 1
-        op = str(header.get("op", ""))
         payload = pack(header, blob)     # once: retries re-send as-is
         dl = _rpc.current_deadline()
         for attempt in range(attempts):
@@ -89,6 +100,9 @@ class WorkerClient:
                 raise RuntimeError(
                     f"worker {self.address}: {code}") from e
         h, b = unpack(resp)
+        # worker-side spans ride the response header home — merged even
+        # on an error frame (the failed server span is evidence too)
+        motrace.merge_remote(h)
         if "error" in h:
             raise RuntimeError(f"worker: {h['error']}")
         return h, b

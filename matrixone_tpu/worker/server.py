@@ -515,13 +515,29 @@ class TpuWorkerServer:
         self.core = WorkerCore()
 
         def run_handler(request: bytes, context):
+            from matrixone_tpu.utils import motrace
             header, blob = unpack(request)
+            # gRPC handler threads inherit no context: re-enter the
+            # caller's trace from the request header (motrace), same
+            # contract as deadline_ms re-entry in run_stage
+            rs = motrace.remote_session(
+                header, proc="worker",
+                name=f"worker.{header.get('op', '?')}")
             try:
-                return self.core.run_stage(header, blob)
+                with rs:
+                    out = self.core.run_stage(header, blob)
             except Exception as e:   # noqa: BLE001 — service boundary:
                 # every failure becomes a typed error frame the client
                 # re-raises; swallowing here would hang the caller
-                return pack({"error": f"{type(e).__name__}: {e}"})
+                out = pack({"error": f"{type(e).__name__}: {e}"})
+            spans = rs.harvest()
+            if spans:
+                # ship the worker-side spans back on the response
+                # header (one unpack/repack, only on sampled traces)
+                h, b = unpack(out)
+                h["trace_spans"] = spans
+                out = pack(h, b)
+            return out
 
         def health_handler(request: bytes, context):
             return pack(self.core.health())

@@ -1,0 +1,23 @@
+"""span-hygiene fixture: spans opened outside `with`, out-of-fabric
+injection, hand-built trace wire keys.  AST-only."""
+
+from matrixone_tpu.utils import motrace
+
+
+def leaky(work):
+    sp = motrace.span("leaky")           # opened outside `with`
+    sp.__enter__()
+    try:
+        return work()
+    finally:
+        sp.__exit__(None, None, None)
+
+
+def forked_propagation(client, header):
+    motrace.inject(header)               # injection outside the fabric
+    return client.call(header)
+
+
+def clobbered(client):
+    # hand-built "trace" key ships a stale/foreign context
+    return client.call({"op": "ping", "trace": ["dead", "beef"]})

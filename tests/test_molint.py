@@ -55,7 +55,7 @@ def test_suite_shape():
     assert sorted(rules) == [
         "broad-except", "cache-invalidation", "deadline-propagation",
         "fault-coverage", "jit-purity", "knob-doc", "lock-discipline",
-        "metric-hygiene", "san-adoption"]
+        "metric-hygiene", "san-adoption", "span-hygiene"]
 
 
 # ------------------------------------------------- per-checker fixtures
@@ -663,3 +663,47 @@ def test_mo_ctl_lint_status_and_run():
     assert st2["last_run"]["suppressions_used"] >= 3
     with pytest.raises(Exception):
         s.execute("select mo_ctl('lint','bogus')")
+
+
+# ---------------------------------------------------------- span-hygiene
+def test_span_hygiene_fixtures():
+    d = os.path.join(FIX, "span_hygiene")
+    bad = _fixture_pair("span-hygiene",
+                        [os.path.join(d, "bad.py")],
+                        [os.path.join(d, "good.py")])
+    msgs = " | ".join(f.message for f in bad)
+    assert "outside a `with`" in msgs          # unbalanced enter/exit
+    assert "outside the RPC fabric" in msgs    # forked injection
+    assert "hand-built" in msgs                # clobbered wire key
+
+
+def test_span_hygiene_good_fixture_uses_a_suppression():
+    """The clean fixture carries ONE justified suppression (a
+    deliberate out-of-fabric injection) — the rule must honor it."""
+    d = os.path.join(FIX, "span_hygiene")
+    findings, stats = _run([os.path.join(d, "good.py")],
+                           rules=["span-hygiene"])
+    assert not findings
+    assert stats["suppressions_used"] == 1
+
+
+def test_span_hygiene_planted_violation(tmp_path):
+    """A bare-span plant in a temp tree fires; aliased imports resolve;
+    fabric modules stay exempt."""
+    p = tmp_path / "feature.py"
+    p.write_text("from matrixone_tpu.utils import motrace as _mt\n"
+                 "def f(work):\n"
+                 "    sp = _mt.span('planted')\n"
+                 "    sp.__enter__()\n"
+                 "    return work()\n")
+    findings, _ = _run([str(p)], rules=["span-hygiene"])
+    assert len(findings) == 1 and "_mt.span" in findings[0].message
+    # the fabric's OWN definition modules are exempt by config
+    fabric = tmp_path / "cluster"
+    fabric.mkdir()
+    q = fabric / "rpc.py"
+    q.write_text("from matrixone_tpu.utils import motrace\n"
+                 "def attempt(wire):\n"
+                 "    motrace.inject(wire)\n")
+    findings2, _ = _run([str(q)], rules=["span-hygiene"])
+    assert not findings2

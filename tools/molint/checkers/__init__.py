@@ -11,6 +11,7 @@ from tools.molint.checkers.fault_coverage import FaultCoverageChecker
 from tools.molint.checkers.broad_except import BroadExceptChecker
 from tools.molint.checkers.san_adoption import SanAdoptionChecker
 from tools.molint.checkers.knob_doc import KnobDocChecker
+from tools.molint.checkers.span_hygiene import SpanHygieneChecker
 
 ALL = [
     JitPurityChecker,
@@ -22,4 +23,5 @@ ALL = [
     BroadExceptChecker,
     SanAdoptionChecker,
     KnobDocChecker,
+    SpanHygieneChecker,
 ]

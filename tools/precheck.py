@@ -32,6 +32,10 @@ def main(argv=None) -> int:
                     help="also run the moqa differential smoke (small "
                          "seeded corpus across the config lattice + "
                          "the planted pad-leak drill; <30s)")
+    ap.add_argument("--trace-smoke", action="store_true",
+                    help="also run a query with motrace armed and "
+                         "assert a well-formed span tree + valid "
+                         "Chrome-trace JSON (tools/motrace.py; <30s)")
     args = ap.parse_args(argv)
 
     from tools import bench_guard, molint
@@ -100,6 +104,19 @@ def main(argv=None) -> int:
         else:
             print("qa-smoke: planted pad-leak NOT caught",
                   file=sys.stderr)
+            rc = 1
+
+    if args.trace_smoke:
+        from tools import motrace as motrace_smoke
+        rep = motrace_smoke.run_smoke()
+        for e in rep["errors"]:
+            print(f"trace-smoke: {e}", file=sys.stderr)
+        if rep["ok"]:
+            print(f"trace-smoke: span tree + chrome export ok "
+                  f"({rep['traces']} traces, {rep['spans']} spans, "
+                  f"{rep['seconds']}s)")
+        else:
+            print("trace-smoke: FAIL", file=sys.stderr)
             rc = 1
     return rc
 
