@@ -656,7 +656,10 @@ class Session:
         fmap = fusion.fragment_map(op)
         if not fmap:
             return None
-        return lambda n: (f" fragment=f{fmap[id(n)]}"
+        roles = fusion.fragment_roles(op)
+        return lambda n: ((f" fragment=f{fmap[id(n)]}"
+                           + (f" {roles[id(n)]}" if id(n) in roles
+                              else ""))
                           if id(n) in fmap else "")
 
     def _explain_analyze(self, node) -> str:
@@ -720,12 +723,14 @@ class Session:
             out = [line]
             if isinstance(o, FusedFragmentOp):
                 fs = o.last_stats
+                build = ("" if "build_dispatches" not in fs else
+                         f" build_dispatches={fs['build_dispatches']}")
                 out.append(
                     "  " * (indent + 1)
                     + f"fragment f{o.fragment_id} [{o.describe()}] "
                       f"mode={fs['mode']} dispatches={fs['dispatches']} "
                       f"trace_ms={fs['trace_ms']:.1f} "
-                      f"compile_cache={fs['cache']}")
+                      f"compile_cache={fs['cache']}" + build)
             if notes:
                 # the UdfCall rides the operator's pull loop: its
                 # rows/batches ARE the operator's (EXPLAIN ANALYZE
@@ -1319,12 +1324,19 @@ class Session:
     def _tree_vars_sig(self) -> tuple:
         """Session state BAKED into a compiled operator tree at build
         time (everything else is re-read through the ExecContext at
-        execute time): pallas kernel selection and the fusion gate."""
+        execute time): pallas kernel selection, the fusion gates —
+        incl. the join/window/topk kill-switches the planner consults
+        while building fragments — and the join build budget (JoinOp
+        snapshots it at construction)."""
         from matrixone_tpu.ops import pallas_kernels as PK
         from matrixone_tpu.vm import fusion
         return (bool(PK.effective_use_pallas(
                     self.variables.get("use_pallas"))),
-                fusion.enabled(self._ctx()))
+                fusion.enabled(self._ctx()),
+                fusion.join_fusion_enabled(),
+                fusion.window_fusion_enabled(),
+                fusion.topk_fusion_enabled(),
+                self.variables.get("join_build_budget"))
 
     # ------------------------------------------------- serving versions
     def _serving_gens(self):

@@ -57,7 +57,14 @@ def sort_indices(columns: Sequence[jnp.ndarray],
     # least-significant key first; stable sorts preserve prior order.
     # each key = value pass then null/padding class pass (both stable).
     for data, valid, desc in reversed(list(zip(columns, validities, descendings))):
-        vkey = _value_key(data, desc)[order]
+        vkey = _value_key(data, desc)
+        if valid is not None:
+            # NULL lanes carry arbitrary underlying data; a constant key
+            # keeps the value pass a no-op for them, so the prior
+            # (less-significant) key's order survives into the NULL
+            # class instead of being shuffled by garbage
+            vkey = jnp.where(valid, vkey, jnp.zeros((), vkey.dtype))
+        vkey = vkey[order]
         perm = jnp.argsort(vkey, stable=True)
         order = order[perm]
         ckey = _class_key(None if valid is None else valid, desc, row_mask)[order]

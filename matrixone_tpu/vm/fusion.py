@@ -58,7 +58,7 @@ import numpy as np
 
 from matrixone_tpu.container.device import DeviceBatch, DeviceColumn
 from matrixone_tpu.container.dtypes import TypeOid
-from matrixone_tpu.ops import agg as A, filter as F
+from matrixone_tpu.ops import agg as A, filter as F, sort as msort
 from matrixone_tpu.sql.expr import (BoundCase, BoundCast, BoundCol,
                                     BoundExpr, BoundFunc, BoundInList,
                                     BoundIsNull, BoundLike, BoundLiteral,
@@ -90,6 +90,24 @@ def min_fused_rows() -> int:
         return int(os.environ.get("MO_FUSION_MIN_ROWS", "65536"))
     except ValueError:
         return 65536
+
+
+def join_fusion_enabled() -> bool:
+    """MO_FUSION_JOIN=0 keeps joins as fusion barriers (kill-switch for
+    the build/probe fragments of vm/fusion_join.py)."""
+    return os.environ.get("MO_FUSION_JOIN", "1") != "0"
+
+
+def window_fusion_enabled() -> bool:
+    """MO_FUSION_WINDOW=0 keeps window functions as fusion barriers
+    (kill-switch for the fragments of vm/fusion_window.py)."""
+    return os.environ.get("MO_FUSION_WINDOW", "1") != "0"
+
+
+def topk_fusion_enabled() -> bool:
+    """MO_FUSION_TOPK=0 keeps ORDER BY .. LIMIT tails on the host-
+    orchestrated TopKOp path instead of the fused streaming terminal."""
+    return os.environ.get("MO_FUSION_TOPK", "1") != "0"
 
 
 # =====================================================================
@@ -573,6 +591,41 @@ def fragment_map(root) -> Dict[int, int]:
     return out
 
 
+def fragment_roles(root) -> Dict[int, str]:
+    """id(plan node) -> role label for nodes with a special place in a
+    fragment (join build/probe, window prelude, sort/topk terminal) —
+    the EXPLAIN annotator renders these next to fragment=fN."""
+    from matrixone_tpu.vm.compile import iter_ops
+    out: Dict[int, str] = {}
+    for op in iter_ops(root):
+        if isinstance(op, FusedFragmentOp):
+            out.update(op.node_roles)
+    return out
+
+
+def _topk_static_ok(op) -> bool:
+    """Can this TopKOp become a fused streaming terminal?  Keys and
+    output columns must be scalar non-varlen (a dictionary-coded column
+    carried across batches would pin the carry to one dictionary — the
+    code spaces of different batches need not agree), and the carry
+    must stay bounded."""
+    from matrixone_tpu.container.device import bucket_length
+    node = op.node
+    want = node.k + node.offset
+    if want <= 0 or bucket_length(max(want, 1)) > 8192:
+        return False
+    probe = _ExprInfo()
+    for k in node.keys:
+        if k.dtype.is_varlen or getattr(k.dtype, "is_vector", False):
+            return False
+        if not _analyze_expr(k, probe):
+            return False
+    for _nm, t in op.schema:
+        if t.is_varlen or getattr(t, "is_vector", False):
+            return False
+    return True
+
+
 def fuse_operator_tree(root, ctx):
     """Replace maximal traceable chains in a compiled operator tree with
     FusedFragmentOp nodes.  Non-traceable operators stay and their
@@ -581,21 +634,63 @@ def fuse_operator_tree(root, ctx):
     return _fuse(root, ctx, counter)
 
 
+def _join_fusable(op) -> bool:
+    from matrixone_tpu.vm.fusion_join import join_fusable
+    return join_fusable(op)
+
+
+def _window_fusable(op) -> bool:
+    from matrixone_tpu.vm.fusion_window import window_fusable
+    return window_fusable(op)
+
+
+def _try_fragment(top, ctx, counter, agg_op=None, sort_op=None):
+    """Build a fragment whose chain ends at `top` (inclusive for stage
+    operators; agg_op/sort_op ride as the terminal).  Join and window
+    sources become in-trace PRELUDES instead of barriers; returns None
+    when no fragment is worth building here."""
+    from matrixone_tpu.vm import fusion_join as FJ
+    from matrixone_tpu.vm import fusion_window as FW
+    stages, source = _collect_chain(top)
+    if _join_fusable(source):
+        return FJ.FusedJoinProbeOp(
+            source, stages, agg_op,
+            _fuse(source.left, ctx, counter),
+            _fuse(source.right, ctx, counter),
+            ctx, next(counter), sort_op=sort_op)
+    if _window_fusable(source):
+        return FW.FusedWindowOp(
+            source, stages, agg_op,
+            _fuse(source.child, ctx, counter),
+            ctx, next(counter), sort_op=sort_op)
+    if agg_op is None and (not stages or _small_output(source)):
+        # not worth a fragment here (untraceable stage, or a source
+        # whose output is already tiny): barrier; fuse below it.
+        # This also covers every sort_op-only case with no stages —
+        # agg_op and sort_op are never both set (see _fuse).
+        return None
+    src = _fuse(source, ctx, counter)
+    return FusedFragmentOp(src, stages, agg_op, ctx, next(counter),
+                           sort_op=sort_op)
+
+
 def _fuse(op, ctx, counter):
     if isinstance(op, FusedFragmentOp):
         return op
+    got = None
     if isinstance(op, O.AggOp) and _agg_static_ok(op.node):
-        stages, source = _collect_chain(op.child)
-        src = _fuse(source, ctx, counter)
-        return FusedFragmentOp(src, stages, op, ctx, next(counter))
-    if isinstance(op, (O.FilterOp, O.ProjectOp, O.LimitOp)):
-        stages, source = _collect_chain(op)
-        if stages and not _small_output(source):
-            src = _fuse(source, ctx, counter)
-            return FusedFragmentOp(src, stages, None, ctx,
-                                   next(counter))
-        # not worth a fragment here (untraceable stage, or a source
-        # whose output is already tiny): barrier; fuse below it
+        got = _try_fragment(op.child, ctx, counter, agg_op=op)
+    elif isinstance(op, O.TopKOp) and topk_fusion_enabled() \
+            and _topk_static_ok(op):
+        got = _try_fragment(op.child, ctx, counter, sort_op=op)
+    elif isinstance(op, (O.FilterOp, O.ProjectOp, O.LimitOp)):
+        got = _try_fragment(op, ctx, counter)
+    elif _join_fusable(op) or _window_fusable(op):
+        # a bare join probe / window with nothing fusable above it still
+        # collapses its own per-operator dispatches into one program
+        got = _try_fragment(op, ctx, counter)
+    if got is not None:
+        return got
     for attr in ("child", "left", "right"):
         c = getattr(op, attr, None)
         if isinstance(c, O.Operator):
@@ -641,11 +736,16 @@ class FusedFragmentOp(O.Operator):
     ANALYZE, runtime-filter resolution, ctx retargeting) traverse
     through fragments unchanged."""
 
+    #: prelude subclasses (join probe, window) build the chain's input
+    #: batch in-trace — the child scan stays its own operator there
+    _allow_scan_defer = True
+
     def __init__(self, source, stages: List[_Stage], agg_op, ctx,
-                 fragment_id: int):
+                 fragment_id: int, sort_op=None):
         self.child = source
         self.stages = stages
         self._agg_op = agg_op                  # original AggOp or None
+        self._sort_op = sort_op                # original TopKOp or None
         self.ctx = ctx
         self.fragment_id = fragment_id
         self._limit_stages = [st for st in stages if st.kind == "limit"]
@@ -654,25 +754,31 @@ class FusedFragmentOp(O.Operator):
             self.node = agg_op.node
             self._terminal = ("agg_grouped" if agg_op.node.group_keys
                               else "agg_scalar")
+        elif sort_op is not None:
+            self.schema = sort_op.schema
+            self.node = sort_op.node
+            self._terminal = "topk"
         elif stages:
             top = stages[-1]
             self.schema = top.op.schema
             self.node = top.node
             self._terminal = "stream"
         else:
-            self.schema = source.schema
-            self.node = getattr(source, "node", None)
+            self.schema = self._source_schema()
+            self.node = self._source_node()
             self._terminal = "stream"
         # original chain links for the fallback path
         chain_ops = [st.op for st in stages] + (
-            [agg_op] if agg_op is not None else [])
+            [agg_op] if agg_op is not None else
+            [sort_op] if sort_op is not None else [])
         self._orig_top = chain_ops[-1] if chain_ops else None
         self._orig_bottom = chain_ops[0] if chain_ops else None
         # scan absorption: defer the source scan's filter-mask eval into
         # the trace when every pushed filter is traceable
         scan_info = _ExprInfo()
         self._scan_defer = (
-            isinstance(source, O.ScanOp)
+            self._allow_scan_defer
+            and isinstance(source, O.ScanOp)
             and all(_analyze_expr(f, scan_info)
                     for f in source.node.filters))
         # full analysis in EXECUTION order (env indexes line up with the
@@ -682,6 +788,7 @@ class FusedFragmentOp(O.Operator):
             info.env_idx = 0
             for f in source.node.filters:
                 _analyze_expr(f, info)
+        self._analyze_prelude(info)
         env_i = 0
         for st in stages:
             info.env_idx = env_i
@@ -698,6 +805,13 @@ class FusedFragmentOp(O.Operator):
             for a in agg_op.node.aggs:
                 if a.arg is not None:
                     _analyze_expr(a.arg, info)
+        if sort_op is not None:
+            info.env_idx = env_i
+            for k in sort_op.node.keys:
+                _analyze_expr(k, info)
+            from matrixone_tpu.container.device import bucket_length
+            self._topk_w = bucket_length(
+                max(sort_op.node.k + sort_op.node.offset, 1))
         self._lift_lits = list(info.lift)
         self._baked_lits = list(info.baked)
         self._dictdeps = list(info.dictdep)
@@ -707,13 +821,32 @@ class FusedFragmentOp(O.Operator):
             self._plan_validity_flags()
         # EXPLAIN surface
         self.covered_nodes = {id(st.node) for st in stages}
+        self.node_roles: Dict[int, str] = {}
         if agg_op is not None:
             self.covered_nodes.add(id(agg_op.node))
+        if sort_op is not None:
+            self.covered_nodes.add(id(sort_op.node))
+            self.node_roles[id(sort_op.node)] = "topk-terminal"
         if self._scan_defer:
             self.covered_nodes.add(id(source.node))
         #: EXPLAIN ANALYZE surface for the last execution
         self.last_stats = {"mode": "none", "dispatches": 0,
                            "trace_ms": 0.0, "cache": "-"}
+
+    # -------------------------------------------- subclass seam points
+    def _source_schema(self):
+        """Schema of the batches entering the stage chain (a prelude
+        subclass produces these in-trace instead of pulling them from
+        `child`)."""
+        return self.child.schema
+
+    def _source_node(self):
+        return getattr(self.child, "node", None)
+
+    def _analyze_prelude(self, info: _ExprInfo) -> None:
+        """Hook for prelude expressions (join keys/residual, window
+        entries) to contribute lifted/baked literals and dict deps at
+        env index 0."""
 
     def describe(self) -> str:
         """Compact chain label: the fused operator names, bottom-up
@@ -721,14 +854,21 @@ class FusedFragmentOp(O.Operator):
         parts = []
         if self._scan_defer:
             parts.append("ScanOp")
+        parts.extend(self._prelude_labels())
         parts.extend(type(st.op).__name__ for st in self.stages)
         if self._agg_op is not None:
             parts.append("AggOp")
+        if self._sort_op is not None:
+            parts.append("TopKOp")
         return ">".join(parts) or "PassOp"
+
+    def _prelude_labels(self) -> List[str]:
+        return []
 
     # ----------------------------------------------------------- sig
     def _build_plan_sig(self, lift_ids) -> tuple:
         parts: List[tuple] = [("term", self._terminal)]
+        parts.extend(self._prelude_sig(lift_ids))
         if self._scan_defer:
             parts.append(("scanf",
                           tuple(_expr_sig(f, lift_ids)
@@ -753,7 +893,65 @@ class FusedFragmentOp(O.Operator):
                                  _expr_sig(a.arg, lift_ids)
                                  if a.arg is not None else None)
                                 for a in node.aggs)))
+        if self._sort_op is not None:
+            node = self._sort_op.node
+            parts.append(("topk", node.k, node.offset,
+                          tuple(_expr_sig(k, lift_ids)
+                                for k in node.keys),
+                          tuple(bool(d) for d in node.descendings)))
         return tuple(parts)
+
+    def _prelude_sig(self, lift_ids) -> List[tuple]:
+        return []
+
+    # --------------------------------- compile/dispatch shared plumbing
+    # (the jit wrap + try/except stays AT each call site: the traced fn
+    # is a local alias there, the root shape molint's jit-purity checker
+    # discovers — only the bookkeeping is centralized)
+    def _note_trace_fail(self, entry) -> None:
+        from matrixone_tpu.utils import metrics as M
+        entry["failed"] = True
+        M.fusion_compile.inc(outcome="trace_fail")
+
+    def _note_compiled(self, entry, slot, compiled, t0) -> None:
+        """Post-compile bookkeeping shared by every fragment program."""
+        from matrixone_tpu.utils import metrics as M
+        dt = time.perf_counter() - t0
+        entry["compiled"][slot] = compiled
+        entry["trace_s"] += dt
+        M.fusion_trace_seconds.inc(dt)
+        self.last_stats["trace_ms"] += dt * 1000.0
+        if self.last_stats["cache"] == "-":
+            self.last_stats["cache"] = "miss"
+
+    def _dispatch_entry(self, entry, slot, args, profile=False):
+        """One compiled-program dispatch under the shared span/metric
+        discipline; profile mode syncs and attributes TRUE device time
+        to the span instead of async-dispatch time."""
+        from matrixone_tpu.utils import metrics as M
+        from matrixone_tpu.utils import motrace
+        if self.last_stats["cache"] == "-":
+            self.last_stats["cache"] = "hit"
+        t_dev0 = time.perf_counter()
+        with motrace.span("fusion.dispatch", slot=slot,
+                          profiled=profile):
+            out = entry["compiled"][slot](*args)
+            M.fusion_dispatch.inc(kind="step")
+            self.last_stats["dispatches"] += 1
+            if profile:
+                san.check_blocking("device.sync")
+                jax.block_until_ready(out)
+                M.fusion_step_seconds.inc(
+                    time.perf_counter() - t_dev0, kind="device")
+        return out
+
+    def _initial_validity_colmap(self) -> dict:
+        """name -> (source column set, flaggable) seed for the flag
+        resolution walk — the ONE piece prelude subclasses (join,
+        window) specialize; everything in _plan_validity_flags below is
+        shared."""
+        return {nm: (frozenset([nm]), True)
+                for nm, _ in self.child.schema}
 
     def _plan_validity_flags(self) -> None:
         """Static wiring for the per-batch all-valid flags (the fused
@@ -764,8 +962,7 @@ class FusedFragmentOp(O.Operator):
         compiles the compact / count-collapsed variant — same lane
         layout as the unfused dense path."""
         node = self._agg_op.node
-        colmap = {nm: (frozenset([nm]), True)
-                  for nm, _ in self.child.schema}
+        colmap = self._initial_validity_colmap()
         for st in self.stages:
             if st.kind != "project":
                 continue
@@ -843,7 +1040,8 @@ class FusedFragmentOp(O.Operator):
         A limit stage makes pre-filtering unsafe (it changes which rows
         reach the limit), exactly like the unfused walker stopping at
         LimitOp."""
-        if self._limit_stages or self._agg_op is not None:
+        if self._limit_stages or self._agg_op is not None \
+                or self._sort_op is not None:
             return None
         for st in reversed(self.stages):
             if st.kind != "project":
@@ -1001,6 +1199,8 @@ class FusedFragmentOp(O.Operator):
         rt_baked = tuple(_norm_val(lit.value) for lit in rt_info.baked)
         scan_filters = filters if self._scan_defer else []
         carry = None
+        if self._terminal == "topk":
+            carry = self._init_topk_carry()
         seens: tuple = tuple(np.int64(0) for _ in self._limit_stages)
         trace_sizes: object = ()          # () = not yet pinned
         batches = itertools.chain([first], src_iter)
@@ -1058,38 +1258,15 @@ class FusedFragmentOp(O.Operator):
                         # tracer rejected, the eager path below computes
                         # the identical result (and surfaces identical
                         # user errors); mark so we stop re-trying
-                        entry["failed"] = True
-                        M.fusion_compile.inc(outcome="trace_fail")
+                        self._note_trace_fail(entry)
                     else:
-                        dt = time.perf_counter() - t0
-                        entry["compiled"][slot] = compiled
-                        entry["trace_s"] += dt
-                        M.fusion_trace_seconds.inc(dt)
-                        self.last_stats["trace_ms"] += dt * 1000.0
-                        if self.last_stats["cache"] == "-":
-                            self.last_stats["cache"] = "miss"
+                        self._note_compiled(entry, slot, compiled, t0)
                 if not entry["failed"]:
-                    if self.last_stats["cache"] == "-":
-                        self.last_stats["cache"] = "hit"
                     if profile:
                         M.fusion_step_seconds.inc(
                             time.perf_counter() - t_host0, kind="host")
-                        t_dev0 = time.perf_counter()
-                    from matrixone_tpu.utils import motrace
-                    # span covers dispatch (+ the profile-mode device
-                    # sync, so armed-profile runs attribute TRUE device
-                    # time to the span instead of async-dispatch time)
-                    with motrace.span("fusion.dispatch", slot=slot,
-                                      profiled=profile):
-                        out = entry["compiled"][slot](*args)
-                        M.fusion_dispatch.inc(kind="step")
-                        self.last_stats["dispatches"] += 1
-                        if profile:
-                            san.check_blocking("device.sync")
-                            jax.block_until_ready(out)
-                            M.fusion_step_seconds.inc(
-                                time.perf_counter() - t_dev0,
-                                kind="device")
+                    out = self._dispatch_entry(entry, slot, args,
+                                               profile)
             if out is None:
                 # eager evaluation of the SAME step function — identical
                 # math, per-op dispatch (the pre-fusion cost model)
@@ -1105,6 +1282,9 @@ class FusedFragmentOp(O.Operator):
                     src_iter.close()
                 break
         if self._terminal == "stream":
+            return
+        if self._terminal == "topk":
+            yield self._finalize_topk(carry)
             return
         yield self._finalize_agg(carry, trace_sizes, key_dicts)
 
@@ -1142,19 +1322,9 @@ class FusedFragmentOp(O.Operator):
         """Build the fragment's step function.  The SAME function is
         either jit-compiled (fused path) or called eagerly (degraded
         path) — one implementation, so the two modes cannot diverge."""
-        node = self._agg_op.node if self._agg_op is not None else None
-        terminal = self._terminal
-        stages = self.stages
+        chain = self._make_chain_fn(sizes, flags, envs)
         lift_lits = self._lift_lits + rt_lift
         env0 = envs[0]
-        all_envs = envs
-        if terminal == "agg_grouped":
-            keys_allvalid, agg_flags = flags
-            with_null = not keys_allvalid
-            pos = _compact_positions(sizes, with_null)
-        else:
-            keys_allvalid = with_null = None
-            agg_flags = pos = None
 
         def _fragment_step(datas, valids, n_rows, mask, lifted, seens,
                            carry):
@@ -1170,120 +1340,237 @@ class FusedFragmentOp(O.Operator):
                 for f in scan_filters:
                     ex.mask = ex.mask & F.predicate_mask(
                         eval_expr(f, ex), ex.batch)
-                out_seens: list = []
-                li = 0
-                env_i = 0
-                for st in stages:
-                    if st.kind == "filter":
-                        ex.mask = ex.mask & F.predicate_mask(
-                            eval_expr(st.pred, ex), ex.batch)
-                    elif st.kind == "project":
-                        env_i += 1
-                        pcols = {}
-                        for (nm, _d), e in zip(st.schema, st.exprs):
-                            pcols[nm] = eval_expr(e, ex)
-                        ex = ExecBatch(
-                            batch=DeviceBatch(columns=pcols,
-                                              n_rows=ex.batch.n_rows),
-                            dicts=all_envs[env_i], mask=ex.mask)
-                    else:          # limit
-                        seen = seens[li]
-                        rank = jnp.cumsum(
-                            ex.mask.astype(jnp.int64)) + seen
-                        keep = ex.mask
-                        if st.offset:
-                            keep = keep & (rank > st.offset)
-                        if st.n is not None:
-                            keep = keep & (rank <= st.offset + st.n)
-                        out_seens.append(
-                            seen + jnp.sum(ex.mask.astype(jnp.int64)))
-                        ex = ExecBatch(ex.batch, ex.dicts, keep)
-                        li += 1
-                if terminal == "stream":
-                    ocols = list(ex.batch.columns.values())
-                    payload = (tuple(c.data for c in ocols),
-                               tuple(c.validity for c in ocols),
-                               ex.mask)
-                    return payload, tuple(out_seens)
-                if terminal == "agg_scalar":
-                    sts = (carry if carry is not None
-                           else [None] * len(node.aggs))
-                    new = tuple(O._scalar_step(a, ex, s)
-                                for a, s in zip(node.aggs, sts))
-                    return new, tuple(out_seens)
-                # agg_grouped: the traced port of AggOp._dense_step —
-                # deduplicated lanes over the compact (all-valid) or
-                # NULL-slotted key space, scattered into the full-space
-                # carry so batch variants can mix mid-stream
-                n = ex.padded_len
-                kdata, kvalid = [], []
-                for k in node.group_keys:
-                    kc = O._broadcast_full(eval_expr(k, ex), n)
-                    kdata.append(kc.data)
-                    kvalid.append(kc.validity)
-                val_cache: dict = {}
-
-                def _val(arg):
-                    sig = _dedup_sig(arg)
-                    got = val_cache.get(sig)
-                    if got is None:
-                        got = O._broadcast_full(eval_expr(arg, ex), n)
-                        val_cache[sig] = got
-                    return got
-
-                int_vals, int_masks = [], []
-                float_vals, float_masks = [], []
-                lane_of: dict = {}
-                fieldmap: list = []      # one entry per carry field
-                for a, aflag in zip(node.aggs, agg_flags):
-                    v = None if a.arg is None else _val(a.arg)
-                    allv = v is None or aflag
-                    mkey = ("rows" if allv
-                            else ("m", _dedup_sig(a.arg)))
-                    mval = None if allv else v.validity
-                    x = None
-                    for cls, field in O.AggOp._dense_fields(a):
-                        if field == "count" and mkey == "rows":
-                            fieldmap.append("rows")
-                            continue
-                        if cls == "float" and field != "count" \
-                                and a.func in STDDEV_AGGS and x is None:
-                            x = O._float_of(v)
-                        val = (None if field == "count"
-                               else x * x if field == "sumsq"
-                               else x if x is not None else v.data)
-                        lk = (cls, field == "sumsq",
-                              None if field == "count"
-                              else _dedup_sig(a.arg), mkey)
-                        lane = lane_of.get(lk)
-                        if lane is None:
-                            if cls == "int":
-                                lane = ("int", len(int_vals))
-                                int_vals.append(val)
-                                int_masks.append(mval)
-                            else:
-                                lane = ("float", len(float_vals))
-                                float_vals.append(val)
-                                float_masks.append(mval)
-                            lane_of[lk] = lane
-                        fieldmap.append(lane)
-                ints, floats, rows = A.dense_lane_partials(
-                    tuple(kdata), tuple(kvalid), ex.mask,
-                    tuple(int_vals), tuple(int_masks),
-                    tuple(float_vals), tuple(float_masks),
-                    sizes=sizes, with_null=with_null)
-                fields, crows = carry
-                new_fields = []
-                for f_arr, ref in zip(fields, fieldmap):
-                    add = (rows if ref == "rows"
-                           else ints[ref[1]] if ref[0] == "int"
-                           else floats[ref[1]])
-                    new_fields.append(
-                        f_arr.at[pos].add(add.astype(f_arr.dtype)))
-                new_rows = crows.at[pos].add(rows)
-                return (tuple(new_fields), new_rows), tuple(out_seens)
+                return chain(ex, seens, carry)
 
         return _fragment_step
+
+    def _make_chain_fn(self, sizes, flags, envs):
+        """The stage + terminal body shared by every fragment flavor:
+        consumes the chain's input ExecBatch (built from traced inputs
+        by the caller — plain columns for scan chains, the probe/window
+        prelude's output for vm/fusion_join.py / vm/fusion_window.py)
+        and returns (payload, out_seens).  Must be called inside the
+        lifted-literal scope."""
+        node = self._agg_op.node if self._agg_op is not None else None
+        sort_node = (self._sort_op.node if self._sort_op is not None
+                     else None)
+        terminal = self._terminal
+        stages = self.stages
+        out_schema = list(self.schema)
+        topk_w = getattr(self, "_topk_w", None)
+        all_envs = envs
+        if terminal == "agg_grouped":
+            keys_allvalid, agg_flags = flags
+            with_null = not keys_allvalid
+            pos = _compact_positions(sizes, with_null)
+        else:
+            keys_allvalid = with_null = None
+            agg_flags = pos = None
+
+        def chain(ex, seens, carry):
+            out_seens: list = []
+            li = 0
+            env_i = 0
+            for st in stages:
+                if st.kind == "filter":
+                    ex.mask = ex.mask & F.predicate_mask(
+                        eval_expr(st.pred, ex), ex.batch)
+                elif st.kind == "project":
+                    env_i += 1
+                    pcols = {}
+                    for (nm, _d), e in zip(st.schema, st.exprs):
+                        pcols[nm] = eval_expr(e, ex)
+                    ex = ExecBatch(
+                        batch=DeviceBatch(columns=pcols,
+                                          n_rows=ex.batch.n_rows),
+                        dicts=all_envs[env_i], mask=ex.mask)
+                else:          # limit
+                    seen = seens[li]
+                    rank = jnp.cumsum(
+                        ex.mask.astype(jnp.int64)) + seen
+                    keep = ex.mask
+                    if st.offset:
+                        keep = keep & (rank > st.offset)
+                    if st.n is not None:
+                        keep = keep & (rank <= st.offset + st.n)
+                    out_seens.append(
+                        seen + jnp.sum(ex.mask.astype(jnp.int64)))
+                    ex = ExecBatch(ex.batch, ex.dicts, keep)
+                    li += 1
+            if terminal == "stream":
+                ocols = list(ex.batch.columns.values())
+                payload = (tuple(c.data for c in ocols),
+                           tuple(c.validity for c in ocols),
+                           ex.mask)
+                return payload, tuple(out_seens)
+            if terminal == "agg_scalar":
+                sts = (carry if carry is not None
+                       else [None] * len(node.aggs))
+                new = tuple(O._scalar_step(a, ex, s)
+                            for a, s in zip(node.aggs, sts))
+                return new, tuple(out_seens)
+            if terminal == "topk":
+                # streaming ORDER BY .. LIMIT k: merge this batch's
+                # rows into the running top-W carry under the exact
+                # total order (sort keys, then global row index —
+                # the tiebreak the host path realizes implicitly by
+                # stable-sorting the concatenated stream)
+                cdat, cval, cgid, cmask, clive, coff = carry
+                n = ex.padded_len
+                gidx = coff + jnp.arange(n, dtype=jnp.int64)
+                mdat, mval, mcols = [], [], {}
+                for (nm, t), cd, cv in zip(out_schema, cdat, cval):
+                    col = O._broadcast_full(ex.batch.columns[nm], n)
+                    mdat.append(jnp.concatenate([cd, col.data]))
+                    mval.append(jnp.concatenate([cv, col.validity]))
+                    mcols[nm] = DeviceColumn(mdat[-1], mval[-1], t)
+                mmask = jnp.concatenate([cmask, ex.mask])
+                mgid = jnp.concatenate([cgid, gidx])
+                mex = ExecBatch(
+                    batch=DeviceBatch(
+                        columns=mcols,
+                        n_rows=jnp.sum(mmask.astype(jnp.int32))),
+                    dicts=ex.dicts, mask=mmask)
+                kcols = [O._sort_key_col(k, mex)
+                         for k in sort_node.keys]
+                if len(kcols) == 1:
+                    # the host path's lax.top_k selection: on ties it
+                    # prefers the lower merged index == lower global
+                    # row index (carry lanes precede batch lanes and
+                    # are older), so the SET matches the sort path
+                    take, _cnt = msort.top_k_indices(
+                        kcols[0].data, kcols[0].validity,
+                        sort_node.descendings[0], mmask, topk_w)
+                else:
+                    order = msort.sort_indices(
+                        [c.data for c in kcols] + [mgid],
+                        [c.validity for c in kcols] + [None],
+                        list(sort_node.descendings) + [False],
+                        mmask)
+                    take = order[:topk_w]
+                new = (tuple(d[take] for d in mdat),
+                       tuple(v[take] for v in mval),
+                       mgid[take], mmask[take],
+                       clive + jnp.sum(ex.mask.astype(jnp.int64)),
+                       coff + n)
+                return new, tuple(out_seens)
+            # agg_grouped: the traced port of AggOp._dense_step —
+            # deduplicated lanes over the compact (all-valid) or
+            # NULL-slotted key space, scattered into the full-space
+            # carry so batch variants can mix mid-stream
+            n = ex.padded_len
+            kdata, kvalid = [], []
+            for k in node.group_keys:
+                kc = O._broadcast_full(eval_expr(k, ex), n)
+                kdata.append(kc.data)
+                kvalid.append(kc.validity)
+            val_cache: dict = {}
+
+            def _val(arg):
+                sig = _dedup_sig(arg)
+                got = val_cache.get(sig)
+                if got is None:
+                    got = O._broadcast_full(eval_expr(arg, ex), n)
+                    val_cache[sig] = got
+                return got
+
+            int_vals, int_masks = [], []
+            float_vals, float_masks = [], []
+            lane_of: dict = {}
+            fieldmap: list = []      # one entry per carry field
+            for a, aflag in zip(node.aggs, agg_flags):
+                v = None if a.arg is None else _val(a.arg)
+                allv = v is None or aflag
+                mkey = ("rows" if allv
+                        else ("m", _dedup_sig(a.arg)))
+                mval = None if allv else v.validity
+                x = None
+                for cls, field in O.AggOp._dense_fields(a):
+                    if field == "count" and mkey == "rows":
+                        fieldmap.append("rows")
+                        continue
+                    if cls == "float" and field != "count" \
+                            and a.func in STDDEV_AGGS and x is None:
+                        x = O._float_of(v)
+                    val = (None if field == "count"
+                           else x * x if field == "sumsq"
+                           else x if x is not None else v.data)
+                    lk = (cls, field == "sumsq",
+                          None if field == "count"
+                          else _dedup_sig(a.arg), mkey)
+                    lane = lane_of.get(lk)
+                    if lane is None:
+                        if cls == "int":
+                            lane = ("int", len(int_vals))
+                            int_vals.append(val)
+                            int_masks.append(mval)
+                        else:
+                            lane = ("float", len(float_vals))
+                            float_vals.append(val)
+                            float_masks.append(mval)
+                        lane_of[lk] = lane
+                    fieldmap.append(lane)
+            ints, floats, rows = A.dense_lane_partials(
+                tuple(kdata), tuple(kvalid), ex.mask,
+                tuple(int_vals), tuple(int_masks),
+                tuple(float_vals), tuple(float_masks),
+                sizes=sizes, with_null=with_null)
+            fields, crows = carry
+            new_fields = []
+            for f_arr, ref in zip(fields, fieldmap):
+                add = (rows if ref == "rows"
+                       else ints[ref[1]] if ref[0] == "int"
+                       else floats[ref[1]])
+                new_fields.append(
+                    f_arr.at[pos].add(add.astype(f_arr.dtype)))
+            new_rows = crows.at[pos].add(rows)
+            return (tuple(new_fields), new_rows), tuple(out_seens)
+
+        return chain
+
+    # ------------------------------------------------- topk terminal
+    def _init_topk_carry(self):
+        """Empty top-W carry: per output column (data, validity), plus
+        global row index, live-lane mask, live-row count and the padded
+        offset the next batch's global indexes start at."""
+        w = self._topk_w
+        datas, valids = [], []
+        for _nm, t in self.schema:
+            datas.append(jnp.zeros((w,), t.jnp_dtype))
+            valids.append(jnp.zeros((w,), jnp.bool_))
+        return (tuple(datas), tuple(valids),
+                jnp.zeros((w,), jnp.int64),
+                jnp.zeros((w,), jnp.bool_),
+                jnp.zeros((), jnp.int64),
+                jnp.zeros((), jnp.int64))
+
+    def _finalize_topk(self, carry) -> ExecBatch:
+        """Order the carried top-W rows exactly (sort keys, then global
+        row index — the stable-sort order of the host path) and apply
+        the node's offset/k window."""
+        datas, valids, gidx, cmask, live, _off = carry
+        node = self._sort_op.node
+        w = self._topk_w
+        cols = {nm: DeviceColumn(d, v, t)
+                for (nm, t), d, v in zip(self.schema, datas, valids)}
+        cex = ExecBatch(batch=DeviceBatch(
+            columns=cols, n_rows=jnp.sum(cmask.astype(jnp.int32))),
+            dicts={}, mask=cmask)
+        kcols = [O._sort_key_col(k, cex) for k in node.keys]
+        order = msort.sort_indices(
+            [c.data for c in kcols] + [gidx],
+            [c.validity for c in kcols] + [None],
+            list(node.descendings) + [False], cmask)
+        idx = order[jnp.clip(jnp.arange(w, dtype=jnp.int32)
+                             + node.offset, 0, w - 1)]
+        n_out = jnp.clip(jnp.minimum(live, node.offset + node.k)
+                         - node.offset, 0, node.k).astype(jnp.int32)
+        keep = jnp.arange(w, dtype=jnp.int32) < n_out
+        out_cols = {nm: DeviceColumn(d[idx], v[idx] & keep, t)
+                    for (nm, t), d, v in zip(self.schema, datas,
+                                             valids)}
+        db = DeviceBatch(columns=out_cols, n_rows=n_out)
+        return ExecBatch(batch=db, dicts={}, mask=keep)
 
     # -------------------------------------------------- agg finalize
     def _grouped_partials(self, carry, sizes):

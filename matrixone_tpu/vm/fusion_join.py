@@ -1,0 +1,621 @@
+"""Device-resident join fragments: the fusion planner's answer to the
+join barrier.
+
+A fusable `JoinOp` (inner / left / semi / anti with traceable keys and
+residual) splits into two traced pieces instead of splitting the plan:
+
+  * **build fragment** — key hash -> argsort -> sorted hash array (plus
+    the runtime-filter min/max ranges), traced ONCE per (build-side
+    shape bucket, dtype signature, key-dictionary content) and executed
+    as one device dispatch per build, carry-style like the fused grouped
+    aggregate;
+  * **probe fragment** — probe hash -> searchsorted -> duplicate-lane
+    expand -> key verify -> gather -> the downstream filter/project/
+    agg/topk chain, all ONE compiled program per probe batch.
+
+Both pieces call the SAME pure kernels `JoinOp` executes eagerly
+(vm/join.py: `build_key_columns`, `build_sorted_hash`, `expand_probe`,
+`collapse_semi_anti`) — fused and unfused cannot diverge.  The
+degradation ladder is preserved bit-identically: a build side past the
+budget, an empty build, a trace failure, tiny probe batches, or
+`MO_FUSION_JOIN=0` all land on the original `JoinOp` (including its
+Grace spill path); duplicate fan-out past `max_matches` re-runs the
+SAME probe batch with a doubled lane budget (the overflow flag is a
+traced output of the probe program — one host sync, no extra dispatch).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import time
+from typing import Dict, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from matrixone_tpu.container.device import DeviceBatch, DeviceColumn
+from matrixone_tpu.vm import exprs as EX
+from matrixone_tpu.vm import fusion as FF
+from matrixone_tpu.vm import join as J
+from matrixone_tpu.vm import operators as O
+from matrixone_tpu.vm.exprs import ExecBatch
+from matrixone_tpu.vm.operators import Operator, _concat_batches
+
+#: join kinds the probe fragment traces; cross has no keys and full
+#: carries cross-batch build-matched state the host loop owns
+_FUSABLE_KINDS = ("inner", "left", "semi", "anti")
+
+
+def join_fusable(op) -> bool:
+    """Can this operator become a fused build/probe fragment pair?"""
+    if not isinstance(op, J.JoinOp) or not FF.join_fusion_enabled():
+        return False
+    node = op.node
+    if node.kind not in _FUSABLE_KINDS or not node.right_keys:
+        return False
+    probe = FF._ExprInfo()
+    for k in list(node.left_keys) + list(node.right_keys):
+        if getattr(k.dtype, "is_vector", False):
+            return False
+        if not FF._analyze_expr(k, probe):
+            return False
+    if node.residual is not None \
+            and not FF._analyze_expr(node.residual, probe):
+        return False
+    return True
+
+
+class _IterSource(Operator):
+    """Already-pulled batches (plus the rest of an iterator) as an
+    operator, so the original JoinOp can re-enter the degradation
+    ladder without re-executing its children."""
+
+    def __init__(self, batches, rest, schema):
+        self._batches = batches
+        self._rest = rest
+        self.schema = schema
+
+    def execute(self) -> Iterator[ExecBatch]:
+        yield from itertools.chain(self._batches, self._rest)
+
+
+class FusedJoinProbeOp(FF.FusedFragmentOp):
+    """One fragment covering JoinOp + the traceable chain above it.
+
+    `child` is the probe (left) side, `right` the build side — tree
+    walkers (EXPLAIN ANALYZE, retarget_tree, runtime-filter resolution)
+    traverse both unchanged."""
+
+    _allow_scan_defer = False
+
+    def __init__(self, join_op, stages, agg_op, probe_src, build_src,
+                 ctx, fragment_id: int, sort_op=None):
+        self._join = join_op
+        # keep the original operator pointed at the FUSED children so
+        # every fallback re-enters the per-operator ladder unchanged
+        join_op.left = probe_src
+        join_op.right = build_src
+        super().__init__(probe_src, stages, agg_op, ctx, fragment_id,
+                         sort_op=sort_op)
+        self.right = build_src
+        self.covered_nodes.add(id(join_op.node))
+        self.node_roles[id(join_op.node)] = "join=build+probe"
+        # per-execution build state
+        self._build_dicts: Dict[str, list] = {}
+        self._cur_build: Optional[ExecBatch] = None
+        self._bkey_dicts: List[Optional[list]] = []
+
+    # ------------------------------------------------- analysis hooks
+    def _source_schema(self):
+        return self._join.node.schema
+
+    def _source_node(self):
+        return self._join.node
+
+    def _analyze_prelude(self, info) -> None:
+        node = self._join.node
+        info.env_idx = 0
+        for k in list(node.left_keys) + list(node.right_keys):
+            FF._analyze_expr(k, info)
+        if node.residual is not None:
+            FF._analyze_expr(node.residual, info)
+
+    def _prelude_sig(self, lift_ids) -> List[tuple]:
+        node = self._join.node
+        return [("join", node.kind,
+                 tuple(FF._expr_sig(k, lift_ids)
+                       for k in node.left_keys),
+                 tuple(FF._expr_sig(k, lift_ids)
+                       for k in node.right_keys),
+                 FF._expr_sig(node.residual, lift_ids)
+                 if node.residual is not None else None,
+                 tuple((nm, FF._tsig(t)) for nm, t in node.left.schema),
+                 tuple((nm, FF._tsig(t))
+                       for nm, t in node.right.schema))]
+
+    def _prelude_labels(self) -> List[str]:
+        return ["JoinBuild", "JoinProbe"]
+
+    def _initial_validity_colmap(self) -> dict:
+        """Join-aware all-valid seed: probe-side columns resolve to the
+        probe batch, build-side columns to the (fixed) build batch.  A
+        left join NULL-extends build columns, so they are never
+        flaggable there; for semi/anti only probe columns exist."""
+        jn = self._join.node
+        colmap = {nm: (frozenset([nm]), True) for nm, _ in jn.left.schema}
+        if jn.kind in ("inner",):
+            colmap.update({nm: (frozenset([nm]), True)
+                           for nm, _ in jn.right.schema})
+        else:
+            colmap.update({nm: (frozenset(), False)
+                           for nm, _ in jn.right.schema})
+        return colmap
+
+    def _flag_validities(self, ex):
+        """Validity arrays for the flag columns, resolved across the two
+        sides (probe batch / current build)."""
+        probe_cols = ex.batch.columns
+        build_cols = (self._cur_build.batch.columns
+                      if self._cur_build is not None else {})
+        out = []
+        for c in self._flag_cols:
+            if c in probe_cols:
+                out.append(probe_cols[c].validity)
+            elif c in build_cols:
+                out.append(build_cols[c].validity)
+            else:
+                return None
+        return tuple(out)
+
+    def _batch_flags(self, ex):
+        from matrixone_tpu.utils import metrics as M
+        node = self._agg_op.node
+        flaggable = (self._keys_flaggable
+                     or any(p and a.arg is not None
+                            for (p, _), a in zip(self._agg_flag_specs,
+                                                 node.aggs)))
+        if not flaggable or not self._flag_cols:
+            return False, tuple(p and a.arg is None
+                                for (p, _), a in zip(
+                                    self._agg_flag_specs, node.aggs))
+        valids = self._flag_validities(ex)
+        if valids is None:
+            return False, tuple(a.arg is None for a in node.aggs)
+        got = np.asarray(jax.device_get(FF._allvalid_flags(valids)))
+        M.fusion_dispatch.inc(kind="step")
+        self.last_stats["dispatches"] += 1
+        ok = dict(zip(self._flag_cols, (bool(x) for x in got)))
+        keys_allvalid = self._keys_flaggable and \
+            all(ok[c] for c in self._key_flag_cols)
+        agg_flags = tuple(
+            a.arg is None or (p and all(ok[c] for c in cs))
+            for (p, cs), a in zip(self._agg_flag_specs, node.aggs))
+        return keys_allvalid, agg_flags
+
+    # --------------------------------------------------- dict plumbing
+    def _dict_envs(self, dicts0):
+        merged = dict(self._build_dicts)
+        merged.update(dicts0)
+        return super()._dict_envs(merged)
+
+    def _out_schema(self, ex):
+        for st in reversed(self.stages):
+            if st.kind == "project":
+                return ([n for n, _ in st.schema],
+                        [d for _, d in st.schema])
+        # no projection: the stream payload's column ORDER is the
+        # probe-chain construction order — left schema then (for
+        # inner/left) right schema.  NOT jn.schema: after a CBO side
+        # swap the join node's declared order differs from the physical
+        # batch order, and a positional zip against it would hand every
+        # downstream operator the wrong column under each name
+        jn = self._join.node
+        sch = list(jn.left.schema)
+        if jn.kind not in ("semi", "anti"):
+            sch += list(jn.right.schema)
+        return ([n for n, _ in sch], [d for _, d in sch])
+
+    def _stream_batch(self, ex, payload, envs) -> ExecBatch:
+        out_datas, out_valids, out_mask = payload
+        names, dtypes = self._out_schema(ex)
+        cols = {nm: DeviceColumn(d, v, t)
+                for nm, t, d, v in zip(names, dtypes, out_datas,
+                                       out_valids)}
+        env_final = envs[-1]
+        dicts = {nm: env_final[nm] for nm, t in zip(names, dtypes)
+                 if t.is_varlen and env_final.get(nm) is not None}
+        db = DeviceBatch(columns=cols,
+                         n_rows=jnp.sum(out_mask.astype(jnp.int32)))
+        out = ExecBatch(batch=db, dicts=dicts, mask=out_mask)
+        # same lane discipline as the per-operator probe: join output
+        # lanes are np*mm wide but usually sparse
+        return J._maybe_compact(out)
+
+    # ----------------------------------------------------- execution
+    def execute(self):
+        from matrixone_tpu.utils import metrics as M
+        self.last_stats = {"mode": "none", "dispatches": 0,
+                           "trace_ms": 0.0, "cache": "-",
+                           "build_dispatches": 0}
+        join = self._join
+        node = join.node
+        build_iter = self.right.execute()
+        build_batches, overflowed = J.stream_build_side(
+            build_iter, join.build_budget)
+        if overflowed or not build_batches:
+            # over-budget (Grace spill) or empty build side: the
+            # original JoinOp owns every one of those ladders
+            M.fusion_exec.inc(mode="fallback")
+            self.last_stats["mode"] = "fallback"
+            yield from self._orig_join_chain(build_batches, build_iter)
+            return
+        build = _concat_batches(build_batches, node.right.schema)
+        # build BEFORE the first probe pull: the build fragment pushes
+        # the runtime min/max filters onto the probe scans, and zonemap
+        # pruning only sees them for chunks not yet read
+        bstate = self._build_state(build)
+        probe_iter = self.child.execute()
+        first = next(probe_iter, None)
+        # degrade ladders below re-enter the ORIGINAL JoinOp: hand it
+        # the finalized build state so it neither re-runs the build
+        # math nor re-pushes the runtime filters
+        sorted_hash, order, bvalid, bkeys, _bkey = bstate
+        join._prepared_build = (build, sorted_hash, order, bvalid,
+                                bkeys, list(self._bkey_dicts))
+        if first is None:
+            M.fusion_exec.inc(mode="fallback")
+            self.last_stats["mode"] = "fallback"
+            yield from self._orig_join_chain([build], iter(()),
+                                             probe=([], iter(())))
+            return
+        if first.padded_len < FF.min_fused_rows():
+            M.fusion_exec.inc(mode="eager")
+            self.last_stats["mode"] = "eager"
+            yield from self._orig_join_chain(
+                [build], iter(()), probe=([first], probe_iter))
+            return
+        join._prepared_build = None
+        yield from self._execute_join_fused(build, bstate, first,
+                                            probe_iter)
+
+    def _orig_join_chain(self, build_batches, build_rest, probe=None):
+        """Run the ORIGINAL JoinOp (+ the original chain above it) over
+        the partially-pulled sides — the bit-identical ladder for every
+        degradation."""
+        join = self._join
+        node = join.node
+        saved_l, saved_r = join.left, join.right
+        join.right = _IterSource(build_batches, build_rest,
+                                 node.right.schema)
+        if probe is not None:
+            join.left = _IterSource(probe[0], probe[1],
+                                    node.left.schema)
+        if self._orig_bottom is not None:
+            self._orig_bottom.child = join
+        try:
+            top = self._orig_top if self._orig_top is not None else join
+            yield from top.execute()
+        finally:
+            join.left, join.right = saved_l, saved_r
+
+    def _build_state(self, build):
+        """Trace (or reuse) the build fragment for this build batch and
+        execute it: ONE dispatch producing the sorted hash array, the
+        row order, the key columns and the runtime-filter ranges."""
+        from matrixone_tpu.utils import metrics as M
+        from matrixone_tpu.utils import motrace
+        node = self._join.node
+        self._cur_build = build
+        self._build_dicts = dict(build.dicts)
+        self._bkey_dicts = [
+            O._expr_dict(k, build) if k.dtype.is_varlen else None
+            for k in node.right_keys]
+        specs = J.runtime_filter_specs(node)
+        # the build program depends ONLY on the build-key expressions —
+        # its lifted-literal inputs (and baked values in the key) come
+        # from them, never from the fragment's probe-side chain: two
+        # fragments sharing a build side but differing above the probe
+        # must share (not corrupt) the compiled build program
+        binfo = FF._ExprInfo()
+        binfo.env_idx = 0
+        for k in node.right_keys:
+            FF._analyze_expr(k, binfo)
+        lift_lits = list(binfo.lift)
+        colsig = tuple((nm, int(c.dtype.oid), tuple(c.data.shape))
+                       for nm, c in build.batch.columns.items())
+        # keyed on the BUILD-side inputs alone (key exprs + runtime-
+        # filter eligibility + schema/dicts/shape + baked values): two
+        # fragments sharing a build side but differing above the probe
+        # — or in their terminal — share one compiled build program
+        blids = frozenset(id(x) for x in lift_lits)
+        key = ("joinbuild",
+               tuple(FF._expr_sig(k, blids) for k in node.right_keys),
+               tuple(i for i, _lk in specs), colsig,
+               int(build.mask.shape[0]),
+               tuple(FF._norm_val(lit.value) for lit in binfo.baked),
+               tuple(FF._dict_key(d) for d in self._bkey_dicts))
+        entry = FF.CACHE.entry(key)
+        bschema = tuple((nm, c.dtype)
+                        for nm, c in build.batch.columns.items())
+        bdicts = self._build_dicts
+
+        def _join_build_step(datas, valids, n_rows, mask, lifted):
+            binding = {id(lit): v
+                       for lit, v in zip(lift_lits, lifted)}
+            with EX.lifted_literal_scope(binding):
+                cols = {nm: DeviceColumn(d, v, t)
+                        for (nm, t), d, v in zip(bschema, datas,
+                                                 valids)}
+                bex = ExecBatch(batch=DeviceBatch(columns=cols,
+                                                  n_rows=n_rows),
+                                dicts=bdicts, mask=mask)
+                bkeys, _ = J.build_key_columns(node, bex)
+                sorted_hash, order, bvalid = J.build_sorted_hash(
+                    bkeys, bex.mask)
+                lo, hi, anyv = J.runtime_filter_ranges(specs, bkeys,
+                                                       bvalid)
+                return (sorted_hash, order, bvalid,
+                        tuple(k.data for k in bkeys),
+                        tuple(k.validity for k in bkeys), lo, hi, anyv)
+
+        fn = entry["fn"].get("build")
+        if fn is None:
+            fn = _join_build_step
+            entry["fn"]["build"] = fn
+        args = (tuple(c.data for c in build.batch.columns.values()),
+                tuple(c.validity for c in build.batch.columns.values()),
+                jnp.asarray(build.batch.n_rows, jnp.int32), build.mask,
+                tuple(np.dtype(lit.dtype.np_dtype).type(lit.value)
+                      for lit in lift_lits))
+        out = None
+        if not entry["failed"]:
+            compiled = entry["compiled"].get("build")
+            if compiled is None:
+                t0 = time.perf_counter()
+                try:
+                    with motrace.span("fusion.compile", slot="build"):
+                        compiled = jax.jit(fn).lower(*args).compile()
+                except Exception:   # noqa: BLE001 — whatever the tracer
+                    # rejected, the eager call below computes the
+                    # identical result (same function)
+                    self._note_trace_fail(entry)
+                else:
+                    self._note_compiled(entry, "build", compiled, t0)
+            if not entry["failed"]:
+                out = self._dispatch_entry(
+                    entry, "build", args,
+                    os.environ.get("MO_FUSION_PROFILE") == "1")
+                self.last_stats["build_dispatches"] += 1
+        if out is None:
+            out = fn(*args)
+            M.fusion_dispatch.inc(kind="eager")
+        (sorted_hash, order, bvalid, bkdatas, bkvalids,
+         lo, hi, anyv) = out
+        bkeys = [DeviceColumn(d, v, k.dtype)
+                 for d, v, k in zip(bkdatas, bkvalids,
+                                    node.right_keys)]
+        if specs and node.kind in ("inner", "semi"):
+            got = jax.device_get((lo, hi, anyv))
+            self._join.apply_runtime_filters(
+                specs, np.asarray(got[0]), np.asarray(got[1]),
+                bool(got[2]))
+        return sorted_hash, order, bvalid, bkeys, key
+
+    def _probe_runtime_key(self, ex, envs, mm, build_key, sizes_flags):
+        cols = ex.batch.columns
+        colsig = tuple((nm, int(c.dtype.oid), tuple(c.data.shape))
+                       for nm, c in cols.items())
+        baked = tuple(FF._norm_val(lit.value)
+                      for lit in self._baked_lits)
+        dicts = tuple(FF._dict_key(FF._static_dict(e, envs[i]))
+                      for i, e in self._dictdeps)
+        # the varchar key-translation LUT depends on BOTH dictionaries
+        node = self._join.node
+        keydicts = tuple(
+            (FF._dict_key(bd),
+             FF._dict_key(O._expr_dict(k, ex))
+             if k.dtype.is_varlen else None)
+            for k, bd in zip(node.left_keys, self._bkey_dicts))
+        return (self._plan_sig, colsig, int(ex.mask.shape[0]), baked,
+                dicts, sizes_flags, mm, build_key, keydicts)
+
+    def _make_probe_step(self, trig_schema, bschema, sizes, flags, envs,
+                         mm):
+        chain = self._make_chain_fn(sizes, flags, envs)
+        node = self._join.node
+        lift_lits = list(self._lift_lits)
+        bkey_dicts = list(self._bkey_dicts)
+        bdicts = self._build_dicts
+        kinds_collapse = node.kind in ("semi", "anti")
+
+        def _join_probe_step(pdatas, pvalids, p_nrows, pmask, bdatas,
+                             bvalids, b_nrows, bmask, sorted_hash,
+                             border, bkdatas, bkvalids, lifted, seens,
+                             carry):
+            binding = {id(lit): v
+                       for lit, v in zip(lift_lits, lifted)}
+            with EX.lifted_literal_scope(binding):
+                pcols = {nm: DeviceColumn(d, v, t)
+                         for (nm, t), d, v in zip(trig_schema, pdatas,
+                                                  pvalids)}
+                pex = ExecBatch(batch=DeviceBatch(columns=pcols,
+                                                  n_rows=p_nrows),
+                                dicts=dict(envs[0]), mask=pmask)
+                bcols = {nm: DeviceColumn(d, v, t)
+                         for (nm, t), d, v in zip(bschema, bdatas,
+                                                  bvalids)}
+                build = ExecBatch(batch=DeviceBatch(columns=bcols,
+                                                    n_rows=b_nrows),
+                                  dicts=bdicts, mask=bmask)
+                bkeys = [DeviceColumn(d, v, k.dtype)
+                         for d, v, k in zip(bkdatas, bkvalids,
+                                            node.right_keys)]
+                pkeys = J.probe_key_columns(node, pex, bkey_dicts)
+                phash, pvalid = J.hash_valid_keys(pkeys, pex.mask)
+                out, overflow, _bm = J.expand_probe(
+                    node, pex, build, sorted_hash, border, phash,
+                    pvalid, pkeys, bkeys, mm, None)
+                if kinds_collapse:
+                    oex = J.collapse_semi_anti(node, pex, out.mask, mm)
+                else:
+                    oex = out
+                payload, out_seens = chain(oex, seens, carry)
+                return payload, out_seens, overflow
+
+        return _join_probe_step
+
+    def _execute_join_fused(self, build, bstate, first, probe_iter):
+        from matrixone_tpu.utils import metrics as M
+        from matrixone_tpu.utils import motrace
+        self.last_stats["mode"] = "fused"
+        M.fusion_exec.inc(mode="fused")
+        profile = os.environ.get("MO_FUSION_PROFILE") == "1"
+        sorted_hash, border, bvalid, bkeys, build_key = bstate
+        node = self._agg_op.node if self._agg_op is not None else None
+        grouped = self._terminal == "agg_grouped"
+        nkeys = len(node.group_keys) if grouped else 0
+        key_dicts: List[Optional[list]] = [None] * nkeys
+        bschema = tuple((nm, c.dtype)
+                        for nm, c in build.batch.columns.items())
+        mm = self._join.max_matches
+        carry = None
+        if self._terminal == "topk":
+            carry = self._init_topk_carry()
+        seens: tuple = tuple(np.int64(0) for _ in self._limit_stages)
+        trace_sizes: object = ()
+        batches = itertools.chain([first], probe_iter)
+        for ex in batches:
+            t_host0 = time.perf_counter() if profile else 0.0
+            envs = self._dict_envs(ex.dicts)
+            sizes = None
+            flags = None
+            if grouped:
+                for i, k in enumerate(node.group_keys):
+                    d = FF._static_dict(k, envs[-1])
+                    if d is not None:
+                        key_dicts[i] = d
+                sizes = self._sizes(envs[-1])
+                if trace_sizes == ():
+                    trace_sizes = sizes
+                if sizes is None or sizes != trace_sizes:
+                    M.fusion_exec.inc(mode="degraded")
+                    self.last_stats["mode"] = "degraded"
+                    # same build-state handoff as the execute() ladders:
+                    # the original JoinOp must not redo the finalized
+                    # build math or re-push the runtime filters
+                    self._join._prepared_build = (
+                        build, sorted_hash, border, bvalid, bkeys,
+                        list(self._bkey_dicts))
+                    yield from self._degrade_join_grouped(
+                        carry, trace_sizes, key_dicts, build, ex,
+                        batches)
+                    return
+                flags = self._batch_flags(ex)
+                if carry is None:
+                    carry = self._init_grouped_carry(sizes)
+            trig = tuple((nm, c.dtype)
+                         for nm, c in ex.batch.columns.items())
+            while True:
+                key = self._probe_runtime_key(ex, envs, mm, build_key,
+                                              (sizes, flags))
+                entry = FF.CACHE.entry(key)
+                slot = "step"
+                if self._terminal == "agg_scalar":
+                    slot = "step0" if carry is None else "stepN"
+                fn = entry["fn"].get(slot)
+                if fn is None:
+                    fn = self._make_probe_step(trig, bschema, sizes,
+                                               flags, envs, mm)
+                    entry["fn"][slot] = fn
+                args = (tuple(c.data
+                              for c in ex.batch.columns.values()),
+                        tuple(c.validity
+                              for c in ex.batch.columns.values()),
+                        jnp.asarray(ex.batch.n_rows, jnp.int32),
+                        ex.mask,
+                        tuple(c.data for c in build.batch.columns
+                              .values()),
+                        tuple(c.validity for c in build.batch.columns
+                              .values()),
+                        jnp.asarray(build.batch.n_rows, jnp.int32),
+                        build.mask, sorted_hash, border,
+                        tuple(k.data for k in bkeys),
+                        tuple(k.validity for k in bkeys),
+                        self._lifted_values([]), seens, carry)
+                out = None
+                if not entry["failed"]:
+                    compiled = entry["compiled"].get(slot)
+                    if compiled is None:
+                        t0 = time.perf_counter()
+                        try:
+                            with motrace.span("fusion.compile",
+                                              slot=slot):
+                                compiled = jax.jit(fn).lower(
+                                    *args).compile()
+                        except Exception:   # noqa: BLE001 — eager
+                            # evaluation of the SAME function below
+                            # computes the identical result
+                            self._note_trace_fail(entry)
+                        else:
+                            self._note_compiled(entry, slot, compiled,
+                                                t0)
+                    if not entry["failed"]:
+                        if profile:
+                            M.fusion_step_seconds.inc(
+                                time.perf_counter() - t_host0,
+                                kind="host")
+                        out = self._dispatch_entry(entry, slot, args,
+                                                   profile)
+                if out is None:
+                    out = fn(*args)
+                    M.fusion_dispatch.inc(kind="eager")
+                payload, new_seens, overflow = out
+                if not bool(jax.device_get(overflow)):
+                    seens = new_seens
+                    break
+                # duplicate fan-out past the lane budget: re-run the
+                # SAME batch with doubled lanes (the JoinOp ladder)
+                mm *= 2
+            if self._terminal == "stream":
+                yield self._stream_batch(ex, payload, envs)
+            else:
+                carry = payload
+            if self._limits_satisfied(seens):
+                if hasattr(probe_iter, "close"):
+                    probe_iter.close()
+                break
+        if self._terminal == "stream":
+            return
+        if self._terminal == "topk":
+            yield self._finalize_topk(carry)
+            return
+        yield self._finalize_agg(carry, trace_sizes, key_dicts)
+
+    def _degrade_join_grouped(self, carry, sizes, key_dicts, build, ex,
+                              rest):
+        """A group-key dictionary grew mid-probe-stream (or the key
+        space was never dense): convert the fused partials into a
+        general group-table state and continue on the ORIGINAL
+        JoinOp -> chain, seeded."""
+        agg = self._agg_op
+        agg._agg_tracker = O._AggDictTracker(agg.node.aggs)
+        seed = None
+        if carry is not None:
+            dense = self._grouped_partials(carry, sizes)
+            seed = agg._dense_to_state(dense)
+        join = self._join
+        node = join.node
+        saved_l, saved_r = join.left, join.right
+        join.right = _IterSource([build], iter(()), node.right.schema)
+        join.left = _IterSource([ex], rest, node.left.schema)
+        rewire = self._orig_bottom if self.stages else agg
+        saved_child = rewire.child
+        rewire.child = join
+        try:
+            yield from agg._grouped_agg(seed=seed,
+                                        seed_dicts=key_dicts)
+        finally:
+            join.left, join.right = saved_l, saved_r
+            rewire.child = saved_child

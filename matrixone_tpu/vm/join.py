@@ -17,14 +17,26 @@ colexec/spillutil/join_spill.go + spill_threshold.go): both sides are
 hash-partitioned to host disk by the join key, and each partition joins
 with the normal in-memory path — rows with equal keys always share a
 partition, so every join kind except cross partitions exactly.
+
+The device math lives in module-level PURE functions (`build_key_columns`,
+`build_sorted_hash`, `expand_probe`, `collapse_semi_anti`, ...) shared
+verbatim by JoinOp and the fused join fragments (vm/fusion_join.py): the
+fused probe program traces the SAME code the per-operator path executes
+eagerly, so the two modes cannot diverge.
+
+Dictionary-coded (varchar) join keys translate the PROBE side's codes
+into the BUILD side's code space through a host O(distinct) LUT before
+hashing — two tables' dictionaries assign codes independently, so a raw
+code compare would join by insertion position, not by value.
 """
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import os
 import tempfile
-from typing import Iterator, List, Optional
+from typing import Iterator, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,6 +48,8 @@ from matrixone_tpu.ops import filter as F, hash as H
 from matrixone_tpu.sql import plan as P
 from matrixone_tpu.vm.exprs import ExecBatch, eval_expr
 from matrixone_tpu.vm.operators import Operator, _broadcast_full, _concat_batches
+
+_NULL_HASH = np.uint64(0xFFFFFFFFFFFFFFFF)
 
 
 def _probe_scans(op, name: str):
@@ -84,6 +98,260 @@ def _maybe_compact(out: ExecBatch) -> ExecBatch:
     db = F.compact(out.batch, out.mask, cap)
     return ExecBatch(batch=db, dicts=out.dicts,
                      mask=jnp.arange(cap, dtype=jnp.int32) < db.n_rows)
+
+
+# =====================================================================
+# pure device kernels, shared by JoinOp and vm/fusion_join.py
+# =====================================================================
+
+def _str_hash_i64(s) -> np.int64:
+    """Stable 64-bit value hash of a dictionary entry (spill routing:
+    equal strings must land in equal partitions on BOTH sides)."""
+    d = hashlib.blake2b(str(s).encode("utf-8"), digest_size=8).digest()
+    return np.int64(np.frombuffer(d, dtype="<u8")[0].astype(np.int64))
+
+
+def build_key_columns(node, build: ExecBatch):
+    """Evaluate the build side's join keys.  Varchar keys stay in their
+    own (build) code space widened to int64 — the probe side translates
+    into it — and their dictionaries are returned for that translation."""
+    from matrixone_tpu.vm.operators import _expr_dict
+    bkeys, bdicts = [], []
+    for k in node.right_keys:
+        c = _broadcast_full(eval_expr(k, build), build.padded_len)
+        d = None
+        if k.dtype.is_varlen:
+            d = _expr_dict(k, build)
+            c = DeviceColumn(c.data.astype(jnp.int64), c.validity,
+                             c.dtype)
+        bkeys.append(c)
+        bdicts.append(d)
+    return bkeys, bdicts
+
+
+def probe_key_columns(node, ex: ExecBatch, bkey_dicts):
+    """Evaluate the probe side's join keys, translating varchar codes
+    into the build side's code space: a probe string present in the
+    build dictionary takes the build code, an absent one takes a
+    non-colliding id past it.  Exact value equality, O(distinct) host
+    work per batch."""
+    from matrixone_tpu.vm.operators import _expr_dict
+    pkeys = []
+    for k, bd in zip(node.left_keys, bkey_dicts):
+        c = _broadcast_full(eval_expr(k, ex), ex.padded_len)
+        if k.dtype.is_varlen:
+            d = _expr_dict(k, ex)
+            if d is not None and bd is not None:
+                if len(d) == 0:
+                    # all-NULL probe column: the empty dictionary has
+                    # no codes to translate and no row can match (the
+                    # validity mask is already all-false) — any
+                    # constant works
+                    data = jnp.zeros_like(c.data, jnp.int64)
+                else:
+                    code_of = {str(s): i for i, s in enumerate(bd)}
+                    lut = np.asarray(
+                        [code_of.get(str(s), len(bd) + i)
+                         for i, s in enumerate(d)], np.int64)
+                    data = jnp.asarray(lut)[
+                        jnp.clip(c.data, 0, max(len(d) - 1, 0))]
+            else:
+                # no dictionary to translate through: the two sides'
+                # code spaces are incomparable, and matching raw codes
+                # would join by insertion position, not value — refuse,
+                # matching _eval_compare's discipline for the same case
+                from matrixone_tpu.vm.exprs import EvalError
+                raise EvalError(
+                    "unsupported string comparison: varchar join key "
+                    f"{k!r} has no resolvable dictionary")
+            c = DeviceColumn(data, c.validity, c.dtype)
+        pkeys.append(c)
+    return pkeys
+
+
+def hash_valid_keys(kcols, mask):
+    """(row hash, all-keys-valid mask) for one side's key columns; rows
+    with any NULL key never match (SQL equi-join semantics)."""
+    h = H.hash_columns([k.data for k in kcols],
+                       [k.validity for k in kcols])
+    valid = mask
+    for k in kcols:
+        valid = valid & k.validity
+    return h, valid
+
+
+def build_sorted_hash(bkeys, mask):
+    """Build finalize: hash + argsort of the build keys -> the sorted
+    hash array the probe binary-searches, plus the row order and the
+    valid-key mask."""
+    bhash, bvalid = hash_valid_keys(bkeys, mask)
+    bhash = jnp.where(bvalid, bhash, jnp.uint64(_NULL_HASH))
+    order = jnp.argsort(bhash).astype(jnp.int32)
+    return bhash[order], order, bvalid
+
+
+def runtime_filter_specs(node):
+    """Static eligibility for the build-side min/max runtime filters:
+    [(key index, probe BoundCol)] for the int-like BoundCol probe keys
+    whose width/scale agree with the build key so a raw-unit range is
+    valid.  Purely dtype-driven, so the fused build fragment can decide
+    eligibility before tracing."""
+    from matrixone_tpu.sql.expr import BoundCol
+    specs = []
+    for i, (lk, rk) in enumerate(zip(node.left_keys, node.right_keys)):
+        if not isinstance(lk, BoundCol):
+            continue
+        dtype = lk.dtype
+        int_like = dtype.is_integer or dtype.oid in (
+            dt.TypeOid.DATE, dt.TypeOid.DECIMAL64)
+        if not int_like or dtype.is_varlen:
+            continue
+        # scales/widths must agree for a raw-unit range to be valid
+        if rk.dtype != dtype and not (rk.dtype.is_integer
+                                      and dtype.is_integer):
+            continue
+        if getattr(rk.dtype, "is_vector", False):
+            continue
+        specs.append((i, lk))
+    return specs
+
+
+def runtime_filter_ranges(specs, bkeys, bvalid):
+    """(lo[], hi[], any_valid) build-key ranges for the eligible probe
+    keys, in raw units.  Pure — the fused build program returns these
+    as traced outputs, the eager path device_gets them."""
+    los, his = [], []
+    for i, _lk in specs:
+        data = bkeys[i].data
+        big = jnp.iinfo(data.dtype).max
+        los.append(jnp.min(jnp.where(bvalid, data, big)).astype(jnp.int64))
+        his.append(jnp.max(jnp.where(bvalid, data,
+                                     -big - 1)).astype(jnp.int64))
+    lo = (jnp.stack(los) if los
+          else jnp.zeros((0,), jnp.int64))
+    hi = (jnp.stack(his) if his
+          else jnp.zeros((0,), jnp.int64))
+    return lo, hi, jnp.any(bvalid)
+
+
+def expand_probe(node, ex: ExecBatch, build: ExecBatch, sorted_hash,
+                 border, phash, pvalid, pkeys, bkeys, mm: int,
+                 build_matched=None):
+    """One probe batch against a finalized build side: searchsorted ->
+    expand `mm` duplicate lanes -> verify true key equality -> gather
+    both sides -> residual -> left/full NULL-extension.  Returns
+    (out ExecBatch [np*mm lanes], overflow bool array, build_matched').
+    Pure (the overflow flag stays on device): JoinOp device_gets it,
+    the fused probe program returns it as a traced output."""
+    np_ = ex.padded_len
+    start = jnp.searchsorted(sorted_hash, phash)          # [np]
+    lane = jnp.arange(mm, dtype=jnp.int32)
+    pos = start[:, None] + lane[None, :]                  # [np, mm]
+    pos_c = jnp.clip(pos, 0, sorted_hash.shape[0] - 1)
+    cand_hash = sorted_hash[pos_c]
+    hash_ok = (cand_hash == phash[:, None]) & \
+        (pos < sorted_hash.shape[0]) & pvalid[:, None]
+    cand_rows = border[pos_c]                             # build row ids
+    # verify true key equality (hash only routes)
+    key_ok = hash_ok
+    for pk, bk in zip(pkeys, bkeys):
+        pv = pk.data[:, None]
+        bv = bk.data[cand_rows]
+        if pk.data.dtype != bv.dtype:
+            ct = jnp.promote_types(pk.data.dtype, bv.dtype)
+            pv, bv = pv.astype(ct), bv.astype(ct)
+        key_ok = key_ok & (pv == bv)
+    # overflow: a (mm+1)-th duplicate would also match
+    extra = jnp.clip(start + mm, 0, sorted_hash.shape[0] - 1)
+    overflow = jnp.any(
+        (sorted_hash[extra] == phash) & (start + mm < sorted_hash.shape[0])
+        & pvalid)
+
+    match = key_ok.reshape(-1)                            # [np*mm]
+    probe_idx = jnp.repeat(jnp.arange(np_, dtype=jnp.int32), mm)
+    build_idx = cand_rows.reshape(-1)
+
+    cols = {}
+    for name, _ in node.left.schema:
+        c = _broadcast_full(ex.batch.columns[name], np_)
+        cols[name] = DeviceColumn(c.data[probe_idx],
+                                  c.validity[probe_idx], c.dtype)
+    for name, _ in node.right.schema:
+        c = _broadcast_full(build.batch.columns[name], build.padded_len)
+        validity = c.validity[build_idx] & match
+        cols[name] = DeviceColumn(c.data[build_idx], validity, c.dtype)
+    db = DeviceBatch(columns=cols, n_rows=jnp.sum(match.astype(jnp.int32)))
+    out = ExecBatch(batch=db, dicts={**build.dicts, **ex.dicts},
+                    mask=match)
+    # residual ON predicate filters match lanes BEFORE left-join
+    # null-extension: a left row whose matches all fail the residual
+    # still emits one null-extended row (MySQL semantics)
+    if node.residual is not None:
+        pred = eval_expr(node.residual, out)
+        out.mask = out.mask & F.predicate_mask(pred, db)
+    if node.kind == "full":
+        # record which build rows matched (post-residual, pre-null-
+        # extension) — monotonic across overflow re-runs
+        build_matched = build_matched.at[build_idx].max(out.mask)
+    if node.kind in ("left", "full"):
+        matched_any = jnp.any(out.mask.reshape(np_, mm), axis=1)
+        lane0 = jnp.tile(lane == 0, (np_,))
+        null_emit = lane0 & ~jnp.repeat(matched_any, mm) & \
+            jnp.repeat(ex.mask, mm)
+        # null-extended lanes: right-side columns must read as NULL
+        for name, _ in node.right.schema:
+            c = out.batch.columns[name]
+            out.batch.columns[name] = DeviceColumn(
+                c.data, c.validity & ~null_emit, c.dtype)
+        out.mask = out.mask | null_emit
+    out.batch.n_rows = jnp.sum(out.mask.astype(jnp.int32))
+    return out, overflow, build_matched
+
+
+def collapse_semi_anti(node, ex: ExecBatch, out_mask, mm: int):
+    """Collapse match lanes back onto the probe rows: emit each left
+    row once iff it has (semi) / lacks (anti) a surviving match."""
+    np_ = ex.padded_len
+    matched_any = jnp.any(out_mask.reshape(np_, mm), axis=1)
+    keep = (ex.mask & matched_any if node.kind == "semi"
+            else ex.mask & ~matched_any)
+    db = DeviceBatch(
+        columns={n: _broadcast_full(ex.batch.columns[n], np_)
+                 for n, _ in node.left.schema},
+        n_rows=jnp.sum(keep.astype(jnp.int32)))
+    return ExecBatch(batch=db, dicts=dict(ex.dicts), mask=keep)
+
+
+def stream_build_side(build_iter, budget: int):
+    """Pull the build side counting live rows against `budget` ->
+    (batches, overflowed).  The padded lane count bounds live rows from
+    above, so a build fitting the budget never syncs; past the bound the
+    per-batch mask sums are STACKED on device and drained in one fused
+    reduction only when the un-synced upper bound could cross — one (or
+    a few) host syncs per build finalize instead of one per batch (the
+    old per-batch `device_get` serialized every dispatch past the
+    bound).  Each drain is a `join.build.livesync` motrace span, which
+    is how the regression test counts them."""
+    from matrixone_tpu.utils import motrace
+    batches: List[ExecBatch] = []
+    pending = []
+    padded_pending = 0
+    live = 0
+    overflowed = False
+    for ex in build_iter:
+        batches.append(ex)
+        pending.append(jnp.sum(ex.mask.astype(jnp.int64)))
+        padded_pending += int(ex.padded_len)
+        if live + padded_pending <= budget:
+            continue
+        with motrace.span("join.build.livesync", pending=len(pending)):
+            live += int(jax.device_get(jnp.sum(jnp.stack(pending))))
+        pending = []
+        padded_pending = 0
+        if live > budget:
+            overflowed = True
+            break
+    return batches, overflowed
 
 
 class _JoinSpill:
@@ -157,6 +425,12 @@ class JoinOp(Operator):
         self.schema = node.schema
         self.max_matches = max_matches
         self.spill_partitions = spill_partitions
+        #: (build ExecBatch, sorted_hash, order, bvalid, bkeys,
+        #: bkey_dicts) handed over by a fused join fragment degrading to
+        #: this op — its build program already computed the finalize AND
+        #: pushed the runtime filters; consumed (and cleared) by the
+        #: next execute() iff the build batch is the very same object
+        self._prepared_build = None
         self.build_budget = self.DEFAULT_BUILD_BUDGET
         if ctx is not None and ctx.variables:
             self.build_budget = int(ctx.variables.get(
@@ -166,29 +440,11 @@ class JoinOp(Operator):
         # stream the build side counting live rows; past the budget,
         # switch to the Grace path (cross joins have no key to partition
         # by — they stay in-memory whatever the size)
-        build_batches: List[ExecBatch] = []
         build_iter = self.right.execute()
         overflowed = False
         if self.node.kind != "cross" and self.node.right_keys:
-            # cheap gate first: the padded lane count bounds live rows
-            # from above, so no host sync happens until a build side is
-            # actually near the budget (the common case never syncs)
-            padded = 0
-            pending_sums = []
-            live = 0
-            for ex in build_iter:
-                build_batches.append(ex)
-                padded += int(ex.padded_len)
-                pending_sums.append(jnp.sum(ex.mask.astype(jnp.int64)))
-                if padded <= self.build_budget:
-                    continue
-                # drain the un-synced sums into the running counter: one
-                # host sync per NEW batch past the bound, never a re-sum
-                live += int(jax.device_get(sum(pending_sums)))
-                pending_sums = []
-                if live > self.build_budget:
-                    overflowed = True
-                    break
+            build_batches, overflowed = stream_build_side(
+                build_iter, self.build_budget)
         else:
             build_batches = list(build_iter)
         if overflowed:
@@ -211,27 +467,26 @@ class JoinOp(Operator):
                 yield self._null_extend_all(ex)
             return
         # build side: dense-compact masked rows, hash + sort keys
-        bkeys = [_broadcast_full(eval_expr(k, build), build.padded_len)
-                 for k in self.node.right_keys]
-        bhash = H.hash_columns([k.data for k in bkeys],
-                               [k.validity for k in bkeys])
-        # rows with NULL keys never match (SQL equi-join semantics)
-        bvalid = build.mask
-        for k in bkeys:
-            bvalid = bvalid & k.validity
-        bhash = jnp.where(bvalid, bhash, jnp.uint64(0xFFFFFFFFFFFFFFFF))
-        order = jnp.argsort(bhash).astype(jnp.int32)
-        sorted_hash = bhash[order]
-
-        if self.node.kind in ("inner", "semi"):
-            self._push_runtime_filters(bkeys, bvalid)
+        prep, self._prepared_build = self._prepared_build, None
+        if prep is not None and prep[0] is build:
+            # fused-fragment degrade handoff: the build finalize already
+            # ran as one compiled dispatch and the runtime filters are
+            # already on the probe scans — don't redo either
+            _, sorted_hash, order, bvalid, bkeys, bkey_dicts = prep
+        else:
+            bkeys, bkey_dicts = build_key_columns(self.node, build)
+            sorted_hash, order, bvalid = build_sorted_hash(bkeys,
+                                                           build.mask)
+            if self.node.kind in ("inner", "semi"):
+                self._push_runtime_filters(bkeys, bvalid)
         if self.node.kind == "full":
             self._build_matched = jnp.zeros(build.padded_len, jnp.bool_)
             self._probe_dicts = {}
         for ex in self.left.execute():
             if self.node.kind == "full":
                 self._probe_dicts.update(ex.dicts)
-            yield from self._probe(ex, build, sorted_hash, order, bkeys)
+            yield from self._probe(ex, build, sorted_hash, order, bkeys,
+                                   bkey_dicts)
         if self.node.kind == "full":
             # FULL OUTER: emit build rows no probe row matched, probe-side
             # columns null-extended (the probe loop already null-extended
@@ -294,9 +549,29 @@ class JoinOp(Operator):
                         keys, schema) -> None:
         """Route each live row to partition hash(key) % P. NULL-key rows
         ride their hash too: they never match, but left/anti/full joins
-        still emit them from within their partition."""
-        kcols = [_broadcast_full(eval_expr(k, ex), ex.padded_len)
-                 for k in keys]
+        still emit them from within their partition.  Varchar keys route
+        by a stable VALUE hash of the string (each side partitions
+        independently, so codes cannot agree across sides)."""
+        from matrixone_tpu.vm.operators import _expr_dict
+        kcols = []
+        for k in keys:
+            c = _broadcast_full(eval_expr(k, ex), ex.padded_len)
+            if k.dtype.is_varlen:
+                d = _expr_dict(k, ex)
+                if d:
+                    lut = np.asarray([_str_hash_i64(s) for s in d],
+                                     np.int64)
+                    c = DeviceColumn(
+                        jnp.asarray(lut)[
+                            jnp.clip(c.data, 0, max(len(d) - 1, 0))],
+                        c.validity, c.dtype)
+                else:
+                    # None (unresolvable: the in-memory join inside the
+                    # partition raises) or empty (all-NULL: routing is
+                    # irrelevant, NULL keys never match)
+                    c = DeviceColumn(jnp.zeros_like(c.data, jnp.int64),
+                                     c.validity, c.dtype)
+            kcols.append(c)
         h = H.hash_columns([k.data for k in kcols],
                            [k.validity for k in kcols])
         part = (h % jnp.uint64(spill.P)).astype(jnp.int32)
@@ -323,33 +598,27 @@ class JoinOp(Operator):
         Inner/semi only — removing non-matching probe rows early cannot
         change the result. Ranges ride the scan's zonemap pruning, so
         whole chunks outside the build key range are never read."""
+        specs = runtime_filter_specs(self.node)
+        if not specs:
+            return
+        lo, hi, any_valid = runtime_filter_ranges(specs, bkeys, bvalid)
+        got = jax.device_get((lo, hi, any_valid))
+        self.apply_runtime_filters(specs, np.asarray(got[0]),
+                                   np.asarray(got[1]), bool(got[2]))
+
+    def apply_runtime_filters(self, specs, lo_np, hi_np,
+                              any_valid: bool) -> None:
+        """Inject ge/le runtime filters for the pre-computed build-key
+        ranges (shared with the fused build fragment, which computes the
+        ranges as traced outputs of the build program)."""
         from matrixone_tpu.sql.expr import BoundCol, BoundFunc, BoundLiteral
-        any_valid = bool(jax.device_get(jnp.any(bvalid)))
         if not any_valid:
             return
-        for lk, bk in zip(self.node.left_keys, bkeys):
-            if not isinstance(lk, BoundCol):
-                continue
+        for (_i, lk), lo, hi in zip(specs, lo_np, hi_np):
             dtype = lk.dtype
-            int_like = dtype.is_integer or dtype.oid in (
-                dt.TypeOid.DATE, dt.TypeOid.DECIMAL64)
-            if not int_like or dtype.is_varlen:
-                continue
-            # scales/widths must agree for a raw-unit range to be valid
-            if bk.dtype != dtype and not (bk.dtype.is_integer
-                                          and dtype.is_integer):
-                continue
-            data = bk.data
-            if data.ndim != 1:
-                continue
-            big = jnp.iinfo(data.dtype).max
-            lo = int(jax.device_get(
-                jnp.min(jnp.where(bvalid, data, big))))
-            hi = int(jax.device_get(
-                jnp.max(jnp.where(bvalid, data, -big - 1))))
+            lo, hi = int(lo), int(hi)
             if dtype.is_integer:
-                import numpy as _np
-                info = _np.iinfo(dtype.np_dtype)
+                info = np.iinfo(dtype.np_dtype)
                 lo = max(lo, int(info.min))
                 hi = min(hi, int(info.max))
             for scan, name in _probe_scans(self.left, lk.name):
@@ -359,103 +628,25 @@ class JoinOp(Operator):
                 scan.runtime_filters.append(
                     BoundFunc("le", [col, BoundLiteral(hi, dtype)], dt.BOOL))
 
-    def _probe(self, ex: ExecBatch, build, sorted_hash, border, bkeys):
-        pkeys = [_broadcast_full(eval_expr(k, ex), ex.padded_len)
-                 for k in self.node.left_keys]
-        phash = H.hash_columns([k.data for k in pkeys],
-                               [k.validity for k in pkeys])
-        pvalid = ex.mask
-        for k in pkeys:
-            pvalid = pvalid & k.validity
+    def _probe(self, ex: ExecBatch, build, sorted_hash, border, bkeys,
+               bkey_dicts):
+        pkeys = probe_key_columns(self.node, ex, bkey_dicts)
+        phash, pvalid = hash_valid_keys(pkeys, ex.mask)
         mm = self.max_matches
         while True:
-            out, overflow = self._expand(ex, build, sorted_hash, border,
-                                         phash, pvalid, pkeys, bkeys, mm)
-            if not overflow:
+            bm = getattr(self, "_build_matched", None)
+            out, overflow, bm = expand_probe(
+                self.node, ex, build, sorted_hash, border, phash,
+                pvalid, pkeys, bkeys, mm, bm)
+            if not bool(jax.device_get(overflow)):
+                if self.node.kind == "full":
+                    self._build_matched = bm
                 break
             mm *= 2
         if self.node.kind in ("semi", "anti"):
-            # collapse match lanes back onto the probe rows: emit each left
-            # row once iff it has (semi) / lacks (anti) a surviving match
-            matched_any = jnp.any(out.mask.reshape(ex.padded_len, mm),
-                                  axis=1)
-            keep = (ex.mask & matched_any if self.node.kind == "semi"
-                    else ex.mask & ~matched_any)
-            db = DeviceBatch(
-                columns={n: _broadcast_full(ex.batch.columns[n],
-                                            ex.padded_len)
-                         for n, _ in self.node.left.schema},
-                n_rows=jnp.sum(keep.astype(jnp.int32)))
-            yield ExecBatch(batch=db, dicts=dict(ex.dicts), mask=keep)
+            yield collapse_semi_anti(self.node, ex, out.mask, mm)
             return
         yield _maybe_compact(out)
-
-    def _expand(self, ex, build, sorted_hash, border, phash, pvalid,
-                pkeys, bkeys, mm):
-        np_ = ex.padded_len
-        start = jnp.searchsorted(sorted_hash, phash)          # [np]
-        lane = jnp.arange(mm, dtype=jnp.int32)
-        pos = start[:, None] + lane[None, :]                  # [np, mm]
-        pos_c = jnp.clip(pos, 0, sorted_hash.shape[0] - 1)
-        cand_hash = sorted_hash[pos_c]
-        hash_ok = (cand_hash == phash[:, None]) & \
-            (pos < sorted_hash.shape[0]) & pvalid[:, None]
-        cand_rows = border[pos_c]                             # build row ids
-        # verify true key equality (hash only routes)
-        key_ok = hash_ok
-        for pk, bk in zip(pkeys, bkeys):
-            pv = pk.data[:, None]
-            bv = bk.data[cand_rows]
-            if pk.data.dtype != bv.dtype:
-                ct = jnp.promote_types(pk.data.dtype, bv.dtype)
-                pv, bv = pv.astype(ct), bv.astype(ct)
-            key_ok = key_ok & (pv == bv)
-        # overflow: a (mm+1)-th duplicate would also match
-        extra = jnp.clip(start + mm, 0, sorted_hash.shape[0] - 1)
-        overflow = bool(jax.device_get(jnp.any(
-            (sorted_hash[extra] == phash) & (start + mm < sorted_hash.shape[0])
-            & pvalid)))
-
-        match = key_ok.reshape(-1)                            # [np*mm]
-        probe_idx = jnp.repeat(jnp.arange(np_, dtype=jnp.int32), mm)
-        build_idx = cand_rows.reshape(-1)
-
-        cols = {}
-        for name, _ in self.node.left.schema:
-            c = _broadcast_full(ex.batch.columns[name], np_)
-            cols[name] = DeviceColumn(c.data[probe_idx],
-                                      c.validity[probe_idx], c.dtype)
-        for name, _ in self.node.right.schema:
-            c = _broadcast_full(build.batch.columns[name], build.padded_len)
-            validity = c.validity[build_idx] & match
-            cols[name] = DeviceColumn(c.data[build_idx], validity, c.dtype)
-        db = DeviceBatch(columns=cols, n_rows=jnp.sum(match.astype(jnp.int32)))
-        out = ExecBatch(batch=db, dicts={**build.dicts, **ex.dicts},
-                        mask=match)
-        # residual ON predicate filters match lanes BEFORE left-join
-        # null-extension: a left row whose matches all fail the residual
-        # still emits one null-extended row (MySQL semantics)
-        if self.node.residual is not None:
-            pred = eval_expr(self.node.residual, out)
-            out.mask = out.mask & F.predicate_mask(pred, db)
-        if self.node.kind == "full":
-            # record which build rows matched (post-residual, pre-null-
-            # extension) — monotonic across overflow re-runs
-            self._build_matched = self._build_matched.at[build_idx].max(
-                out.mask)
-        if self.node.kind in ("left", "full"):
-            matched_any = jnp.any(out.mask.reshape(np_, mm), axis=1)
-            lane0 = jnp.tile(lane == 0, (np_,))
-            null_emit = lane0 & ~jnp.repeat(matched_any, mm) & \
-                jnp.repeat(ex.mask, mm)
-            # null-extended lanes: right-side columns must read as NULL
-            for name, _ in self.node.right.schema:
-                c = out.batch.columns[name]
-                out.batch.columns[name] = DeviceColumn(
-                    c.data, c.validity & ~null_emit, c.dtype)
-            out.mask = out.mask | null_emit
-        out.batch.n_rows = jnp.sum(out.mask.astype(jnp.int32))
-        return out, overflow
 
     def _null_extend_all(self, ex: ExecBatch) -> ExecBatch:
         np_ = ex.padded_len

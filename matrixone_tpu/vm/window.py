@@ -62,11 +62,21 @@ class WindowOp(Operator):
         self.max_partitions = max_partitions
 
     def execute(self) -> Iterator[ExecBatch]:
-        from matrixone_tpu.vm.operators import _expr_dict
         batches = list(self.child.execute())
         if not batches:
             return
         ex = _concat_batches(batches, self.node.child.schema)
+        out_cols, out_dicts = self.compute_columns(ex)
+        db = DeviceBatch(columns=out_cols, n_rows=ex.batch.n_rows)
+        yield ExecBatch(batch=db, dicts=out_dicts, mask=ex.mask)
+
+    def compute_columns(self, ex: ExecBatch):
+        """Evaluate every window entry over one materialized batch ->
+        (output columns, output dicts).  Pure device math (argsort +
+        segmented scans + gathers): the fused window fragment
+        (vm/fusion_window.py) traces this very method, so the fused and
+        per-operator paths share one kernel body."""
+        from matrixone_tpu.vm.operators import _expr_dict
         out_cols = dict(ex.batch.columns)
         out_dicts = dict(ex.dicts)
         # entries sharing one OVER spec share the sort/segment machinery
@@ -87,8 +97,7 @@ class WindowOp(Operator):
                 d = _expr_dict(arg, ex)
                 if d is not None:
                     out_dicts[out_name] = d
-        db = DeviceBatch(columns=out_cols, n_rows=ex.batch.n_rows)
-        yield ExecBatch(batch=db, dicts=out_dicts, mask=ex.mask)
+        return out_cols, out_dicts
 
     # ------------------------------------------------------------ kernels
     def _spec(self, part, okeys, odescs, ex):

@@ -1,0 +1,8 @@
+create table f_orders (okey bigint primary key, cust bigint, pri int);
+create table f_lines (lkey bigint, amount bigint, disc bigint);
+insert into f_orders values (1, 10, 0), (2, 20, 1), (3, 10, 0);
+insert into f_lines values (1, 100, 2), (1, 50, 1), (2, 70, 0), (3, 30, 3);
+explain select lkey, sum(amount - disc) rev from f_lines join f_orders on lkey = okey where pri = 0 group by lkey;
+explain select lkey, amount from f_lines join f_orders on lkey = okey order by amount desc limit 2;
+select lkey, sum(amount - disc) rev from f_lines join f_orders on lkey = okey where pri = 0 group by lkey order by lkey;
+select lkey, amount from f_lines join f_orders on lkey = okey order by amount desc limit 2;

@@ -1,0 +1,36 @@
+"""jit-purity fixture: fused-JOIN-fragment-style trace roots where the
+jit target is a LOCAL VARIABLE — either a direct alias of a nested def
+(`fn = _build_step; jax.jit(fn)`) or the closure a factory method
+returns (`fn = self._make_probe_step(); jax.jit(fn)`).  Both bodies
+must be discovered and walked.  AST-only — never imported or
+executed."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+class BadJoinFragment:
+    def build(self, datas, mask):
+        def _build_step(datas, mask):
+            # reachable from jit through the local-alias wrap below
+            scale = time.perf_counter()
+            return jnp.sum(jnp.where(mask, datas, 0.0)) * scale
+
+        fn = _build_step
+        compiled = jax.jit(fn)
+        return compiled(datas, mask)
+
+    def _make_probe_step(self):
+        def _probe_step(datas, mask):
+            # reachable from jit through the factory-returned wrap
+            scale = time.perf_counter()
+            return jnp.max(jnp.where(mask, datas, -1.0)) * scale
+
+        return _probe_step
+
+    def probe(self, datas, mask):
+        fn = self._make_probe_step()
+        compiled = jax.jit(fn)
+        return compiled(datas, mask)

@@ -1,0 +1,31 @@
+"""jit-purity fixture (clean): the same local-alias and
+factory-returned trace-root shapes as alias_bad.py, but the traced
+bodies are pure — host-side timing stays OUTSIDE the jit wrap."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+class GoodJoinFragment:
+    def build(self, datas, mask):
+        def _build_step(datas, mask):
+            return jnp.sum(jnp.where(mask, datas, 0.0))
+
+        fn = _build_step
+        t0 = time.perf_counter()          # host side: times the wrap
+        compiled = jax.jit(fn)
+        out = compiled(datas, mask)
+        return out, time.perf_counter() - t0
+
+    def _make_probe_step(self):
+        def _probe_step(datas, mask):
+            return jnp.max(jnp.where(mask, datas, -1.0))
+
+        return _probe_step
+
+    def probe(self, datas, mask):
+        fn = self._make_probe_step()
+        compiled = jax.jit(fn)
+        return compiled(datas, mask)

@@ -1,0 +1,21 @@
+"""jit-purity fixture (cross-module, file 1/2): the base-class jit
+site of the fused-fragment idiom — the traced fn comes from a
+`self._make_step()` factory that SUBCLASSES override in other modules
+(xmod_bad_sub.py).  The checker must root every same-named factory's
+nested defs across modules.  AST-only — never imported or executed."""
+
+import jax
+
+
+class BaseFragment:
+    def _make_step(self):
+        def _base_step(datas, mask):
+            return datas
+
+        return _base_step
+
+    def run(self, datas, mask):
+        fn = self._make_step()
+        _step = fn
+        compiled = jax.jit(_step)
+        return compiled(datas, mask)

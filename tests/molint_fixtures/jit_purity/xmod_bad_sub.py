@@ -1,0 +1,30 @@
+"""jit-purity fixture (cross-module, file 2/2): a subclass whose
+`_make_step` override lives in a DIFFERENT module than the jit wrap
+(xmod_bad_base.py), and whose traced body calls through an
+instance-attribute local (`kop = self._kernel`) into another class's
+method — both hops must be followed.  AST-only."""
+
+import time
+
+import jax.numpy as jnp
+
+
+class Kernel:
+    def compute(self, datas, mask):
+        # traced through SubFragment._make_step._sub_step below:
+        # wall-clock read freezes at trace time
+        scale = time.perf_counter()
+        return jnp.sum(jnp.where(mask, datas, 0.0)) * scale
+
+
+class SubFragment:
+    def __init__(self):
+        self._kernel = Kernel()
+
+    def _make_step(self):
+        kop = self._kernel
+
+        def _sub_step(datas, mask):
+            return kop.compute(datas, mask)
+
+        return _sub_step

@@ -1,0 +1,18 @@
+"""jit-purity fixture (clean, cross-module, file 1/2): same base-class
+jit-site shape as xmod_bad_base.py."""
+
+import jax
+
+
+class BaseFragment:
+    def _make_step(self):
+        def _base_step(datas, mask):
+            return datas
+
+        return _base_step
+
+    def run(self, datas, mask):
+        fn = self._make_step()
+        _step = fn
+        compiled = jax.jit(_step)
+        return compiled(datas, mask)

@@ -1,0 +1,26 @@
+"""jit-purity fixture (clean, cross-module, file 2/2): the same
+subclass-factory + attribute-receiver shapes as xmod_bad_sub.py, with a
+pure kernel body — host timing stays OUTSIDE the traced path."""
+
+import time
+
+import jax.numpy as jnp
+
+
+class Kernel:
+    def compute(self, datas, mask):
+        return jnp.sum(jnp.where(mask, datas, 0.0))
+
+
+class SubFragment:
+    def __init__(self):
+        self._kernel = Kernel()
+        self.built_at = time.perf_counter()   # host side: not traced
+
+    def _make_step(self):
+        kop = self._kernel
+
+        def _sub_step(datas, mask):
+            return kop.compute(datas, mask)
+
+        return _sub_step

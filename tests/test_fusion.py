@@ -104,31 +104,50 @@ def test_fused_lockstep_eager_threshold(sess, env):
     assert M.fusion_exec.get(mode="eager") > 0
 
 
-def test_barrier_join_splits_fragments(sess):
+def _plan_of(sess, sql):
+    from matrixone_tpu.sql.binder import Binder
+    from matrixone_tpu.sql.parser import parse
+    sel = parse(sql)[0]
+    sess._prepare_select(sel)
+    node = Binder(sess.catalog).bind_statement(sel)
+    node = sess._cbo(node)
+    return compile_plan(node, sess._ctx())
+
+
+def test_join_fuses_into_probe_fragment(sess):
+    """A fusable equi-join is no longer a barrier: it becomes a
+    build/probe fragment (FusedJoinProbeOp) fused WITH the chain above
+    it, and `MO_FUSION_JOIN=0` restores the barrier bit-identically."""
+    from matrixone_tpu.vm.fusion_join import FusedJoinProbeOp
+    from matrixone_tpu.vm.join import JoinOp
     sess.execute("create table dim (k bigint, label varchar(8))")
     sess.execute("insert into dim values (1,'one'),(2,'two'),(3,'three')"
                  ",(4,'four'),(5,'five')")
     sql = ("select dim.label, sum(t.v) s, count(*) n from t"
            " join dim on t.v = dim.k where t.d > 0.5 and dim.k > 1"
            " group by dim.label order by dim.label")
-    _lockstep(sess, sql)
-    # the join is a fusion barrier: fragments exist BELOW it (scan
-    # sides) and ABOVE it (the aggregate), the join op itself survives
-    from matrixone_tpu.sql.binder import Binder
-    from matrixone_tpu.sql.parser import parse
-    from matrixone_tpu.vm.join import JoinOp
+    r = _lockstep(sess, sql)
     os.environ["MO_PLAN_FUSION"] = "1"
-    sel = parse(sql)[0]
-    sess._prepare_select(sel)
-    node = Binder(sess.catalog).bind_statement(sel)
-    node = sess._cbo(node)
-    op = compile_plan(node, sess._ctx())
-    kinds = [type(o).__name__ for o in iter_ops(op)]
-    assert "JoinOp" in kinds
-    frags = [o for o in iter_ops(op) if isinstance(o, FusedFragmentOp)]
-    assert len(frags) >= 1          # at least the aggregate fragment
-    agg_frag = [f for f in frags if f._agg_op is not None]
-    assert agg_frag, "aggregate above the join must fuse"
+    op = _plan_of(sess, sql)
+    frags = [o for o in iter_ops(op)
+             if isinstance(o, FusedJoinProbeOp)]
+    assert frags, "the equi-join must fuse into a probe fragment"
+    assert frags[0]._agg_op is not None, \
+        "the grouped aggregate above the join must ride the fragment"
+    assert "join=build+probe" in frags[0].node_roles.values()
+    # the ORIGINAL JoinOp survives inside the fragment as the
+    # degradation ladder, its children pointed at the fused sources
+    assert isinstance(frags[0]._join, JoinOp)
+    # MO_FUSION_JOIN=0: the join is a barrier again, same rows
+    os.environ["MO_FUSION_JOIN"] = "0"
+    try:
+        op = _plan_of(sess, sql)
+        assert not [o for o in iter_ops(op)
+                    if isinstance(o, FusedJoinProbeOp)]
+        assert "JoinOp" in [type(o).__name__ for o in iter_ops(op)]
+        assert sess.execute(sql).rows() == r
+    finally:
+        os.environ.pop("MO_FUSION_JOIN", None)
 
 
 def test_barrier_udf_row_loop_splits_chain(sess):

@@ -97,6 +97,39 @@ def test_jit_purity_attribute_wrapped_roots():
                and "time.perf_counter" in f.message for f in bad)
 
 
+def test_jit_purity_alias_and_factory_roots():
+    """Fused-join-fragment-style trace roots where the jit target is a
+    local variable — a direct alias of a nested def (`fn = _build_step;
+    jax.jit(fn)`) or a factory-returned closure (`fn =
+    self._make_probe_step(); jax.jit(fn)`) — are discovered and walked;
+    the same shapes with pure bodies stay quiet."""
+    d = os.path.join(FIX, "jit_purity")
+    bad = _fixture_pair("jit-purity",
+                        [os.path.join(d, "alias_bad.py")],
+                        [os.path.join(d, "alias_good.py")])
+    assert any("_build_step" in f.message
+               and "time.perf_counter" in f.message for f in bad)
+    assert any("_probe_step" in f.message
+               and "time.perf_counter" in f.message for f in bad)
+
+
+def test_jit_purity_cross_module_factory_roots():
+    """A base-class jit site whose traced fn comes from a
+    `self._make_step()` factory overridden in ANOTHER module (the fused
+    window idiom: fusion.py wraps, fusion_window.py makes the step,
+    window.py owns the kernel body reached through `wop = self._window`)
+    is followed across both hops; the pure twin stays quiet."""
+    d = os.path.join(FIX, "jit_purity")
+    bad = _fixture_pair(
+        "jit-purity",
+        [os.path.join(d, "xmod_bad_base.py"),
+         os.path.join(d, "xmod_bad_sub.py")],
+        [os.path.join(d, "xmod_good_base.py"),
+         os.path.join(d, "xmod_good_sub.py")])
+    assert any("Kernel.compute" in f.message
+               and "time.perf_counter" in f.message for f in bad)
+
+
 def test_lock_discipline_fixtures():
     d = os.path.join(FIX, "lock_discipline")
     bad = _fixture_pair("lock-discipline",

@@ -209,6 +209,45 @@ def test_moqa_repro_case_arith_truncation_sqlite():
         pair="oracle:sqlite") == []
 
 
+def test_moqa_repro_null_key_tiebreak_sqlite():
+    """moqa-reduced repro (seed 2026, sqlite oracle): within the NULL
+    class of an ORDER BY key, `ops/sort.py` sorted rows by the lanes'
+    arbitrary underlying data (here `0 - id`, so id DESCENDING) instead
+    of preserving the less-significant key's order — the value pass
+    must be a no-op for invalid lanes."""
+    from tools import moqa
+    assert moqa.replay(
+        create="create table qa_nullsort (id bigint, d double)",
+        insert="insert into qa_nullsort values (1, null), (2, null), "
+               "(3, null), (4, 0.5)",
+        query="select (d - id) c0, id oid from qa_nullsort "
+              "order by c0, id",
+        ordered=True,
+        pair="oracle:sqlite") == []
+
+
+def test_reducer_sqlite_oracle_drops_unmirrorable_columns():
+    """reduce_finding on an oracle-sqlite finding over a scenario with
+    sqlite-unmirrorable columns (decimal/bool/date) pre-drops them, so
+    the first probe doesn't die in the replay mirror's CREATE."""
+    from tools.moqa import runner as R
+    gen_ = Generator(2026)
+    sc = [s for s in gen_.scenarios() if s.name == "qa_nulls"][0]
+    assert any(not c.sqlite_type for c in sc.columns)  # premise
+    q = GenQuery(table="qa_nulls",
+                 select=[("id", "oid")], order_by=["id"],
+                 features=frozenset({"ordered"}))
+
+    # the fabricated finding does NOT actually reproduce — the point is
+    # which error reduce_finding raises: post-drop the initial probe
+    # RUNS and reports non-reproduction; without the drop it died in
+    # the sqlite mirror on 'schema has sqlite-unmirrorable columns'
+    f = R.Finding(kind="oracle-sqlite", scenario="qa_nulls", seed=2026,
+                  pair="-", sql=q.sql(), detail="unit", query=q)
+    with pytest.raises(ValueError, match="does not reproduce"):
+        reducer.reduce_finding(f, gen_)
+
+
 def test_case_branch_coercion_decimal_float():
     """Companion pin for the evaluator half of the fix: every CASE
     branch coerces to the bound result type BEFORE jnp.where — a

@@ -108,6 +108,9 @@ class JitPurityChecker(Checker):
         funcs: Dict[Tuple[str, str], List["FuncEntry"]] = {}
         mod_globals: Dict[str, Set[str]] = {}
         roots: Set[Tuple[str, str]] = set()
+        mod_alias: Dict[str, Dict[str, Set[str]]] = {}
+        mod_factory: Dict[str, Dict[str, Set[str]]] = {}
+        jit_targets: List[Tuple[str, str]] = []
         for mod in project.modules:
             if mod.tree is None:
                 continue
@@ -126,12 +129,74 @@ class JitPurityChecker(Checker):
                 funcs.setdefault(key, []).append(FuncEntry(fi))
                 if _decorated_as_jit(fi.node):
                     roots.add(key)
-            # x = jax.jit(f) / jit-wrapped call expressions
+            # local aliases a jit wrap may resolve through:
+            #   fn = _traced_step            (direct alias)
+            #   fn = self._make_step(...)    (factory returning the
+            #                                 closure it defines)
+            # — the fused-fragment idiom: the wrapped Name is a local
+            # variable, not a def, so the plain def lookup misses it
+            alias: Dict[str, Set[str]] = {}
+            factory: Dict[str, Set[str]] = {}
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    v = node.value
+                    if isinstance(v, (ast.Name, ast.Attribute)):
+                        d = dotted(v)
+                        if d:
+                            alias.setdefault(t.id, set()).add(
+                                d.split(".")[-1])
+                    elif isinstance(v, ast.Call):
+                        d = dotted(v.func)
+                        if d:
+                            factory.setdefault(t.id, set()).add(
+                                d.split(".")[-1])
+            mod_alias[mod.modname] = alias
+            mod_factory[mod.modname] = factory
             for node in ast.walk(mod.tree):
                 if isinstance(node, ast.Call):
                     tgt = _jit_wrap_target(node)
-                    if tgt and (mod.modname, tgt) in funcs:
-                        roots.add((mod.modname, tgt))
+                    if tgt:
+                        jit_targets.append((mod.modname, tgt))
+
+        # ---- resolve every jit wrap target: the named def, plus the
+        # transitive local-alias closure (`_step = fn; fn =
+        # self._make_step(...)`), plus factory-returned closures.  A
+        # factory is matched by BARE NAME ACROSS MODULES: `self._make_
+        # step()` at a base-class jit site dispatches virtually to any
+        # subclass override, whose module the AST cannot know — rooting
+        # every same-named factory's nested defs is the same over-
+        # approximation policy as bare-name call resolution
+        facs_by_name: Dict[str, List[Tuple[str, "FuncEntry"]]] = {}
+        for (m2, nm2), entries in funcs.items():
+            for entry in entries:
+                facs_by_name.setdefault(nm2, []).append((m2, entry))
+        for modname, tgt in jit_targets:
+            alias = mod_alias.get(modname, {})
+            factory = mod_factory.get(modname, {})
+            names = {tgt}
+            while True:
+                more = {a for n in names for a in alias.get(n, ())} \
+                    - names
+                if not more:
+                    break
+                names |= more
+            for n in names:
+                if (modname, n) in funcs:
+                    roots.add((modname, n))
+            for fac in {f for n in names for f in factory.get(n, ())}:
+                for m2, entry in facs_by_name.get(fac, ()):
+                    # the factory's nested defs ARE the traced
+                    # bodies it returns; root them all
+                    for sub in ast.walk(entry.fi.node):
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)) \
+                                and sub is not entry.fi.node \
+                                and (m2, sub.name) in funcs:
+                            roots.add((m2, sub.name))
 
         # ---- reachability closure over the call graph
         reach: Set[Tuple[str, str]] = set()
@@ -143,7 +208,14 @@ class JitPurityChecker(Checker):
             reach.add(key)
             for entry in funcs[key]:
                 for callee in entry.callees():
-                    if callee in funcs and callee not in reach:
+                    if callee[0] == "*":
+                        # unknown receiver: every module's same-named
+                        # def (facs_by_name is the by-name index)
+                        for m2, e2 in facs_by_name.get(callee[1], ()):
+                            k2 = (m2, callee[1])
+                            if k2 not in reach:
+                                stack.append(k2)
+                    elif callee in funcs and callee not in reach:
                         stack.append(callee)
 
         # ---- impurity scan of every reachable function
@@ -233,6 +305,21 @@ class FuncEntry:
             return self._callees
         out: List[Tuple[str, str]] = []
         modname = self.fi.module.modname
+        # names bound to instance attributes anywhere in the module
+        # (`wop = self._window` — often in the ENCLOSING factory of a
+        # nested traced def, so collected module-wide): method calls
+        # through them dispatch to classes the AST cannot name, so those
+        # calls resolve by bare method name (below)
+        attr_locals = getattr(self.fi.module, "_attr_locals", None)
+        if attr_locals is None:
+            attr_locals = set()
+            for node in ast.walk(self.fi.module.tree):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Attribute):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            attr_locals.add(t.id)
+            self.fi.module._attr_locals = attr_locals
         for node in walk_skip_nested_funcs(self.fi.node):
             if not isinstance(node, ast.Call):
                 continue
@@ -248,6 +335,14 @@ class FuncEntry:
                 target = self.aliases.get(parts[0])
                 if target:
                     out.append((target, parts[1]))
+                elif parts[0] in attr_locals:
+                    # method call through an instance-attribute local
+                    # (`wop = self._window; ... wop.compute_columns()`):
+                    # anything invoked from a trace-reachable body is
+                    # itself traced, so over-approximate by bare method
+                    # name across modules ("*" is expanded in the
+                    # reachability closure)
+                    out.append(("*", parts[1]))
             # also: functions passed by name as call arguments
             for a in node.args:
                 if isinstance(a, ast.Name):

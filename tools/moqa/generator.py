@@ -128,12 +128,18 @@ class GenQuery:
     order_by: List[str] = dataclasses.field(default_factory=list)
     limit: Optional[int] = None
     offset: Optional[int] = None
+    #: equi-join tail: "from table <join_kind> <join_table> on <join_on>"
+    join_table: Optional[str] = None
+    join_kind: str = "join"
+    join_on: Optional[str] = None
     features: frozenset = frozenset()
     cols: frozenset = frozenset()
 
     def sql(self) -> str:
         items = ", ".join(f"{e} {a}" if a else e for e, a in self.select)
         s = f"select {items} from {self.table}"
+        if self.join_table:
+            s += f" {self.join_kind} {self.join_table} on {self.join_on}"
         if self.where:
             s += " where " + " and ".join(
                 w if len(self.where) == 1 else f"({w})"
@@ -186,9 +192,46 @@ class Generator:
             self.mixed_scenario("qa_nulls", n_rows=88, null_p=0.45),
             self.mixed_scenario("qa_pad", n_rows=straddle_rows,
                                 null_p=0.10),
+            self.join_scenario("qa_join", n_rows=131, null_p=0.25),
             self.vector_scenario("qa_vec", n_rows=72, dim=8),
         ]
         return out
+
+    def join_scenario(self, table: str, n_rows: int,
+                      null_p: float) -> Scenario:
+        """A mixed main (probe) table plus a NULL-heavy build-side dim
+        table `<table>_d`, created through setup_sql so every replay /
+        repro path carries it.  Its string key `jg` shares the `g`
+        value space (the varchar code-translation path) and its bigint
+        key `jk` overlaps `v` WITH duplicates, so probe fan-out, NULL
+        keys, and left-join null-extension all occur in the corpus."""
+        sc = self.mixed_scenario(table, n_rows=n_rows, null_p=null_p)
+        rng = self.rng
+        dim = f"{table}_d"
+        n_dim = 37
+        dim_rows = []
+        for j in range(n_dim):
+            jg = None if float(rng.random()) < null_p else \
+                _G_VALUES[int(rng.integers(0, 5))]
+            jk = None if float(rng.random()) < null_p else \
+                int(rng.integers(-10, 40))       # dups near v's range
+            jv = None if float(rng.random()) < null_p / 2 else \
+                int(rng.integers(-50, 200))
+            jw = int(rng.integers(0, 6))
+            dim_rows.append((j, jg, jk, jv, jw))
+        vals = ",".join(
+            "(" + ",".join(("null" if x is None
+                            else f"'{x}'" if isinstance(x, str)
+                            else str(x)) for x in r) + ")"
+            for r in dim_rows)
+        setup = list(sc.setup_sql) + [
+            f"create table {dim} (jid bigint, jg varchar(8), "
+            f"jk bigint, jv bigint, jw int)",
+            f"insert into {dim} values {vals}",
+        ]
+        return dataclasses.replace(
+            sc, name=table, setup_sql=setup,
+            features=sc.features | frozenset({"join_scenario"}))
 
     def mixed_scenario(self, table: str, n_rows: int,
                        null_p: float) -> Scenario:
@@ -331,12 +374,112 @@ class Generator:
     def query(self, scenario: Scenario) -> GenQuery:
         if "vector" in scenario.features:
             return self._vector_query(scenario)
+        if "join_scenario" in scenario.features:
+            r = float(self.rng.random())
+            if r < 0.45:
+                return self._join_query(scenario)
+            if r < 0.80:
+                return self._window_query(scenario)
+            # the single-table shapes still run on the probe table
         r = float(self.rng.random())
         if r < 0.42:
             return self._plain_query(scenario)
         if r < 0.58:
             return self._scalar_agg_query(scenario)
         return self._grouped_agg_query(scenario)
+
+    def _join_on(self, sc: Scenario) -> Tuple[str, frozenset]:
+        dim = f"{sc.table}_d"
+        if self._maybe(0.5):
+            # dict-string key: each side's dictionary assigns codes
+            # independently — the probe-side code translation path
+            return (f"{sc.table}.g = {dim}.jg", frozenset(["g"]))
+        return (f"{sc.table}.v = {dim}.jk", frozenset(["v"]))
+
+    def _join_query(self, sc: Scenario) -> GenQuery:
+        """Two-table equi-join over NULL-heavy keys: grouped aggregate
+        above the probe (the fused probe→agg chain) or a plain
+        probe-gather tail with a deterministic total order."""
+        dim = f"{sc.table}_d"
+        on, oncols = self._join_on(sc)
+        kind = "join" if self._maybe(0.65) else "left join"
+        feats = {"join"} | ({"left_join"} if kind != "join" else set())
+        where, wcols, wfeats, _ = self._where(p=0.55)
+        feats |= set(wfeats)
+        if self._maybe(0.45):
+            select = [("g", "k0"), ("count(*)", "a0"),
+                      ("sum(jv)", "a1")]
+            if self._maybe(0.5):
+                select.append(("sum(v + jw)", "a2"))
+            q = GenQuery(table=sc.table, select=select,
+                         group_by=["k0"], where=where,
+                         join_table=dim, join_kind=kind, join_on=on,
+                         cols=oncols | wcols | frozenset(["g", "v"]),
+                         features=frozenset(feats | {"agg", "grouped"}))
+            return q
+        select = [("id", None), ("jid", None), ("v", "c0"),
+                  ("jv", "c1")]
+        if self._maybe(0.4):
+            select.append(("jg", "c2"))
+        q = GenQuery(table=sc.table, select=select, where=where,
+                     join_table=dim, join_kind=kind, join_on=on,
+                     order_by=["id", "jid"],
+                     cols=oncols | wcols | frozenset(["id", "v"]),
+                     features=frozenset(feats | {"ordered"}))
+        if self._maybe(0.4):
+            q.limit = int(self.rng.integers(1, 30))
+            q.features = q.features | {"limited"}
+        return q
+
+    _WIN_FNS = (
+        "row_number() over (partition by g order by v, id)",
+        "rank() over (partition by g order by v)",
+        "dense_rank() over (partition by g order by w)",
+        "rank() over (order by v)",
+        "ntile(3) over (order by id)",
+        "sum(v) over (partition by g)",
+        "count(*) over (partition by b)",
+        "max(d) over (partition by g)",
+        "avg(v) over (partition by g)",
+        "min(w) over (partition by s)",
+    )
+
+    #: join-output rows can tie on every probe column (duplicate build
+    #: matches), so windows OVER a join draw only from the tie-safe
+    #: subset — rank/dense_rank and partition aggregates are functions
+    #: of the row's VALUES, never of the order among tied rows
+    _WIN_FNS_TIE_SAFE = tuple(f for f in _WIN_FNS
+                              if not f.startswith(("row_number",
+                                                   "ntile")))
+
+    def _window_query(self, sc: Scenario) -> GenQuery:
+        """Frame-free rank / partition-aggregate windows, ordered by
+        the unique id so the row-set compare is total-order exact;
+        sometimes over the join so the window prelude consumes a
+        probe-gather tail."""
+        feats = {"window", "ordered"}
+        joined = self._maybe(0.25)
+        fns = self._WIN_FNS_TIE_SAFE if joined else self._WIN_FNS
+        select = [("id", None)]
+        n_wins = 1 + int(self.rng.integers(0, 2))
+        cols = frozenset(["id", "g", "v"])
+        for i in range(n_wins):
+            select.append((self._choice(fns), f"w{i}"))
+        where, wcols, wfeats, _ = self._where(p=0.4)
+        feats |= set(wfeats)
+        q = GenQuery(table=sc.table, select=select, where=where,
+                     order_by=["id"], cols=cols | wcols,
+                     features=frozenset(feats))
+        if joined:
+            on, oncols = self._join_on(sc)
+            q.join_table = f"{sc.table}_d"
+            q.join_kind = "join" if self._maybe(0.6) else "left join"
+            q.join_on = on
+            q.select = q.select + [("jid", None)]
+            q.order_by = ["id", "jid"]
+            q.cols = q.cols | oncols
+            q.features = q.features | {"join"}
+        return q
 
     def _where(self, p: float = 0.75) -> Tuple[List[str], frozenset,
                                                frozenset, bool]:

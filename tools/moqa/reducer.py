@@ -154,7 +154,7 @@ def render_repro(case: Case, kind: str, seed) -> str:
     rows_sql = ",".join(sc.render_row(r) for r in case.rows)
     name = f"test_moqa_repro_{kind.replace('-', '_')}_{seed}"
     extra = []
-    if q.has("udf") and sc.setup_sql:
+    if (q.has("udf") or q.has("join")) and sc.setup_sql:
         extra.append(f"        setup={tuple(sc.setup_sql)!r},")
     if case.partition:
         extra.append(f"        partition={case.partition!r},")
@@ -216,6 +216,18 @@ def reduce_finding(finding, gen) -> str:
         pair = "mview"
     if mode == "pair" and pair not in R.PAIR_ENV:
         pair = "fusion"
+    if mode == "oracle:sqlite":
+        # the runner's sqlite mirror only ever holds the mirrorable
+        # column subset (oracles.sqlite_setup filters), but replay's
+        # mirror takes the whole CREATE — pre-drop the unmirrorable
+        # columns so the very first probe doesn't die on a decimal/
+        # bool/date column the query never reads
+        keep = [c for c in sc.columns if c.sqlite_type]
+        if 0 < len(keep) < len(sc.columns):
+            idx = [i for i, c in enumerate(sc.columns) if c.sqlite_type]
+            sc = dataclasses.replace(
+                sc, columns=keep,
+                rows=[tuple(r[i] for i in idx) for r in sc.rows])
 
     def still_fails(c: Case) -> bool:
         sc2, q2 = c.replay_args()

@@ -240,6 +240,10 @@ def run_corpus(seed: int = 0, queries_per_scenario: int = 80,
             break
         n_q = queries_per_scenario if "vector" not in sc.features \
             else max(8, queries_per_scenario // 5)
+        if "join_scenario" in sc.features:
+            # the join/window scenario rides every non-vector pair too;
+            # half the per-scenario budget keeps the tier-1 gate bounded
+            n_q = max(12, n_q // 2)
         qs = gen.queries(sc, n_q)
         n_queries += len(qs)
         qa.note_query(len(qs))
