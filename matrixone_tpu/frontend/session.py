@@ -1088,6 +1088,34 @@ class Session:
             else:
                 raise BindError(f"unknown qa subcommand {arg!r}; "
                                 "use status | clear | run:<seed>")
+        elif cmd == "keys":
+            # trace-capture / cache-key auditor ops surface
+            # (utils/keys.py + tools/mokey): armed state, audited
+            # sites, mismatch findings with both stacks, last static
+            # run — mirrors the mo_ctl('lint'|'san'|'qa') pattern
+            import json as _json
+            from matrixone_tpu.utils import keys as _keys
+            if arg in ("", "status"):
+                st = _keys.report()
+                try:
+                    from tools import mokey as _mokey
+                    st["static"] = _mokey.last_run_status()
+                except ImportError:
+                    st["static"] = None
+                out = _json.dumps(st, sort_keys=True, default=str)
+            elif arg == "clear":
+                _keys.clear()
+                out = "key-audit records and findings cleared"
+            elif arg == "audit:on":
+                _keys.arm()
+                out = "key audit armed"
+            elif arg == "audit:off":
+                _keys.disarm()
+                out = "key audit disarmed"
+            else:
+                raise BindError(f"unknown keys subcommand {arg!r}; "
+                                "use status | clear | audit:on | "
+                                "audit:off")
         elif cmd == "mview":
             # materialized-view ops surface: registry + per-view
             # watermark/mode, on-demand refresh — matching the
@@ -1275,6 +1303,17 @@ class Session:
                           and sv.plan_enabled() and node2 is node)
         tree_vars = self._tree_vars_sig() if tree_cacheable else None
         if tree_cacheable:
+            from matrixone_tpu.utils import keys as keyaudit
+            if keyaudit.armed():
+                # each build-time knob re-read INDEPENDENTLY of
+                # _tree_vars_sig: a knob that starts steering tree
+                # construction without riding the signature (the
+                # kill-switches-not-in-_tree_vars_sig bug class)
+                # mismatches here instead of reusing a wrong tree
+                keyaudit.audit(
+                    "serving/plan_cache.py:tree",
+                    (sv.plan_key(), gens[0], gens[1], tree_vars),
+                    self._tree_vars_deps())
             cached = sv.state.plan_cache.take_tree(
                 sv.plan_key(), gens[0], gens[1], tree_vars)
             if cached is not None:
@@ -1324,19 +1363,33 @@ class Session:
     def _tree_vars_sig(self) -> tuple:
         """Session state BAKED into a compiled operator tree at build
         time (everything else is re-read through the ExecContext at
-        execute time): pallas kernel selection, the fusion gates —
-        incl. the join/window/topk kill-switches the planner consults
-        while building fragments — and the join build budget (JoinOp
-        snapshots it at construction)."""
+        execute time).  DERIVED from _tree_vars_deps so the signature
+        and the audited dep set cannot drift: a knob added to the deps
+        rides the signature automatically, and there is no second list
+        to forget."""
+        return tuple(self._tree_vars_deps().values())
+
+    def _tree_vars_deps(self) -> dict:
+        """Every build-time knob a compiled operator tree bakes, NAMED:
+        pallas kernel selection, the fusion gates — incl. the
+        join/window/topk kill-switches the planner consults while
+        building fragments — and the join build budget (JoinOp
+        snapshots it at construction).  The armed key auditor
+        (utils/keys.py) hashes these per tree take/put; adding a
+        build-time knob means adding a row HERE (dict order is part of
+        the signature — append, don't reorder)."""
         from matrixone_tpu.ops import pallas_kernels as PK
         from matrixone_tpu.vm import fusion
-        return (bool(PK.effective_use_pallas(
-                    self.variables.get("use_pallas"))),
-                fusion.enabled(self._ctx()),
-                fusion.join_fusion_enabled(),
-                fusion.window_fusion_enabled(),
-                fusion.topk_fusion_enabled(),
-                self.variables.get("join_build_budget"))
+        return {
+            "use_pallas": bool(PK.effective_use_pallas(
+                self.variables.get("use_pallas"))),
+            "plan_fusion": fusion.enabled(self._ctx()),
+            "fusion_join": fusion.join_fusion_enabled(),
+            "fusion_window": fusion.window_fusion_enabled(),
+            "fusion_topk": fusion.topk_fusion_enabled(),
+            "join_build_budget":
+                self.variables.get("join_build_budget"),
+        }
 
     # ------------------------------------------------- serving versions
     def _serving_gens(self):

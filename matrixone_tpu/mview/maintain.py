@@ -501,6 +501,24 @@ class MViewService:
         key = ("mview", rt.def_hash, colsig, int(ex.mask.shape[0]),
                sizes, dict_keys)
         entry = fusion.CACHE.entry(key)
+        from matrixone_tpu.utils import keys as keyaudit
+        if keyaudit.armed():
+            # full dictionary CONTENT recomputed independently of
+            # fusion._dict_key: a length-only regression in the compile
+            # key (the PR-7 class) mismatches here on the first
+            # colliding hit instead of serving stale delta partials
+            keyaudit.audit("mview/maintain.py:mview", key, {
+                "scan_dict_content": tuple(
+                    (c, tuple(str(s) for s in t.dicts[c]))
+                    for c in spec.scan_columns if c in t.dicts),
+                "env_dict_content": tuple(
+                    sorted((nm, tuple(str(s) for s in d))
+                           for nm, d in ex.dicts.items()
+                           if d is not None)),
+                "sizes": sizes,
+                "shape": (len(spec.filters), len(spec.group_keys),
+                          len(spec.aggs)),
+            })
         fn = entry["fn"].get("step")
         if fn is None:
             trig = tuple((nm, c.dtype)

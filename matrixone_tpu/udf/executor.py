@@ -78,6 +78,18 @@ class UdfCompileCache:
 
     def entry(self, key: tuple, name: str, body: str,
               arg_names: List[str]) -> dict:
+        from matrixone_tpu.utils import keys as keyaudit
+        if keyaudit.armed():
+            # the key carries body_HASH (which hashes name|arg_names|
+            # body — see catalog.Udf.body_hash) + the dtype sig; the
+            # audit re-hashes the body TEXT and argument names on every
+            # hit, re-checking the CONTENT behind that hash, so a hash
+            # collision or a keying regression (body_hash dropped or
+            # weakened) mismatches loudly instead of compiling one
+            # user's body for another's call
+            keyaudit.audit("udf/executor.py:udf", key,
+                           {"body": body,
+                            "arg_names": tuple(arg_names)})
         e = self._lru.lookup(key)
         if e is not None:
             M.udf_compile.inc(outcome="hit")

@@ -1,0 +1,353 @@
+"""Trace-capture / cache-key completeness auditor — the runtime half of
+mokey (tools/mokey), the fourth analysis leg after molint (static),
+mosan (concurrency) and moqa (differential).
+
+The engine's correctness rests on one invariant no existing gate
+checks directly: a cached compiled program must be keyed by EVERYTHING
+its traced closure captures.  The bug class has recurred in almost
+every perf PR — a dictionary LUT keyed by length instead of content
+(PR 7), a build-program key missing its lifted-literal arity (PR 13) —
+and always ships plausible-but-wrong rows.  tools/mokey proves key
+completeness statically at the name level; this module is the dynamic
+oracle for the part names cannot prove: CONTENT.
+
+Armed (`MO_KEY_AUDIT=1` or `arm()`), every compile-cache surface calls
+
+    keys.audit("<relpath>:<label>", cache_key, {dep_name: value, ...})
+
+once per cache access, where the deps are the capture-relevant values
+RECOMPUTED FROM SOURCE STATE (dictionary contents, baked literal
+values, lifted-literal arity, baked session knobs) — never sliced back
+out of the key itself.  The first sight of a (site, key) records a
+content hash per dep plus the recording stack; every later sight (a
+cache hit) re-hashes and compares.  A mismatch means the key COLLIDED:
+two different capture contents mapped to one compiled program — the
+stale-artifact bug, caught at the exact hit that would have served it,
+reported with both stacks (record-time and hit-time).
+
+Disarmed cost is one module-attribute read per cache access — the
+utils/fault.py arming discipline, same as qa.py and san.py.  Findings
+surface through `mo_ctl('keys','status'|'clear'|'audit:on'|'audit:off')`,
+the `mo_key_{captures,audits,findings}_total` metrics, and the tier-1
+gate (tests/test_mokey.py).  `MO_KEY_EXPORT=1` writes the observed
+(site, dep-name) inventory to tools/mokey/observed_captures.json at
+pytest session finish — the handshake file the static pass unions, the
+mosan observed-lock-edges pattern.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import traceback
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from matrixone_tpu.utils import san
+
+#: module-level armed flag: read once per cache access, so the
+#: disarmed fast path stays one attribute read
+_ARMED = os.environ.get("MO_KEY_AUDIT", "0").lower() not in (
+    "0", "", "false", "off")
+
+#: recorded (site, key) entries kept; eviction only means the next
+#: sight re-records (a fresh baseline), never a false finding
+MAX_RECORDS = 4096
+
+#: findings kept verbatim; later duplicates only bump `count`
+MAX_FINDINGS = 200
+
+#: frames kept per recorded stack (innermost last, auditor frames cut)
+_STACK_FRAMES = 8
+
+
+def armed() -> bool:
+    return _ARMED
+
+
+def arm() -> None:
+    global _ARMED
+    _ARMED = True
+
+
+def disarm() -> None:
+    global _ARMED
+    _ARMED = False
+
+
+class _ArmedScope:
+    """Context manager: arm for the duration, restore the prior state."""
+
+    def __enter__(self):
+        self._prev = _ARMED
+        arm()
+        return self
+
+    def __exit__(self, *exc):
+        global _ARMED
+        _ARMED = self._prev
+        return False
+
+
+def armed_scope() -> _ArmedScope:
+    return _ArmedScope()
+
+
+# ------------------------------------------------------- content hashes
+
+def _encode(v, h, depth: int = 0) -> None:
+    """Feed a canonical byte form of `v` into hasher `h`.  Host values
+    only: device arrays are digested by (dtype, shape) WITHOUT content —
+    pulling them back would sync the device on the audit path.  Unknown
+    object types digest as their type name (conservative: a content
+    change the encoder cannot see is missed, never false-reported)."""
+    if depth > 6:
+        h.update(b"<deep>")
+        return
+    if v is None:
+        h.update(b"N")
+    elif isinstance(v, bool):
+        h.update(b"b1" if v else b"b0")
+    elif isinstance(v, (int, np.integer)):
+        h.update(b"i" + str(int(v)).encode())
+    elif isinstance(v, (float, np.floating)):
+        h.update(b"f" + repr(float(v)).encode())
+    elif isinstance(v, str):
+        h.update(b"s" + v.encode("utf-8", "replace"))
+    elif isinstance(v, bytes):
+        h.update(b"y" + v)
+    elif isinstance(v, np.ndarray):
+        h.update(b"a" + str(v.dtype).encode() + str(v.shape).encode())
+        h.update(np.ascontiguousarray(v).tobytes())
+    elif isinstance(v, (list, tuple)):
+        h.update(b"(" if isinstance(v, tuple) else b"[")
+        for x in v:
+            _encode(x, h, depth + 1)
+            h.update(b",")
+        h.update(b")")
+    elif isinstance(v, (set, frozenset)):
+        h.update(b"{")
+        for d in sorted(digest(x) for x in v):
+            h.update(d.encode())
+        h.update(b"}")
+    elif isinstance(v, dict):
+        h.update(b"d{")
+        for k in sorted(v, key=repr):
+            _encode(k, h, depth + 1)
+            h.update(b":")
+            _encode(v[k], h, depth + 1)
+        h.update(b"}")
+    elif dataclasses.is_dataclass(v) and not isinstance(v, type):
+        h.update(b"D" + type(v).__qualname__.encode())
+        for f in dataclasses.fields(v):
+            h.update(f.name.encode() + b"=")
+            _encode(getattr(v, f.name, None), h, depth + 1)
+    elif callable(v):
+        h.update(b"F" + getattr(v, "__qualname__",
+                                type(v).__qualname__).encode())
+    elif hasattr(v, "dtype") and hasattr(v, "shape"):
+        # device array (jax): identity by signature, never by content
+        h.update(b"A" + str(v.dtype).encode() + str(v.shape).encode())
+    else:
+        h.update(b"O" + type(v).__qualname__.encode())
+
+
+def digest(v) -> str:
+    """Stable content hash of one capture value (hex, 16 bytes)."""
+    h = hashlib.blake2b(digest_size=16)
+    _encode(v, h)
+    return h.hexdigest()
+
+
+# --------------------------------------------------------------- records
+
+class Finding:
+    """One capture-content mismatch under a colliding cache key."""
+
+    __slots__ = ("site", "name", "detail", "record_stack", "hit_stack",
+                 "count")
+
+    def __init__(self, site: str, name: str, detail: str,
+                 record_stack: str, hit_stack: str):
+        self.site = site
+        self.name = name
+        self.detail = detail
+        self.record_stack = record_stack
+        self.hit_stack = hit_stack
+        self.count = 1
+
+    def format(self) -> str:
+        extra = f" (x{self.count})" if self.count > 1 else ""
+        return (f"[key-capture-mismatch] {self.site} capture "
+                f"{self.name!r}: {self.detail}{extra}\n"
+                f"  recorded at:\n{self.record_stack}"
+                f"  hit at:\n{self.hit_stack}")
+
+    def as_dict(self) -> dict:
+        return {"site": self.site, "name": self.name,
+                "detail": self.detail, "count": self.count,
+                "record_stack": self.record_stack,
+                "hit_stack": self.hit_stack}
+
+
+_LOCK = san.lock("matrixone_tpu.utils.keys._LOCK", internal=True)
+_RECORDS: "OrderedDict[tuple, dict]" = OrderedDict()
+_FINDINGS: List[Finding] = []
+#: (site, dep name) pairs seen by any record/audit — the handshake
+#: inventory exported for the static pass
+_OBSERVED: Dict[str, set] = {}
+
+
+def _stack() -> str:
+    frames = traceback.format_stack()[:-2]   # cut the auditor frames
+    return "".join("    " + ln for f in frames[-_STACK_FRAMES:]
+                   for ln in f.splitlines(keepends=True))
+
+
+def _record_finding(site: str, name: str, detail: str,
+                    record_stack: str, hit_stack: str) -> None:
+    from matrixone_tpu.utils import metrics as M
+    with _LOCK:
+        for f in _FINDINGS:
+            if f.site == site and f.name == name:
+                f.count += 1
+                M.key_findings.inc(site=_site_label(site))
+                return
+        if len(_FINDINGS) < MAX_FINDINGS:
+            _FINDINGS.append(Finding(site, name, detail, record_stack,
+                                     hit_stack))
+    M.key_findings.inc(site=_site_label(site))
+
+
+def _site_label(site: str) -> str:
+    """Label half of a '<relpath>:<label>' site (metric cardinality
+    stays the small fixed set of wired surfaces)."""
+    return site.rsplit(":", 1)[-1]
+
+
+def audit(site: str, key, deps: Dict[str, object]) -> None:
+    """One call per compile-cache access.  First sight of (site, key)
+    records a content hash per dep; every later sight re-hashes and
+    compares — a mismatch is the stale-artifact bug, reported with both
+    stacks.  `deps` must be recomputed from source state, never sliced
+    out of `key` (a key-derived dep can never mismatch)."""
+    if not _ARMED:
+        return
+    from matrixone_tpu.utils import metrics as M
+    kd = digest(key)
+    fresh = {name: digest(v) for name, v in deps.items()}
+    with _LOCK:
+        obs = _OBSERVED.setdefault(site, set())
+        obs.update(fresh)
+        rec = _RECORDS.get((site, kd))
+        if rec is None:
+            _RECORDS[(site, kd)] = {"deps": fresh, "stack": _stack()}
+            while len(_RECORDS) > MAX_RECORDS:
+                _RECORDS.popitem(last=False)
+            M.key_captures.inc(len(fresh))
+            return
+        _RECORDS.move_to_end((site, kd))
+        mismatched = [(name, d) for name, d in fresh.items()
+                      if rec["deps"].get(name) not in (None, d)]
+        # a dep name this record has not seen (call-shape drift after
+        # an eviction/re-record) starts a fresh baseline, not a finding
+        for name, d in fresh.items():
+            rec["deps"].setdefault(name, d)
+        record_stack = rec["stack"]
+    M.key_audits.inc(outcome="mismatch" if mismatched else "ok")
+    for name, d in mismatched:
+        _record_finding(
+            site, name,
+            "content changed under an UNCHANGED cache key — the key "
+            "is missing this capture (stale compiled artifact served)",
+            record_stack, _stack())
+
+
+# ------------------------------------------------------------- reporting
+
+def findings() -> List[Finding]:
+    with _LOCK:
+        return list(_FINDINGS)
+
+
+def clear() -> None:
+    """Drop findings, records and the observed inventory."""
+    with _LOCK:
+        del _FINDINGS[:]
+        _RECORDS.clear()
+        _OBSERVED.clear()
+
+
+class _Capture:
+    """Swap in a fresh findings sink for the scope's duration (the
+    qa.capture() pattern: the global list dedups by (site, name), so
+    len() deltas go blind on repeats)."""
+
+    def __enter__(self):
+        global _FINDINGS
+        with _LOCK:
+            self._saved = _FINDINGS
+            _FINDINGS = []
+            self._mine = _FINDINGS
+        return self
+
+    def findings(self) -> List[Finding]:
+        with _LOCK:
+            return list(self._mine)
+
+    def __exit__(self, *exc):
+        global _FINDINGS
+        with _LOCK:
+            _FINDINGS = self._saved
+        return False
+
+
+def capture() -> _Capture:
+    return _Capture()
+
+
+def report() -> dict:
+    """mo_ctl('keys','status') payload."""
+    with _LOCK:
+        return {"armed": _ARMED,
+                "records": len(_RECORDS),
+                "sites": sorted(_OBSERVED),
+                "findings": len(_FINDINGS),
+                "findings_list": [f.format() for f in _FINDINGS[:10]]}
+
+
+def observed() -> Dict[str, List[str]]:
+    """site -> sorted dep names audited this process (the handshake
+    inventory)."""
+    with _LOCK:
+        return {s: sorted(names) for s, names in _OBSERVED.items()}
+
+
+def export_observed(path: str, only_package: bool = True) -> int:
+    """Write the observed-capture handshake JSON (checked in as
+    tools/mokey/observed_captures.json; regenerate with MO_KEY_EXPORT=1
+    over the test suite).  Returns the number of (site, name) pairs.
+    `only_package` drops sites whose module path does not resolve
+    under matrixone_tpu/ — test rigs and planted fixtures audit
+    throwaway sites that must never enter the checked-in handshake."""
+    import json
+    obs = observed()
+    if only_package:
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        obs = {site: names for site, names in obs.items()
+               if os.path.isfile(os.path.join(
+                   pkg, site.rsplit(":", 1)[0]))}
+    n = sum(len(v) for v in obs.values())
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"comment": "runtime-audited capture inventory: "
+                              "dep names hashed per cache hit by "
+                              "matrixone_tpu/utils/keys.py; the mokey "
+                              "static pass unions these with its "
+                              "name-level resolution",
+                   "sites": obs}, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return n

@@ -440,3 +440,16 @@ san_findings = REGISTRY.counter(
 san_lock_edges = REGISTRY.gauge(
     "mo_san_lock_edges",
     "distinct lock-order edges observed by the armed sanitizer")
+
+# ---- trace-capture / cache-key auditor (utils/keys.py, tools/mokey)
+key_captures = REGISTRY.counter(
+    "mo_key_captures_total",
+    "capture content hashes recorded at compile time by the armed "
+    "key auditor (one per dep per first-sighted cache key)")
+key_audits = REGISTRY.counter(
+    "mo_key_audits_total",
+    "cache-hit re-hash audits by outcome (ok/mismatch)")
+key_findings = REGISTRY.counter(
+    "mo_key_findings_total",
+    "capture-content mismatches under a colliding cache key, by "
+    "audited site label (fragment/joinbuild/joinprobe/mview/udf/tree)")

@@ -57,6 +57,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from matrixone_tpu.container.device import DeviceBatch, DeviceColumn
+from matrixone_tpu.utils import keys as keyaudit
 from matrixone_tpu.container.dtypes import TypeOid
 from matrixone_tpu.ops import agg as A, filter as F, sort as msort
 from matrixone_tpu.sql.expr import (BoundCase, BoundCast, BoundCol,
@@ -328,6 +329,49 @@ def _norm_val(v):
 
 def _tsig(d) -> tuple:
     return (int(d.oid), d.width, d.scale, getattr(d, "dim", 0) or 0)
+
+
+def _baked_consts(exprs, lift_ids: frozenset) -> tuple:
+    """Every constant a traced fragment BAKES from these expressions
+    (IN-list values, LIKE patterns, non-lifted literal values, dtypes)
+    — the key auditor's independent re-walk of what _expr_sig is
+    supposed to have keyed.  Lifted literals contribute only their
+    dtype: their VALUES are traced inputs, legitimately different
+    across hits of one compiled program."""
+    out: list = []
+
+    def walk(e):
+        if e is None or not isinstance(e, BoundExpr):
+            return
+        if isinstance(e, BoundLiteral):
+            out.append(("lit", _tsig(e.dtype),
+                        "P" if id(e) in lift_ids
+                        else _norm_val(e.value)))
+            return
+        if isinstance(e, BoundInList):
+            out.append(("in", tuple(_norm_val(v) for v in e.values),
+                        e.negated))
+            walk(e.arg)
+            return
+        if isinstance(e, BoundLike):
+            out.append(("like", e.pattern, e.negated))
+            walk(e.arg)
+            return
+        if isinstance(e, BoundCase):
+            for c, v in e.whens:
+                walk(c)
+                walk(v)
+            walk(e.else_)
+            return
+        for a in getattr(e, "args", None) or ():
+            walk(a)
+        arg = getattr(e, "arg", None)
+        if isinstance(arg, BoundExpr):
+            walk(arg)
+
+    for e in exprs:
+        walk(e)
+    return tuple(out)
 
 
 def _expr_sig(e: BoundExpr, lift_ids: frozenset) -> tuple:
@@ -1171,6 +1215,49 @@ class FusedFragmentOp(O.Operator):
         return (self._plan_sig, rt_sig, colsig,
                 int(ex.mask.shape[0]), baked, dicts, sizes)
 
+    def _audit_deps(self, envs, rt_lift, scan_filters, sizes_flags):
+        """Capture-relevant content RECOMPUTED FROM SOURCE STATE for
+        the armed key auditor (utils/keys.py) — independent of
+        _runtime_key's own hashing (full dictionary content instead of
+        _dict_key's memo, a fresh constant walk instead of _expr_sig),
+        so a weakened key (the PR-7 length-only / PR-13 dropped-arity
+        classes) surfaces as a content mismatch on the first colliding
+        cache hit instead of as wrong rows."""
+        lift_ids = frozenset(id(x) for x in self._lift_lits) | \
+            frozenset(id(x) for x in rt_lift)
+        return {
+            "dict_content": tuple(
+                tuple(str(s) for s in d) if d is not None else None
+                for d in (_static_dict(e, envs[i])
+                          for i, e in self._dictdeps)),
+            "baked_values": tuple(_norm_val(lit.value)
+                                  for lit in self._baked_lits),
+            "baked_plan_constants": _baked_consts(
+                self._audit_exprs() + list(scan_filters), lift_ids),
+            "lift_arity": len(self._lift_lits) + len(rt_lift),
+            "sizes_flags": sizes_flags,
+            "chain_shape": self.describe(),
+        }
+
+    def _audit_exprs(self) -> list:
+        """Every expression whose BAKED constants the traced program
+        may embed (subclasses extend with their prelude expressions;
+        lifted literal slots are excluded by the walker — their values
+        enter as traced inputs patched per call)."""
+        out: list = []
+        for st in self.stages:
+            if st.kind == "filter":
+                out.append(st.pred)
+            elif st.kind == "project":
+                out.extend(st.exprs)
+        if self._agg_op is not None:
+            node = self._agg_op.node
+            out.extend(node.group_keys)
+            out.extend(a.arg for a in node.aggs if a.arg is not None)
+        if self._sort_op is not None:
+            out.extend(self._sort_op.node.keys)
+        return out
+
     def _lifted_values(self, rt_lift) -> tuple:
         return tuple(np.dtype(lit.dtype.np_dtype).type(lit.value)
                      for lit in self._lift_lits + rt_lift)
@@ -1232,6 +1319,11 @@ class FusedFragmentOp(O.Operator):
             key = self._runtime_key(ex, envs, rt_sig, rt_baked,
                                     (sizes, flags))
             entry = CACHE.entry(key)
+            if keyaudit.armed():
+                keyaudit.audit("vm/fusion.py:fragment", key,
+                               self._audit_deps(envs, rt_lift,
+                                                scan_filters,
+                                                (sizes, flags)))
             slot = "step"
             if self._terminal == "agg_scalar":
                 slot = "step0" if carry is None else "stepN"

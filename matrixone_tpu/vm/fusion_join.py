@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from matrixone_tpu.container.device import DeviceBatch, DeviceColumn
+from matrixone_tpu.utils import keys as keyaudit
 from matrixone_tpu.vm import exprs as EX
 from matrixone_tpu.vm import fusion as FF
 from matrixone_tpu.vm import join as J
@@ -137,6 +138,15 @@ class FusedJoinProbeOp(FF.FusedFragmentOp):
 
     def _prelude_labels(self) -> List[str]:
         return ["JoinBuild", "JoinProbe"]
+
+    def _audit_exprs(self) -> list:
+        node = self._join.node
+        out = super()._audit_exprs()
+        out.extend(node.left_keys)
+        out.extend(node.right_keys)
+        if node.residual is not None:
+            out.append(node.residual)
+        return out
 
     def _initial_validity_colmap(self) -> dict:
         """Join-aware all-valid seed: probe-side columns resolve to the
@@ -328,15 +338,37 @@ class FusedJoinProbeOp(FF.FusedFragmentOp):
         # keyed on the BUILD-side inputs alone (key exprs + runtime-
         # filter eligibility + schema/dicts/shape + baked values): two
         # fragments sharing a build side but differing above the probe
-        # — or in their terminal — share one compiled build program
+        # — or in their terminal — share one compiled build program.
+        # binfo.dictdep content rides the key too: a dict-DEPENDENT
+        # sub-expression inside a key (LIKE / varchar compare in a CASE
+        # key) bakes its lookup table from the build batch's
+        # dictionaries at trace time, and only the OUTPUT dicts of
+        # varlen keys were keyed before — a mokey-found gap of exactly
+        # the PR-7 stale-LUT class
         blids = frozenset(id(x) for x in lift_lits)
         key = ("joinbuild",
                tuple(FF._expr_sig(k, blids) for k in node.right_keys),
                tuple(i for i, _lk in specs), colsig,
                int(build.mask.shape[0]),
                tuple(FF._norm_val(lit.value) for lit in binfo.baked),
-               tuple(FF._dict_key(d) for d in self._bkey_dicts))
+               tuple(FF._dict_key(d) for d in self._bkey_dicts),
+               tuple(FF._dict_key(FF._static_dict(e, self._build_dicts))
+                     for _i, e in binfo.dictdep))
         entry = FF.CACHE.entry(key)
+        if keyaudit.armed():
+            keyaudit.audit("vm/fusion_join.py:joinbuild", key, {
+                "bkey_dict_content": tuple(
+                    tuple(str(s) for s in d) if d is not None else None
+                    for d in self._bkey_dicts),
+                "dictdep_content": tuple(
+                    tuple(str(s) for s in d) if d is not None else None
+                    for d in (FF._static_dict(e, self._build_dicts)
+                              for _i, e in binfo.dictdep)),
+                "baked_values": tuple(FF._norm_val(lit.value)
+                                      for lit in binfo.baked),
+                "lift_arity": len(lift_lits),
+                "rf_spec_indexes": tuple(i for i, _lk in specs),
+            })
         bschema = tuple((nm, c.dtype)
                         for nm, c in build.batch.columns.items())
         bdicts = self._build_dicts
@@ -521,6 +553,20 @@ class FusedJoinProbeOp(FF.FusedFragmentOp):
                 key = self._probe_runtime_key(ex, envs, mm, build_key,
                                               (sizes, flags))
                 entry = FF.CACHE.entry(key)
+                if keyaudit.armed():
+                    deps = self._audit_deps(envs, [], [],
+                                            (sizes, flags))
+                    deps["keydict_content"] = tuple(
+                        (tuple(str(s) for s in bd)
+                         if bd is not None else None,
+                         tuple(str(s)
+                               for s in O._expr_dict(k, ex) or ())
+                         if k.dtype.is_varlen else None)
+                        for k, bd in zip(self._join.node.left_keys,
+                                         self._bkey_dicts))
+                    deps["max_matches"] = mm
+                    keyaudit.audit("vm/fusion_join.py:joinprobe", key,
+                                   deps)
                 slot = "step"
                 if self._terminal == "agg_scalar":
                     slot = "step0" if carry is None else "stepN"

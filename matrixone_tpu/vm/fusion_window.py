@@ -128,6 +128,16 @@ class FusedWindowOp(FF.FusedFragmentOp):
     def _prelude_labels(self) -> List[str]:
         return ["WindowOp"]
 
+    def _audit_exprs(self) -> list:
+        out = super()._audit_exprs()
+        for entry in self._window.node.entries:
+            _fn, arg, part, okeys, _odescs, _out_name = entry[:6]
+            if arg is not None:
+                out.append(arg)
+            out.extend(part)
+            out.extend(okeys)
+        return out
+
     def _initial_validity_colmap(self) -> dict:
         """Window output columns have data-dependent validity (padding
         lanes, all-NULL frames) — only the passthrough child columns are

@@ -47,6 +47,14 @@ def pytest_configure(config):
     if os.environ.get("MO_SAN", "1").lower() not in ("0", "false", "off"):
         from matrixone_tpu.utils import san
         san.arm()
+    # mokey runtime half: the trace-capture / cache-key auditor is ON
+    # by default under pytest (MO_KEY_AUDIT=0 opts out); its mismatch
+    # findings gate tier-1 via tests/test_mokey.py::
+    # test_suite_runs_key_audit_clean
+    if os.environ.get("MO_KEY_AUDIT", "1").lower() not in ("0", "false",
+                                                           "off"):
+        from matrixone_tpu.utils import keys
+        keys.arm()
 
 
 def pytest_collection_modifyitems(session, config, items):
@@ -54,14 +62,34 @@ def pytest_collection_modifyitems(session, config, items):
     # collection (file order would leave every test after test_mosan.py
     # outside its coverage)
     gate = [i for i in items
-            if i.nodeid.endswith("test_suite_runs_sanitizer_clean")]
+            if i.nodeid.endswith("test_suite_runs_sanitizer_clean")
+            or i.nodeid.endswith("test_suite_runs_key_audit_clean")]
     for g in gate:
         items.remove(g)
         items.append(g)
 
 
 def pytest_sessionfinish(session, exitstatus):
-    from matrixone_tpu.utils import san
+    from matrixone_tpu.utils import keys, san
+    if keys.armed():
+        # regenerate the checked-in runtime capture-inventory export
+        # that mokey's static pass unions (README "Static analysis");
+        # opt-in so ordinary runs never dirty the working tree
+        if os.environ.get("MO_KEY_EXPORT", "").lower() in ("1", "true",
+                                                           "on"):
+            path = os.path.join(os.path.dirname(__file__), "..",
+                                "tools", "mokey",
+                                "observed_captures.json")
+            n = keys.export_observed(os.path.abspath(path))
+            print(f"\n[mokey] exported {n} audited captures -> {path}")
+        leftover = keys.findings()
+        if leftover:
+            print(f"\n[mokey] {len(leftover)} capture-mismatch "
+                  f"finding(s) accumulated this run (the gate test "
+                  f"fails on these when tests/test_mokey.py is part "
+                  f"of the selection):")
+            for f in leftover[:5]:
+                print(f.format())
     if not san.armed():
         return
     # regenerate the checked-in runtime lock-order edge export that

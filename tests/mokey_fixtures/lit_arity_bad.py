@@ -1,0 +1,40 @@
+"""PLANTED (do not fix): the PR-13 dropped-literal-arity bug shape.
+
+A compiled program bakes a tuple of lifted literal values while the
+cache key carries neither their arity nor their values — two calls
+whose lifted tuples differ collide on one compiled program and the
+second silently reuses the first's baked constants.  mokey's static
+pass must flag the `lift_vals` capture as `key-capture`, and the armed
+runtime auditor must report `lift_arity`/`baked_values` mismatches on
+the colliding hit.  Clean twin: lit_arity_good.py.
+"""
+
+import jax
+
+from matrixone_tpu.utils import keys as keyaudit
+
+
+class LiftedProgramCache:
+    def __init__(self):
+        self._programs = {}
+
+    def run(self, xs, shape_sig, lifted):
+        # THE PLANT: the lifted-literal arity (and values) never enter
+        # the key — the exact pre-fix PR-13 shape
+        key = (shape_sig,)
+        keyaudit.audit("mokey_fixtures/lit_arity_bad.py:prog", key,
+                       {"lift_arity": len(lifted),
+                        "baked_values": tuple(lifted)})
+        fn = self._programs.get(key)
+        if fn is None:
+            lift_vals = tuple(lifted)
+
+            def _prog(arr):
+                acc = arr
+                for v in lift_vals:    # baked as traced constants
+                    acc = acc + v
+                return acc
+
+            fn = jax.jit(_prog)
+            self._programs[key] = fn
+        return fn(xs)

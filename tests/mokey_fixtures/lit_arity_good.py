@@ -1,0 +1,32 @@
+"""Clean twin of lit_arity_bad.py: the lifted values enter the traced
+program as INPUTS (nothing baked to capture) and the key carries the
+arity, so differing lifted tuples never collide on one compiled
+program — the shape of the real PR-13 fix in vm/fusion.py's
+param-literal lifting.  mokey and the runtime auditor stay quiet.
+"""
+
+import jax
+
+from matrixone_tpu.utils import keys as keyaudit
+
+
+class LiftedProgramCache:
+    def __init__(self):
+        self._programs = {}
+
+    def run(self, xs, shape_sig, lifted):
+        key = (shape_sig, len(lifted))
+        keyaudit.audit("mokey_fixtures/lit_arity_good.py:prog", key,
+                       {"lift_arity": len(lifted)})
+        fn = self._programs.get(key)
+        if fn is None:
+
+            def _prog(arr, lvals):
+                acc = arr
+                for v in lvals:        # traced inputs, not captures
+                    acc = acc + v
+                return acc
+
+            fn = jax.jit(_prog)
+            self._programs[key] = fn
+        return fn(xs, tuple(lifted))

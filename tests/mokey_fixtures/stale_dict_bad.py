@@ -1,0 +1,46 @@
+"""PLANTED (do not fix): the PR-7 stale-dict-LUT bug shape.
+
+A compiled program bakes a dictionary lookup table at trace time while
+the cache key carries only the dictionary LENGTH — same-cardinality
+content churn then serves a stale LUT: plausible rows, wrong strings.
+mokey's static pass must flag the `lut` capture as `weak-key` (its
+only path into the key is `len()`), and the armed runtime auditor
+(utils/keys.py) must report a `lut_content` mismatch after a rotate.
+Clean twin: stale_dict_good.py.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from matrixone_tpu.utils import keys as keyaudit
+
+
+class LutProgramCache:
+    def __init__(self, lut_dict):
+        self._programs = {}
+        self._lut_dict = list(lut_dict)
+
+    def rotate(self, lut_dict):
+        """Same-cardinality content churn (the stale-LUT trap)."""
+        self._lut_dict = list(lut_dict)
+
+    def _key(self, n):
+        # THE PLANT: dictionary LENGTH in the compile key, content
+        # dropped — the exact pre-fix PR-7 shape
+        return (n, len(self._lut_dict))
+
+    def run(self, codes):
+        key = self._key(int(codes.shape[0]))
+        keyaudit.audit("mokey_fixtures/stale_dict_bad.py:lut", key,
+                       {"lut_content": tuple(self._lut_dict)})
+        fn = self._programs.get(key)
+        if fn is None:
+            lut = [ord(s[0]) for s in self._lut_dict]
+
+            def _step(xs):
+                # the LUT bakes into the traced program as a constant
+                return jnp.take(jnp.asarray(lut), xs)
+
+            fn = jax.jit(_step)
+            self._programs[key] = fn
+        return fn(codes)

@@ -1,0 +1,38 @@
+"""Clean twin of stale_dict_bad.py: the compile key carries the
+dictionary CONTENT, so content churn re-keys (and re-traces) instead
+of serving a stale baked LUT.  mokey's static pass and the runtime
+auditor must both stay quiet here.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from matrixone_tpu.utils import keys as keyaudit
+
+
+class LutProgramCache:
+    def __init__(self, lut_dict):
+        self._programs = {}
+        self._lut_dict = list(lut_dict)
+
+    def rotate(self, lut_dict):
+        self._lut_dict = list(lut_dict)
+
+    def _key(self, n):
+        # content-addressed: churn re-keys instead of colliding
+        return (n, tuple(self._lut_dict))
+
+    def run(self, codes):
+        key = self._key(int(codes.shape[0]))
+        keyaudit.audit("mokey_fixtures/stale_dict_good.py:lut", key,
+                       {"lut_content": tuple(self._lut_dict)})
+        fn = self._programs.get(key)
+        if fn is None:
+            lut = [ord(s[0]) for s in self._lut_dict]
+
+            def _step(xs):
+                return jnp.take(jnp.asarray(lut), xs)
+
+            fn = jax.jit(_step)
+            self._programs[key] = fn
+        return fn(codes)

@@ -1,0 +1,430 @@
+"""mokey (tools/mokey + matrixone_tpu/utils/keys.py): the
+trace-capture / cache-key completeness analyzer, fourth leg of the
+molint / mosan / moqa suite.
+
+Coverage layers (the test_molint.py structure):
+
+  * **tier-1 gates** — the static pass over the real `matrixone_tpu/`
+    tree must be clean, and the runtime auditor (armed for the whole
+    pytest run by conftest) must have accumulated zero capture
+    mismatches by session end;
+  * **planted fixture pairs** — both historical bug classes (the PR-7
+    length-only dict key, the PR-13 dropped lifted-literal arity)
+    live under tests/mokey_fixtures/ and are caught by BOTH the
+    static pass and the runtime audit, while their clean twins stay
+    quiet on both sides;
+  * **end-to-end plant** — moqa's stale-dict-LUT plant driven through
+    the real fusion path is caught by the armed auditor at the exact
+    colliding hit;
+  * **machinery** — declaration round-trip (justified silences,
+    unjustified is itself a finding), the observed-captures
+    handshake, the audit API (record / re-hash / mismatch with both
+    stacks, metrics, capture isolation, export), the CLI, and
+    mo_ctl('keys', ...).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from matrixone_tpu.utils import keys  # noqa: E402
+from tools import mokey  # noqa: E402
+from tools.mokey import plants  # noqa: E402
+
+FIX = os.path.join(REPO, "tests", "mokey_fixtures")
+
+
+# ------------------------------------------------------------ tier-1 gate
+
+def test_repo_tree_is_clean():
+    """THE gate: the capture-completeness pass over the real package,
+    zero findings.  A finding here means a traced closure captures
+    something its compile cache cannot see — key it, audit it, or
+    declare it with a justification."""
+    findings, stats = mokey.run_checks(REPO)
+    assert stats["roots"] >= 5, \
+        "root discovery regressed: the fragment/join/window/mview " \
+        "step closures must all be found"
+    assert stats["captures"] >= 20
+    assert not findings, "\n" + "\n".join(f.format() for f in findings)
+
+
+def test_suite_runs_key_audit_clean():
+    """Runtime gate (moved to the end of the collection by conftest):
+    the auditor armed across the whole suite saw no capture-content
+    mismatch under any colliding cache key."""
+    assert keys.armed() or os.environ.get(
+        "MO_KEY_AUDIT", "").lower() in ("0", "false", "off")
+    leftover = keys.findings()
+    assert not leftover, "\n" + "\n".join(
+        f.format() for f in leftover)
+
+
+# ------------------------------------------------- planted fixture pairs
+
+def _run_fixture(fn):
+    return mokey.run_checks(
+        REPO, src_paths=[os.path.join(FIX, fn)], record=False)[0]
+
+
+def test_static_stale_dict_pair():
+    """The PR-7 plant: a LUT-baking closure whose dictionary reaches
+    the key only through len() fires `weak-key`; the content-keyed
+    twin is quiet."""
+    bad = _run_fixture("stale_dict_bad.py")
+    assert any(f.rule == "weak-key" and "lut" in f.message
+               and "len()" in f.message for f in bad), bad
+    good = _run_fixture("stale_dict_good.py")
+    assert not good, "\n".join(f.format() for f in good)
+
+
+def test_static_lit_arity_pair():
+    """The PR-13 plant: a closure baking a lifted tuple the key never
+    sees fires `key-capture`; the traced-inputs twin is quiet."""
+    bad = _run_fixture("lit_arity_bad.py")
+    assert any(f.rule == "key-capture" and "lift_vals" in f.message
+               for f in bad), bad
+    good = _run_fixture("lit_arity_good.py")
+    assert not good, "\n".join(f.format() for f in good)
+
+
+def test_runtime_plants_caught_with_both_stacks():
+    """Both planted caches, executed under the armed auditor, collide
+    and report — with the record-time AND hit-time stacks — while the
+    clean twins re-key and stay quiet."""
+    with keys.armed_scope(), keys.capture() as cap:
+        bad = plants._load_fixture("stale_dict_bad.py") \
+            .LutProgramCache(["aa", "bb"])
+        codes = np.asarray([0, 1, 0], np.int32)
+        first = np.asarray(bad.run(codes))
+        bad.rotate(["zq", "bb"])       # same cardinality, new content
+        stale = np.asarray(bad.run(codes))
+        got = cap.findings()
+    # the planted cache really served the stale program ...
+    assert np.array_equal(first, stale)
+    # ... and the auditor said so, with both stacks
+    assert any(f.name == "lut_content" for f in got), got
+    f = [f for f in got if f.name == "lut_content"][0]
+    assert "recorded at" in f.format() and "hit at" in f.format()
+    assert f.record_stack.strip() and f.hit_stack.strip()
+
+    smoke = plants.run_runtime_smoke()
+    assert smoke["ok"], smoke
+
+
+def test_static_smoke_planted_temp_tree():
+    """The precheck --key-smoke static half: plants copied into a temp
+    tree are caught with the expected rules, twins quiet."""
+    st = plants.run_static_smoke()
+    assert st["ok"], st
+
+
+def test_engine_stale_lut_plant_caught_by_audit():
+    """moqa's stale-dict-LUT plant through the REAL fusion path: after
+    a shape-preserving rebuild (same dictionary cardinality, rotated
+    content) the planted length-only key collides, the engine serves
+    rows computed by the stale program, and the armed auditor flags
+    `dict_content` at that exact hit."""
+    from tools.moqa import plants as qplants
+
+    from matrixone_tpu.frontend import Session
+    from matrixone_tpu.storage.engine import Engine
+    old = os.environ.get("MO_FUSION_MIN_ROWS")
+    os.environ["MO_FUSION_MIN_ROWS"] = "0"
+    try:
+        # the capture opens INSIDE the plant: the planter swaps in its
+        # own isolation sink so deliberate findings can't leak into
+        # the suite-wide gate, and nested captures see their own
+        with keys.armed_scope(), qplants.plant_stale_dict_lut(), \
+                keys.capture() as cap:
+            s = Session(catalog=Engine())
+            s.execute("create table mk_t (a int, g varchar(4))")
+            s.execute("insert into mk_t values "
+                      "(1,'aa'),(2,'bb'),(3,'aa')")
+            r1 = s.execute(
+                "select sum(a) s from mk_t where g like 'a%'").rows()
+            s.execute("drop table mk_t")
+            s.execute("create table mk_t (a int, g varchar(4))")
+            s.execute("insert into mk_t values "
+                      "(1,'zq'),(2,'ab'),(3,'zq')")
+            r2 = s.execute(
+                "select sum(a) s from mk_t where g like 'a%'").rows()
+            got = cap.findings()
+    finally:
+        if old is None:
+            os.environ.pop("MO_FUSION_MIN_ROWS", None)
+        else:
+            os.environ["MO_FUSION_MIN_ROWS"] = old
+    assert r1 == [(4,)]
+    assert r2 == [(4,)], "the plant should have served stale rows " \
+        "(truth is 2) — did the key stop colliding?"
+    assert any(f.site == "vm/fusion.py:fragment"
+               and f.name == "dict_content" for f in got), got
+
+
+def test_moqa_stale_drill_runs_audited():
+    """The moqa cache-staleness drill arms the auditor for both
+    phases: with the stale-LUT plant active, the drill's own capture
+    audit reports the collision as a key-capture-mismatch finding
+    (even if the row diff also catches it)."""
+    from tools.moqa import plants as qplants
+    from tools.moqa import runner
+    from tools.moqa.generator import Generator
+
+    gen = Generator(seed=20260804)
+    scs = [sc for sc in gen.scenarios()
+           if any(c.name == "g" for c in sc.columns)
+           and "vector" not in sc.features
+           and "join_scenario" not in sc.features]
+    sc = scs[0]
+    qs = [q for q in gen.queries(sc, 8)
+          if runner._applicable("cache-stale", q)][:3]
+    assert qs, "generator produced no cache-stale-applicable queries"
+    hits = []
+
+    def note(oracle):
+        pass
+
+    def found(kind, scenario, pair, sql, detail, q=None,
+              partition=None):
+        hits.append(kind)
+
+    with qplants.plant_stale_dict_lut():
+        runner._run_stale_pair(sc, qs, {}, note, found, {},
+                               fraction=1.0)
+    assert "key-capture-mismatch" in hits or "cache-staleness" in hits
+    assert "key-capture-mismatch" in hits, \
+        f"drill ran un-audited (kinds seen: {sorted(set(hits))})"
+
+
+# ---------------------------------------------------------- declarations
+
+_PLANTED = textwrap.dedent("""\
+    import jax
+
+    class C:
+        def __init__(self, d):
+            self._progs = {}
+            self._d = list(d)
+
+        def run(self, xs, n):
+            key = (n,)
+            fn = self._progs.get(key)
+            if fn is None:
+                baked = tuple(self._d)__DECL__
+                def _step(a):
+                    return a + len(baked)
+                fn = jax.jit(_step)
+                self._progs[key] = fn
+            return fn(xs)
+""")
+
+
+def _planted_tree(tmp_path, decl=""):
+    p = tmp_path / "planted_mod.py"
+    p.write_text(_PLANTED.replace("__DECL__", decl))
+    return str(tmp_path), [str(p)]
+
+
+def test_planted_capture_is_found(tmp_path):
+    root, src = _planted_tree(tmp_path)
+    findings, _ = mokey.run_checks(root, src_paths=src, record=False)
+    assert any(f.rule == "key-capture" and "baked" in f.message
+               for f in findings), findings
+
+
+def test_justified_declaration_silences(tmp_path):
+    root, src = _planted_tree(
+        tmp_path,
+        decl="  # mokey: invariant=baked -- test: pinned per entry")
+    findings, _ = mokey.run_checks(root, src_paths=src, record=False)
+    assert not findings, findings
+
+
+def test_unjustified_declaration_is_itself_a_finding(tmp_path):
+    root, src = _planted_tree(tmp_path,
+                              decl="  # mokey: invariant=baked")
+    findings, _ = mokey.run_checks(root, src_paths=src, record=False)
+    rules = {f.rule for f in findings}
+    assert "invariant-decl" in rules, findings
+    assert "key-capture" in rules, \
+        "an unjustified declaration must not silence"
+
+
+def test_observed_handshake_resolves(tmp_path):
+    """A capture the armed audit demonstrably hashes (present in the
+    checked-in export under this module's site) resolves without a
+    declaration — the mosan observed-edges union."""
+    root, src = _planted_tree(tmp_path)
+    obs = tmp_path / "observed.json"
+    obs.write_text(json.dumps(
+        {"sites": {"planted_mod.py:x": ["baked"]}}))
+    findings, _ = mokey.run_checks(root, src_paths=src,
+                                   observed_path=str(obs),
+                                   record=False)
+    assert not findings, findings
+    # a missing/corrupt export degrades, never crashes
+    assert mokey.load_observed(str(tmp_path / "nope.json")) == {}
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert mokey.load_observed(str(bad)) == {}
+
+
+def test_checked_in_export_is_fresh():
+    """The checked-in handshake file parses and still names only sites
+    that exist in the tree (a renamed module must regenerate it)."""
+    obs = mokey.load_observed()
+    assert obs, "tools/mokey/observed_captures.json missing or empty"
+    for suffix in obs:
+        assert os.path.isfile(os.path.join(REPO, "matrixone_tpu",
+                                           suffix)), \
+            f"export names unknown module {suffix!r} — regenerate " \
+            f"with MO_KEY_EXPORT=1"
+
+
+# ------------------------------------------------------------- audit API
+
+def test_audit_record_then_mismatch():
+    from matrixone_tpu.utils import metrics as M
+    cap0 = M.key_captures.get()
+    ok0 = M.key_audits.get(outcome="ok")
+    mm0 = M.key_audits.get(outcome="mismatch")
+    with keys.armed_scope(), keys.capture() as cap:
+        keys.audit("test.py:t", ("k", 1), {"dep": [1, 2], "other": "x"})
+        keys.audit("test.py:t", ("k", 1), {"dep": [1, 2], "other": "x"})
+        assert not cap.findings()
+        keys.audit("test.py:t", ("k", 1), {"dep": [1, 3], "other": "x"})
+        got = cap.findings()
+    assert len(got) == 1 and got[0].name == "dep"
+    assert "UNCHANGED cache key" in got[0].detail
+    assert M.key_captures.get() - cap0 >= 2
+    assert M.key_audits.get(outcome="ok") - ok0 >= 1
+    assert M.key_audits.get(outcome="mismatch") - mm0 >= 1
+    # distinct keys never compare against each other (fresh site:
+    # audit records are process-global by design)
+    with keys.armed_scope(), keys.capture() as cap:
+        keys.audit("test.py:t2", ("k", 1), {"dep": 1})
+        keys.audit("test.py:t2", ("k", 2), {"dep": 2})
+        assert not cap.findings()
+
+
+def test_audit_disarmed_is_noop():
+    was = keys.armed()
+    keys.disarm()
+    try:
+        with keys.capture() as cap:
+            keys.audit("test.py:noop", ("k",), {"dep": 1})
+            keys.audit("test.py:noop", ("k",), {"dep": 2})
+            assert not cap.findings()
+    finally:
+        if was:
+            keys.arm()
+
+
+def test_digest_stability():
+    d = keys.digest
+    assert d(("a", 1, 2.5)) == d(("a", 1, 2.5))
+    assert d([1, 2]) != d([1, 3])
+    assert d({"a": 1, "b": 2}) == d({"b": 2, "a": 1})
+    assert d(np.asarray([1, 2])) == d(np.asarray([1, 2]))
+    assert d(np.asarray([1, 2])) != d(np.asarray([1, 3]))
+    assert d(None) != d(0) != d("")
+    # device-array-like objects digest by signature, not content
+    class _Dev:
+        dtype = "f32"
+        shape = (4,)
+    assert d(_Dev()) == d(_Dev())
+
+
+def test_export_observed_round_trip(tmp_path):
+    with keys.armed_scope():
+        keys.audit("mod_a.py:x", ("k",), {"alpha": 1, "beta": 2})
+        path = str(tmp_path / "obs.json")
+        n = keys.export_observed(path, only_package=False)
+    assert n >= 2
+    obs = mokey.load_observed(path)
+    assert {"alpha", "beta"} <= obs["mod_a.py"]
+    # the checked-in export path filters throwaway test sites
+    pkg_path = str(tmp_path / "obs2.json")
+    keys.export_observed(pkg_path)
+    assert "mod_a.py" not in mokey.load_observed(pkg_path)
+
+
+def test_report_shape():
+    rep = keys.report()
+    assert set(rep) >= {"armed", "records", "sites", "findings",
+                        "findings_list"}
+
+
+# ------------------------------------------------------------ ops + CLI
+
+def test_mo_ctl_keys_surface():
+    from matrixone_tpu.frontend import Session
+    from matrixone_tpu.storage.engine import Engine
+    s = Session(catalog=Engine())
+
+    def ctl(arg):
+        return s.execute(f"select mo_ctl('keys','{arg}')").rows()[0][0]
+
+    st = json.loads(ctl("status"))
+    assert set(st) >= {"armed", "records", "sites", "findings",
+                       "static"}
+    was = keys.armed()
+    try:
+        assert ctl("audit:off") == "key audit disarmed"
+        assert not keys.armed()
+        assert ctl("audit:on") == "key audit armed"
+        assert keys.armed()
+    finally:
+        (keys.arm if was else keys.disarm)()
+    # 'clear' wipes the PROCESS-GLOBAL auditor state — snapshot and
+    # restore it, or this test would erase findings/records/observed
+    # accumulated by earlier tests and blind both the end-of-suite
+    # zero-mismatch gate and an MO_KEY_EXPORT regeneration run
+    with keys._LOCK:
+        saved = (dict(keys._RECORDS),
+                 {s_: set(v) for s_, v in keys._OBSERVED.items()},
+                 list(keys._FINDINGS))
+    try:
+        assert "cleared" in ctl("clear")
+        assert keys.report()["records"] == 0
+    finally:
+        with keys._LOCK:
+            keys._RECORDS.update(saved[0])
+            keys._OBSERVED.update(saved[1])
+            keys._FINDINGS[:] = saved[2]
+    from matrixone_tpu.sql.binder import BindError
+    with pytest.raises(BindError, match="unknown keys subcommand"):
+        ctl("bogus")
+
+
+def test_cli_exit_codes():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    bad = subprocess.run(
+        [sys.executable, "-m", "tools.mokey",
+         os.path.join(FIX, "stale_dict_bad.py")],
+        cwd=REPO, env=env, capture_output=True, text=True)
+    assert bad.returncode == 1
+    assert "weak-key" in bad.stdout
+    good = subprocess.run(
+        [sys.executable, "-m", "tools.mokey",
+         os.path.join(FIX, "stale_dict_good.py")],
+        cwd=REPO, env=env, capture_output=True, text=True)
+    assert good.returncode == 0, good.stdout + good.stderr
+
+
+def test_last_run_status():
+    mokey.run_checks(REPO, src_paths=[
+        os.path.join(FIX, "lit_arity_good.py")])
+    st = mokey.last_run_status()
+    assert st["last_run"] is not None
+    assert set(st["last_run"]) >= {"files", "roots", "captures",
+                                   "findings", "findings_list"}

@@ -740,3 +740,44 @@ def test_span_hygiene_planted_violation(tmp_path):
                  "    motrace.inject(wire)\n")
     findings2, _ = _run([str(q)], rules=["span-hygiene"])
     assert not findings2
+
+
+# --------------------------------------------------- framework perf (PR 14)
+def test_per_checker_timings_reported():
+    """run_checks times every checker (the suite keeps growing — the
+    next slow checker must be visible) and surfaces the table through
+    stats and mo_ctl('lint','status'), slowest first."""
+    findings, stats = molint.run_checks(REPO)
+    secs = stats["checker_seconds"]
+    assert set(secs) == set(stats["rules"])
+    assert all(isinstance(v, float) and v >= 0 for v in secs.values())
+    vals = list(secs.values())
+    assert vals == sorted(vals, reverse=True)
+    st = molint.last_run_status()
+    assert st["last_run"]["checker_seconds"] == secs
+
+
+def test_parse_cache_shares_modules_across_runs():
+    """Each file parses ONCE per process: two Project constructions
+    over the same tree hand back the SAME PyModule objects (the AST is
+    shared across all checkers and across every run_checks caller —
+    the per-invocation re-parse was O(invocations x files))."""
+    p1 = molint.Project(REPO, [os.path.join(REPO, "matrixone_tpu")])
+    p2 = molint.Project(REPO, [os.path.join(REPO, "matrixone_tpu")])
+    assert len(p1.modules) == len(p2.modules) > 50
+    assert all(a is b for a, b in zip(p1.modules, p2.modules))
+
+
+def test_parse_cache_invalidates_on_edit(tmp_path):
+    """An edited file re-parses (mtime/size keyed) — the cache can
+    never serve a stale AST for a changed source."""
+    p = tmp_path / "m.py"
+    p.write_text("x = 1\n")
+    m1 = molint._load_module(str(p), "m.py")
+    m2 = molint._load_module(str(p), "m.py")
+    assert m1 is m2
+    os.utime(str(p), (0, 0))          # force a different mtime
+    p.write_text("x = 2  # changed\n")
+    m3 = molint._load_module(str(p), "m.py")
+    assert m3 is not m1
+    assert "changed" in m3.text

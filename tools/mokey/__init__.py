@@ -1,0 +1,1064 @@
+"""mokey — trace-capture / cache-key completeness analyzer (static
+half; the runtime half is matrixone_tpu/utils/keys.py).
+
+The engine caches compiled JAX programs in four places — fragment
+programs (vm/fusion.py + the join/window subclasses), UDF bodies
+(udf/executor.py), mview delta programs (mview/maintain.py) and
+compiled operator trees (serving/plan_cache.py) — and its #1 historical
+bug class is a cached program whose traced closure captured something
+the cache key did not cover: the PR-7 dictionary LUT keyed by LENGTH
+instead of content, the PR-13 build key missing its lifted-literal
+arity.  Each shipped plausible-but-wrong rows and was found late.
+
+This pass makes the class visible at lint time.  Over every module
+that touches a recognized compile cache it:
+
+  1. discovers the TRACE ROOTS molint's jit-purity checker also
+     discovers — `@jax.jit` defs, `jax.jit(f)` wrap targets through
+     local aliases, and factory-returned closures (plus closures a
+     root CAPTURES from a factory, e.g. the shared `chain` body);
+  2. computes what each traced closure CAPTURES: free variables from
+     enclosing function scopes and `self.`-attribute reads;
+  3. resolves every capture to one of
+       (a) a traced argument        — parameters are traced by
+                                      construction, so free vars are
+                                      exactly the non-(a) set;
+       (b) a compile-key component  — the name (or what it was
+                                      assigned from, chased through
+                                      local dataflow) appears in the
+                                      KEY VOCABULARY: the backward
+                                      closure of names feeding the
+                                      key expression at the cache
+                                      access, through key-builder
+                                      methods and `self.x = ...`
+                                      assignments across related
+                                      classes;
+       (c) a runtime-audited dep    — the name appears in the
+                                      checked-in handshake export
+                                      (observed_captures.json) the
+                                      armed auditor wrote for this
+                                      module (the mosan
+                                      observed-edges pattern);
+       (d) a declared invariant     — `# mokey: invariant=<name> --
+                                      <justification>` inside the
+                                      enclosing factory;
+     anything else is a `key-capture` finding.  A capture whose only
+     path into the key goes through `len()`/`id()` is the PR-7 shape
+     and reports as `weak-key` even though the name technically
+     appears.
+
+The vocabulary chase is a deliberate over-approximation (bare-name
+method dispatch, whole-body inlining of key builders): mokey's job is
+zero FALSE findings on a disciplined tree while the two historical bug
+shapes stay mechanically detectable — the runtime auditor is the sound
+content oracle, and the fixture pairs under tests/mokey_fixtures pin
+both sides.  Gate: tests/test_mokey.py::test_repo_tree_is_clean.
+
+CLI: `python -m tools.mokey [paths] [--json]`; programmatic surface
+`run_checks(root)` / `last_run_status()` mirrors tools/molint.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import sys
+import time
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.molint import Finding, Project, PyModule, repo_root
+from tools.molint.astutil import dotted
+from tools.molint.checkers.jit_purity import (_decorated_as_jit,
+                                              _jit_wrap_target)
+
+#: receivers whose .entry/.lookup/... calls count as compile-cache
+#: accesses (terminal attribute or full dotted name, case-insensitive)
+_CACHE_RECV_RE = re.compile(
+    r"(?i)(cache|progs?|programs|entries|_lru|compiled)")
+_CACHE_METHODS = {"entry", "lookup", "insert", "get", "setdefault",
+                  "peek", "take_tree", "put_tree"}
+
+_DECL_RE = re.compile(
+    r"#\s*mokey:\s*invariant\s*=\s*(?P<names>[A-Za-z0-9_.,]+)"
+    r"\s*(?P<rest>.*)$")
+_JUST_STRIP = re.compile(r"^[\s:;—-]+")
+
+#: default handshake export (written by MO_KEY_EXPORT=1 test runs)
+OBSERVED_DEFAULT = os.path.join(os.path.dirname(__file__),
+                                "observed_captures.json")
+
+import builtins as _b
+
+_BUILTINS = set(dir(_b))
+
+_MAX_DEPTH = 5                  # dataflow recursion bound
+_MAX_VOCAB = 4000               # vocabulary expansion budget
+
+
+# =====================================================================
+# per-module structure
+# =====================================================================
+
+class _FuncRec:
+    """One function/method with its lexical position."""
+
+    __slots__ = ("node", "name", "classname", "parents", "module")
+
+    def __init__(self, node, name, classname, parents, module):
+        self.node = node
+        self.name = name
+        self.classname = classname      # enclosing class or None
+        self.parents = parents          # enclosing FunctionDefs, outer->inner
+        self.module = module
+
+    @property
+    def span(self) -> Tuple[int, int]:
+        return (self.node.lineno,
+                getattr(self.node, "end_lineno", self.node.lineno))
+
+
+class _Decl:
+    """One `# mokey: invariant=a,b -- why` declaration."""
+
+    __slots__ = ("lineno", "names", "justification", "used")
+
+    def __init__(self, lineno, names, justification):
+        self.lineno = lineno
+        self.names = names
+        self.justification = justification
+        self.used = False
+
+
+class _ModIndex:
+    """Everything the analyzer needs from one parsed module."""
+
+    def __init__(self, mod: PyModule):
+        self.mod = mod
+        self.funcs: List[_FuncRec] = []
+        self.by_name: Dict[str, List[_FuncRec]] = {}
+        self.module_bindings: Set[str] = set()
+        self.class_bases: Dict[str, List[str]] = {}
+        self.decls: List[_Decl] = []
+        self._attr_assigns: Optional[Dict[str, list]] = None
+        if mod.tree is None:
+            return
+        for node in mod.tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                for a in node.names:
+                    self.module_bindings.add(
+                        (a.asname or a.name).split(".")[0])
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                self.module_bindings.add(node.name)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_bindings.add(t.id)
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name):
+                self.module_bindings.add(node.target.id)
+        self._walk(mod.tree, None, [])
+        for fr in self.funcs:
+            self.by_name.setdefault(fr.name, []).append(fr)
+        for i, line in enumerate(mod.lines, 1):
+            m = _DECL_RE.search(line)
+            if not m:
+                continue
+            names = [n.strip() for n in m.group("names").split(",")
+                     if n.strip()]
+            just = _JUST_STRIP.sub("", m.group("rest")).strip()
+            self.decls.append(_Decl(i, names, just))
+
+    def attr_assigns(self) -> Dict[str, list]:
+        """attr name -> [(RHS, method _FuncRec)] for every
+        `self.<attr> = ...` in the module (built once — the resolver
+        and vocabulary chase query this constantly)."""
+        if self._attr_assigns is None:
+            out: Dict[str, list] = {}
+            for fr in self.funcs:
+                if fr.classname is None:
+                    continue
+                for node in ast.walk(fr.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for t in node.targets:
+                        ch = _self_chain(t) \
+                            if isinstance(t, ast.Attribute) else None
+                        if ch is not None and ch.count(".") == 1:
+                            out.setdefault(ch.split(".")[1],
+                                           []).append((node.value, fr))
+            self._attr_assigns = out
+        return self._attr_assigns
+
+    def _walk(self, node, classname, parents):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                self.funcs.append(_FuncRec(child, child.name, classname,
+                                           list(parents), self.mod))
+                self._walk(child, classname, parents + [child])
+            elif isinstance(child, ast.ClassDef):
+                self.class_bases[child.name] = [
+                    b for b in (dotted(x) for x in child.bases) if b]
+                self._walk(child, child.name, parents)
+            else:
+                self._walk(child, classname, parents)
+
+
+# =====================================================================
+# expression item extraction (names + self chains, len/id weakness)
+# =====================================================================
+
+def _self_chain(node) -> Optional[str]:
+    """'self.a.b' (up to 3 attrs) for an Attribute chain on self."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name) and node.id == "self" and parts:
+        return "self." + ".".join(reversed(parts[-3:]))
+    return None
+
+
+def _expr_items(node) -> List[Tuple[str, bool, Optional[ast.Call]]]:
+    """(item, strong, call) for every name / self-chain / call in an
+    expression.  `strong` is False when the occurrence sits directly
+    inside `len(...)` / `id(...)` — the PR-7 length-only-key shape.
+    Calls are returned so the caller can chase key-builder methods."""
+    out: List[Tuple[str, bool, Optional[ast.Call]]] = []
+
+    def visit(n, weak, bound):
+        if isinstance(n, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                          ast.DictComp)):
+            inner = set(bound)
+            for gen in n.generators:
+                for t in ast.walk(gen.target):
+                    if isinstance(t, ast.Name):
+                        inner.add(t.id)
+                visit(gen.iter, weak, bound)
+                for cond in gen.ifs:
+                    visit(cond, weak, inner)
+            if isinstance(n, ast.DictComp):
+                visit(n.key, weak, inner)
+                visit(n.value, weak, inner)
+            else:
+                visit(n.elt, weak, inner)
+            return
+        if isinstance(n, ast.Lambda):
+            inner = set(bound) | {a.arg for a in
+                                  (n.args.posonlyargs + n.args.args
+                                   + n.args.kwonlyargs)}
+            visit(n.body, weak, inner)
+            return
+        if isinstance(n, ast.Call):
+            fn = n.func
+            fname = dotted(fn)
+            inner_weak = weak
+            if isinstance(fn, ast.Name) and fn.id in ("len", "id"):
+                inner_weak = True
+            else:
+                out.append(((fname or "?call"), not weak, n))
+            for a in list(n.args) + [kw.value for kw in n.keywords]:
+                visit(a, inner_weak, bound)
+            if not isinstance(fn, ast.Name) and dotted(fn) is None \
+                    and _self_chain(fn) is None:
+                # complex callee (subscript/call result): its parts are
+                # data, not a method identity already on the call item
+                visit(fn, weak, bound)
+            return
+        if isinstance(n, ast.Attribute):
+            ch = _self_chain(n)
+            if ch is not None:
+                out.append((ch, not weak, None))
+                return
+            d = dotted(n)
+            if d is not None:
+                if d.split(".")[0] not in bound:
+                    out.append((d.split(".")[0], not weak, None))
+                return
+        if isinstance(n, ast.Name):
+            if n.id not in bound:
+                out.append((n.id, not weak, None))
+            return
+        for c in ast.iter_child_nodes(n):
+            visit(c, weak, bound)
+
+    visit(node, False, set())
+    return out
+
+
+def _target_names(t) -> List[str]:
+    """Bare names bound by one assignment target (tuple unpacking
+    included — `fn, fieldmap = ...` binds both to the whole RHS)."""
+    if isinstance(t, ast.Name):
+        return [t.id]
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out = []
+        for e in t.elts:
+            out.extend(_target_names(e))
+        return out
+    return []
+
+
+def _assignments_to(fn_node, name: str, skip: Optional[ast.AST] = None
+                    ) -> List[ast.AST]:
+    """RHS expressions assigned to bare `name` within fn_node's body
+    (nested defs other than `skip` excluded — their locals shadow;
+    tuple-unpack targets over-approximate to the whole RHS)."""
+    out = []
+
+    def visit(n):
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)) and c is not skip:
+                continue
+            if isinstance(c, ast.Assign):
+                for t in c.targets:
+                    if name in _target_names(t):
+                        out.append(c.value)
+            elif isinstance(c, (ast.AugAssign, ast.AnnAssign)) and \
+                    isinstance(c.target, ast.Name) and \
+                    c.target.id == name and c.value is not None:
+                out.append(c.value)
+            elif isinstance(c, ast.For) and \
+                    name in _target_names(c.target):
+                out.append(c.iter)
+            visit(c)
+
+    visit(fn_node)
+    return out
+
+
+def _attr_assignments(indexes: Dict[str, "_ModIndex"], relatives,
+                      chain: str
+                      ) -> List[Tuple[ast.AST, "_FuncRec", "_ModIndex"]]:
+    """(RHS, containing method, module) for every `self.x = ...` of
+    chain 'self.x' across the related classes (any module)."""
+    head = chain.split(".")[1] if chain.startswith("self.") else chain
+    out = []
+    for idx in indexes.values():
+        for rhs, fr in idx.attr_assigns().get(head, ()):
+            if fr.classname in relatives:
+                out.append((rhs, fr, idx))
+    return out
+
+
+# =====================================================================
+# class relations (name-matched across the project, jit-purity policy)
+# =====================================================================
+
+def _related_classes(indexes: Dict[str, "_ModIndex"],
+                     classname: Optional[str]) -> Set[str]:
+    if classname is None:
+        return set()
+    bases: Dict[str, Set[str]] = {}
+    for idx in indexes.values():
+        for cls, bs in idx.class_bases.items():
+            bases.setdefault(cls, set()).update(
+                b.split(".")[-1] for b in bs)
+    rel = {classname}
+    while True:
+        more = set()
+        for cls, bs in bases.items():
+            if cls in rel and bs - rel:
+                more |= bs - rel            # ancestors
+            if bs & rel and cls not in rel:
+                more.add(cls)               # descendants
+        if not more:
+            break
+        rel |= more
+    return rel
+
+
+# =====================================================================
+# key vocabulary
+# =====================================================================
+
+class _Vocab:
+    __slots__ = ("strong", "weak", "sites")
+
+    def __init__(self):
+        self.strong: Set[str] = set()
+        self.weak: Set[str] = set()
+        self.sites: List[Tuple[str, int]] = []   # (path, lineno)
+
+    def has(self, item: str) -> bool:
+        return self._match(item, self.strong)
+
+    def has_weak(self, item: str) -> bool:
+        return self._match(item, self.weak)
+
+    @staticmethod
+    def _match(item: str, pool: Set[str]) -> bool:
+        if item in pool:
+            return True
+        if item.startswith("self."):
+            # prefix match: vocab 'self._agg_op' covers capture
+            # 'self._agg_op.node' (an attribute of a keyed object)
+            parts = item.split(".")
+            for i in range(2, len(parts)):
+                if ".".join(parts[:i]) in pool:
+                    return True
+            # and the tail as a bare name ('_lift_lits' via param)
+            return parts[-1] in pool
+        return False
+
+
+def _cache_call_sites(idx: _ModIndex):
+    """(call, key_expr, enclosing _FuncRec) for every recognized
+    compile-cache access in the module."""
+    out = []
+    for fr in idx.funcs:
+        for node in ast.walk(fr.node):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _CACHE_METHODS
+                    and node.args):
+                continue
+            recv = dotted(node.func.value) or (
+                _self_chain(node.func.value) or "")
+            if not _CACHE_RECV_RE.search(recv):
+                continue
+            # innermost enclosing function wins
+            best = None
+            for cand in idx.funcs:
+                s, e = cand.span
+                if s <= node.lineno <= e and (
+                        best is None or s >= best.span[0]):
+                    best = cand
+            if best is not None:
+                out.append((node, node.args[0], best))
+    return out
+
+
+def _build_vocab(indexes: Dict[str, "_ModIndex"], idx: _ModIndex,
+                 scope_classes: Set[str]) -> _Vocab:
+    """The key vocabulary for a class scope (or the module when
+    scope_classes is empty): the backward closure of names feeding any
+    cache-key expression of the scope, through local assignments,
+    key-builder method bodies (bare-name dispatch across related
+    classes), and `self.x = ...` provenance."""
+    vocab = _Vocab()
+    #: worklist of (kind, payload): ("expr", node, fn_node, modidx,
+    #: ctx_weak), ("method", name, modidx, ctx_weak).  ctx_weak marks
+    #: provenance chased out of a len()/id()-only occurrence — its
+    #: constituents must land in the WEAK pool too, or a length-only
+    #: key would launder its dictionary into the strong vocabulary
+    work: list = []
+    seen_expr: Set[tuple] = set()
+    seen_methods: Set[tuple] = set()
+
+    def add_expr(node, fn_node, modidx, ctx_weak):
+        k = (id(node), ctx_weak)
+        if k in seen_expr:
+            return
+        seen_expr.add(k)
+        work.append(("expr", node, fn_node, modidx, ctx_weak))
+
+    for midx in indexes.values():
+        for call, key_expr, fr in _cache_call_sites(midx):
+            in_scope = (fr.classname in scope_classes if scope_classes
+                        else (midx is idx and fr.classname is None))
+            if not in_scope:
+                continue
+            vocab.sites.append((midx.mod.path, call.lineno))
+            add_expr(key_expr, fr.node, midx, False)
+
+    budget = _MAX_VOCAB
+    while work and budget > 0:
+        budget -= 1
+        kind, *payload = work.pop()
+        if kind == "method":
+            name, midx, ctx_weak = payload
+            for owner in indexes.values():
+                for fr2 in owner.by_name.get(name, ()):
+                    if fr2.classname is not None and scope_classes and \
+                            fr2.classname not in scope_classes:
+                        continue
+                    add_expr(fr2.node, fr2.node, owner, ctx_weak)
+            continue
+        node, fn_node, midx, ctx_weak = payload
+        for item, strong, call in _expr_items(node):
+            eff_strong = strong and not ctx_weak
+            pool = vocab.strong if eff_strong else vocab.weak
+            if item == "?call":
+                continue
+            if call is not None:
+                # a call in key position: its ARGUMENTS already visited
+                # by _expr_items; chase the callee's body when it is a
+                # method/function of this scope ("self._runtime_key",
+                # "FF._dict_key", bare "helper")
+                mname = item.split(".")[-1]
+                mk = (mname, not eff_strong)
+                if mk not in seen_methods:
+                    seen_methods.add(mk)
+                    work.append(("method", mname, midx,
+                                 not eff_strong))
+                continue
+            if item in pool:
+                continue
+            pool.add(item)
+            if item.startswith("self."):
+                for rhs, owner_fr, owner in _attr_assignments(
+                        indexes, scope_classes or {None}, item):
+                    add_expr(rhs, owner_fr.node, owner,
+                             not eff_strong)
+            else:
+                for rhs in _assignments_to(fn_node, item):
+                    add_expr(rhs, fn_node, midx, not eff_strong)
+    return vocab
+
+
+# =====================================================================
+# trace-root discovery
+# =====================================================================
+
+def _nested_defs(indexes: Dict[str, _ModIndex], fac_name: str
+                 ) -> List[_FuncRec]:
+    """Nested defs of every function named `fac_name` across the
+    project (bare-name virtual dispatch — the jit-purity policy: a
+    base-class wrap site reaches subclass factory overrides in other
+    modules, e.g. fusion.py wrapping fusion_window's _make_step)."""
+    out = []
+    for owner in indexes.values():
+        for fr in owner.by_name.get(fac_name, ()):
+            for sub in owner.funcs:
+                if sub.parents and sub.parents[-1] is fr.node:
+                    out.append(sub)
+    return out
+
+
+def _jit_roots(indexes: Dict[str, _ModIndex], idx: _ModIndex
+               ) -> List[_FuncRec]:
+    """Defs traced by jax.jit/shard_map: decorated defs, wrap targets
+    resolved through local aliases, factory-returned nested defs (the
+    jit-purity discovery, on def nodes; factories dispatch by bare
+    name across modules)."""
+    if idx.mod.tree is None:
+        return []
+    roots: List[_FuncRec] = []
+    seen: Set[int] = set()
+
+    def add(fr: _FuncRec):
+        if id(fr.node) not in seen:
+            seen.add(id(fr.node))
+            roots.append(fr)
+
+    for fr in idx.funcs:
+        if _decorated_as_jit(fr.node):
+            add(fr)
+    # alias and factory maps, module-wide (the jit_purity policy);
+    # tuple-unpack targets (`fn, fieldmap = self._make_dense_step(...)`)
+    # bind every name to the factory
+    alias: Dict[str, Set[str]] = {}
+    factory: Dict[str, Set[str]] = {}
+    targets: List[str] = []
+    for node in ast.walk(idx.mod.tree):
+        if isinstance(node, ast.Assign):
+            names = []
+            for t in node.targets:
+                names.extend(_target_names(t))
+            v = node.value
+            if isinstance(v, (ast.Name, ast.Attribute)):
+                d = dotted(v) or _self_chain(v)
+                if d:
+                    for nm in names:
+                        alias.setdefault(nm, set()).add(
+                            d.split(".")[-1])
+            elif isinstance(v, ast.Call):
+                d = dotted(v.func) or _self_chain(v.func)
+                if d:
+                    for nm in names:
+                        factory.setdefault(nm, set()).add(
+                            d.split(".")[-1])
+        if isinstance(node, ast.Call):
+            tgt = _jit_wrap_target(node)
+            if tgt:
+                targets.append(tgt)
+    for tgt in targets:
+        names = {tgt}
+        while True:
+            more = {a for n in names for a in alias.get(n, ())} - names
+            if not more:
+                break
+            names |= more
+        for n in names:
+            for fr in idx.by_name.get(n, ()):
+                add(fr)
+        for fac in {f for n in names for f in factory.get(n, ())}:
+            for sub in _nested_defs(indexes, fac):
+                add(sub)
+    return roots
+
+
+# =====================================================================
+# capture computation
+# =====================================================================
+
+def _captures_of(fr: _FuncRec, idx: _ModIndex
+                 ) -> List[Tuple[str, int]]:
+    """(capture item, first line) for a traced def: free bare names
+    bound in an enclosing function scope, plus self-attribute chains.
+    Walks the WHOLE subtree (nested helper defs run at trace time
+    too)."""
+    node = fr.node
+    bound: Set[str] = set()
+    loads: Dict[str, int] = {}
+    self_chains: Dict[str, int] = {}
+
+    def collect_args(args):
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            bound.add(a.arg)
+        if args.vararg:
+            bound.add(args.vararg.arg)
+        if args.kwarg:
+            bound.add(args.kwarg.arg)
+
+    collect_args(node.args)
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            bound.add(sub.name)
+            if sub is not node:
+                collect_args(sub.args)
+        elif isinstance(sub, ast.Lambda):
+            collect_args(sub.args)
+        elif isinstance(sub, ast.Name):
+            if isinstance(sub.ctx, (ast.Store, ast.Del)):
+                bound.add(sub.id)
+            elif sub.id not in loads:
+                loads[sub.id] = sub.lineno
+        elif isinstance(sub, ast.Attribute) and \
+                isinstance(sub.ctx, ast.Load):
+            ch = _self_chain(sub)
+            if ch is not None and ch not in self_chains:
+                self_chains[ch] = sub.lineno
+        elif isinstance(sub, (ast.Global, ast.Nonlocal)):
+            for n in sub.names:
+                bound.discard(n)
+        elif isinstance(sub, ast.comprehension):
+            for t in ast.walk(sub.target):
+                if isinstance(t, ast.Name):
+                    bound.add(t.id)
+        elif isinstance(sub, (ast.ExceptHandler,)) and sub.name:
+            bound.add(sub.name)
+        elif isinstance(sub, ast.withitem) and sub.optional_vars:
+            for t in ast.walk(sub.optional_vars):
+                if isinstance(t, ast.Name):
+                    bound.add(t.id)
+
+    enclosing_bound: Set[str] = set()
+    for p in fr.parents:
+        a = p.args
+        for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+            enclosing_bound.add(arg.arg)
+        if a.vararg:
+            enclosing_bound.add(a.vararg.arg)
+        if a.kwarg:
+            enclosing_bound.add(a.kwarg.arg)
+        for sub in ast.walk(p):
+            if isinstance(sub, ast.Name) and \
+                    isinstance(sub.ctx, ast.Store):
+                enclosing_bound.add(sub.id)
+            elif isinstance(sub, ast.comprehension):
+                for t in ast.walk(sub.target):
+                    if isinstance(t, ast.Name):
+                        enclosing_bound.add(t.id)
+
+    out: List[Tuple[str, int]] = []
+    for name, line in sorted(loads.items()):
+        if name in bound or name in _BUILTINS or name == "self":
+            continue
+        if name in idx.module_bindings:
+            continue                        # (a)-adjacent: module code
+        if name not in enclosing_bound:
+            continue                        # not a closure capture
+        out.append((name, line))
+    for ch, line in sorted(self_chains.items()):
+        out.append((ch, line))
+    return out
+
+
+# =====================================================================
+# resolution
+# =====================================================================
+
+class _Ctx:
+    """One resolution context: the function scopes whose assignments a
+    name may come from, plus the owning module."""
+
+    __slots__ = ("parents", "idx", "skip")
+
+    def __init__(self, parents, idx: _ModIndex, skip=None):
+        self.parents = parents          # fn nodes, outer -> inner
+        self.idx = idx
+        self.skip = skip                # the closure itself (excluded)
+
+    def key(self) -> int:
+        return id(self.parents[-1]) if self.parents else id(self.idx)
+
+
+def _params_of(fn_node) -> List[str]:
+    a = fn_node.args
+    return [x.arg for x in (a.posonlyargs + a.args + a.kwonlyargs)]
+
+
+def _enclosing_func(idx: _ModIndex, lineno: int) -> Optional[_FuncRec]:
+    best = None
+    for fr in idx.funcs:
+        s, e = fr.span
+        if s <= lineno <= e and (best is None or s >= best.span[0]):
+            best = fr
+    return best
+
+
+class _Resolver:
+    def __init__(self, indexes: Dict[str, _ModIndex],
+                 relatives: Set[str], vocab: _Vocab,
+                 observed: Set[str]):
+        self.indexes = indexes
+        self.relatives = relatives
+        self.vocab = vocab
+        self.observed = observed
+        #: factories whose nested defs must also be analyzed (the
+        #: `chain = self._make_chain_fn(...)` shape)
+        self.derived_factories: Set[str] = set()
+
+    def resolve(self, ctx: _Ctx, item: str, depth: int = 0,
+                visited: Optional[Set[tuple]] = None) -> str:
+        """-> 'ok' | 'weak' | 'no' for one capture item in context."""
+        if visited is None:
+            visited = set()
+        vk = (ctx.key(), item)
+        if vk in visited or depth > _MAX_DEPTH:
+            return "no"
+        visited.add(vk)
+        if item in self.observed or (
+                item.startswith("self.")
+                and item.split(".")[1] in self.observed):
+            return "ok"
+        if self.vocab.has(item):
+            return "ok"
+        weak_fallback = (lambda got:
+                         "weak" if got == "no"
+                         and self.vocab.has_weak(item) else got)
+        if item.startswith("self."):
+            tail = item.split(".")[1]
+            for owner in self.indexes.values():
+                for fr2 in owner.by_name.get(tail, ()):
+                    if fr2.classname in self.relatives:
+                        return "ok"      # a method reference: code,
+                                         # not captured data
+            rhss = _attr_assignments(self.indexes, self.relatives, item)
+            if rhss:
+                return weak_fallback(self._resolve_rhss(
+                    [(r, _Ctx(fr2.parents + [fr2.node], owner))
+                     for r, fr2, owner in rhss], depth, visited))
+            return "weak" if self.vocab.has_weak(item) else "no"
+        if item in ctx.idx.module_bindings or item in _BUILTINS:
+            return "ok"
+        # local dataflow: chase assignments in the context scopes
+        rhss = []
+        for p in ctx.parents:
+            for r in _assignments_to(p, item, skip=ctx.skip):
+                rhss.append((r, ctx))
+        if rhss:
+            return weak_fallback(self._resolve_rhss(rhss, depth,
+                                                    visited))
+        # a parameter of a context scope: resolve the matching ARGUMENT
+        # expression at every call site of that function (the factory-
+        # argument hop: `self._make_step(trig, ...)` keys `trig_schema`
+        # through the caller's `trig`)
+        got = self._via_call_sites(ctx, item, depth, visited)
+        if got is not None:
+            return got
+        return "weak" if self.vocab.has_weak(item) else "no"
+
+    def _via_call_sites(self, ctx: _Ctx, item: str, depth, visited
+                        ) -> Optional[str]:
+        owner_fn = None
+        for p in ctx.parents:
+            if item in _params_of(p):
+                owner_fn = p
+        if owner_fn is None:
+            return None
+        params = _params_of(owner_fn)
+        pos = params.index(item)
+        is_method = bool(params) and params[0] == "self"
+        fname = owner_fn.name
+        sites: List[Tuple[ast.AST, _Ctx]] = []
+        for owner in self.indexes.values():
+            if fname not in owner.mod.text:
+                continue
+            for fr2 in owner.funcs:
+                for node in ast.walk(fr2.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    d = dotted(node.func) or _self_chain(node.func)
+                    if not d or d.split(".")[-1] != fname:
+                        continue
+                    arg = None
+                    ppos = pos - 1 if (is_method
+                                       and not isinstance(node.func,
+                                                          ast.Name)) \
+                        else pos
+                    if 0 <= ppos < len(node.args):
+                        arg = node.args[ppos]
+                    for kw in node.keywords:
+                        if kw.arg == item:
+                            arg = kw.value
+                    if arg is None:
+                        continue
+                    caller = _enclosing_func(owner, node.lineno)
+                    if caller is None:
+                        continue
+                    sites.append((arg, _Ctx(caller.parents
+                                            + [caller.node], owner)))
+        if not sites:
+            return None
+        return self._resolve_rhss(sites, depth, visited)
+
+    def _resolve_rhss(self, rhss: List[Tuple[ast.AST, _Ctx]], depth,
+                      visited) -> str:
+        """A capture with reaching assignments/arguments resolves when
+        EVERY constituent of EVERY reaching expression resolves (over-
+        approximation of which one reaches the closure)."""
+        worst = "ok"
+        for rhs, ctx in rhss:
+            for item2, strong, call in _expr_items(rhs):
+                if item2 == "?call":
+                    continue
+                if call is not None:
+                    # method/function code is module code; its nested
+                    # defs become analysis roots (shared step bodies)
+                    self.derived_factories.add(item2.split(".")[-1])
+                    continue
+                got = self.resolve(ctx, item2, depth + 1, set(visited))
+                if got == "no":
+                    return "no"
+                if got == "weak":
+                    worst = "weak"
+        return worst
+
+
+# =====================================================================
+# declarations
+# =====================================================================
+
+def _decl_matches(decl: _Decl, item: str) -> bool:
+    tail = item.split(".")[-1]
+    for n in decl.names:
+        ntail = n.split(".")[-1]
+        if n == item or ntail == tail:
+            return True
+    return False
+
+
+def _decl_for(idx: _ModIndex, fr: _FuncRec, item: str
+              ) -> Optional[_Decl]:
+    """A declaration covering `item`, scoped to the root's outermost
+    enclosing factory span (or the whole module for module-level
+    roots)."""
+    if fr.parents:
+        outer = fr.parents[0]
+        lo, hi = outer.lineno, getattr(outer, "end_lineno",
+                                       outer.lineno)
+    else:
+        lo, hi = 1, len(idx.mod.lines)
+    for d in idx.decls:
+        if lo <= d.lineno <= hi and _decl_matches(d, item):
+            return d
+    return None
+
+
+# =====================================================================
+# the analyzer
+# =====================================================================
+
+def load_observed(path: Optional[str] = None) -> Dict[str, Set[str]]:
+    """site-path-suffix -> dep names from the handshake export.  A
+    missing or unreadable export degrades to empty — never a crashed
+    gate (the mosan convention)."""
+    path = path or OBSERVED_DEFAULT
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        out: Dict[str, Set[str]] = {}
+        for site, names in data.get("sites", {}).items():
+            mod_path = site.rsplit(":", 1)[0]
+            out.setdefault(mod_path, set()).update(names)
+        return out
+    except (OSError, ValueError):
+        return {}
+
+
+def run_checks(root: str, src_paths: Optional[List[str]] = None,
+               observed_path: Optional[str] = None,
+               record: bool = True):
+    """Run the capture-completeness pass.  Scans <root>/matrixone_tpu
+    by default; returns (findings, stats) in the molint shape."""
+    global LAST_RUN
+    t0 = time.perf_counter()
+    root = os.path.abspath(root)
+    if src_paths is None:
+        src_paths = [os.path.join(root, "matrixone_tpu")]
+    project = Project(root, src_paths, tests_dir=None, complete=False)
+    observed_all = load_observed(observed_path)
+
+    indexes: Dict[str, _ModIndex] = {}
+    for mod in project.modules:
+        if mod.tree is not None:
+            indexes[mod.path] = _ModIndex(mod)
+
+    findings: List[Finding] = []
+    for mod in project.modules:
+        if mod.tree is None:
+            findings.append(Finding("parse", mod.path, 1,
+                                    f"file does not parse: "
+                                    f"{mod.parse_error}"))
+
+    # ---- global root set (a wrap site in one module can root a
+    # factory-returned closure defined in another)
+    pending: List[_FuncRec] = []
+    queued: Set[int] = set()
+    for path in sorted(indexes):
+        for fr in _jit_roots(indexes, indexes[path]):
+            if id(fr.node) not in queued:
+                queued.add(id(fr.node))
+                pending.append(fr)
+
+    n_roots = 0
+    n_captures = 0
+    vocab_cache: Dict[tuple, Tuple[Set[str], _Vocab]] = {}
+    while pending:
+        fr = pending.pop(0)
+        idx = indexes[fr.module.path]
+        path = fr.module.path
+        if not fr.parents:
+            continue            # module-level jit fn: captures are
+                                # module bindings — nothing cacheable
+                                # outlives the function object
+        ck = (path, fr.classname)
+        cached = vocab_cache.get(ck)
+        if cached is None:
+            relatives = _related_classes(indexes, fr.classname)
+            vocab = _build_vocab(indexes, idx, relatives)
+            vocab_cache[ck] = (relatives, vocab)
+        else:
+            relatives, vocab = cached
+        if not vocab.sites:
+            # no compile cache in scope: the closure dies with its
+            # factory call — jax keys its own cache by function
+            # identity, so captures cannot go stale
+            continue
+        observed = set()
+        for suffix, names in observed_all.items():
+            if path.endswith(suffix):
+                observed |= names
+        n_roots += 1
+        res = _Resolver(indexes, relatives, vocab, observed)
+        ctx = _Ctx(list(fr.parents), idx, skip=fr.node)
+        for item, line in _captures_of(fr, idx):
+            n_captures += 1
+            got = res.resolve(ctx, item)
+            if got == "ok":
+                continue
+            decl = _decl_for(idx, fr, item)
+            if decl is not None and decl.justification:
+                # an UNjustified declaration does not silence — it is
+                # itself a finding (the molint suppression discipline)
+                decl.used = True
+                continue
+            if got == "weak":
+                findings.append(Finding(
+                    "weak-key", path, line,
+                    f"traced closure {fr.name!r} captures {item!r} "
+                    f"whose only path into the compile key is "
+                    f"len()/id() — key the CONTENT (the PR-7 "
+                    f"stale-LUT class) or declare "
+                    f"`# mokey: invariant={item.split('.')[-1]} "
+                    f"-- why`"))
+            else:
+                findings.append(Finding(
+                    "key-capture", path, line,
+                    f"traced closure {fr.name!r} captures {item!r} "
+                    f"— not a traced argument, not resolvable to "
+                    f"the enclosing compile key, not runtime-"
+                    f"audited, and not declared "
+                    f"`# mokey: invariant={item.split('.')[-1]} "
+                    f"-- why` (the stale-compiled-program class)"))
+        # shared step bodies produced by factories a capture chased
+        # become roots too (`chain = self._make_chain_fn(...)`)
+        for fac in res.derived_factories:
+            for sub in _nested_defs(indexes, fac):
+                if id(sub.node) not in queued:
+                    queued.add(id(sub.node))
+                    pending.append(sub)
+
+    # declaration meta-rules (the molint suppression discipline)
+    for path, idx in sorted(indexes.items()):
+        for d in idx.decls:
+            if not d.justification:
+                findings.append(Finding(
+                    "invariant-decl", path, d.lineno,
+                    "invariant declaration has no justification text "
+                    "(write `# mokey: invariant=<name> -- why`)"))
+
+    findings.sort(key=Finding.sort_key)
+    stats = {"files": len(project.modules),
+             "roots": n_roots,
+             "captures": n_captures,
+             "findings": len(findings),
+             "seconds": round(time.perf_counter() - t0, 3)}
+    if record:
+        LAST_RUN = dict(stats)
+        LAST_RUN["ts"] = time.time()
+        LAST_RUN["findings_list"] = [f.format() for f in findings[:50]]
+    return findings, stats
+
+
+#: last completed run, for mo_ctl('keys','status') introspection
+LAST_RUN: Optional[dict] = None
+
+
+def last_run_status() -> dict:
+    st: dict = {"observed_sites": sorted(load_observed())}
+    if LAST_RUN is None:
+        st["last_run"] = None
+    else:
+        st["last_run"] = {k: LAST_RUN[k]
+                          for k in ("files", "roots", "captures",
+                                    "findings", "ts")}
+        st["last_run"]["findings_list"] = LAST_RUN["findings_list"]
+    return st
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.mokey",
+        description="trace-capture / cache-key completeness analyzer "
+                    "(see README 'Static analysis').")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: matrixone_tpu/)")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--root", default=None)
+    ap.add_argument("--observed", default=None,
+                    help="handshake export path (default: "
+                         "tools/mokey/observed_captures.json)")
+    args = ap.parse_args(argv)
+    root = os.path.abspath(args.root or repo_root())
+    src = [os.path.abspath(p) for p in args.paths] or None
+    findings, stats = run_checks(root, src_paths=src,
+                                 observed_path=args.observed)
+    if args.json:
+        print(json.dumps([f.as_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+    print(f"mokey: {stats['roots']} traced closures, "
+          f"{stats['captures']} captures, {stats['findings']} "
+          f"finding(s) across {stats['files']} file(s) "
+          f"[{stats['seconds']}s]", file=sys.stderr)
+    return 1 if findings else 0

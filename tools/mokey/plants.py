@@ -1,0 +1,113 @@
+"""mokey planted-bug smoke drills — the precheck `--key-smoke` stage.
+
+Proves the analyzer catches what it claims to, on BOTH sides, in a few
+seconds (mirrors tools/mosan.plant_eviction_race and tools/moqa's
+plant drills):
+
+  static   — copy the planted fixture pairs (tests/mokey_fixtures/)
+             into a temp tree and run the static pass: the PR-7
+             length-only-key plant must report `weak-key`, the PR-13
+             dropped-arity plant `key-capture`, and both clean twins
+             must stay quiet;
+  runtime  — execute the same planted caches with the auditor armed:
+             same-cardinality dictionary churn / a grown lifted tuple
+             collide on the planted keys and must surface as
+             `key-capture-mismatch` findings carrying both stacks,
+             while the clean twins re-key and stay quiet.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import shutil
+import tempfile
+
+from tools.molint import repo_root
+
+
+def fixture_dir() -> str:
+    return os.path.join(repo_root(), "tests", "mokey_fixtures")
+
+
+_PAIRS = (
+    ("stale_dict_bad.py", "stale_dict_good.py", "weak-key"),
+    ("lit_arity_bad.py", "lit_arity_good.py", "key-capture"),
+)
+
+
+def run_static_smoke() -> dict:
+    """Static pass over a planted temp tree: both plants caught with
+    the expected rule, both clean twins quiet."""
+    from tools import mokey
+    out = {"caught": {}, "clean": {}, "ok": True}
+    with tempfile.TemporaryDirectory(prefix="mokey_smoke_") as tmp:
+        for fn in [f for pair in _PAIRS for f in pair[:2]]:
+            shutil.copy(os.path.join(fixture_dir(), fn),
+                        os.path.join(tmp, fn))
+        for bad, good, rule in _PAIRS:
+            fb, _ = mokey.run_checks(tmp,
+                                     src_paths=[os.path.join(tmp, bad)],
+                                     record=False)
+            fg, _ = mokey.run_checks(tmp,
+                                     src_paths=[os.path.join(tmp,
+                                                             good)],
+                                     record=False)
+            out["caught"][bad] = any(f.rule == rule for f in fb)
+            out["clean"][good] = not fg
+            out["ok"] = out["ok"] and out["caught"][bad] \
+                and out["clean"][good]
+    return out
+
+
+def _load_fixture(fn: str):
+    path = os.path.join(fixture_dir(), fn)
+    spec = importlib.util.spec_from_file_location(
+        f"mokey_fixture_{fn[:-3]}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_runtime_smoke() -> dict:
+    """One audit round-trip per plant: drive the planted caches under
+    the armed auditor, assert the collision is reported (with both
+    stacks) and the clean twins stay quiet."""
+    import numpy as np
+
+    from matrixone_tpu.utils import keys
+    out = {"caught": {}, "clean": {}, "ok": True}
+    with keys.armed_scope(), keys.capture() as cap:
+        bad = _load_fixture("stale_dict_bad.py").LutProgramCache(
+            ["aa", "bb"])
+        codes = np.asarray([0, 1, 0], np.int32)
+        bad.run(codes)
+        bad.rotate(["zq", "bb"])       # same cardinality, new content
+        bad.run(codes)
+        got = cap.findings()
+        out["caught"]["stale_dict_bad.py"] = any(
+            f.name == "lut_content" and f.record_stack and f.hit_stack
+            for f in got)
+    with keys.armed_scope(), keys.capture() as cap:
+        good = _load_fixture("stale_dict_good.py").LutProgramCache(
+            ["aa", "bb"])
+        good.run(codes)
+        good.rotate(["zq", "bb"])
+        good.run(codes)
+        out["clean"]["stale_dict_good.py"] = not cap.findings()
+    with keys.armed_scope(), keys.capture() as cap:
+        bad = _load_fixture("lit_arity_bad.py").LiftedProgramCache()
+        xs = np.asarray([1.0, 2.0])
+        bad.run(xs, "f8x2", (2.0,))
+        bad.run(xs, "f8x2", (2.0, 3.0))   # arity grew, key did not
+        got = cap.findings()
+        out["caught"]["lit_arity_bad.py"] = any(
+            f.name in ("lift_arity", "baked_values") for f in got)
+    with keys.armed_scope(), keys.capture() as cap:
+        good = _load_fixture("lit_arity_good.py").LiftedProgramCache()
+        good.run(xs, "f8x2", (2.0,))
+        good.run(xs, "f8x2", (2.0, 3.0))
+        out["clean"]["lit_arity_good.py"] = not cap.findings()
+    out["ok"] = all(out["caught"].values()) and all(
+        out["clean"].values())
+    return out

@@ -40,7 +40,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 #: directories never scanned (as path components)
 SKIP_DIRS = {"__pycache__", ".git", ".claude", "node_modules",
-             "molint_fixtures"}
+             "molint_fixtures", "mokey_fixtures"}
 
 _SUPPRESS_RE = re.compile(
     r"#\s*molint:\s*disable(?P<file>-file)?\s*=\s*"
@@ -166,6 +166,34 @@ class PyModule:
         return out
 
 
+#: process-global parse cache: abspath -> (mtime_ns, size, PyModule).
+#: ONE parse per file per process, shared by every run_checks caller —
+#: the tier-1 gate, the per-rule fixture invocations, precheck's
+#: concurrent legs and tools/mokey all construct Projects over the
+#: same tree, and re-parsing the ~130-file package per construction
+#: was the suite's O(invocations × files) hot spot.  Checker memo
+#: attributes (_molint_aliases, _attr_locals) ride the cached module,
+#: which is exactly the sharing the checkers already assume.
+_PARSE_CACHE: Dict[str, tuple] = {}
+_PARSE_LOCK = __import__("threading").Lock()
+
+
+def _load_module(abspath: str, relpath: str) -> PyModule:
+    try:
+        st = os.stat(abspath)
+        sig = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return PyModule(abspath, relpath)   # unreadable: parse finding
+    with _PARSE_LOCK:
+        hit = _PARSE_CACHE.get(abspath)
+        if hit is not None and hit[0] == sig and hit[1].path == relpath:
+            return hit[1]
+    mod = PyModule(abspath, relpath)
+    with _PARSE_LOCK:
+        _PARSE_CACHE[abspath] = (sig, mod)
+    return mod
+
+
 class Project:
     """Everything the checkers see: parsed source modules plus (for the
     coverage-style checkers) parsed test modules.  `complete` says the
@@ -189,14 +217,14 @@ class Project:
         path = os.path.abspath(path)
         mods: List[PyModule] = []
         if os.path.isfile(path):
-            mods.append(PyModule(path, self._rel(path)))
+            mods.append(_load_module(path, self._rel(path)))
             return mods
         for dirpath, dirs, files in os.walk(path):
             dirs[:] = sorted(d for d in dirs if d not in SKIP_DIRS)
             for fn in sorted(files):
                 if fn.endswith(".py"):
                     ap = os.path.join(dirpath, fn)
-                    mods.append(PyModule(ap, self._rel(ap)))
+                    mods.append(_load_module(ap, self._rel(ap)))
         return mods
 
     def _rel(self, abspath: str) -> str:
@@ -325,10 +353,13 @@ def run_checks(root: str, src_paths: Optional[List[str]] = None,
             findings.append(Finding("parse", mod.path, 1,
                                     f"file does not parse: "
                                     f"{mod.parse_error}"))
+    timings: Dict[str, float] = {}
     for c in checkers:
         cfg = dict(c.default_config)
         cfg.update((config or {}).get(c.rule, {}))
+        t0 = time.perf_counter()
         findings.extend(c.check(project, cfg))
+        timings[c.rule] = round(time.perf_counter() - t0, 4)
     findings, suppressed = _apply_suppressions(project, findings)
     if rules:
         findings = [f for f in findings
@@ -338,7 +369,12 @@ def run_checks(root: str, src_paths: Optional[List[str]] = None,
              "files": len(project.modules),
              "findings": len(findings),
              "suppressions_used": suppressed,
-             "rules": sorted(c.rule for c in checkers)}
+             "rules": sorted(c.rule for c in checkers),
+             #: per-checker wall seconds, slowest first — the growing
+             #: suite's next hot spot must stay visible (mo_ctl
+             #: ('lint','status') and the CLI summary both surface it)
+             "checker_seconds": dict(sorted(
+                 timings.items(), key=lambda kv: -kv[1]))}
     if record:
         LAST_RUN = dict(stats)
         LAST_RUN["ts"] = time.time()
@@ -355,7 +391,8 @@ def last_run_status() -> dict:
     else:
         st["last_run"] = {k: LAST_RUN[k]
                           for k in ("findings", "files",
-                                    "suppressions_used", "ts")}
+                                    "suppressions_used",
+                                    "checker_seconds", "ts")}
         st["last_run"]["findings_list"] = LAST_RUN["findings_list"]
     return st
 
@@ -407,6 +444,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         for f in findings:
             print(f.format())
+    secs = stats.get("checker_seconds", {})
+    slowest = ", ".join(f"{r}={s}s" for r, s in list(secs.items())[:3])
+    print(f"checker wall time (slowest first): {slowest}"
+          + (f" (+{len(secs) - 3} more)" if len(secs) > 3 else ""),
+          file=sys.stderr)
     if findings:
         print(f"{len(findings)} finding(s) across {stats['files']} "
               f"file(s); {stats['suppressions_used']} suppressed",

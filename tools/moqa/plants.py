@@ -21,6 +21,13 @@ and reduces what it claims to (tests/test_moqa.py, precheck
 Both planters clear the process-global fragment compile cache on entry
 AND exit: compiled-under-the-bug programs must not leak into later
 (clean) runs, and clean pre-compiled programs must not mask the bug.
+They also SWAP IN an isolated findings sink for the key auditor
+(utils/keys.py, armed suite-wide under pytest): the auditor rightly
+screams about a planted key collision, and those deliberate findings
+must not leak into the session-wide zero-mismatch gate
+(tests/test_mokey.py::test_suite_runs_key_audit_clean).  Callers that
+want the auditor's verdict on a plant open their own nested
+keys.capture() inside the plant scope.
 """
 
 from __future__ import annotations
@@ -37,6 +44,7 @@ def _clear_fragment_cache():
 def plant_stale_dict_lut():
     """Key fragment programs on dictionary LENGTH only (the pre-fix
     PR-7 shape): same-cardinality content churn now serves stale LUTs."""
+    from matrixone_tpu.utils import keys
     from matrixone_tpu.vm import fusion
 
     original = fusion._dict_key
@@ -48,7 +56,8 @@ def plant_stale_dict_lut():
     _clear_fragment_cache()
     fusion._dict_key = length_only_key
     try:
-        yield
+        with keys.capture():
+            yield
     finally:
         fusion._dict_key = original
         _clear_fragment_cache()
@@ -75,11 +84,13 @@ def plant_pad_leak():
     def leaky_scalar_sum(values, mask):
         return jnp.sum(values)
 
+    from matrixone_tpu.utils import keys
     _clear_fragment_cache()
     A.seg_sum = leaky_seg_sum
     A.scalar_sum = leaky_scalar_sum
     try:
-        yield
+        with keys.capture():
+            yield
     finally:
         A.seg_sum = orig_seg_sum
         A.scalar_sum = orig_scalar_sum

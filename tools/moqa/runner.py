@@ -517,14 +517,21 @@ def _run_stale_pair(sc, qs, base_err, note, found, pair_counts,
     """Warm every cache layer, mutate the table, re-run: a cached plan,
     result, or compiled fragment that outlives the data it was built
     from returns plausible-but-wrong rows — exactly the PR-7 stale-LUT
-    bug class."""
+    bug class.  Both phases run with the capture auditor ARMED
+    (MO_KEY_AUDIT semantics, utils/keys.py): every rotate-rebuild
+    lockstep also re-hashes the dictionary/constant content behind
+    every fragment/plan-tree cache hit, so a weakened compile key
+    surfaces as a `key-capture-mismatch` finding with both stacks even
+    when the row diff happens to pass."""
+    from matrixone_tpu.utils import keys as keyaudit
     cand = [(i, q) for i, q in enumerate(qs)
             if i not in base_err and _applicable("cache-stale", q)]
     step = max(1, round(1 / max(fraction, 1e-6)))
     cand = cand[::step]
     if not cand:
         return
-    with _pair_scope("cache-stale"):
+    with _pair_scope("cache-stale"), keyaudit.armed_scope(), \
+            keyaudit.capture() as kcap:
         live = LiveScenario(sc, waves=1, serving_off=False)
         try:
             live.ctl("serving", "result:on")
@@ -617,6 +624,15 @@ def _run_stale_pair(sc, qs, base_err, note, found, pair_counts,
                 if d is not None:
                     found("cache-staleness", sc.name, "cache-stale",
                           q.sql(), d, q=q)
+            # ---- the capture auditor's verdict on both phases: a
+            # mismatch here is a compile key that COLLIDED across the
+            # mutation/rebuild — report it even when the row diff
+            # passed (a zero-row or value-coincident query can mask
+            # the stale program)
+            for kf in kcap.findings():
+                note("staleness")
+                found("key-capture-mismatch", sc.name, "cache-stale",
+                      f"{kf.site} capture {kf.name!r}", kf.detail)
         finally:
             live.close()
 
