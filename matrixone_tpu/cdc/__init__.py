@@ -92,6 +92,31 @@ def delta_events(engine, table: str, from_ts: int) -> List[tuple]:
                                                     key=lambda e: e[:2])]
 
 
+class FileWatermark:
+    """Durable CDC watermark: one atomically-replaced file on any
+    FileService (the sink side's fs in a mirror deployment).  The
+    ordering contract is the whole point — callers persist ONLY AFTER
+    the delivery it covers is durable downstream, so a crash between
+    the two re-delivers (at-least-once; PK sinks upsert) instead of
+    skipping (a gap is silent data loss the mocrash sweep's planted
+    `watermark-early` violation demonstrates).  A torn store can never
+    surface: FileService.write is atomic-replace, so `load` sees the
+    old or the new watermark, never a mix."""
+
+    def __init__(self, fs, path: str = "cdc/watermark"):
+        self.fs = fs
+        self.path = path
+
+    def load(self) -> int:
+        if not self.fs.exists(self.path):
+            return 0
+        raw = self.fs.read(self.path).decode().strip()
+        return int(raw) if raw else 0
+
+    def store(self, ts: int) -> None:
+        self.fs.write(self.path, str(int(ts)).encode())
+
+
 class CallbackSink:
     def __init__(self, fn: Callable):
         self.fn = fn

@@ -86,8 +86,11 @@ class LogtailHub:
             # from the checkpoint, which _serve_logtail routes to resync
             self._backlog = []
 
-    def replay(self):
-        return self.wal.replay()
+    def replay(self, stats=None):
+        try:
+            return self.wal.replay(stats=stats)
+        except TypeError:      # wrapped wal predates the stats hook
+            return self.wal.replay()
 
     def stop(self) -> None:
         self._stop.set()

@@ -19,7 +19,8 @@ from typing import List, Optional
 from matrixone_tpu.frontend.server import MOServer
 from matrixone_tpu.frontend.session import Session
 from matrixone_tpu.storage.engine import Engine
-from matrixone_tpu.storage.fileservice import LocalFS, MemoryFS
+from matrixone_tpu.storage.fileservice import (LocalFS, MemoryFS,
+                                               maybe_record)
 from matrixone_tpu.taskservice import TaskService
 
 
@@ -36,6 +37,10 @@ class Cluster:
             fs = LocalFS(data_dir)
         else:
             fs = MemoryFS()
+        # MO_CRASH_RECORD: journal every storage mutation into the
+        # process-global crash journal (utils/crash) so an operator can
+        # sweep a captured history offline (tools/mocrash)
+        fs = maybe_record(fs, tag="embed")
         self.engine = (Engine.open(fs) if fs.exists("meta/manifest.json")
                        or fs.exists("wal/wal.log") else Engine(fs))
         self.sessions: List[Session] = [Session(catalog=self.engine)

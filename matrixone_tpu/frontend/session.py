@@ -1116,6 +1116,48 @@ class Session:
                 raise BindError(f"unknown keys subcommand {arg!r}; "
                                 "use status | clear | audit:on | "
                                 "audit:off")
+        elif cmd == "crash":
+            # crash-recovery sweep ops surface (utils/crash.py +
+            # tools/mocrash): journal/recording state, last sweep
+            # summary; run:<seed> executes a small in-process sweep —
+            # mirrors the mo_ctl('lint'|'san'|'qa'|'keys') pattern
+            import json as _json
+            from matrixone_tpu.utils import crash as _crash
+            if arg in ("", "status"):
+                try:
+                    from tools import mocrash as _mocrash
+                    out = _json.dumps(_mocrash.last_run_status(),
+                                      sort_keys=True, default=str)
+                except ImportError:
+                    out = _json.dumps(_crash.report(), sort_keys=True,
+                                      default=str)
+            elif arg == "clear":
+                _crash.clear()
+                out = "crash sweep records cleared"
+            elif arg.startswith("run:"):
+                try:
+                    seed = int(arg.split(":", 1)[1])
+                except ValueError:
+                    raise BindError(f"bad seed in {arg!r}")
+                try:
+                    from tools import mocrash as _mocrash
+                except ImportError:
+                    raise BindError(
+                        "mocrash unavailable: the tools/ package is "
+                        "not on sys.path (run from a repo checkout)")
+                # a QUICK in-process probe: capped points, engine
+                # scenario only (the full sweep belongs to the gate /
+                # CLI, not an ops command)
+                rep = _mocrash.run_sweep(seed=seed, points=40,
+                                         scenario="engine")
+                out = _json.dumps(
+                    {k: rep[k] for k in ("seed", "events", "points",
+                                         "recoveries", "seconds")}
+                    | {"findings": len(rep["findings"])},
+                    sort_keys=True)
+            else:
+                raise BindError(f"unknown crash subcommand {arg!r}; "
+                                "use status | clear | run:<seed>")
         elif cmd == "mview":
             # materialized-view ops surface: registry + per-view
             # watermark/mode, on-demand refresh — matching the

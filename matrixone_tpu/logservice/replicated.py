@@ -79,25 +79,116 @@ def _recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
 _REC = struct.Struct("<QQI")       # epoch, seq, payload_len
 
 
-class LogReplica:
-    """One log replica: append-only frame file + TCP service."""
+class ReplicaCore:
+    """One replica's durable state machine, decoupled from the socket
+    service so the crash harness (tools/mocrash) can drive it over a
+    RecordingFileService and reopen it from any materialized crash
+    state.  All I/O rides a FileService: the append path is durable-on-
+    return (fs.append fsyncs) and BOTH metadata writes — epoch/watermark
+    and the truncation rewrite — are atomic replaces (the old in-place
+    `replica.meta` write could tear, corrupting the epoch fence after a
+    crash; mocrash write-path audit)."""
 
-    def __init__(self, data_dir: str, port: int = 0):
-        os.makedirs(data_dir, exist_ok=True)
-        self.path = os.path.join(data_dir, "replica.log")
-        self.meta_path = os.path.join(data_dir, "replica.meta")
+    LOG = "replica.log"
+    META = "replica.meta"
+
+    def __init__(self, fs):
+        self.fs = fs
         self.epoch = 0
+        #: low watermark: entries at or below this seq were truncated by
+        #: a checkpoint — a rejoining laggard's stale copies of them must
+        #: never resurrect (repair/replay honor max watermark)
+        self.truncated_upto = 0
+        self.entries: Dict[int, Tuple[int, bytes]] = {}  # seq -> (epoch, payload)
+        self.torn_bytes = 0
+        self._load()
+
+    def _load(self) -> None:
+        if self.fs.exists(self.META):
+            parts = (self.fs.read(self.META).decode().strip()
+                     or "0").split()
+            self.epoch = int(parts[0])
+            self.truncated_upto = int(parts[1]) if len(parts) > 1 else 0
+        if not self.fs.exists(self.LOG):
+            return
+        blob = self.fs.read(self.LOG)
+        off = 0
+        while off + _REC.size <= len(blob):
+            epoch, seq, plen = _REC.unpack_from(blob, off)
+            if off + _REC.size + plen > len(blob):
+                break                  # torn tail
+            payload = blob[off + _REC.size:off + _REC.size + plen]
+            self.entries[seq] = (epoch, payload)
+            off += _REC.size + plen
+        self.torn_bytes = len(blob) - off
+
+    def persist_meta(self) -> None:
+        self.fs.write(self.META,
+                      f"{self.epoch} {self.truncated_upto}".encode())
+
+    def append(self, epoch: int, seq: int, payload: bytes) -> dict:
+        if epoch < self.epoch:
+            return {"ok": False,
+                    "err": f"stale epoch {epoch} < {self.epoch}"}
+        if epoch > self.epoch:
+            self.epoch = epoch
+            self.persist_meta()
+        self.entries[seq] = (epoch, payload)
+        self.fs.append(self.LOG,
+                       _REC.pack(epoch, seq, len(payload)) + payload)
+        return {"ok": True}
+
+    def truncate(self, epoch: int, upto: int) -> dict:
+        if epoch < self.epoch:
+            return {"ok": False, "err": "stale epoch"}
+        self.entries = {s: v for s, v in self.entries.items()
+                        if s > upto}
+        self.truncated_upto = max(self.truncated_upto, upto)
+        self.persist_meta()
+        self.fs.write(self.LOG, b"".join(
+            _REC.pack(e, s, len(p)) + p
+            for s, (e, p) in sorted(self.entries.items())))
+        return {"ok": True}
+
+    def read_blob(self) -> bytes:
+        return b"".join(
+            _REC.pack(self.entries[s][0], s, len(self.entries[s][1]))
+            + self.entries[s][1] for s in sorted(self.entries))
+
+
+def merge_majority(reads: List[Tuple[int, Dict[int, bytes]]]
+                   ) -> Tuple[int, Dict[int, bytes]]:
+    """Union a set of replica reads past the highest truncation
+    watermark — THE quorum recovery rule (single-writer sequencing
+    makes the union conflict-free; any majority overlaps every ack
+    set, so the union of any majority contains every acked entry;
+    entries at or below a truncation watermark never resurrect).
+    Shared by ReplicatedLog's repair/replay and the mocrash quorum
+    scenario so the recovery contract cannot drift from the checker.
+    `reads`: [(truncated_upto, {seq: payload})]."""
+    upto = max((u for u, _e in reads), default=0)
+    merged: Dict[int, bytes] = {}
+    for _u, entries in reads:
+        for s, payload in entries.items():
+            if s > upto:
+                merged[s] = payload
+    return upto, merged
+
+
+class LogReplica:
+    """One log replica: append-only frame file + TCP service (the
+    durable state machine lives in ReplicaCore)."""
+
+    def __init__(self, data_dir: str, port: int = 0, fs=None):
+        from matrixone_tpu.storage.fileservice import LocalFS
+        os.makedirs(data_dir, exist_ok=True)
+        self.core = ReplicaCore(fs if fs is not None
+                                else LocalFS(data_dir))
         #: writer lease (election): volatile by design — a replica
         #: restart forgets the lease (grace only shrinks; epochs still
         #: fence), it never extends a dead writer's tenure
         self.writer_id: Optional[str] = None
         self.lease_expires = 0.0
-        #: low watermark: entries at or below this seq were truncated by
-        #: a checkpoint — a rejoining laggard's stale copies of them must
-        #: never resurrect (repair/replay honor max watermark)
-        self.truncated_upto = 0
-        self.entries: Dict[int, Tuple[int, bytes]] = {}   # seq -> (epoch, payload)
-        self._load()
         self._lock = san.lock("LogReplica._lock")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -107,44 +198,29 @@ class LogReplica:
         self._stopping = threading.Event()
         self._svc = ServiceThreads("mo-log")
 
-    def _load(self) -> None:
-        if os.path.exists(self.meta_path):
-            with open(self.meta_path) as f:
-                parts = (f.read().strip() or "0").split()
-            self.epoch = int(parts[0])
-            self.truncated_upto = int(parts[1]) if len(parts) > 1 else 0
-        if not os.path.exists(self.path):
-            return
-        with open(self.path, "rb") as f:
-            blob = f.read()
-        off = 0
-        while off + _REC.size <= len(blob):
-            epoch, seq, plen = _REC.unpack_from(blob, off)
-            if off + _REC.size + plen > len(blob):
-                break                  # torn tail
-            payload = blob[off + _REC.size:off + _REC.size + plen]
-            self.entries[seq] = (epoch, payload)
-            off += _REC.size + plen
+    # core views (the handler + tests read these)
+    @property
+    def epoch(self) -> int:
+        return self.core.epoch
+
+    @epoch.setter
+    def epoch(self, v: int) -> None:
+        self.core.epoch = v
+
+    @property
+    def truncated_upto(self) -> int:
+        return self.core.truncated_upto
+
+    @property
+    def entries(self) -> Dict[int, Tuple[int, bytes]]:
+        return self.core.entries
 
     def _persist_epoch(self) -> None:
-        with open(self.meta_path, "w") as f:
-            f.write(f"{self.epoch} {self.truncated_upto}")
-            f.flush()
-            os.fsync(f.fileno())
+        self.core.persist_meta()
 
     def _append(self, epoch: int, seq: int, payload: bytes) -> dict:
         with self._lock:
-            if epoch < self.epoch:
-                return {"ok": False, "err": f"stale epoch {epoch} < {self.epoch}"}
-            if epoch > self.epoch:
-                self.epoch = epoch
-                self._persist_epoch()
-            self.entries[seq] = (epoch, payload)
-            with open(self.path, "ab") as f:
-                f.write(_REC.pack(epoch, seq, len(payload)) + payload)
-                f.flush()
-                os.fsync(f.fileno())
-            return {"ok": True}
+            return self.core.append(epoch, seq, payload)
 
     def _elect(self, writer: str, epoch: int, lease_s: float) -> dict:
         """VOTE for a candidate: grant iff the proposed epoch advances
@@ -188,21 +264,7 @@ class LogReplica:
 
     def _truncate(self, epoch: int, upto: int) -> dict:
         with self._lock:
-            if epoch < self.epoch:
-                return {"ok": False, "err": "stale epoch"}
-            self.entries = {s: v for s, v in self.entries.items()
-                            if s > upto}
-            self.truncated_upto = max(self.truncated_upto, upto)
-            self._persist_epoch()
-            tmp = self.path + ".tmp"
-            with open(tmp, "wb") as f:
-                for s in sorted(self.entries):
-                    e, p = self.entries[s]
-                    f.write(_REC.pack(e, s, len(p)) + p)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.path)
-            return {"ok": True}
+            return self.core.truncate(epoch, upto)
 
     def serve_forever(self) -> None:
         while not self._stopping.is_set():
@@ -234,14 +296,11 @@ class LogReplica:
                                                  header["seq"], blob))
                 elif op == "read":
                     with self._lock:
-                        seqs = sorted(self.entries)
-                        out = b"".join(
-                            _REC.pack(self.entries[s][0], s,
-                                      len(self.entries[s][1]))
-                            + self.entries[s][1] for s in seqs)
+                        out = self.core.read_blob()
+                        n = len(self.core.entries)
                     _send_msg(conn, {"ok": True, "epoch": self.epoch,
                                      "upto": self.truncated_upto,
-                                     "n": len(seqs)}, out)
+                                     "n": n}, out)
                 elif op == "hello":
                     with self._lock:
                         if header["epoch"] > self.epoch:
@@ -358,12 +417,8 @@ class ReplicatedLog:
         # have its stale pre-checkpoint entries dropped, never pushed
         # back onto healthy replicas.
         reads = self._read_majority()
-        upto = max((u for _i, u, _e in reads), default=0)
-        merged: Dict[int, bytes] = {}
-        for _i, _u, entries in reads:
-            for s, payload in entries:
-                if s > upto:
-                    merged[s] = payload
+        upto, merged = merge_majority(
+            [(u, dict(entries)) for _i, u, entries in reads])
         self.seq = max(merged) if merged else upto
         for i, rep_upto, entries in reads:
             have = {s for s, _ in entries}
@@ -508,18 +563,19 @@ class ReplicatedLog:
                 f"{len(out)} replicas readable < quorum {self.quorum}")
         return out
 
-    def replay(self) -> Iterator[Tuple[dict, bytes]]:
+    def replay(self, stats: Optional[dict] = None
+               ) -> Iterator[Tuple[dict, bytes]]:
         """Union of a majority's entries past the highest truncation
         watermark, seq-ordered (single-writer: union is conflict-free;
         contains every majority-acked entry; never resurrects
-        checkpoint-truncated ones)."""
+        checkpoint-truncated ones).  Per-replica torn tails are already
+        dropped at ReplicaCore load; `stats` reports frames only."""
         reads = self._read_majority()
-        upto = max((u for _i, u, _e in reads), default=0)
-        merged: Dict[int, bytes] = {}
-        for _i, _u, entries in reads:
-            for seq, payload in entries:
-                if seq > upto:
-                    merged[seq] = payload
+        _upto, merged = merge_majority(
+            [(u, dict(entries)) for _i, u, entries in reads])
+        if stats is not None:
+            stats.update(frames=len(merged), torn_bytes=0,
+                         bytes=sum(len(p) for p in merged.values()))
         for seq in sorted(merged):
             payload = merged[seq]
             (hlen,) = struct.unpack_from("<I", payload, 0)

@@ -740,6 +740,9 @@ class Engine:
         #: up lazily on the first commit after that
         self._has_mview_catalog = False
         self._mview_service = None
+        #: last restart's recovery report (Engine.open fills it; a fresh
+        #: engine never recovered anything)
+        self.recovery_summary: Optional[dict] = None
 
     # ----------------------------------------------------------- catalog
     def create_table(self, meta: TableMeta, if_not_exists=False,
@@ -1324,20 +1327,44 @@ class Engine:
     @classmethod
     def open(cls, fs: FileService, wal=None) -> "Engine":
         """Restart path: load last checkpoint then replay the WAL tail
-        (tae/db/replay.go analogue)."""
+        (tae/db/replay.go analogue).  Emits a recovery summary — frames
+        replayed, torn-tail bytes discarded, checkpoint ts, orphan tmp
+        files GC'd — as `eng.recovery_summary`, the `mo_recovery_*`
+        metrics and a motrace `engine.recover` span: a restart that
+        silently dropped a torn tail or swept crash leftovers must be
+        observable (the mocrash sweep asserts on it)."""
+        from matrixone_tpu.utils import metrics as M
+        from matrixone_tpu.utils import motrace
         eng = cls(fs, wal=wal)
         # restart replay is one big commit-group apply: run it under the
         # commit lock like every other writer through the version funnel.
         # Reading the quorum WAL tail does socket I/O — that is the
         # restart protocol itself (nobody else can hold this brand-new
         # engine's lock yet), not a blocking-under-lock hazard
-        with eng._commit_lock:
-            with san.allow_blocking(
-                    "startup WAL replay: quorum reads under the commit "
-                    "lock ARE the restart protocol; the engine is not "
-                    "yet shared"):
-                eng._load_checkpoint()
-                eng._replay_wal()
+        with motrace.root_span("engine.recover"):
+            with eng._commit_lock:
+                with san.allow_blocking(
+                        "startup WAL replay: quorum reads under the commit "
+                        "lock ARE the restart protocol; the engine is not "
+                        "yet shared"):
+                    eng._load_checkpoint()
+                    wal_stats = eng._replay_wal()
+            # crash-leftover `*.tmp` files (a writer died between its
+            # tmp fsync and the atomic replace) are invisible to
+            # readers but leak disk forever — GC them at startup, the
+            # one moment no writer can be mid-protocol
+            orphans = eng.fs.orphans()
+            for p in orphans:
+                eng.fs.delete(p)
+            eng.recovery_summary = {
+                "frames_replayed": wal_stats.get("frames", 0),
+                "torn_bytes": wal_stats.get("torn_bytes", 0),
+                "ckpt_ts": eng._ckpt_ts,
+                "orphans_gcd": len(orphans)}
+            M.recovery_frames.inc(wal_stats.get("frames", 0))
+            M.recovery_torn_bytes.inc(wal_stats.get("torn_bytes", 0))
+            M.recovery_orphans.inc(len(orphans))
+            motrace.annotate(**eng.recovery_summary)
         eng.committed_ts = eng.hlc.now()
         # rolling catalog upgrades (pkg/bootstrap/versions role): an
         # old data dir gains the newer system tables in place
@@ -1438,11 +1465,23 @@ class Engine:
                 t.observe_auto(seg.arrays[t.meta.auto_increment][
                     seg.validity[t.meta.auto_increment]])
 
-    def _replay_wal(self) -> None:
+    def _replay_wal(self) -> dict:
+        stats: dict = {"frames": 0, "torn_bytes": 0}
         ap = WalApplier(self, skip_ts=self._ckpt_ts)
-        for header, blob in self.wal.replay():
+        try:
+            frames = self.wal.replay(stats=stats)
+        except TypeError:
+            # a wal duck predating the stats hook (LogtailHub wrappers,
+            # test doubles): replay without the summary, count frames
+            frames = self.wal.replay()
+        n = 0
+        for header, blob in frames:
             ap.apply(header, blob)
+            n += 1
+        stats.setdefault("frames", n)
+        stats["frames"] = max(stats["frames"], n)
         self.hlc.update(ap.max_ts)
+        return stats
 
 
 class _NullWal:
@@ -1455,7 +1494,7 @@ class _NullWal:
     def truncate(self) -> None:
         pass
 
-    def replay(self):
+    def replay(self, stats=None):
         return iter(())
 
 

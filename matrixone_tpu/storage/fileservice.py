@@ -4,6 +4,17 @@
 Backends: memory (tests), local disk, and S3-compatible object storage
 with tiered caches (storage/s3.py: S3FS + MemCacheFS/DiskCacheFS); all
 engine code above (objectio, WAL, checkpoints) is backend-agnostic.
+
+Write discipline (audited by the mocrash sweep, tools/mocrash):
+`write` is ATOMIC-REPLACE — a crashed writer leaves either the old
+content or the new, never a torn mix (LocalFS: write-tmp -> fsync ->
+os.replace -> directory fsync; a leftover `*.tmp` from a crash between
+fsync and replace is an orphan, surfaced by `orphans()` and GC'd by
+`Engine.open`).  `append` is DURABLE-ON-RETURN (fsync; the directory
+entry is fsynced on first creation, so a brand-new WAL file cannot
+vanish with the dirent after power loss).  `RecordingFileService`
+journals this exact event sequence so the crash harness can materialize
+any fsync-consistent on-disk prefix.
 """
 
 from __future__ import annotations
@@ -40,6 +51,14 @@ class FileService:
     def list(self, prefix: str) -> List[str]:
         raise NotImplementedError
 
+    def orphans(self) -> List[str]:
+        """`*.tmp` files left behind by a writer that crashed between
+        its tmp-fsync and the atomic replace.  Invisible to `list()`
+        (readers must never open half-written objects); `Engine.open`
+        GC's them at startup.  Backends without a tmp protocol (S3 PUT
+        is atomic) report none."""
+        return []
+
 
 class MemoryFS(FileService):
     def __init__(self):
@@ -69,8 +88,35 @@ class MemoryFS(FileService):
             self._files.pop(path, None)
 
     def list(self, prefix):
+        # `.tmp` names exist in a MemoryFS only when it was materialized
+        # from a crash journal (utils/crash) — hide them from readers
+        # exactly like LocalFS does for real leftover tmp files
         with self._lock:
-            return sorted(p for p in self._files if p.startswith(prefix))
+            return sorted(p for p in self._files if p.startswith(prefix)
+                          and not p.endswith(".tmp"))
+
+    def orphans(self):
+        with self._lock:
+            return sorted(p for p in self._files if p.endswith(".tmp"))
+
+
+def _fsync_dir(path: str) -> None:
+    """Durability of the directory ENTRY: after os.replace / file
+    creation, the rename itself lives in the directory inode — without
+    an explicit directory fsync a power loss can roll the rename back
+    (the classic zero-length-config-file bug).  Best-effort: platforms
+    that cannot open directories simply skip."""
+    d = os.path.dirname(path) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 class LocalFS(FileService):
@@ -91,12 +137,17 @@ class LocalFS(FileService):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, full)
+        _fsync_dir(full)
 
     def append(self, path, data):
-        with open(self._p(path), "ab") as f:
+        full = self._p(path)
+        created = not os.path.exists(full)
+        with open(full, "ab") as f:
             f.write(data)
             f.flush()
             os.fsync(f.fileno())
+        if created:
+            _fsync_dir(full)
 
     def read(self, path):
         with open(os.path.join(self.root, path), "rb") as f:
@@ -125,3 +176,94 @@ class LocalFS(FileService):
                 if rel.startswith(prefix) and not rel.endswith(".tmp"):
                     out.append(rel)
         return sorted(out)
+
+    def orphans(self):
+        out = []
+        for dirpath, _, files in os.walk(self.root):
+            for fn in files:
+                if fn.endswith(".tmp"):
+                    rel = os.path.relpath(os.path.join(dirpath, fn),
+                                          self.root)
+                    out.append(rel.replace(os.sep, "/"))
+        return sorted(out)
+
+
+class RecordingFileService(FileService):
+    """Transparent wrapper journaling every mutation as the DISK-level
+    event sequence the disciplined LocalFS performs (utils/crash.py):
+    `write` -> write_tmp, fsync, replace, fsync_dir; `append` ->
+    append, fsync (+ fsync_dir on creation).  The crash harness
+    (tools/mocrash) materializes any crash-consistent prefix of the
+    journal — including torn tails of the in-flight event — and
+    re-opens the engine from it.
+
+    Reads pass straight through; events are recorded after the inner
+    backend succeeded (a failed write never happened, so it must not
+    appear as a crash point).  Several wrappers may share one journal
+    (`tag` attributes the events), giving cross-system crash cuts —
+    e.g. a TN commit vs its CDC mirror's watermark persist."""
+
+    #: plant hooks (tools/mocrash/plants.py): re-introduce the
+    #: historical write-path bugs IN THE JOURNAL ONLY — the recorded
+    #: event stream claims the undisciplined sequence, the sweep must
+    #: catch the consequences
+    SKIP_WRITE_FSYNC = False       # rename-before-fsync writer
+
+    def __init__(self, inner: FileService,
+                 journal=None, tag: str = "fs"):
+        from matrixone_tpu.utils import crash
+        self.inner = inner
+        self.journal = journal if journal is not None \
+            else crash.GLOBAL_JOURNAL
+        self.tag = tag
+
+    # ---- mutations (journaled)
+    def write(self, path, data):
+        self.inner.write(path, data)
+        j, t = self.journal, self.tag
+        tmp = path + ".tmp"
+        j.record(t, "write_tmp", tmp, data=bytes(data))
+        if not RecordingFileService.SKIP_WRITE_FSYNC:
+            j.record(t, "fsync", tmp)
+        j.record(t, "replace", tmp, dst=path)
+        j.record(t, "fsync_dir", os.path.dirname(path))
+
+    def append(self, path, data):
+        created = not self.inner.exists(path)
+        self.inner.append(path, data)
+        j, t = self.journal, self.tag
+        j.record(t, "append", path, data=bytes(data))
+        j.record(t, "fsync", path)
+        if created:
+            j.record(t, "fsync_dir", os.path.dirname(path))
+
+    def delete(self, path):
+        self.inner.delete(path)
+        self.journal.record(self.tag, "delete", path)
+
+    # ---- reads (pass-through)
+    def read(self, path):
+        return self.inner.read(path)
+
+    def read_range(self, path, offset, length):
+        return self.inner.read_range(path, offset, length)
+
+    def exists(self, path):
+        return self.inner.exists(path)
+
+    def list(self, prefix):
+        return self.inner.list(prefix)
+
+    def orphans(self):
+        return self.inner.orphans()
+
+
+def maybe_record(fs: FileService, tag: str = "fs") -> FileService:
+    """Wrap `fs` in a RecordingFileService journaling into the process-
+    global crash journal when MO_CRASH_RECORD is set — the operational
+    capture switch (embed.Cluster wires it), letting `mo_ctl('crash',
+    'status')` report a live journal an operator can sweep offline."""
+    if os.environ.get("MO_CRASH_RECORD", "").lower() in ("1", "true",
+                                                         "on"):
+        return RecordingFileService(fs, tag=tag)
+    return fs

@@ -297,6 +297,16 @@ class DiskCacheFS(FileService):
         self.base = base
         self.dir = cache_dir
         os.makedirs(cache_dir, exist_ok=True)
+        # GC `*.tmp` leftovers from a writer that crashed between its
+        # tmp write and the rename: invisible to the LRU index and
+        # never counted against the byte budget, they would leak cache
+        # disk forever (the same orphan class Engine.open sweeps)
+        for fn in os.listdir(cache_dir):
+            if fn.endswith(".tmp"):
+                try:
+                    os.remove(os.path.join(cache_dir, fn))
+                except OSError:
+                    pass
         self.budget = budget_bytes
         self._lock = san.lock("DiskCacheFS._lock")
         self._lru: "OrderedDict[str, int]" = OrderedDict()
@@ -325,6 +335,13 @@ class DiskCacheFS(FileService):
             if len(v) <= self.budget:
                 with open(cp + ".tmp", "wb") as f:
                     f.write(v)
+                    f.flush()
+                    # fsync BEFORE the rename: an unsynced replace can
+                    # surface a torn/empty cache file after a crash, and
+                    # this cache SERVES reads — it would return corrupt
+                    # object bytes, not just lose a warm entry (mocrash
+                    # write-path audit)
+                    os.fsync(f.fileno())
                 os.replace(cp + ".tmp", cp)
                 if path in self._lru:
                     self._used -= self._lru.pop(path)
@@ -383,6 +400,10 @@ class DiskCacheFS(FileService):
 
     def list(self, prefix):
         return self.base.list(prefix)
+
+    def orphans(self):
+        return sorted(fn for fn in os.listdir(self.dir)
+                      if fn.endswith(".tmp"))
 
 
 # ------------------------------------------------------------- fake S3

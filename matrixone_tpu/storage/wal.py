@@ -44,31 +44,46 @@ class WalWriter:
             self.fs.append(self.path, frame)
 
     def truncate(self) -> None:
+        # atomic-replace truncation: Engine._checkpoint_locked calls this
+        # ONLY after the checkpoint manifest is durably renamed — a crash
+        # between the two replays the tail against the OLD manifest (the
+        # mocrash sweep's checkpoint-window drill pins the ordering)
         self.fs.write(self.path, b"")
 
-    def replay(self) -> Iterator[Tuple[dict, bytes]]:
-        return replay(self.fs, self.path)
+    def replay(self, stats: Optional[dict] = None
+               ) -> Iterator[Tuple[dict, bytes]]:
+        return replay(self.fs, self.path, stats=stats)
 
 
-def replay(fs: FileService, path: str = "wal/wal.log"
-           ) -> Iterator[Tuple[dict, bytes]]:
+def replay(fs: FileService, path: str = "wal/wal.log",
+           stats: Optional[dict] = None) -> Iterator[Tuple[dict, bytes]]:
     """Yield (header, arrow_blob) for each intact frame; stops at the first
-    torn/corrupt frame (crash-consistent tail handling)."""
+    torn/corrupt frame (crash-consistent tail handling).  `stats`, when
+    given, is filled as the scan proceeds — at exhaustion it holds the
+    recovery summary Engine.open reports: frames replayed, torn-tail
+    bytes discarded (anything after the last intact frame), total log
+    bytes."""
+    if stats is None:
+        stats = {}
+    stats.update(frames=0, torn_bytes=0, bytes=0)
     if not fs.exists(path):
         return
     blob = fs.read(path)
+    stats["bytes"] = len(blob)
     off = 0
     while off + 12 <= len(blob):
         magic, plen, crc = struct.unpack_from("<III", blob, off)
         if magic != _FRAME_MAGIC or off + 12 + plen > len(blob):
-            return
+            break
         payload = blob[off + 12:off + 12 + plen]
         if zlib.crc32(payload) != crc:
-            return
+            break
         (hlen,) = struct.unpack_from("<I", payload, 0)
         header = json.loads(payload[4:4 + hlen].decode())
+        stats["frames"] += 1
         yield header, payload[4 + hlen:]
         off += 12 + plen
+    stats["torn_bytes"] = len(blob) - off
 
 
 def arrays_to_arrow(arrays, validity):

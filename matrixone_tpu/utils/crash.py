@@ -1,0 +1,330 @@
+"""Crash-point journaling + materialization for mocrash (tools/mocrash)
+— the engine-side half of the deterministic crash-recovery sweep, the
+fifth analysis leg (molint static / mosan concurrency / moqa
+differential / mokey key-completeness / mocrash durability).
+
+The durability story (CRC-framed WAL, checkpoint manifests, quorum log,
+mview/CDC watermarks) is only as good as its behaviour when the process
+dies at an ARBITRARY byte of an in-flight write.  PR-2's injector
+faults whole calls; a real crash leaves any fsync-consistent PREFIX of
+the I/O stream on disk — torn tails, renamed-but-unsynced files,
+manifests half-replaced.  This module makes that state space
+enumerable:
+
+  * `CrashJournal` — an ordered log of every storage-mutating event a
+    `RecordingFileService` (storage/fileservice.py, armed by
+    `MO_CRASH_RECORD` or explicitly by the harness) performs, at the
+    granularity the DISK sees: a FileService `write` decomposes into
+    write_tmp -> fsync -> replace -> fsync_dir, an `append` into
+    append -> fsync (+ fsync_dir on creation), exactly mirroring the
+    disciplined LocalFS implementation;
+  * `materialize(k, torn, lossy)` — reconstructs the crash-consistent
+    on-disk state after a kill while event k is in flight: events
+    [0, k) are fully issued, event k applies `torn` (0 / 0.5 / 1.0) of
+    its bytes, and `lossy=True` additionally drops everything the
+    kernel never promised (un-fsynced bytes; renames and file
+    creations whose directory entry was never fsynced roll back) —
+    the ALICE "any fsync-consistent prefix" model, bounded to the
+    variants tools/mocrash sweeps;
+  * the `mo_crash_*` metric drive points (`note_point`,
+    `note_recovery`, `note_finding`) and the `mo_ctl('crash',...)`
+    status payload, matching the utils/qa.py discipline: the sweep
+    runner in tools/ never touches the registry directly.
+
+Multiple RecordingFileService instances (the TN's fs, a CDC mirror's
+fs, three log replicas) share ONE journal, so a crash point is a
+consistent cut across every system in the workload — the windows that
+matter (mview backing commit vs watermark advance, sink delivery vs
+watermark persist, manifest rename vs WAL truncate) span file services.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from matrixone_tpu.utils import san
+
+#: journal hard caps — MO_CRASH_RECORD on a long-lived cluster must not
+#: grow memory without bound; past EITHER cap (event count, or total
+#: payload bytes — one bulk load can out-weigh thousands of small
+#: events) the journal stops recording (overflow flag set,
+#: materialization refused) while the wrapped FileService keeps
+#: working untouched
+MAX_EVENTS = 200_000
+MAX_BYTES = 512 << 20
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """One disk-level mutation. `data` only for write_tmp/append;
+    `dst` only for replace."""
+    tag: str                 # which FileService universe ("tn", "rep0"...)
+    op: str                  # write_tmp|append|fsync|replace|fsync_dir|delete
+    path: str
+    data: Optional[bytes] = None
+    dst: Optional[str] = None
+
+    def label(self) -> str:
+        d = f"->{self.dst}" if self.dst else ""
+        return f"{self.tag}:{self.op}:{self.path}{d}"
+
+
+class CrashJournal:
+    """Ordered, shared event log; append-only until cleared."""
+
+    def __init__(self, max_events: int = MAX_EVENTS,
+                 max_bytes: int = MAX_BYTES):
+        self._lock = san.lock("CrashJournal._lock")
+        self._events: List[Event] = []
+        self.max_events = max_events
+        self.max_bytes = max_bytes
+        self.bytes = 0
+        self.overflow = False
+
+    def record(self, tag: str, op: str, path: str,
+               data: Optional[bytes] = None,
+               dst: Optional[str] = None) -> None:
+        with self._lock:
+            if len(self._events) >= self.max_events \
+                    or self.bytes >= self.max_bytes:
+                self.overflow = True
+                return
+            self.bytes += len(data) if data is not None else 0
+            self._events.append(Event(tag, op, path,
+                                      bytes(data) if data is not None
+                                      else None, dst))
+
+    def position(self) -> int:
+        """Index of the NEXT event — an ack recorded at position p means
+        every event the acked operation issued has index < p."""
+        with self._lock:
+            return len(self._events)
+
+    def events(self) -> List[Event]:
+        with self._lock:
+            return list(self._events)
+
+    def __len__(self) -> int:
+        return self.position()
+
+    # ------------------------------------------------------ materialize
+    def materialize(self, k: int, torn: float = 1.0,
+                    lossy: bool = False) -> Dict[str, "object"]:
+        """The on-disk state of every recorded universe after a crash
+        while event k was in flight.  Returns {tag: MemoryFS} — fresh,
+        isolated file services a recovery can open.
+
+        Model (mirrors the disciplined LocalFS): events [0, k) are
+        fully issued; event k applies `torn` of its payload bytes
+        (non-payload events apply iff torn >= 1.0); with `lossy`, any
+        byte not covered by an fsync is dropped and any rename /
+        file-creation whose directory entry was never fsynced rolls
+        back — the kernel kept only what the writer paid for."""
+        from matrixone_tpu.storage.fileservice import MemoryFS
+        if self.overflow:
+            raise RuntimeError(
+                "crash journal overflowed its event cap; state "
+                "materialization would be incomplete")
+        events = self.events()
+        if not 0 <= k <= len(events):
+            raise IndexError(f"crash point {k} outside [0, {len(events)}]")
+        st = _DiskState()
+        for ev in events[:k]:
+            st.apply(ev, 1.0)
+        if k < len(events):
+            st.apply(events[k], torn)
+        files = st.surviving(lossy)
+        out: Dict[str, object] = {}
+        for (tag, path), content in files.items():
+            fs = out.get(tag)
+            if fs is None:
+                fs = out[tag] = MemoryFS()
+            fs.write(path, content)
+        # a universe that recorded events but lost every file still
+        # deserves an (empty) fs — recovery must cope with "nothing
+        # survived", not KeyError
+        for ev in events[:k + 1 if k < len(events) else k]:
+            out.setdefault(ev.tag, MemoryFS())
+        return out
+
+    def clear_events(self) -> None:
+        with self._lock:
+            self._events = []
+            self.bytes = 0
+            self.overflow = False
+
+
+def universe_digest(universes: Dict[str, "object"]) -> str:
+    """Stable fingerprint of one materialized {tag: MemoryFS} state —
+    the sweep memoizes recovery verdicts on it (many crash variants
+    collapse to identical disk states).  Reads through the public
+    FileService surface (`list` hides tmp names; `orphans` returns
+    them), so the ONE digest implementation cannot drift from what a
+    recovery can actually observe."""
+    h = hashlib.sha1()
+    for tag in sorted(universes):
+        fs = universes[tag]
+        h.update(tag.encode())
+        for path in sorted(fs.list("") + fs.orphans()):
+            data = fs.read(path)
+            h.update(path.encode())
+            h.update(len(data).to_bytes(8, "little"))
+            h.update(data)
+    return h.hexdigest()
+
+
+class _File:
+    """Simulated file: applied bytes + the fsync frontier + pending
+    directory-entry state."""
+
+    __slots__ = ("content", "synced_len", "link_pending", "prev_durable")
+
+    def __init__(self):
+        self.content = bytearray()
+        self.synced_len = 0
+        #: True while the file's directory entry is not yet durable
+        #: (freshly created, or the target of a not-yet-dir-synced
+        #: rename); `prev_durable` holds what a lossy crash exposes
+        #: instead (None = the name did not exist durably)
+        self.link_pending = True
+        self.prev_durable: Optional[bytes] = None
+
+
+class _DiskState:
+    def __init__(self):
+        self.files: Dict[Tuple[str, str], _File] = {}
+
+    def _get(self, tag: str, path: str) -> _File:
+        f = self.files.get((tag, path))
+        if f is None:
+            f = self.files[(tag, path)] = _File()
+        return f
+
+    def apply(self, ev: Event, fraction: float) -> None:
+        key = (ev.tag, ev.path)
+        if ev.op in ("write_tmp", "append"):
+            data = ev.data or b""
+            n = len(data) if fraction >= 1.0 else int(len(data) * fraction)
+            f = self.files.get(key)
+            if ev.op == "write_tmp" or f is None:
+                nf = _File()
+                if f is not None:
+                    # overwrite-in-place of an existing name keeps the
+                    # old durable view until the new content is synced
+                    nf.link_pending = f.link_pending
+                    nf.prev_durable = (f.prev_durable if f.link_pending
+                                       else bytes(f.content[:f.synced_len]))
+                self.files[key] = nf
+                f = nf
+            f.content += data[:n]
+            return
+        if fraction < 1.0:
+            return                     # metadata ops are atomic: all-or-none
+        if ev.op == "fsync":
+            f = self.files.get(key)
+            if f is not None:
+                f.synced_len = len(f.content)
+            return
+        if ev.op == "replace":
+            src = self.files.pop(key, None)
+            if src is None:
+                return
+            dkey = (ev.tag, ev.dst)
+            old = self.files.get(dkey)
+            nf = _File()
+            nf.content = src.content
+            nf.synced_len = src.synced_len
+            nf.link_pending = True
+            if old is not None and not old.link_pending:
+                nf.prev_durable = bytes(old.content[:old.synced_len])
+            elif old is not None:
+                nf.prev_durable = old.prev_durable
+            self.files[dkey] = nf
+            return
+        if ev.op == "fsync_dir":
+            d = ev.path.rstrip("/")
+            for (tag, path), f in self.files.items():
+                if tag != ev.tag:
+                    continue
+                pdir = path.rsplit("/", 1)[0] if "/" in path else ""
+                if pdir == d:
+                    f.link_pending = False
+                    f.prev_durable = None
+            return
+        if ev.op == "delete":
+            self.files.pop(key, None)
+
+    def surviving(self, lossy: bool) -> Dict[Tuple[str, str], bytes]:
+        out: Dict[Tuple[str, str], bytes] = {}
+        for key, f in self.files.items():
+            if not lossy:
+                out[key] = bytes(f.content)
+                continue
+            if f.link_pending:
+                # the directory entry never became durable: the name
+                # reverts to its previous durable content (or vanishes)
+                if f.prev_durable is not None:
+                    out[key] = f.prev_durable
+                continue
+            out[key] = bytes(f.content[:f.synced_len])
+        return out
+
+
+# ===================================================================
+# process-global journal for the MO_CRASH_RECORD operational wrapper
+# ===================================================================
+
+GLOBAL_JOURNAL = CrashJournal()
+
+
+# ===================================================================
+# findings / status / metric drive points (utils/qa.py discipline)
+# ===================================================================
+
+_STATE_LOCK = san.lock("matrixone_tpu.utils.crash._STATE_LOCK")
+_LAST_RUN: Optional[dict] = None
+
+
+def note_point(variant: str) -> None:
+    from matrixone_tpu.utils import metrics as M
+    M.crash_points.inc(variant=variant)
+
+
+def note_recovery(ok: bool) -> None:
+    from matrixone_tpu.utils import metrics as M
+    M.crash_recoveries.inc(outcome="ok" if ok else "violation")
+
+
+def note_finding(invariant: str) -> None:
+    from matrixone_tpu.utils import metrics as M
+    M.crash_findings.inc(invariant=invariant)
+
+
+def set_last_run(summary: dict) -> None:
+    global _LAST_RUN
+    with _STATE_LOCK:
+        _LAST_RUN = dict(summary)
+
+
+def report() -> dict:
+    """mo_ctl('crash','status') payload (the tools half adds the
+    sweep inventory)."""
+    with _STATE_LOCK:
+        last = dict(_LAST_RUN) if _LAST_RUN else None
+    return {"recording": bool(len(GLOBAL_JOURNAL)),
+            "journal_events": len(GLOBAL_JOURNAL),
+            "journal_bytes": GLOBAL_JOURNAL.bytes,
+            "journal_overflow": GLOBAL_JOURNAL.overflow,
+            "last_run": last}
+
+
+def clear() -> None:
+    """Drop the last-run record AND the operational journal (so a
+    long-recording cluster can reset its capture window)."""
+    global _LAST_RUN
+    with _STATE_LOCK:
+        _LAST_RUN = None
+    GLOBAL_JOURNAL.clear_events()

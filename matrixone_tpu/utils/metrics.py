@@ -453,3 +453,28 @@ key_findings = REGISTRY.counter(
     "mo_key_findings_total",
     "capture-content mismatches under a colliding cache key, by "
     "audited site label (fragment/joinbuild/joinprobe/mview/udf/tree)")
+
+# ---- restart recovery (Engine.open) + crash sweep (utils/crash.py,
+# ---- tools/mocrash)
+recovery_frames = REGISTRY.counter(
+    "mo_recovery_frames_total",
+    "intact WAL frames replayed by Engine.open restarts")
+recovery_torn_bytes = REGISTRY.counter(
+    "mo_recovery_torn_bytes_total",
+    "torn-tail bytes discarded at the end of the WAL during restart "
+    "replay (a crash mid-append leaves them; non-zero is normal after "
+    "a kill, growth without kills is a bug)")
+recovery_orphans = REGISTRY.counter(
+    "mo_recovery_orphans_total",
+    "orphaned *.tmp files GC'd by Engine.open (a writer died between "
+    "its tmp fsync and the atomic replace)")
+crash_points = REGISTRY.counter(
+    "mo_crash_points_total",
+    "crash points materialized by the mocrash sweep, by torn/lossy "
+    "variant")
+crash_recoveries = REGISTRY.counter(
+    "mo_crash_recoveries_total",
+    "mocrash recovery attempts by outcome (ok/violation)")
+crash_findings = REGISTRY.counter(
+    "mo_crash_findings_total",
+    "mocrash invariant violations by invariant name")

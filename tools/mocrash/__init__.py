@@ -1,0 +1,256 @@
+"""mocrash — deterministic crash-point recovery sweep.
+
+The fifth analysis leg (molint static / mosan concurrency / moqa
+differential / mokey key-completeness / mocrash durability): every
+durability mechanism in this repo — the CRC-framed WAL, checkpoint
+manifests, the quorum log, mview/CDC watermarks — is crash-TESTED, not
+crash-hoped.  In the ALICE tradition:
+
+  * a `RecordingFileService` (storage/fileservice.py) journals every
+    write/append/fsync/replace as an ordered event log
+    (utils/crash.CrashJournal);
+  * a seeded workload (tools/mocrash/workload.py) runs commits, DDL,
+    snapshots, a maintained materialized view, CDC mirroring with a
+    durable watermark, checkpoint, merge and quorum appends over
+    recording file services, logging which operations were ACKED at
+    which journal position;
+  * the sweep "crashes" at every journal event under torn-tail and
+    fsync-loss variants, materializes the surviving on-disk prefix,
+    reopens the engine / replica set from it, and checks the recovery
+    invariants (tools/mocrash/invariants.py): acked commits survive,
+    in-flight commits are atomic, replay stops cleanly at torn frames,
+    the mview and CDC mirror reconverge exactly-once from their
+    watermarks, orphan tmp files are GC'd, quorum-acked entries are in
+    every majority union;
+  * three planted violations (tools/mocrash/plants.py) prove the net
+    catches: rename-before-fsync, WAL-truncate-before-checkpoint-
+    durable, watermark-advance-before-backing-commit.
+
+Gates: tests/test_mocrash.py runs a quick seeded sweep in tier-1 (zero
+findings fails the build); `python -m tools.precheck --crash-smoke` is
+the CI one-shot; `mo_ctl('crash','status'|'run:<seed>')` is the ops
+surface.  Knobs (README "Crash consistency"): MO_CRASH_RECORD,
+MO_CRASH_SEED, MO_CRASH_POINTS.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+from matrixone_tpu.utils import crash
+
+from tools.mocrash import invariants, plants, workload
+
+#: torn fraction of the in-flight event x drop-unsynced-bytes mode.
+#: quick covers the three distinct behaviours (pure ordering, torn
+#: tail, maximum fsync loss); full adds the mixed cases.
+VARIANTS_QUICK = [(1.0, False), (0.5, False), (0.0, True)]
+VARIANTS_FULL = VARIANTS_QUICK + [(0.5, True), (1.0, True)]
+
+
+def sweep_seed(default: int = 2026) -> int:
+    """MO_CRASH_SEED: the tier-1 sweep's workload seed."""
+    try:
+        return int(os.environ.get("MO_CRASH_SEED", "") or default)
+    except ValueError:
+        return default
+
+
+def sweep_points(default: int = 0) -> int:
+    """MO_CRASH_POINTS: cap on crash points per scenario (0 = every
+    journal event)."""
+    try:
+        return int(os.environ.get("MO_CRASH_POINTS", "") or default)
+    except ValueError:
+        return default
+
+
+def _pick_points(n: int, cap: Optional[int]) -> List[int]:
+    if not cap or cap <= 0 or cap >= n:
+        return list(range(n))
+    step = n / cap
+    return sorted({int(i * step) for i in range(cap)})
+
+
+def _sweep_world(world, checker, variants, pts, findings,
+                 counts) -> None:
+    """Crash/recover/check at each point in `pts` under every variant;
+    recovery verdicts memoized on the materialized state + the visible
+    ack prefix (many variants collapse to identical disk states).  The
+    universe materializes ONCE per point-variant and is handed to the
+    checker — the recovery reopens exactly the fingerprinted state."""
+    memo = {}
+    for k in pts:
+        acked_sig = tuple(i for i, a in enumerate(world.acks)
+                          if a.event_hi <= k)
+        for torn, lossy in variants:
+            var = invariants.variant_name(torn, lossy)
+            crash.note_point(var)
+            counts["points"] += 1
+            u = world.journal.materialize(k, torn, lossy)
+            key = (crash.universe_digest(u), acked_sig)
+            if key in memo:
+                counts["memo_hits"] += 1
+                continue
+            fnds = checker(world, k, torn, lossy, u=u)
+            memo[key] = bool(fnds)
+            counts["recoveries"] += 1
+            crash.note_recovery(not fnds)
+            for f in fnds:
+                crash.note_finding(f.invariant)
+            findings.extend(fnds)
+
+
+def _plant_points(name: str, journal) -> List[int]:
+    """Crash points covering a plant's violation window (a full-journal
+    sweep would find them too — this keeps the drills fast)."""
+    evs = journal.events()
+    idxs: set = set()
+    for i, e in enumerate(evs):
+        if name == "truncate-early" and e.tag == "tn" \
+                and e.op == "write_tmp" and e.path == "wal/wal.log.tmp":
+            idxs.update(range(i, min(i + 40, len(evs))))
+        elif name == "fsync-skip" and e.op == "replace" \
+                and e.path.endswith("manifest.json.tmp"):
+            idxs.update(range(i, min(i + 10, len(evs))))
+        elif name == "watermark-early" and e.op == "write_tmp" \
+                and e.path.endswith(".wm.tmp"):
+            idxs.update(range(i, min(i + 30, len(evs))))
+    return sorted(idxs)
+
+
+def run_sweep(seed: Optional[int] = None, points: Optional[int] = None,
+              variants: str = "quick", scenario: str = "all",
+              plant: Optional[str] = None) -> dict:
+    """Run workload(s), then crash/recover/check at every selected
+    point.  Returns {findings, findings_formatted, points, recoveries,
+    memo_hits, events, seconds, seed, scenario, plant}."""
+    t0 = time.monotonic()
+    seed = sweep_seed() if seed is None else seed
+    if points is None:
+        points = sweep_points()
+    vlist = VARIANTS_FULL if variants == "full" else VARIANTS_QUICK
+    findings: List[invariants.Finding] = []
+    counts = {"points": 0, "recoveries": 0, "memo_hits": 0,
+              "events": 0}
+
+    def build_and_sweep():
+        if scenario in ("engine", "all"):
+            world = workload.run_engine_workload(seed)
+            counts["events"] += len(world.journal)
+            pts = (_plant_points(plant, world.journal)
+                   if plant is not None
+                   else _pick_points(len(world.journal), points))
+            _sweep_world(world, invariants.check_engine, vlist, pts,
+                         findings, counts)
+        if scenario in ("quorum", "all") and plant is None:
+            qw = workload.run_quorum_workload(seed)
+            counts["events"] += len(qw.journal)
+            _sweep_world(qw, invariants.check_quorum, vlist,
+                         _pick_points(len(qw.journal), points),
+                         findings, counts)
+
+    if plant is not None:
+        with plants.plant(plant):
+            build_and_sweep()
+    else:
+        build_and_sweep()
+
+    rep = {"seed": seed, "scenario": scenario, "plant": plant,
+           "variants": [invariants.variant_name(t, lo)
+                        for t, lo in vlist],
+           "events": counts["events"], "points": counts["points"],
+           "recoveries": counts["recoveries"],
+           "memo_hits": counts["memo_hits"],
+           "findings": [f.__dict__ for f in findings],
+           "findings_formatted": [f.format() for f in findings],
+           "seconds": round(time.monotonic() - t0, 2)}
+    crash.set_last_run({k: rep[k] for k in
+                        ("seed", "scenario", "plant", "events",
+                         "points", "recoveries", "seconds")}
+                       | {"findings": len(findings)})
+    return rep
+
+
+def run_smoke(seed: Optional[int] = None) -> dict:
+    """The precheck one-shot: one clean capped sweep + one planted
+    drill; <30s on the tier-1 box."""
+    seed = sweep_seed() if seed is None else seed
+    rep = run_sweep(seed=seed, points=60, scenario="all")
+    planted = run_sweep(seed=seed, scenario="engine",
+                        plant="truncate-early")
+    rep["plant_caught"] = any(
+        f["invariant"] == "acked-commit-lost"
+        for f in planted["findings"])
+    rep["plant_findings"] = len(planted["findings"])
+    return rep
+
+
+def last_run_status() -> dict:
+    """mo_ctl('crash','status') payload (the tools half)."""
+    return crash.report() | {
+        "variants_quick": [invariants.variant_name(t, lo)
+                           for t, lo in VARIANTS_QUICK],
+        "plants": plants.plant_names()}
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.mocrash",
+        description="deterministic crash-point recovery sweep (see "
+                    "README 'Crash consistency')")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="workload seed (default MO_CRASH_SEED or 2026)")
+    ap.add_argument("--points", type=int, default=None,
+                    help="cap on crash points per scenario (default "
+                         "MO_CRASH_POINTS or all)")
+    ap.add_argument("--variants", choices=("quick", "full"),
+                    default="quick")
+    ap.add_argument("--scenario", choices=("engine", "quorum", "all"),
+                    default="all")
+    ap.add_argument("--plant", default=None,
+                    choices=plants.plant_names(),
+                    help="run with a planted violation; exit 0 iff the "
+                         "sweep catches it")
+    ap.add_argument("--smoke", action="store_true",
+                    help="the precheck smoke (capped clean sweep + one "
+                         "planted drill)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        rep = run_smoke(args.seed)
+        print(json.dumps({k: rep[k] for k in
+                          ("seed", "events", "points", "recoveries",
+                           "seconds", "plant_caught")}, sort_keys=True))
+        for line in rep["findings_formatted"]:
+            print(line)
+        return 0 if not rep["findings"] and rep["plant_caught"] else 1
+
+    rep = run_sweep(seed=args.seed, points=args.points,
+                    variants=args.variants, scenario=args.scenario,
+                    plant=args.plant)
+    if args.json:
+        print(json.dumps(rep, indent=1, sort_keys=True, default=str))
+    else:
+        for line in rep["findings_formatted"]:
+            print(line)
+        print(json.dumps({k: rep[k] for k in
+                          ("seed", "scenario", "events", "points",
+                           "recoveries", "memo_hits", "seconds")},
+                         sort_keys=True))
+    if args.plant:
+        print("planted violation CAUGHT" if rep["findings"]
+              else "planted violation NOT caught", file=sys.stderr)
+        return 0 if rep["findings"] else 1
+    return 1 if rep["findings"] else 0
+
+
+__all__ = ["run_sweep", "run_smoke", "last_run_status", "main",
+           "VARIANTS_QUICK", "VARIANTS_FULL"]

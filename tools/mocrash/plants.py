@@ -1,0 +1,75 @@
+"""Planted durability violations — the fixture-pair proof that the
+mocrash sweep actually catches the bug classes it exists for (the
+mosan/moqa/mokey plant discipline: re-introduce the historical bug,
+assert the net catches it, restore).
+
+  * fsync-skip        — the writer renames its tmp file into place
+                        WITHOUT fsyncing it first: after a crash the
+                        rename can expose a torn/empty file under the
+                        final name (the classic zero-length-manifest
+                        bug).  Planted in the RECORDED event stream
+                        only, so the sweep sees the undisciplined
+                        sequence while the live engine stays correct.
+  * truncate-early    — WalWriter.truncate() runs BEFORE the checkpoint
+                        manifest is durably renamed: a crash between
+                        the two loses the whole tail (old manifest, no
+                        WAL) — every acked commit since the previous
+                        checkpoint vanishes.
+  * watermark-early   — the CDC mirror persists its watermark BEFORE
+                        the deliveries it covers are durable
+                        downstream: a crash in between makes the resume
+                        skip history — a silent gap in the mirror.
+
+Each must be caught by the sweep with the point-of-crash and the
+violated invariant named in the finding (tests/test_mocrash.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from matrixone_tpu.storage.engine import Engine
+from matrixone_tpu.storage.fileservice import RecordingFileService
+
+from tools.mocrash import workload
+
+_PLANTS = ("fsync-skip", "truncate-early", "watermark-early")
+
+
+def plant_names():
+    return list(_PLANTS)
+
+
+@contextlib.contextmanager
+def plant(name: str):
+    if name == "fsync-skip":
+        prev = RecordingFileService.SKIP_WRITE_FSYNC
+        RecordingFileService.SKIP_WRITE_FSYNC = True
+        try:
+            yield
+        finally:
+            RecordingFileService.SKIP_WRITE_FSYNC = prev
+    elif name == "truncate-early":
+        orig = Engine._checkpoint_locked
+
+        def early_truncate(self, demote=None):
+            # the violation: the WAL tail is gone before the manifest
+            # that supersedes it is durable (orig truncates again at
+            # the correct point; truncating an empty log is a no-op)
+            self.wal.truncate()
+            return orig(self, demote=demote)
+
+        Engine._checkpoint_locked = early_truncate
+        try:
+            yield
+        finally:
+            Engine._checkpoint_locked = orig
+    elif name == "watermark-early":
+        prev = workload.WM_EARLY
+        workload.WM_EARLY = True
+        try:
+            yield
+        finally:
+            workload.WM_EARLY = prev
+    else:
+        raise ValueError(f"unknown plant {name!r}; use {_PLANTS}")

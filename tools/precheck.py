@@ -4,9 +4,10 @@ cache-key completeness, tools/mokey/) and bench_guard (scoreboard
 regression floors, tools/bench_guard.py), plus opt-in smoke stages:
 `--san-smoke` (mosan concurrency stress drill, <30s), `--qa-smoke`
 (small moqa differential corpus + planted-bug drill, <30s),
-`--trace-smoke` (motrace span-tree round-trip, <30s) and
-`--key-smoke` (mokey planted fixture pairs, static + one armed
-runtime audit round-trip, <30s).
+`--trace-smoke` (motrace span-tree round-trip, <30s), `--key-smoke`
+(mokey planted fixture pairs, static + one armed runtime audit
+round-trip, <30s) and `--crash-smoke` (mocrash capped crash-recovery
+sweep + the planted early-truncate violation, <30s).
 
 Independent legs run CONCURRENTLY: the static analyses (molint,
 mokey, bench_guard) share nothing but the parsed-AST cache and
@@ -212,6 +213,29 @@ def _key_leg():
     return run
 
 
+def _crash_leg():
+    def run(print):
+        from tools import mocrash
+        rc = 0
+        rep = mocrash.run_smoke()
+        for line in rep["findings_formatted"]:
+            print(line)
+        if rep["findings"]:
+            print("crash-smoke: FINDINGS")
+            rc = 1
+        else:
+            print(f"crash-smoke: clean sweep ok ({rep['points']} "
+                  f"crash points, {rep['recoveries']} recoveries, "
+                  f"{rep['seconds']}s)")
+        if rep["plant_caught"]:
+            print("crash-smoke: planted early-truncate caught ok")
+        else:
+            print("crash-smoke: planted early-truncate NOT caught")
+            rc = 1
+        return rc
+    return run
+
+
 def main(argv=None) -> int:
     import argparse
     ap = argparse.ArgumentParser(prog="python -m tools.precheck")
@@ -236,6 +260,11 @@ def main(argv=None) -> int:
                     help="also run the mokey planted fixture pairs: "
                          "static pass over a planted temp tree + one "
                          "armed runtime audit round-trip (<30s)")
+    ap.add_argument("--crash-smoke", action="store_true",
+                    help="also run the mocrash crash-recovery smoke: "
+                         "a capped clean sweep over every durability "
+                         "boundary + the planted early-truncate "
+                         "violation (<30s)")
     args = ap.parse_args(argv)
 
     from tools import molint
@@ -253,6 +282,8 @@ def main(argv=None) -> int:
         legs.append(("trace-smoke", _trace_leg(), True))
     if args.key_smoke:
         legs.append(("key-smoke", _key_leg(), True))
+    if args.crash_smoke:
+        legs.append(("crash-smoke", _crash_leg(), True))
 
     rc = 0
     with ThreadPoolExecutor(max_workers=min(len(legs), 6)) as pool:
