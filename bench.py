@@ -242,6 +242,16 @@ def bench_q1(n: int = None) -> dict:
                            "value": 0, "unit": "error",
                            "vs_baseline": None,
                            "error": f"{type(e).__name__}: {e}"}]
+    if os.environ.get("MO_BENCH_NO_Q3S") != "1":
+        try:
+            q3s_entry = bench_q3_sharded()
+            q3_entries += [q3s_entry] + q3s_entry.pop("extra_metrics",
+                                                      [])
+        except Exception as e:               # noqa: BLE001
+            q3_entries.append({
+                "metric": "tpch_q3_sharded_rows_per_sec",
+                "value": 0, "unit": "error", "vs_baseline": None,
+                "error": f"{type(e).__name__}: {e}"})
     unfused_entry = {
         # the per-operator path's own family: the absolute floor for it
         # stays in BENCH_FLOORS.json, the fused family gets its own
@@ -381,6 +391,158 @@ def bench_q3(n: int = None) -> dict:
             "plan_fusion": 0,
             "backend": jax.default_backend(),
         }],
+    }
+
+
+def bench_q3_sharded(n: int = None) -> dict:
+    """TPC-H Q3 across the simulated device mesh (parallel/dist_query.py
+    shard executor): the same fused fragment compiled per shard over a
+    hash/rr-routed scan, partial group tables merged in one traced
+    dispatch.  Headline is rows/sec at the widest mesh the box offers,
+    with per-shard-count scaling entries (1/2/4/8) as extras — all
+    checked bit-identical to the single-device rows.
+
+    On the 1-core CI box the 8 simulated devices SHARE one core, so the
+    sharded path pays XLA:CPU collective + per-shard dispatch overhead
+    with zero real parallelism and the speedup target is out of reach
+    by construction; when speedup < 1.5x the result documents that
+    overhead instead, with per-stage motrace attribution
+    (shard.partial / shard.merge / shard.broadcast) so the cost is
+    visible, not guessed."""
+    from matrixone_tpu.frontend import Session
+    from matrixone_tpu.storage.engine import Engine
+    from matrixone_tpu.utils import motrace, tpch
+    if n is None:
+        n = int(os.environ.get("MO_BENCH_Q3S_N",
+                               40_000 if SMOKE else 400_000))
+    eng = Engine()
+    s = Session(catalog=eng)
+    t0 = time.time()
+    tpch.load_lineitem(s.catalog, n)
+    tpch.load_tpch_q3(s.catalog, max(n // 4, 100))
+    t_load = time.time() - t0
+    local = s.execute(tpch.Q3_SQL).rows()
+    s.execute("set dist_min_rows = 0")
+    # rr scan routing is chunk-granular: carve segments into ~2 chunks
+    # per shard so every shard of the widest mesh owns real data
+    s.execute(f"set batch_rows = {max(4096, n // 16)}")
+    n_dev = len(jax.devices())
+    reps = 2 if SMOKE else 3
+    per_shard = {}
+    for shards in (1, 2, 4, 8):
+        if shards > 1 and n_dev < shards:
+            continue
+        s.execute(f"set query_shards = {shards}")
+        rows = s.execute(tpch.Q3_SQL).rows()       # warm: compile path
+        exact = rows == local
+        best = 0.0
+        for _ in range(reps):
+            t0 = time.time()
+            s.execute(tpch.Q3_SQL)
+            best = max(best, n / (time.time() - t0))
+        per_shard[shards] = (best, exact)
+    widest = max(per_shard)
+    best, exact = per_shard[widest]
+    speedup = (round(best / per_shard[1][0], 2)
+               if per_shard.get(1, (0, 0))[0] else None)
+    # ---- per-stage attribution: one traced run at the widest mesh
+    was_armed = motrace.TRACER.armed
+    motrace.TRACER.arm(sample=1.0)
+    try:
+        mark = len(motrace.TRACER._ring)
+        s.execute(tpch.Q3_SQL)
+        stages = {}
+        for rec in list(motrace.TRACER._ring)[mark:]:
+            if rec["name"].startswith("shard."):
+                stages[rec["name"]] = round(
+                    stages.get(rec["name"], 0.0)
+                    + rec["dur_us"] / 1000.0, 2)
+    finally:
+        if not was_armed:
+            motrace.TRACER.disarm()
+    # ---- sharded Q1 on the same lineitem (the other headline shape)
+    s.execute("set query_shards = 0")
+    q1_local_rows = s.execute(tpch.Q1_SQL).rows()
+    t0 = time.time()
+    s.execute(tpch.Q1_SQL)
+    q1_local = n / (time.time() - t0)
+    s.execute(f"set query_shards = {widest}")
+    q1_rows = s.execute(tpch.Q1_SQL).rows()        # warm: compile path
+    t0 = time.time()
+    s.execute(tpch.Q1_SQL)
+    q1_best = n / (time.time() - t0)
+    s.execute("set query_shards = 0")
+    s.close()
+    # ---- breadth: Q5/Q9/Q18 (multi-join + shuffle shapes) at the
+    # widest mesh, exact vs the sqlite oracle AND vs the local rows
+    from matrixone_tpu.utils import tpch_full as TF
+    s2 = Session()
+    sf = 0.005 if SMOKE else 0.02
+    tables = TF.load_tpch(s2.catalog, sf=sf, seed=1)
+    conn = TF.to_sqlite(tables)
+    n_li = int(len(tables["lineitem"]["l_orderkey"]))
+    s2.execute("set dist_min_rows = 0")
+    s2.execute(f"set batch_rows = {max(1024, n_li // (2 * widest))}")
+    breadth = []
+    for qnum in (5, 9, 18):
+        sql = TF.QUERIES[qnum]
+        local_rows = s2.execute(sql).rows()
+        want = conn.execute(TF.to_sqlite_sql(sql)).fetchall()
+        oracle_ok = TF.rows_match(TF.normalize_rows(local_rows),
+                                  TF.normalize_rows(want))
+        t0 = time.time()
+        s2.execute(sql)
+        t_local = time.time() - t0
+        s2.execute(f"set query_shards = {widest}")
+        sh_rows = s2.execute(sql).rows()           # warm: compile path
+        t0 = time.time()
+        s2.execute(sql)
+        t_sh = time.time() - t0
+        s2.execute("set query_shards = 0")
+        breadth.append({
+            "metric": f"tpch_q{qnum}_sharded_rows_per_sec_{widest}dev",
+            "value": round(n_li / t_sh, 1),
+            "unit": "rows/s",
+            "vs_baseline": None,
+            "local_rows_per_sec": round(n_li / t_local, 1),
+            "exact_vs_local": bool(TF.rows_match(
+                TF.normalize_rows(sh_rows),
+                TF.normalize_rows(local_rows))),
+            "exact_vs_oracle": bool(oracle_ok),
+            "shards": widest,
+            "backend": jax.default_backend(),
+        })
+    conn.close()
+    s2.close()
+    return {
+        "metric": f"tpch_q3_sharded_rows_per_sec_{n}x{widest}dev",
+        "value": round(best, 1),
+        "unit": "rows/s",
+        "vs_baseline": None,
+        "exact_vs_local": bool(exact
+                               and all(e for _, e in per_shard.values())),
+        "shards": widest,
+        "sharded_over_local": speedup,
+        # the 1-core escape hatch: when < 1.5x, the per-stage spans ARE
+        # the documented XLA:CPU collective/dispatch overhead breakdown
+        "stage_ms": stages,
+        "simulated_devices_share_cores": os.cpu_count(),
+        "load_seconds": round(t_load, 2),
+        "q1_sharded_rows_per_sec": round(q1_best, 1),
+        "q1_local_rows_per_sec": round(q1_local, 1),
+        "q1_sharded_over_local": round(q1_best / q1_local, 2),
+        "q1_exact_vs_local": q1_rows == q1_local_rows,
+        "backend": jax.default_backend(),
+        "extra_metrics": [{
+            "metric": f"tpch_q3_sharded_rows_per_sec_{n}x{sc}dev",
+            "value": round(v, 1),
+            "unit": "rows/s",
+            "vs_baseline": None,
+            "shards": sc,
+            "exact_vs_local": bool(e),
+            "backend": jax.default_backend(),
+        } for sc, (v, e) in sorted(per_shard.items())
+            if sc != widest] + breadth,
     }
 
 
@@ -737,6 +899,9 @@ def main():
         return
     if METRIC == "q3":
         print(json.dumps(bench_q3()))
+        return
+    if METRIC == "q3s":
+        print(json.dumps(bench_q3_sharded()))
         return
     if METRIC == "mview":
         print(json.dumps(bench_mview()))

@@ -91,7 +91,12 @@ class Session:
                           # an N-device mesh (vm/vector_scan.py); the env
                           # default serves deployments that shard always
                           "ivf_shards": int(_os.environ.get(
-                              "MO_IVF_SHARDS", "0") or 0)}
+                              "MO_IVF_SHARDS", "0") or 0),
+                          # SET query_shards = N routes eligible SQL
+                          # fragments onto an N-device mesh
+                          # (parallel/dist_query.py shard executor)
+                          "query_shards": int(_os.environ.get(
+                              "MO_QUERY_SHARDS", "0") or 0)}
         self._procs = registry_for(self.catalog)
         self._admission_depth = 0      # re-entrant execute() guard
         self.conn_id = self._procs.register(user if auth is None
@@ -399,7 +404,8 @@ class Session:
             if stmt.analyze:
                 return Result(text=self._explain_analyze(node))
             anns = [a for a in (self._fragment_annotator(node),
-                                self._mview_annotator())
+                                self._mview_annotator(),
+                                self._exchange_annotator(node))
                     if a is not None]
             annotate = (None if not anns else
                         (lambda pn: "".join(a(pn) for a in anns)))
@@ -661,6 +667,22 @@ class Session:
                            + (f" {roles[id(n)]}" if id(n) in roles
                               else ""))
                           if id(n) in fmap else "")
+
+    def _exchange_annotator(self, node):
+        """EXPLAIN decoration for the device-shard executor: mark each
+        exchange the CBO planned — exchange=broadcast|shuffle|local on
+        the spine joins and the probe scan (parallel/dist_query.py)."""
+        shards = int(self.variables.get("query_shards", 0) or 0)
+        if shards < 2:
+            return None
+        from matrixone_tpu.parallel import dist_query as DQ
+        modes = DQ.explain_exchanges(
+            node, self.catalog, shards,
+            min_rows=int(self.variables.get("dist_min_rows", 100_000)))
+        if not modes:
+            return None
+        return lambda n: (f" exchange={modes[id(n)]}"
+                          if id(n) in modes else "")
 
     def _explain_analyze(self, node) -> str:
         """Run the plan, recording per-operator batches/rows/time
@@ -1591,11 +1613,28 @@ class Session:
         compile/types.go:162): when this CN knows peer fragment
         endpoints, qualifying plans execute their lower subtree across
         the peers and re-enter locally as a Materialized node. `SET
-        dist = 0` disables; `dist_min_rows` tunes the size threshold."""
-        peers = getattr(self.catalog, "dist_peers", None)
-        if not peers or self.txn is not None:
+        dist = 0` disables; `dist_min_rows` tunes the size threshold.
+
+        Device shards take PRIORITY over host peers: `SET query_shards
+        = N` (env MO_QUERY_SHARDS) runs the same fragment split across
+        N device shards of the local mesh — no serialization, no
+        network — and falls through to peers/local when the plan or
+        mesh does not qualify (parallel/dist_query.py)."""
+        if self.txn is not None:
             return node
         if str(self.variables.get("dist", 1)) in ("0", "off", "false"):
+            return node
+        shards = int(self.variables.get("query_shards", 0) or 0)
+        if shards >= 2:
+            from matrixone_tpu.parallel import dist_query as DQ
+            rebuilt = DQ.try_shard(
+                node, self.catalog, ctx, shards,
+                min_rows=int(self.variables.get("dist_min_rows",
+                                                100_000)))
+            if rebuilt is not None:
+                return rebuilt
+        peers = getattr(self.catalog, "dist_peers", None)
+        if not peers:
             return node
         from matrixone_tpu.parallel import fragments as FR
         pool = FR.pool_for(self.catalog)
@@ -2402,7 +2441,8 @@ class _ServingCtx:
         s = current_session()
         v = s.variables if s is not None else {}
         return (str(v.get("cbo", 1)), int(v.get("ivf_nprobe", 8) or 8),
-                int(v.get("ivf_shards", 0) or 0))
+                int(v.get("ivf_shards", 0) or 0),
+                int(v.get("query_shards", 0) or 0))
 
     def plan_key(self) -> tuple:
         return ("plan", self.scope, self.norm.template,

@@ -796,7 +796,11 @@ class Parser:
 
     def _partition_clause(self):
         """PARTITION BY RANGE(col) (PARTITION p VALUES LESS THAN (x|
-        MAXVALUE), ...) | PARTITION BY HASH(col) PARTITIONS n."""
+        MAXVALUE), ...) | PARTITION BY HASH(col) PARTITIONS n.
+        SHARDS n is accepted as an alias of PARTITIONS n: a table hash-
+        partitioned on its join/group column with n == query_shards is
+        read co-partitioned by the device-shard executor (no row ever
+        crosses an exchange, parallel/dist_query.py)."""
         if not self.accept_kw("partition"):
             return None
         self.expect_kw("by")
@@ -808,8 +812,10 @@ class Parser:
         self.expect_op(")")
         if kind == "hash":
             t = self.peek()
-            if not (t.kind == "ident" and t.value.lower() == "partitions"):
-                raise ParseError("HASH partitioning requires PARTITIONS n")
+            if not (t.kind == "ident"
+                    and t.value.lower() in ("partitions", "shards")):
+                raise ParseError(
+                    "HASH partitioning requires PARTITIONS n (SHARDS n)")
             self.next()
             n = int(self.next().value)
             if n < 1:

@@ -35,6 +35,12 @@ class Scan(PlanNode):
     # every n-th chunk (reference: RemoteRun ships scopes whose readers
     # cover disjoint block ranges, compile/scope.go:423)
     shard: Optional[Tuple[int, int]] = None
+    # hash exchange: (column, shard_idx, n_shards) — this scan keeps only
+    # rows whose splitmix64(column) % n_shards == shard_idx (the all-to-all
+    # repartition of colexec/shuffle expressed as a read-side route; when
+    # the table is hash-partitioned on `column` with n_parts == n_shards
+    # the engine skips non-matching segments structurally and no row moves)
+    hash_shard: Optional[Tuple[str, int, int]] = None
 
 
 @dataclasses.dataclass

@@ -137,7 +137,9 @@ def plan_to_json(node) -> dict:
         return {**s, "t": "scan", "table": node.table,
                 "columns": list(node.columns),
                 "filters": [expr_to_json(f) for f in node.filters],
-                "as_of_ts": node.as_of_ts, "shard": node.shard}
+                "as_of_ts": node.as_of_ts, "shard": node.shard,
+                "hash_shard": list(node.hash_shard)
+                if node.hash_shard else None}
     if isinstance(node, P.Filter):
         return {**s, "t": "filter", "child": plan_to_json(node.child),
                 "pred": expr_to_json(node.pred)}
@@ -183,7 +185,11 @@ def plan_from_json(d: dict):
         return P.Scan(d["table"], list(d["columns"]), schema,
                       filters=[expr_from_json(f) for f in d["filters"]],
                       as_of_ts=d.get("as_of_ts"),
-                      shard=tuple(d["shard"]) if d.get("shard") else None)
+                      shard=tuple(d["shard"]) if d.get("shard") else None,
+                      hash_shard=(d["hash_shard"][0],
+                                  int(d["hash_shard"][1]),
+                                  int(d["hash_shard"][2]))
+                      if d.get("hash_shard") else None)
     if t == "filter":
         return P.Filter(plan_from_json(d["child"]),
                         expr_from_json(d["pred"]), schema)

@@ -408,7 +408,8 @@ class MVCCTable:
                     qualified_names: Optional[List[str]] = None,
                     snapshot_ts: Optional[int] = None,
                     extra_segments: Optional[List[Segment]] = None,
-                    extra_deletes: Optional[np.ndarray] = None
+                    extra_deletes: Optional[np.ndarray] = None,
+                    only_part: Optional[int] = None
                     ) -> Iterator[tuple]:
         """Yield (arrays, validity, dicts, n) merging committed segments
         visible at snapshot_ts with txn-local segments/deletes."""
@@ -435,6 +436,12 @@ class MVCCTable:
         for seg in segs:
             if allowed_parts is not None and seg.part_id >= 0 \
                     and seg.part_id not in allowed_parts:
+                continue
+            # co-partitioned shard read (vm/operators._hash_route): only
+            # this partition's segments; part-less segments still flow
+            # and are row-filtered by the caller's hash mask
+            if only_part is not None and seg.part_id >= 0 \
+                    and seg.part_id != only_part:
                 continue
             # object-backed segments: prune on STORED zonemaps before any
             # column fetch — an excluded segment costs zero object-store

@@ -454,6 +454,20 @@ key_findings = REGISTRY.counter(
     "capture-content mismatches under a colliding cache key, by "
     "audited site label (fragment/joinbuild/joinprobe/mview/udf/tree)")
 
+# ---- device-shard exchanges (parallel/dist_query.py shard executor)
+exchange_shuffle_rows = REGISTRY.counter(
+    "mo_exchange_shuffle_rows_total",
+    "rows that crossed a hash exchange (vm/operators._hash_route row "
+    "routing; co-partitioned reads that resolve structurally count 0)")
+exchange_broadcast_bytes = REGISTRY.counter(
+    "mo_exchange_broadcast_bytes_total",
+    "bytes replicated to the non-owning shards by broadcast join "
+    "builds (materialized once, bytes x (n_shards - 1))")
+exchange_partial_merge = REGISTRY.counter(
+    "mo_exchange_partial_merge_total",
+    "cross-shard partial-result merges by kind "
+    "(dense/general/scalar/topk/join)")
+
 # ---- restart recovery (Engine.open) + crash sweep (utils/crash.py,
 # ---- tools/mocrash)
 recovery_frames = REGISTRY.counter(

@@ -909,9 +909,27 @@ class FusedFragmentOp(O.Operator):
     def _prelude_labels(self) -> List[str]:
         return []
 
+    def _shard_ctx(self):
+        """Exchange shape the source scan is routed under: (mode,
+        column, mesh size, mesh axis) or None.  Shard routing is a
+        chunk-production row mask (vm/operators._hash_route), so the
+        traced program is shard-INDEX-invariant — the shape alone keys
+        the cache and one compile serves every shard of the mesh."""
+        sc = getattr(self.child, "node", None)
+        hs = getattr(sc, "hash_shard", None)
+        if hs is not None:
+            return ("hash", hs[0], int(hs[2]), "shard")
+        rr = getattr(sc, "shard", None)
+        if rr is not None:
+            return ("rr", None, int(rr[1]), "shard")
+        return None
+
     # ----------------------------------------------------------- sig
     def _build_plan_sig(self, lift_ids) -> tuple:
         parts: List[tuple] = [("term", self._terminal)]
+        sctx = self._shard_ctx()
+        if sctx is not None:
+            parts.append(("shard",) + sctx)
         parts.extend(self._prelude_sig(lift_ids))
         if self._scan_defer:
             parts.append(("scanf",
@@ -1237,6 +1255,7 @@ class FusedFragmentOp(O.Operator):
             "lift_arity": len(self._lift_lits) + len(rt_lift),
             "sizes_flags": sizes_flags,
             "chain_shape": self.describe(),
+            "shard_ctx": self._shard_ctx(),
         }
 
     def _audit_exprs(self) -> list:

@@ -181,6 +181,24 @@ class ScanOp(Operator):
             batch_rows = int(self.ctx.variables.get("batch_rows",
                                                     batch_rows))
         shard = self.node.shard
+        hs = self.node.hash_shard
+        hs_aligned = False
+        if hs is not None:
+            # read-side hash exchange (colexec/shuffle as a route, not a
+            # send): when the table is hash-partitioned on the shuffle
+            # column with the same fan-out, matching segments are
+            # selected structurally (only_part) and no row moves; the
+            # row-level mask below stays on as the correctness backstop
+            # for any segment without a part id
+            meta = getattr(self.rel, "meta", None)
+            pspec = getattr(meta, "partition", None) \
+                if meta is not None else None
+            hs_aligned = (pspec is not None and pspec.kind == "hash"
+                          and pspec.column == hs[0]
+                          and pspec.n_parts == hs[2])
+            if hs_aligned:
+                read_args = dict(read_args)
+                read_args["only_part"] = hs[1]
         chunks = self.rel.iter_chunks(
             self.node.columns, batch_rows, filters=filters,
             qualified_names=qnames, **read_args)
@@ -208,6 +226,13 @@ class ScanOp(Operator):
                     # every replica)
                     continue
                 arrays, validity, dicts, n = chunk
+                if hs is not None:
+                    arrays, validity, n, moved = _hash_route(
+                        arrays, validity, n, hs, hs_aligned)
+                    if n == 0:
+                        continue
+                    if moved:
+                        M.exchange_shuffle_rows.inc(moved)
                 M.rows_scanned.inc(n, table=self.node.table)
                 ex = chunk_to_execbatch(arrays, validity, dicts, n,
                                         self.node.columns,
@@ -224,6 +249,42 @@ class ScanOp(Operator):
         finally:
             if prefetcher is not None:
                 prefetcher.close()
+
+
+def _hash_route(arrays, validity, n: int, hs, aligned: bool):
+    """Keep only the rows this shard owns under the hash exchange
+    `hash_shard=(column, idx, n_shards)`.  Routing is splitmix64 % n with
+    NULL -> shard 0 — bit-identical to the commit pipeline's
+    storage.partition.assign_partitions, so a partitioned table and an
+    implicit repartition agree on every row's home.  Returns
+    (arrays, validity, n_kept, n_moved); n_moved counts rows that
+    crossed the exchange (0 when the segment selection was structural —
+    a co-partitioned read moves nothing)."""
+    from matrixone_tpu.storage import partition as partmod
+    col, idx, n_shards = hs
+    key = arrays.get(col)
+    if key is None:
+        raise EvalError(f"hash_shard column {col!r} not in scan columns")
+    key = np.asarray(key)
+    if not np.issubdtype(key.dtype, np.integer):
+        raise EvalError(
+            f"hash_shard column {col!r} must be int-backed, "
+            f"got {key.dtype}")
+    v = validity.get(col)
+    valid = (np.asarray(v, bool) if v is not None
+             else np.ones(n, np.bool_))
+    pid = np.where(valid,
+                   (partmod._hash64(key.astype(np.int64))
+                    % np.uint64(n_shards)).astype(np.int64), 0)
+    keep = pid == idx
+    kept = int(keep.sum())
+    moved = 0 if aligned else kept
+    if kept == n:
+        return arrays, validity, n, moved
+    arrays = {c: a[keep] for c, a in arrays.items()}
+    validity = {c: (np.asarray(vv)[keep] if vv is not None else None)
+                for c, vv in validity.items()}
+    return arrays, validity, kept, moved
 
 
 class MaterializedOp(Operator):
@@ -938,6 +999,71 @@ class AggOp(Operator):
             cols[name] = col
         db = DeviceBatch(columns=cols, n_rows=state["n"])
         return ExecBatch(batch=db, dicts=dicts, mask=state["present"])
+
+    # ---- distributed partials (parallel/dist_query.py shard executor)
+    def partial_state(self):
+        """Run the grouped accumulation loop but stop BEFORE finalize and
+        hand back the raw partial group table for a cross-shard merge.
+        Unlike the host-peer fragment path this keeps the dense fast
+        path live (its partials psum across shards).  Returns
+        (kind, payload, key_dicts, tracker):
+
+          kind "dense"   -> payload = the dense accumulator dict
+          kind "general" -> payload = state dict (keys/kvalid/present/
+                            partials/n) sized to self.max_groups
+          kind "empty"   -> payload None (this shard saw no rows)
+
+        Spill is disabled: a shard whose group table exceeds the device
+        budget raises _NeedSpill and the caller degrades the whole query
+        to single-device execution."""
+        key_dicts: List[Optional[list]] = [None] * len(self.node.group_keys)
+        tracker = _AggDictTracker(self.node.aggs)
+        state = None
+        dense = None
+        dense_checked = False
+        for ex in self.child.execute():
+            tracker.observe(ex)
+            keys = [eval_expr(k, ex) for k in self.node.group_keys]
+            for i, (k_ast, _k) in enumerate(zip(self.node.group_keys,
+                                                keys)):
+                d = _expr_dict(k_ast, ex)
+                if d is not None:
+                    key_dicts[i] = d
+            kdata = [_broadcast_full(k, ex.padded_len).data for k in keys]
+            kvalid = [_broadcast_full(k, ex.padded_len).validity
+                      for k in keys]
+            values = [None if (a.func == "count" and a.arg is None)
+                      else _agg_value(a, ex) for a in self.node.aggs]
+            if not dense_checked:
+                dense_checked = True
+                dense = self._dense_init(ex)
+            if dense is not None:
+                if self._dense_sizes(ex) == list(dense["sizes"]):
+                    self._dense_step(dense, kdata, kvalid, ex.mask,
+                                     values)
+                    continue
+                state = self._dense_to_state(dense)
+                dense = None
+            part = self._partial_vals(kdata, kvalid, ex.mask, values,
+                                      allow_spill=False)
+            state = part if state is None else \
+                self._merge(state, part, allow_spill=False)
+        if dense is not None:
+            return "dense", dense, key_dicts, tracker
+        if state is not None:
+            return "general", state, key_dicts, tracker
+        return "empty", None, key_dicts, tracker
+
+    def partial_scalar_state(self):
+        """Scalar (no GROUP BY) counterpart of partial_state: per-agg
+        partial tuples plus the string-dict tracker."""
+        states = [None] * len(self.node.aggs)
+        tracker = _AggDictTracker(self.node.aggs)
+        for ex in self.child.execute():
+            tracker.observe(ex)
+            for i, a in enumerate(self.node.aggs):
+                states[i] = _scalar_step_host(a, ex, states[i])
+        return states, tracker
 
 
 def _broadcast_full(col: DeviceColumn, n: int) -> DeviceColumn:

@@ -54,6 +54,9 @@ PAIR_ENV = {
     "canary": {"MO_PLAN_FUSION": "1", "MO_FUSION_MIN_ROWS": "0"},
     "mview": {},
     "shards": {},
+    # device-shard SQL executor (parallel/dist_query.py): the variant
+    # SETs query_shards live, same mechanism as the ivf "shards" pair
+    "query-shards": {},
     "cache-stale": {"MO_PLAN_FUSION": "1", "MO_FUSION_MIN_ROWS": "0"},
 }
 
@@ -183,6 +186,11 @@ def _applicable(pair: str, q: GenQuery) -> bool:
         return q.has("maintainable")
     if pair == "shards":
         return q.has("vector")
+    if pair == "query-shards":
+        # every non-vector family: the executor itself degrades to the
+        # local plan when the shape doesn't shard, and THAT ladder is
+        # exactly what the lockstep pair must exercise
+        return not q.has("vector")
     return False
 
 
@@ -216,6 +224,8 @@ def run_corpus(seed: int = 0, queries_per_scenario: int = 80,
     pairs = list(PAIR_NAMES) if pairs is None else list(pairs)
     if "shards" in pairs and not _mesh_ok():
         pairs.remove("shards")
+    if "query-shards" in pairs and not _mesh_ok():
+        pairs.remove("query-shards")
     findings: List[Finding] = []
     checks: Dict[str, int] = {}
     pair_counts: Dict[str, int] = {p: 0 for p in pairs}
@@ -268,7 +278,7 @@ def run_corpus(seed: int = 0, queries_per_scenario: int = 80,
 
             # ---- same-session env pairs
             for pair in ("fusion", "dense-groups", "udf-tier",
-                         "shards"):
+                         "shards", "query-shards"):
                 if pair not in pairs:
                     continue
                 if pair == "shards":
@@ -276,6 +286,12 @@ def run_corpus(seed: int = 0, queries_per_scenario: int = 80,
                     # session snapshots MO_IVF_SHARDS at creation, so
                     # the variant must SET it live (and restore)
                     live.sess.execute("set ivf_shards = 2")
+                if pair == "query-shards":
+                    # same mechanism for the SQL device-shard executor;
+                    # dist_min_rows drops so the tiny corpus tables
+                    # actually shard (restored below)
+                    live.sess.execute("set query_shards = 2")
+                    live.sess.execute("set dist_min_rows = 0")
                 try:
                     with _pair_scope(pair):
                         for i, q in enumerate(qs):
@@ -287,6 +303,9 @@ def run_corpus(seed: int = 0, queries_per_scenario: int = 80,
                 finally:
                     if pair == "shards":
                         live.sess.execute("set ivf_shards = 0")
+                    if pair == "query-shards":
+                        live.sess.execute("set query_shards = 0")
+                        live.sess.execute("set dist_min_rows = 100000")
 
             # ---- warm-cache pairs (same session, caches on)
             if "plan-cache" in pairs:
