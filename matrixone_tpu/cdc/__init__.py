@@ -78,14 +78,43 @@ def delta_events(engine, table: str, from_ts: int) -> List[tuple]:
     dynamic-table delta refresh (stream.refresh_dynamic_table): payloads
     are the same objects the live stream carries (Segment for inserts,
     gid arrays for deletes), so a consumer written against one surface
-    works against the other."""
+    works against the other.
+
+    Replay stays EXACTLY-ONCE across background merges: each snapshot
+    fence (engine.MergeFence) contributes the window (prev_merge_ts,
+    merge_ts] replayed from ITS pinned segments/tombstones, the live
+    list contributes everything after the last fence.  A merge's rewrite
+    segment carries commit_ts == merge_ts, which every window's
+    EXCLUSIVE lower bound structurally excludes — resumed consumers
+    never see the compacted rewrite as a fresh insert.  A resume at or
+    below the table's delta_floor (the newest RELEASED fence) has lost
+    its history to GC; callers guard that rung and re-seed."""
     t = engine.get_table(table)
+    fences = getattr(t, "fences", None) or []
+    floor = getattr(t, "delta_floor", 0)
+    if fences and from_ts > floor:
+        windows = []
+        prev = floor
+        for f in fences:                  # ascending merge_ts
+            windows.append((prev, f.merge_ts, f.segments, f.tombstones))
+            prev = f.merge_ts
+        windows.append((prev, None, t.segments, t.tombstones))
+    else:
+        # from-scratch seed (or no fenced history): the live view IS the
+        # net state — one squashed replay
+        windows = [(None, None, t.segments, t.tombstones)]
     events = []
-    for seg in t.segments:
-        if seg.commit_ts >= from_ts:
-            events.append((seg.commit_ts, 1, "insert", seg))
-    for ts, gids in t.tombstones:
-        if ts >= from_ts:
+    for lo, hi, segs, tombs in windows:
+        for seg in segs:
+            ts = seg.commit_ts
+            if ts < from_ts or (lo is not None and ts <= lo) \
+                    or (hi is not None and ts > hi):
+                continue
+            events.append((ts, 1, "insert", seg))
+        for ts, gids in tombs:
+            if ts < from_ts or (lo is not None and ts <= lo) \
+                    or (hi is not None and ts > hi):
+                continue
             events.append((ts, 0, "delete", gids))
     return [(ts, kind, payload)
             for ts, _order, kind, payload in sorted(events,
@@ -205,16 +234,29 @@ class CdcTask:
         self._buffering = False
         self._buffer: List[tuple] = []
         self._active = False
+        self._path = "live"      # mo_cdc_events_total delivery path
+        self._wm_key: Optional[str] = None
 
     def start(self) -> "CdcTask":
         if not self._active:
             self._active = True
             self.engine.subscribe(self._on_commit)
+            # pin this sink's replay history: the merge scheduler's
+            # fence GC holds any compaction fence of this table until
+            # our watermark has caught up past it (delta-aware GC)
+            reg = getattr(self.engine, "register_watermark", None)
+            if reg is not None:
+                self._wm_key = f"cdc:{self.table}:{id(self)}"
+                reg(self._wm_key, self.table, lambda: self.watermark)
         return self
 
     def stop(self):
         self._active = False
         self.engine.unsubscribe(self._on_commit)
+        unreg = getattr(self.engine, "unregister_watermark", None)
+        if unreg is not None and getattr(self, "_wm_key", None):
+            unreg(self._wm_key)
+            self._wm_key = None
 
     def _decode_segment(self, seg) -> List[dict]:
         t = self.engine.get_table(self.table)
@@ -265,13 +307,16 @@ class CdcTask:
         SOURCE engine's commit lock (one at a time), and a backfill
         first arms buffering (queueing new arrivals) then waits out any
         delivery already in flight (_inflight) before replaying."""
+        from matrixone_tpu.utils import metrics as M
         if kind == "insert":
             pk = self.engine.get_table(self.table).meta.primary_key
             self.sink.on_insert(self.table, self._decode_segment(payload),
                                 pk_cols=pk or None)
+            M.cdc_events.inc(path=self._path, kind="insert")
         elif kind == "delete":
             self.sink.on_delete(self.table, self._decode_pk_rows(
                 np.asarray(payload, np.int64)))
+            M.cdc_events.inc(path=self._path, kind="delete")
         with self._lock:
             self.watermark = max(self.watermark, commit_ts)
 
@@ -333,20 +378,31 @@ class CdcTask:
             deadline = time.monotonic() + 30.0
             while self._inflight > 0 and time.monotonic() < deadline:
                 self._cv.wait(timeout=1.0)
+            from matrixone_tpu.utils import metrics as M
             t = self.engine.get_table(self.table)
-            merged = getattr(t, "last_merge_ts", 0)
-            if 0 < from_ts <= merged:
-                # merge_table compacted history at or above the resume
-                # point: the deltas between from_ts and the merge are
-                # GONE (tombstones dropped, live rows rewritten into a
-                # post-merge segment whose replay would duplicate the
-                # whole table).  Silent divergence is worse than a loud
-                # stop — the sink must be re-seeded from scratch
-                # (from_ts=0 replays the full live state).
+            floor = getattr(t, "delta_floor", 0)
+            fences = getattr(t, "fences", None) or []
+            if 0 < from_ts <= floor:
+                # DEGRADE RUNG: the snapshot fence that held this
+                # window's history was GC'd (no consumer was registered
+                # to pin it).  The deltas between from_ts and the floor
+                # are gone — silent divergence is worse than a loud stop,
+                # so the sink must be re-seeded (backfill from 0 replays
+                # the full live state).  A merge whose fence is still
+                # held does NOT land here: delta_events replays it
+                # exactly-once through the fence windows.
+                M.cdc_backfills.inc(outcome="refused")
                 raise ValueError(
                     f"cannot resume CDC on {self.table!r} from "
-                    f"{from_ts}: a merge at {merged} compacted the "
-                    f"deltas away; re-seed the sink (backfill from 0)")
+                    f"{from_ts}: the merge fence below {floor} was "
+                    f"GC'd and the deltas compacted away; re-seed the "
+                    f"sink (backfill from 0)")
+            if from_ts == 0:
+                M.cdc_backfills.inc(outcome="seed")
+            elif fences and from_ts <= fences[-1].merge_ts:
+                M.cdc_backfills.inc(outcome="fenced")
+            else:
+                M.cdc_backfills.inc(outcome="live")
             events = delta_events(self.engine, self.table, from_ts)
         try:
             for ts, kind, payload in events:
@@ -377,4 +433,8 @@ class CdcTask:
     def _replay_event(self, commit_ts: int, kind: str, payload) -> None:
         """Deliver one backfill event regardless of the current watermark
         (which a live commit may have advanced past this event)."""
-        self._apply_event(commit_ts, kind, payload)
+        self._path = "backfill"
+        try:
+            self._apply_event(commit_ts, kind, payload)
+        finally:
+            self._path = "live"

@@ -188,11 +188,13 @@ class StandbyAgent:
             # the primary rewrote gids; mirror the compaction locally
             # from our OWN state (bit-equal row set, locally owned gids).
             # checkpoint=True truncates our WAL inside merge_table, so
-            # the pos file must land first (see _persist_pos)
+            # the pos file must land first (see _persist_pos).  No outer
+            # commit-lock wrap: merge_table takes merge-lock -> commit-
+            # lock itself, and wrapping it inverts that order against
+            # every scheduler/foreground merge (mosan-caught cycle)
             self._persist_pos()
-            with self.engine._commit_lock:
-                self.engine.merge_table(h["name"], min_segments=1,
-                                        checkpoint=True)
+            self.engine.merge_table(h["name"], min_segments=1,
+                                    checkpoint=True)
             self.records_since_ckpt = 0
             self._advance(h.get("ts", 0))
             return

@@ -46,6 +46,10 @@ class Cluster:
         self.sessions: List[Session] = [Session(catalog=self.engine)
                                         for _ in range(n_sessions)]
         self.tasks = TaskService(self.engine).start()
+        # MO_MERGE_SCHED=1: background compaction/checkpoint/GC loop
+        # (storage/merge_sched) rides the embedded engine's lifecycle
+        from matrixone_tpu.storage import merge_sched
+        self.merge_scheduler = merge_sched.maybe_start(self.engine)
         if checkpoint_interval_s > 0:
             resumed = any(t["name"] == "auto-checkpoint"
                           for t in self.tasks._tasks.values())
@@ -112,6 +116,8 @@ class Cluster:
         if self.hakeeper is not None:
             self.hakeeper.stop()
         self.tasks.stop()
+        if self.merge_scheduler is not None:
+            self.merge_scheduler.stop()
         if self.server is not None:
             self.server.stop()
         if self.worker_client is not None:

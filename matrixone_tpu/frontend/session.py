@@ -910,8 +910,32 @@ class Session:
                     return "skipped (too few segments)"
                 if code == -2:
                     return "deferred (open transactions)"
+                if code == -3:
+                    return "deferred (lost race with a concurrent " \
+                           "write — retry)"
                 return f"kept {code} rows"
-            if not arg:
+            if arg in ("status", "run", "pause", "resume", "gc"):
+                # background compaction scheduler ops surface
+                # (storage/merge_sched) — the lint/san/crash pattern
+                import json as _json
+                from matrixone_tpu.storage import merge_sched
+                sched = merge_sched.scheduler_for(self.catalog)
+                if arg == "status":
+                    out = _json.dumps(sched.status(), sort_keys=True,
+                                      default=str)
+                elif arg == "run":
+                    out = _json.dumps(sched.run_cycle(), sort_keys=True,
+                                      default=str)
+                elif arg == "gc":
+                    out = _json.dumps(self.catalog.gc_fences(),
+                                      sort_keys=True)
+                elif arg == "pause":
+                    sched.pause()
+                    out = "merge scheduler paused"
+                else:
+                    sched.resume()
+                    out = "merge scheduler resumed"
+            elif not arg:
                 results = []
                 for name in list(self.catalog.tables):
                     if not name.startswith("system_"):

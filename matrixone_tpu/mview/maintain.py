@@ -881,15 +881,27 @@ class MViewService:
             rt = ViewRuntime(name, spec, dh)
             san.guard(rt, self._lock, name=f"MViewRuntime[{name}]")
             self._dynamic[name] = rt
+            # pin this runtime's replay history: fence GC defers any
+            # compaction fence of the source until the runtime's
+            # watermark passes it (delta-aware GC), so refreshes across
+            # a background merge stay incremental
+            reg = getattr(self.engine, "register_watermark", None)
+            if reg is not None:
+                reg(f"dyn:{name}", rt.spec.source,
+                    lambda rt=rt: rt.watermark if rt.groups is not None
+                    else None)
         was = getattr(self._maint, "active", False)
         self._maint.active = True
         try:
             with self._lock:
                 src = self.engine.get_table(rt.spec.source)
-                merged = getattr(src, "last_merge_ts", 0)
-                if rt.groups is None or rt.watermark < merged:
-                    # first delta refresh (or a merge compacted history
-                    # below the watermark): rebuild from scratch
+                floor = getattr(src, "delta_floor", 0)
+                if rt.groups is None or rt.watermark < floor:
+                    # DEGRADE RUNG: first delta refresh, or the merge
+                    # fence below our watermark was GC'd (history gone)
+                    # — rebuild from scratch.  A merge whose fence is
+                    # still held replays incrementally below via
+                    # delta_events' exactly-once fence windows.
                     ts0 = self.engine.committed_ts
                     rt.replace_state(
                         self._compute_groups(rt.spec, ts0), ts0)

@@ -104,6 +104,22 @@ class Segment:
         return not isinstance(self.arrays, dict)
 
 
+@dataclasses.dataclass
+class MergeFence:
+    """Snapshot fence: the COMPLETE pre-merge view of one table, pinned
+    when merge_table rewrote it (reference: tae keeps merged-away objects
+    until GC proves no snapshot/consumer can reach them).  `segments` is
+    the full live segment list at the catalog swap (original commit_ts
+    preserved), `tombstones` likewise — so AS OF reads below merge_ts and
+    delta replays across it stay exact instead of truncating.  Gid ranges
+    are never reused (next_gid survives the merge), so a fenced gid
+    resolves to exactly one historical segment.  Fences are released
+    oldest-first by Engine.gc_fences once nothing can reach them."""
+    merge_ts: int
+    segments: List[Segment]
+    tombstones: List[Tuple[int, np.ndarray]]
+
+
 class ConflictError(RuntimeError):
     pass
 
@@ -129,10 +145,17 @@ class MVCCTable:
         #: funnels through apply_segment/apply_tombstones, including WAL
         #: replay and the CN logtail apply, so replicas stay versioned)
         self.last_commit_ts = 0
-        #: last merge_table compaction: replay-from-MVCC consumers
-        #: (CDC backfill, dynamic-table delta refresh) cannot resume a
-        #: watermark below this — the deltas were compacted away
+        #: last merge_table compaction ts (informational; fences below
+        #: carry the actual replayable history across merges)
         self.last_merge_ts = 0
+        #: snapshot fences, ascending merge_ts: each merge pins the full
+        #: pre-merge view so AS OF reads and delta consumers below it
+        #: stay exact (released by Engine.gc_fences, oldest first)
+        self.fences: List[MergeFence] = []
+        #: merge_ts of the NEWEST RELEASED fence — the degrade floor:
+        #: a delta resume at or below it lost its history to GC and must
+        #: re-seed/rebuild; anything above replays exactly-once
+        self.delta_floor = 0
         self.next_gid = 0
         self.next_seg = 0
         self.dicts: Dict[str, List[str]] = {
@@ -393,9 +416,37 @@ class MVCCTable:
             self.last_commit_ts = max(self.last_commit_ts, commit_ts)
 
     # --------------------------------------------------------------- read
+    def _view_at(self, snapshot_ts: Optional[int]):
+        """(segments, tombstones) source lists for a read at snapshot_ts.
+        A fence's segments ARE the complete table state at its merge
+        point, so a historical read below any fence uses the oldest such
+        fence and then applies the ordinary commit_ts <= ts filtering —
+        AS OF reads stay bit-identical across a background merge."""
+        if snapshot_ts is None:
+            return self.segments, self.tombstones
+        for f in self.fences:              # ascending merge_ts
+            if snapshot_ts < f.merge_ts:
+                return f.segments, f.tombstones
+        return self.segments, self.tombstones
+
+    def _gid_fence_segment(self, gid: int) -> Optional[Segment]:
+        """Owning segment of a gid that no live segment covers (the row
+        was compacted away): gid ranges are never reused, so exactly one
+        fenced segment can hold it.  Delta replays decode deletes of
+        pre-merge rows through this fallback."""
+        for f in reversed(self.fences):
+            for s in f.segments:           # ascending base_gid
+                if s.base_gid > gid:
+                    break
+                if gid < s.base_gid + s.n_rows:
+                    return s
+        return None
+
     def _dead_gids(self, snapshot_ts: Optional[int],
-                   extra_deletes: Optional[np.ndarray]) -> np.ndarray:
-        parts = [g for ts, g in self.tombstones
+                   extra_deletes: Optional[np.ndarray],
+                   tombstones: Optional[list] = None) -> np.ndarray:
+        src = self.tombstones if tombstones is None else tombstones
+        parts = [g for ts, g in src
                  if snapshot_ts is None or ts <= snapshot_ts]
         if extra_deletes is not None and len(extra_deletes):
             parts.append(np.asarray(extra_deletes, np.int64))
@@ -415,7 +466,8 @@ class MVCCTable:
         visible at snapshot_ts with txn-local segments/deletes."""
         want_rowid = ROWID in columns
         data_cols = [c for c in columns if c != ROWID]
-        dead = self._dead_gids(snapshot_ts, extra_deletes)
+        src_segs, src_tombs = self._view_at(snapshot_ts)
+        dead = self._dead_gids(snapshot_ts, extra_deletes, src_tombs)
         have_dead = len(dead) > 0
         if have_dead:
             # tombstones as a compressed bitmap built ONCE per scan: a
@@ -424,7 +476,7 @@ class MVCCTable:
             # np.isin sort (reference: cgo/croaring.c docfilter role)
             from matrixone_tpu import native
             dead_filter = native.RoaringBitmap(dead)
-        segs = [s for s in self.segments
+        segs = [s for s in src_segs
                 if snapshot_ts is None or s.commit_ts <= snapshot_ts]
         segs = segs + list(extra_segments or [])
         qmap = dict(zip(qualified_names or columns, columns))
@@ -499,30 +551,41 @@ class MVCCTable:
         gids = np.asarray(gids, np.int64)
         if len(gids) == 0:
             return gids
-        bases = np.array([s.base_gid for s in self.segments], np.int64)
-        seg_ts = np.array([s.commit_ts for s in self.segments], np.int64)
+        src_segs, src_tombs = self._view_at(snapshot_ts)
+        bases = np.array([s.base_gid for s in src_segs], np.int64)
+        seg_ts = np.array([s.commit_ts for s in src_segs], np.int64)
         si = np.searchsorted(bases, gids, side="right") - 1
         ok = si >= 0
         if snapshot_ts is not None:
             ok = ok & (seg_ts[np.clip(si, 0, None)] <= snapshot_ts)
-        dead = self._dead_gids(snapshot_ts, extra_deletes)
+        dead = self._dead_gids(snapshot_ts, extra_deletes, src_tombs)
         if len(dead):
             ok = ok & ~np.isin(gids, dead)
         return gids[ok]
 
     def fetch_rows(self, gids: np.ndarray, columns: List[str]):
-        """Host gather of rows by global id (vector-index result fetch).
-        Returns (arrays, validity) in gid order."""
+        """Host gather of rows by global id (vector-index result fetch,
+        delta-replay delete decode).  Returns (arrays, validity) in gid
+        order.  Gids a merge compacted out of the live list resolve
+        through the snapshot fences (gid ranges are never reused)."""
         gids = np.asarray(gids, np.int64)
         bases = np.array([s.base_gid for s in self.segments], np.int64)
+        seg_idx = np.searchsorted(bases, gids, side="right") - 1
+        owners: List[Segment] = []
+        for gi, si in zip(gids, seg_idx):
+            seg = self.segments[si] if si >= 0 else None
+            if seg is None or gi >= seg.base_gid + seg.n_rows:
+                seg = self._gid_fence_segment(int(gi))
+            if seg is None:
+                raise KeyError(f"gid {int(gi)} not found in "
+                               f"{self.meta.name!r} (live or fenced)")
+            owners.append(seg)
         arrays = {c: [] for c in columns}
         validity = {c: [] for c in columns}
-        seg_idx = np.searchsorted(bases, gids, side="right") - 1
         for c in columns:
             dtype = dict(self.meta.schema)[c]
             parts_a, parts_v = [], []
-            for gi, si in zip(gids, seg_idx):
-                seg = self.segments[si]
+            for gi, seg in zip(gids, owners):
                 off = int(gi - seg.base_gid)
                 parts_a.append(seg.arrays[c][off])
                 parts_v.append(seg.validity[c][off])
@@ -742,6 +805,15 @@ class Engine:
         self.index_cache = IndexCache()   # budgeted device-index residency
         self.active_txns = 0           # open explicit txns (merge guard)
         self._pending_merge_records: Dict[str, int] = {}   # name -> merge ts
+        #: serializes merge_table's capture->rewrite->swap pipeline (one
+        #: merge in flight per engine; commits never take it, so there is
+        #: no ordering edge with the commit lock)
+        self._merge_lock = san.lock("Engine._merge_lock")
+        #: delta-consumer watermark registry (merge_sched GC): consumer
+        #: key -> (table, pull-callable returning its watermark ts or
+        #: None).  A fence stays pinned while any registered consumer of
+        #: its table sits below the merge point.
+        self._watermarks: Dict[str, Tuple[str, Callable]] = {}
         #: materialized-view maintenance (matrixone_tpu/mview): flag set
         #: when a system_mview catalog table appears; the service spins
         #: up lazily on the first commit after that
@@ -996,6 +1068,37 @@ class Engine:
     def unsubscribe(self, fn: Callable) -> None:
         self._subscribers = [f for f in self._subscribers if f is not fn]
 
+    # -------------------------------------- delta-consumer watermarks
+    def register_watermark(self, key: str, table: str,
+                           fn: Callable) -> None:
+        """Register a delta consumer (CDC task, dynamic-table runtime):
+        `fn()` returns the consumer's replay watermark ts (or None while
+        unseeded).  gc_fences keeps a table's snapshot fences pinned
+        while any registered consumer sits below them, so the consumer
+        catches up from cdc.delta_events exactly-once instead of
+        rebuilding after a compaction."""
+        with self._commit_lock:
+            self._watermarks[key] = (table, fn)
+
+    def unregister_watermark(self, key: str) -> None:
+        with self._commit_lock:
+            self._watermarks.pop(key, None)
+
+    def min_watermark(self, table: str) -> Optional[int]:
+        """Lowest registered consumer watermark on `table`; None when no
+        consumer constrains it (fences release on snapshots alone)."""
+        vals = []
+        for tbl, fn in list(self._watermarks.values()):
+            if tbl != table:
+                continue
+            try:
+                v = fn()
+            except Exception:   # noqa: BLE001 — a dead consumer must
+                v = None        # not wedge GC; treat as unconstrained
+            if v is not None:
+                vals.append(int(v))
+        return min(vals) if vals else None
+
     # ------------------------------------------------------------ commit
     def commit_write(self, table: str, arrays, validity) -> int:
         """Autocommit a single-table insert."""
@@ -1164,59 +1267,121 @@ class Engine:
     def merge_table(self, name: str, min_segments: int = 2,
                     checkpoint: bool = True) -> int:
         """Background merge (reference: tae/db/merge scheduler): rewrite a
-        table's visible rows into ONE segment and tombstone nothing —
-        dead rows are physically dropped, history before the merge is
-        compacted away (like the reference's merged objects; time travel
-        to pre-merge snapshots of THIS table is truncated, same as TAE
-        after merge+GC). Returns the number of live rows kept."""
+        table's visible rows into ONE segment (per partition), snapshot-
+        FENCING the pre-merge view so AS OF reads and delta consumers
+        below the merge stay exact (the fence is released by gc_fences
+        once nothing can reach it).
+
+        Three phases so foreground commits are never wedged:
+          capture (brief commit lock: pin the segment/tombstone prefix)
+          -> rewrite (NO lock: concat live rows, write the merged object
+          durable — captured segments are immutable, commits proceed)
+          -> swap (brief commit lock: publish merged segment + fence).
+
+        Returns live rows kept, or -1 (too few segments), -2 (open txns
+        — their workspaces hold pre-merge gids), -3 (lost the race: a
+        concurrent commit deleted a captured row or replaced the table —
+        the rewrite is stale; callers retry, foreground always wins)."""
+        from matrixone_tpu.utils.fault import INJECTOR
+        with self._merge_lock:
+            return self._merge_table_locked(name, min_segments,
+                                            checkpoint, INJECTOR)
+
+    def _merge_table_locked(self, name, min_segments, checkpoint,
+                            INJECTOR) -> int:
+        import time as _time
+        from matrixone_tpu.utils import metrics as M
+        # --- capture (brief lock): pin the prefix the rewrite covers
         with self._commit_lock:
             if self.active_txns > 0:
-                # open snapshots would see pre-merge gids/timestamps that
-                # the merge destroys — defer (the background task retries)
                 return -2
             t = self.get_table(name)
             if len(t.segments) < min_segments:
                 return -1
-            cols = [c for c, _ in t.meta.schema]
-            parts_a = {c: [] for c in cols}
-            parts_v = {c: [] for c in cols}
-            dead = t._dead_gids(None, None)
-            dead_filter = None
-            if len(dead):
-                from matrixone_tpu import native
-                dead_filter = native.RoaringBitmap(dead)
-            kept = 0
-            for seg in t.segments:
-                keep = ~dead_filter.test_range(
-                    seg.base_gid, seg.base_gid + seg.n_rows) \
-                    if dead_filter is not None else np.ones(
-                        seg.n_rows, np.bool_)
-                if not keep.any():
-                    continue
-                for c in cols:
-                    parts_a[c].append(seg.arrays[c][keep])
-                    parts_v[c].append(seg.validity[c][keep])
-                kept += int(keep.sum())
+            cap_segs = list(t.segments)
+            cap_tombs = list(t.tombstones)
+            cap_gid = t.next_gid
+        # --- rewrite (no lock): captured segments/tombstones are
+        # immutable once committed; concurrent commits only APPEND
+        t0 = _time.perf_counter()
+        if INJECTOR.trigger("merge.rewrite"):
+            raise RuntimeError("injected fault: merge.rewrite")
+        cols = [c for c, _ in t.meta.schema]
+        parts_a = {c: [] for c in cols}
+        parts_v = {c: [] for c in cols}
+        dead = t._dead_gids(None, None, cap_tombs)
+        dead_filter = None
+        if len(dead):
+            from matrixone_tpu import native
+            dead_filter = native.RoaringBitmap(dead)
+        kept = 0
+        for seg in cap_segs:
+            keep = ~dead_filter.test_range(
+                seg.base_gid, seg.base_gid + seg.n_rows) \
+                if dead_filter is not None else np.ones(
+                    seg.n_rows, np.bool_)
+            if not keep.any():
+                continue
+            for c in cols:
+                parts_a[c].append(np.asarray(seg.arrays[c])[keep])
+                parts_v[c].append(np.asarray(seg.validity[c])[keep])
+            kept += int(keep.sum())
+        arrays = validity = None
+        obj_path = zms_json = None
+        if kept:
+            arrays = {c: np.concatenate(parts_a[c]) for c in cols}
+            validity = {c: np.concatenate(parts_v[c]) for c in cols}
+            if t.meta.partition is None:
+                # write the merged object BEFORE the swap publishes it:
+                # the heavy IO runs outside the commit lock, and crash
+                # ordering gets a real decision point (rewrite durable
+                # -> swap -> manifest).  Partitioned tables re-split at
+                # swap and stay RAM until the next checkpoint.
+                obj_path, zms_json = self._merge_write_object(
+                    name, arrays, validity)
+        M.merge_seconds.inc(_time.perf_counter() - t0, phase="rewrite")
+        # --- swap (brief lock): publish merged segment + fence history
+        t0 = _time.perf_counter()
+        if INJECTOR.trigger("merge.swap"):
+            raise RuntimeError("injected fault: merge.swap")
+        with self._commit_lock:
+            if self.tables.get(name) is not t:
+                return -3          # dropped/replaced during the rewrite
+            if self.active_txns > 0:
+                return -2
+            if len(t.segments) < len(cap_segs) or any(
+                    a is not b for a, b in zip(t.segments, cap_segs)):
+                return -3          # prefix rewritten under us (restore)
+            new_tombs = t.tombstones[len(cap_tombs):]
+            if any(len(g) and int(g.min()) < cap_gid
+                   for _, g in new_tombs):
+                # a concurrent commit deleted a row the rewrite kept as
+                # live — stale rewrite; defer (the scheduler retries)
+                return -3
             merge_ts = self.hlc.now()
-            old_paths = [s.obj_path for s in t.segments
-                         if s.obj_path is not None]
+            # the fence pins the COMPLETE pre-swap view: captured
+            # segments plus any committed during the rewrite (those stay
+            # live too — windowed delta replay emits them exactly once
+            # from whichever side covers their commit_ts)
+            fence = MergeFence(merge_ts=merge_ts,
+                               segments=list(t.segments),
+                               tombstones=list(t.tombstones))
+            post = t.segments[len(cap_segs):]
+            san.mutating(t)
+            t.segments = list(post)
+            t.tombstones = list(new_tombs)
             if kept:
-                arrays = {c: np.concatenate(parts_a[c]) for c in cols}
-                validity = {c: np.concatenate(parts_v[c]) for c in cols}
-                # partitioned tables re-split so the merged layout keeps
-                # one-partition-per-segment (structural pruning invariant)
-                t.segments = []
-                t.insert_segments(arrays, validity, merge_ts)
-            else:
-                t.segments = []
-            if old_paths:
-                # pre-merge objects are dead to THIS engine: free their
-                # block-cache budget (the object files stay until GC —
-                # a replica may still be lazily reading them mid-resync)
-                from matrixone_tpu.storage import blockcache
-                for p in old_paths:
-                    blockcache.CACHE.drop_path(p)
-            t.tombstones = []
+                if t.meta.partition is None:
+                    seg = t.make_segment(arrays, validity, merge_ts)
+                    seg.obj_path = obj_path
+                    seg.zonemaps = zms_json
+                    t.apply_segment(seg)
+                else:
+                    # partitioned tables re-split so the merged layout
+                    # keeps one-partition-per-segment (structural
+                    # pruning invariant)
+                    t.insert_segments(arrays, validity, merge_ts)
+            t.fences.append(fence)
             t.last_commit_ts = max(t.last_commit_ts, merge_ts)
             t.last_merge_ts = merge_ts
             t._pk_bloom = None     # rebuilt lazily over the merged rows
@@ -1231,10 +1396,90 @@ class Engine:
             # records at that later checkpoint — same ordering guarantee.
             self._pending_merge_records[name] = merge_ts
             # durability: the merged state IS the new truth — checkpoint
-            # so replay never resurrects pre-merge rows
+            # so replay never resurrects pre-merge rows (the fence rides
+            # the manifest, so pre-merge history stays reachable)
             if checkpoint:
                 self._checkpoint_locked()
-            return kept
+        M.merge_seconds.inc(_time.perf_counter() - t0, phase="swap")
+        M.merge_rows.inc(kept)
+        M.merge_segments.inc(len(cap_segs))
+        return kept
+
+    def _merge_write_object(self, name: str, arrays, validity):
+        """Write the merged rows as a durable object before the swap
+        references them (plant hook: tools/mocrash monkeypatches this to
+        re-introduce the swap-before-rewrite-durable ordering bug)."""
+        zms = objectio.compute_zonemaps(arrays, validity)
+        n = len(next(iter(arrays.values())))
+        meta = objectio.ObjectMeta(
+            table=name, object_id=f"merge{self.hlc.now()}",
+            n_rows=n, commit_ts=0, zonemaps=zms)
+        path = objectio.write_object(self.fs, meta, arrays, validity)
+        return path, {c: [z.min, z.max, z.null_count]
+                      for c, z in zms.items()}
+
+    #: plant hook (tools/mocrash/plants.py): re-introduce the GC-before-
+    #: fence-release ordering bug — old objects deleted BEFORE the
+    #: fence-free manifest is durable, so a crash in between leaves a
+    #: manifest referencing vanished files
+    GC_DELETE_BEFORE_FENCE_RELEASE = False
+
+    def gc_fences(self, tables: Optional[List[str]] = None) -> dict:
+        """Release snapshot fences nothing can reach: a fence is held
+        while any named snapshot or registered consumer watermark of its
+        table sits below its merge point; releases go oldest-first so
+        the delta floor stays monotone.  Crash ordering: the fence-free
+        manifest is made durable FIRST, old object files deleted only
+        after — a crash in between leaves unreferenced files (a harmless
+        leak), never a reachable-but-deleted object."""
+        from matrixone_tpu.utils import metrics as M
+        released: List[Tuple[str, MergeFence]] = []
+        with self._commit_lock:
+            names = list(self.tables) if tables is None else tables
+            for name in names:
+                t = self.tables.get(name)
+                if t is None or not t.fences:
+                    continue
+                wm = self.min_watermark(name)
+                while t.fences:
+                    f = t.fences[0]
+                    if any(ts < f.merge_ts
+                           for ts in self.snapshots.values()):
+                        break          # snapshot-pinned
+                    if wm is not None and wm < f.merge_ts:
+                        break          # a consumer still replays below
+                    t.fences.pop(0)
+                    t.delta_floor = max(t.delta_floor, f.merge_ts)
+                    released.append((name, f))
+            if not released:
+                return {"released": 0, "objects_deleted": 0}
+            # paths still referenced by live segments or surviving
+            # fences (post-capture segments are shared) must survive
+            live_paths = {s.obj_path for t2 in self.tables.values()
+                          for s in t2.segments}
+            live_paths |= {s.obj_path for t2 in self.tables.values()
+                           for f2 in t2.fences for s in f2.segments}
+            dead_paths = sorted(
+                {s.obj_path for _, f in released for s in f.segments
+                 if s.obj_path is not None} - live_paths)
+            if Engine.GC_DELETE_BEFORE_FENCE_RELEASE:
+                for p in dead_paths:     # planted bug: delete-first
+                    if self.fs.exists(p):
+                        self.fs.delete(p)
+            if self.fs.exists("meta/manifest.json") or \
+                    self._pending_merge_records:
+                self._checkpoint_locked()
+        from matrixone_tpu.storage import blockcache
+        n_del = 0
+        for p in dead_paths:
+            blockcache.CACHE.drop_path(p)
+            if not Engine.GC_DELETE_BEFORE_FENCE_RELEASE \
+                    and self.fs.exists(p):
+                self.fs.delete(p)
+                n_del += 1
+        M.merge_fences_released.inc(len(released))
+        M.merge_gc_objects.inc(n_del)
+        return {"released": len(released), "objects_deleted": n_del}
 
     # ------------------------------------------------- checkpoint / open
     def checkpoint(self, demote: Optional[bool] = None) -> None:
@@ -1299,6 +1544,36 @@ class Engine:
                              "part_id": seg.part_id,
                              "n_rows": seg.n_rows,
                              "zonemaps": seg.zonemaps})
+            # snapshot fences ride the manifest: pre-merge history stays
+            # reachable across restart until gc_fences releases it.
+            # Segments shared with the live list (committed during a
+            # rewrite) reuse the object just written above; RAM-only
+            # fenced segments get their object here, exactly once.
+            fences = []
+            for f in t.fences:
+                fobjs = []
+                for seg in f.segments:
+                    if seg.obj_path is None:
+                        zms = objectio.compute_zonemaps(seg.arrays,
+                                                        seg.validity)
+                        ometa = objectio.ObjectMeta(
+                            table=name, object_id=f"seg{seg.seg_id}",
+                            n_rows=seg.n_rows, commit_ts=seg.commit_ts,
+                            zonemaps=zms)
+                        seg.obj_path = objectio.write_object(
+                            self.fs, ometa, seg.arrays, seg.validity)
+                        seg.zonemaps = {c: [z.min, z.max, z.null_count]
+                                        for c, z in zms.items()}
+                    fobjs.append({"path": seg.obj_path,
+                                  "seg_id": seg.seg_id,
+                                  "base_gid": seg.base_gid,
+                                  "commit_ts": seg.commit_ts,
+                                  "part_id": seg.part_id,
+                                  "n_rows": seg.n_rows,
+                                  "zonemaps": seg.zonemaps})
+                fences.append({"merge_ts": f.merge_ts, "objects": fobjs,
+                               "tombstones": [[ts, g.tolist()]
+                                              for ts, g in f.tombstones]})
             manifest["tables"][name] = {
                 "schema": schema_to_json(t.meta.schema),
                 "pk": t.meta.primary_key,
@@ -1311,6 +1586,8 @@ class Engine:
                 "next_auto": t.next_auto,
                 "partition": (t.meta.partition.to_json()
                               if t.meta.partition is not None else None),
+                "fences": fences,
+                "delta_floor": t.delta_floor,
             }
         self.fs.write("meta/manifest.json",
                       json.dumps(manifest).encode())
@@ -1461,6 +1738,25 @@ class Engine:
             t.apply_segment(seg)
         t.tombstones = [(ts, np.asarray(g, np.int64))
                         for ts, g in tm["tombstones"]]
+        # snapshot fences: pre-merge history loads lazily (object-backed
+        # through the block cache) so holding history costs no RAM
+        from matrixone_tpu.storage import blockcache as _bc
+        for fj in tm.get("fences", []):
+            fsegs = []
+            for ob in fj["objects"]:
+                arrays, validity = _bc.lazy_pair(self.fs, ob["path"],
+                                                 cols)
+                fsegs.append(Segment(
+                    seg_id=ob["seg_id"], commit_ts=ob["commit_ts"],
+                    arrays=arrays, validity=validity,
+                    n_rows=ob["n_rows"], base_gid=ob["base_gid"],
+                    part_id=ob.get("part_id", -1),
+                    obj_path=ob["path"], zonemaps=ob.get("zonemaps")))
+            t.fences.append(MergeFence(
+                merge_ts=fj["merge_ts"], segments=fsegs,
+                tombstones=[(ts, np.asarray(g, np.int64))
+                            for ts, g in fj["tombstones"]]))
+        t.delta_floor = tm.get("delta_floor", 0)
         t.next_gid = tm["next_gid"]
         t.next_seg = tm["next_seg"]
         # incrservice state: older manifests predate the field —

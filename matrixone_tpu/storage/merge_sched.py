@@ -1,0 +1,299 @@
+"""Background compaction / checkpoint / GC scheduler (reference:
+tae/db/merge + tae/db/checkpoint runners behind taskservice — the TN-side
+pipeline that keeps weeks of heavy write traffic from degrading reads).
+
+One MergeScheduler per engine picks work from a policy each cycle:
+
+  * small-segment compaction — a table whose live segment count reached
+    MO_MERGE_MIN_SEGMENTS is rewritten into one segment (per partition)
+    by Engine.merge_table's capture -> off-lock rewrite -> brief-lock
+    swap pipeline, so foreground commits are never wedged;
+  * tombstone-ratio rewrite — a table whose dead/live row ratio passed
+    MO_MERGE_TOMBSTONE_RATIO is compacted even below the segment floor
+    (read-amplification from tombstone filtering, not segment count);
+  * delta-aware object GC — Engine.gc_fences releases merge fences no
+    named snapshot or registered consumer watermark (CDC task, dynamic
+    table) can still reach, then deletes the unreferenced pre-merge
+    object files (fence-free manifest durable FIRST — the ordering the
+    mocrash merge scenario sweeps);
+  * checkpoint cadence — a checkpoint lands after any cycle that merged
+    or released, and at least every MO_MERGE_CKPT_CYCLES idle cycles
+    while WAL frames accumulate.
+
+Pacing and isolation: a cycle defers whole when explicit transactions
+are open (their workspaces hold pre-merge gids; merge_table would defer
+anyway), deferred/raced merges (-2/-3) retry next cycle, and a FAILING
+merge retries with PR-2 jittered exponential backoff (cluster/rpc
+backoff_delay) without ever poisoning the engine — every outcome is
+accounted in mo_merge_tasks_total.
+
+Wiring: `scheduler_for(engine)` returns the per-engine singleton (not
+started); `maybe_start(engine)` starts the thread when MO_MERGE_SCHED=1
+(embedded/server startup); TaskService ships a `merge_cycle` executor so
+a cron task can drive cycles without a dedicated thread; and
+`mo_ctl('merge','status|run|pause|resume')` operates it from SQL.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from matrixone_tpu.utils import san
+
+#: attempts beyond which a failing table's backoff stops growing
+_MAX_BACKOFF_ATTEMPTS = 8
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+class MergeScheduler:
+    """Policy-driven background merge/checkpoint/GC loop for one engine.
+
+    Thread-light: all state behind one small lock, the actual storage
+    work runs through Engine.merge_table / gc_fences / checkpoint which
+    carry their own locking — run_cycle is safe to call from the loop
+    thread, a TaskService runner, or mo_ctl('merge','run') alike (the
+    engine's merge lock serializes overlapping callers)."""
+
+    def __init__(self, engine, interval_s: Optional[float] = None):
+        self.engine = engine
+        self.interval_s = (_env_float("MO_MERGE_INTERVAL_MS", 500.0)
+                           / 1000.0) if interval_s is None else interval_s
+        self.min_segments = _env_int("MO_MERGE_MIN_SEGMENTS", 4)
+        self.tombstone_ratio = _env_float("MO_MERGE_TOMBSTONE_RATIO", 0.2)
+        self.ckpt_cycles = _env_int("MO_MERGE_CKPT_CYCLES", 8)
+        self._lock = san.lock("MergeScheduler._lock")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._paused = False
+        self.cycles = 0
+        self._cycles_since_ckpt = 0
+        #: per-table consecutive merge FAILURES (exceptions, not defers)
+        self._fails: Dict[str, int] = {}
+        #: per-table earliest retry (monotonic clock) after a failure
+        self._next_try: Dict[str, float] = {}
+        self._last_errors: Dict[str, str] = {}
+        self.last_cycle: dict = {}
+
+    # ------------------------------------------------------------ policy
+    def candidates(self) -> List[dict]:
+        """Tables the policy wants compacted this cycle, with reasons.
+        Reads table shapes without the commit lock — counts may be a
+        commit stale, which only mis-times (never mis-applies) a merge."""
+        out = []
+        for name in list(self.engine.tables):
+            t = self.engine.tables.get(name)
+            if t is None or name.startswith("system_") \
+                    or getattr(t, "is_external", False):
+                continue
+            n_segs = len(t.segments)
+            if n_segs < 2:
+                continue
+            dead = sum(len(g) for _, g in t.tombstones)
+            total = sum(s.n_rows for s in t.segments)
+            ratio = dead / total if total else 0.0
+            if n_segs >= self.min_segments:
+                out.append({"table": name, "reason": "segments",
+                            "segments": n_segs, "dead_ratio": ratio})
+            elif dead and ratio >= self.tombstone_ratio:
+                out.append({"table": name, "reason": "tombstones",
+                            "segments": n_segs, "dead_ratio": ratio})
+        return out
+
+    # ------------------------------------------------------------- cycle
+    def run_cycle(self) -> dict:
+        """One scheduler pass: pick -> merge -> fence GC -> checkpoint.
+        Never raises — every failure is isolated into the summary and
+        the metrics, and a failing table backs off exponentially."""
+        from matrixone_tpu.cluster.rpc import backoff_delay
+        from matrixone_tpu.utils import metrics as M
+        summary = {"merged": [], "deferred": [], "skipped": [],
+                   "failed": [], "gc": None, "checkpoint": False}
+        eng = self.engine
+        if eng.active_txns > 0:
+            # admission pacing: open txn workspaces hold pre-merge gids;
+            # merge_table would defer each table anyway — defer the
+            # whole cycle cheaply and retry next tick
+            M.merge_tasks.inc(kind="compact", outcome="deferred")
+            summary["deferred"].append("*active-txns*")
+            self._finish_cycle(summary)
+            return summary
+        now = time.monotonic()
+        for cand in self.candidates():
+            name = cand["table"]
+            if self._next_try.get(name, 0.0) > now:
+                summary["skipped"].append(name)   # still backing off
+                continue
+            try:
+                kept = eng.merge_table(name, min_segments=2,
+                                       checkpoint=False)
+            except Exception as e:   # noqa: BLE001 — task isolation: a
+                # broken merge must never poison the engine or the loop;
+                # it retries with jittered exponential backoff
+                fails = self._fails.get(name, 0) + 1
+                self._fails[name] = fails
+                self._next_try[name] = now + backoff_delay(
+                    min(fails, _MAX_BACKOFF_ATTEMPTS))
+                self._last_errors[name] = f"{type(e).__name__}: {e}"[:256]
+                M.merge_tasks.inc(kind="compact", outcome="failed")
+                summary["failed"].append(
+                    {"table": name, "error": self._last_errors[name],
+                     "attempt": fails})
+                continue
+            if kept >= 0:
+                self._fails.pop(name, None)
+                self._next_try.pop(name, None)
+                self._last_errors.pop(name, None)
+                M.merge_tasks.inc(kind="compact", outcome="ok")
+                summary["merged"].append(
+                    {"table": name, "kept": kept,
+                     "reason": cand["reason"]})
+            elif kept == -1:
+                M.merge_tasks.inc(kind="compact", outcome="noop")
+                summary["skipped"].append(name)
+            else:
+                # -2 open txns / -3 lost a race with a concurrent
+                # delete: foreground won; retry next cycle (no backoff —
+                # defers are the pacing working as designed)
+                M.merge_tasks.inc(kind="compact", outcome="deferred")
+                summary["deferred"].append(name)
+        try:
+            summary["gc"] = eng.gc_fences()
+            M.merge_tasks.inc(kind="gc", outcome="ok")
+        except Exception as e:   # noqa: BLE001 — same isolation rung as
+            # the merge leg: a GC fault surfaces in metrics + status
+            M.merge_tasks.inc(kind="gc", outcome="failed")
+            summary["gc"] = {"error": f"{type(e).__name__}: {e}"[:256]}
+        self._finish_cycle(summary)
+        return summary
+
+    def _finish_cycle(self, summary: dict) -> None:
+        from matrixone_tpu.utils import metrics as M
+        with self._lock:
+            self.cycles += 1
+            self._cycles_since_ckpt += 1
+            need_ckpt = bool(summary["merged"]) or \
+                (summary.get("gc") or {}).get("released", 0) > 0 or \
+                self._cycles_since_ckpt >= max(1, self.ckpt_cycles)
+        if need_ckpt:
+            try:
+                self.engine.checkpoint()
+                M.merge_tasks.inc(kind="checkpoint", outcome="ok")
+                summary["checkpoint"] = True
+                with self._lock:
+                    self._cycles_since_ckpt = 0
+            except Exception as e:   # noqa: BLE001 — isolated like the
+                # merge leg; the WAL keeps everything durable meanwhile
+                M.merge_tasks.inc(kind="checkpoint", outcome="failed")
+                summary["checkpoint"] = f"{type(e).__name__}: {e}"[:256]
+        with self._lock:
+            self.last_cycle = summary
+
+    # ------------------------------------------------------------ thread
+    def start(self) -> "MergeScheduler":
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="mo-merge-sched", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            th, self._thread = self._thread, None
+        if th is not None:
+            th.join(timeout=5)
+
+    def pause(self) -> None:
+        with self._lock:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._lock:
+            self._paused = False
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                paused = self._paused
+            if not paused:
+                self.run_cycle()   # never raises (failure isolation)
+            self._stop.wait(self.interval_s)
+
+    # ------------------------------------------------------------ status
+    def status(self) -> dict:
+        with self._lock:
+            st = {
+                "running": self._thread is not None,
+                "paused": self._paused,
+                "cycles": self.cycles,
+                "interval_ms": int(self.interval_s * 1000),
+                "min_segments": self.min_segments,
+                "tombstone_ratio": self.tombstone_ratio,
+                "ckpt_cycles": self.ckpt_cycles,
+                "backoff": {n: round(t - time.monotonic(), 3)
+                            for n, t in self._next_try.items()
+                            if t > time.monotonic()},
+                "fails": dict(self._fails),
+                "last_errors": dict(self._last_errors),
+                "last_cycle": dict(self.last_cycle),
+            }
+        st["fences"] = {
+            name: {"count": len(t.fences), "delta_floor": t.delta_floor,
+                   "oldest_merge_ts": t.fences[0].merge_ts}
+            for name, t in self.engine.tables.items()
+            if getattr(t, "fences", None)}
+        st["candidates"] = self.candidates()
+        return st
+
+
+# --------------------------------------------------- per-engine singleton
+_LOCK = san.lock("matrixone_tpu.storage.merge_sched._LOCK")
+
+
+def scheduler_for(engine) -> MergeScheduler:
+    """One scheduler per engine (the TN / embedded engine role), created
+    idle — callers decide whether to start() the loop thread or drive
+    run_cycle() themselves (tests, TaskService cron, mo_ctl)."""
+    host = getattr(engine, "_inner", engine)
+    sched = getattr(host, "_merge_scheduler", None)
+    if sched is None:
+        with _LOCK:
+            sched = getattr(host, "_merge_scheduler", None)
+            if sched is None:
+                sched = MergeScheduler(host)
+                host._merge_scheduler = sched
+    return sched
+
+
+def maybe_start(engine) -> Optional[MergeScheduler]:
+    """Start the background loop iff MO_MERGE_SCHED=1 (embedded/server
+    startup hook — tests and default sessions stay thread-free)."""
+    if os.environ.get("MO_MERGE_SCHED") != "1":
+        return None
+    return scheduler_for(engine).start()
+
+
+def merge_cycle_executor(engine, arg: str) -> None:
+    """TaskService executor (`merge_cycle`): one scheduler pass per cron
+    firing — compaction rides the durable task framework instead of a
+    dedicated thread. `arg` is ignored (the policy picks tables)."""
+    scheduler_for(engine).run_cycle()

@@ -56,11 +56,16 @@ def _merge_executor(engine, arg: str):
 class TaskService:
     def __init__(self, engine):
         self.engine = engine
+        from matrixone_tpu.storage import merge_sched
         self.executors: Dict[str, Callable] = {
             "checkpoint": lambda eng, arg: eng.checkpoint(),
             # background LSM merge (tae/db/merge): arg = table name, or
             # empty = every user table with enough segments
             "merge": _merge_executor,
+            # one policy-driven scheduler pass (compact + fence GC +
+            # checkpoint cadence) per cron firing — the taskservice way
+            # to run storage/merge_sched.py without a dedicated thread
+            "merge_cycle": merge_sched.merge_cycle_executor,
         }
         self._tasks: Dict[int, dict] = {}
         self._next_id = 1

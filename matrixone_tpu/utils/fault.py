@@ -28,6 +28,11 @@ tests/test_chaos.py):
   udf.remote         remote UDF offload, before the worker call (arg
                      "drop" = transport loss: the executor falls back
                      to local evaluation)
+  merge.rewrite      background merge, entering the off-lock rewrite
+                     phase (the scheduler isolates the failure and
+                     retries with backoff; foreground commits proceed)
+  merge.swap         background merge, before the brief-lock catalog
+                     swap publishes the merged segment + snapshot fence
 """
 
 from __future__ import annotations

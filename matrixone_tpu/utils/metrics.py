@@ -406,6 +406,38 @@ mview_apply_seconds = REGISTRY.counter(
     "mo_mview_apply_seconds_total",
     "seconds spent in view maintenance by kind (delta/full)")
 
+# ---- CDC delta economy (matrixone_tpu/cdc)
+cdc_events = REGISTRY.counter(
+    "mo_cdc_events_total",
+    "CDC events delivered to sinks by path (live/backfill)")
+cdc_backfills = REGISTRY.counter(
+    "mo_cdc_backfill_total",
+    "CDC backfill/resume runs by outcome (seed: from-scratch replay; "
+    "live: resume with no fence crossed; fenced: exactly-once resume "
+    "across a compaction via its snapshot fence; refused: resume at or "
+    "below the GC'd delta floor — history gone, caller must re-seed)")
+
+# ---- background compaction scheduler (storage/merge_sched.py)
+merge_tasks = REGISTRY.counter(
+    "mo_merge_tasks_total",
+    "merge-scheduler task outcomes by kind (compact/checkpoint/gc) and "
+    "outcome (ok/noop/deferred/failed)")
+merge_rows = REGISTRY.counter(
+    "mo_merge_rows_total", "live rows rewritten into merged segments")
+merge_segments = REGISTRY.counter(
+    "mo_merge_segments_total", "pre-merge segments compacted by merges")
+merge_seconds = REGISTRY.counter(
+    "mo_merge_seconds_total",
+    "merge wall seconds by phase (rewrite: off-lock concat + object "
+    "write; swap: under-lock catalog publish)")
+merge_fences_released = REGISTRY.counter(
+    "mo_merge_fences_released_total",
+    "snapshot fences released by delta-aware GC (nothing below the "
+    "merge point could still reach them)")
+merge_gc_objects = REGISTRY.counter(
+    "mo_merge_gc_objects_total",
+    "pre-merge object files deleted by fence GC")
+
 # ---- differential query-equivalence analyzer (utils/qa.py, tools/moqa)
 qa_queries = REGISTRY.counter(
     "mo_qa_queries_total",

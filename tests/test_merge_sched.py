@@ -1,0 +1,403 @@
+"""Background compaction scheduler (storage/merge_sched) + the snapshot
+fences merges publish (reference: tae/db/merge behind taskservice):
+AS OF reads stay bit-identical across a background merge, fenced delta
+consumers catch up exactly-once, delta-aware GC holds objects while any
+snapshot or watermark can reach them, and injected merge faults are
+isolated with backoff while foreground traffic proceeds."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from matrixone_tpu.cdc import CdcTask, SQLSink
+from matrixone_tpu.frontend import Session
+from matrixone_tpu.storage.engine import Engine
+from matrixone_tpu.storage.fileservice import MemoryFS
+from matrixone_tpu.storage.merge_sched import (MergeScheduler,
+                                               maybe_start,
+                                               merge_cycle_executor,
+                                               scheduler_for)
+from matrixone_tpu.utils import metrics as M
+from matrixone_tpu.utils.fault import INJECTOR
+
+
+def _rows(s, sql):
+    return s.execute(sql).rows()
+
+
+# ============================================ AS OF across the merge swap
+def test_as_of_reads_bit_identical_across_merge_and_restart():
+    """The merge fence serves the pre-merge view: a named snapshot reads
+    the same rows before the merge, after it, and after a restart that
+    reloads the fence from the manifest."""
+    fs = MemoryFS()
+    s = Session(catalog=Engine(fs))
+    s.execute("create table t (id bigint, v varchar(8))")
+    s.execute("insert into t values (1, 'a'), (2, 'b')")
+    s.execute("create snapshot s1")
+    s.execute("insert into t values (3, 'c')")
+    s.execute("delete from t where id = 1")
+    q = "select id, v from t as of snapshot 's1' order by id"
+    before = _rows(s, q)
+    assert before == [(1, "a"), (2, "b")]
+    cur = _rows(s, "select id, v from t order by id")
+    assert s.catalog.merge_table("t", min_segments=1,
+                                 checkpoint=False) == 2
+    assert _rows(s, q) == before
+    assert _rows(s, "select id, v from t order by id") == cur
+    # the fence rides the manifest: restart and read AS OF again
+    s.catalog.checkpoint()
+    s2 = Session(catalog=Engine.open(fs))
+    assert _rows(s2, q) == before
+    assert _rows(s2, "select id, v from t order by id") == cur
+    assert s2.catalog.tables["t"].fences
+
+
+def test_as_of_read_during_merge_swap_window():
+    """A reader racing the merge sees either side consistently: with the
+    merge parked right before its swap (wait fault), current and AS OF
+    reads return exactly the pre-swap rows; after release, the same."""
+    eng = Engine()
+    s = Session(catalog=eng)
+    s.execute("create table t (id bigint)")
+    s.execute("insert into t values (1)")
+    s.execute("insert into t values (2)")
+    s.execute("create snapshot pin")
+    s.execute("insert into t values (3)")
+    INJECTOR.add("merge.swap", "wait", "10", times=1)
+    try:
+        res = []
+        th = threading.Thread(
+            target=lambda: res.append(
+                eng.merge_table("t", min_segments=1, checkpoint=False)))
+        th.start()
+        deadline = time.monotonic() + 5
+        while INJECTOR.status().get("merge.swap", (0, 0, 0))[2] == 0:
+            assert time.monotonic() < deadline, "merge never reached swap"
+            time.sleep(0.005)
+        # merge parked pre-swap: both views still served from live state
+        assert _rows(s, "select id from t order by id") == \
+            [(1,), (2,), (3,)]
+        assert _rows(s, "select id from t as of snapshot 'pin' "
+                        "order by id") == [(1,), (2,)]
+        INJECTOR.notify("merge.swap")
+        th.join(timeout=10)
+        assert res == [3]
+    finally:
+        INJECTOR.clear()
+    # post-swap: identical answers through the fence
+    assert _rows(s, "select id from t order by id") == [(1,), (2,), (3,)]
+    assert _rows(s, "select id from t as of snapshot 'pin' "
+                    "order by id") == [(1,), (2,)]
+
+
+# ================================================= delta-aware object GC
+def test_gc_holds_fence_objects_until_snapshot_drops():
+    """A fence (and the pre-merge object files it references) survives
+    gc_fences while a named snapshot sits below the merge; dropping the
+    snapshot releases the fence and deletes the unreachable objects."""
+    fs = MemoryFS()
+    eng = Engine(fs)
+    s = Session(catalog=eng)
+    s.execute("create table t (id bigint, v bigint)")
+    s.execute("insert into t values (1, 10), (2, 20)")
+    s.execute("insert into t values (3, 30)")
+    eng.checkpoint()           # pre-merge segments become object-backed
+    old_paths = [seg.obj_path for seg in eng.tables["t"].segments]
+    assert all(p is not None for p in old_paths)
+    s.execute("create snapshot pin")
+    s.execute("delete from t where id = 2")
+    assert eng.merge_table("t", min_segments=1, checkpoint=True) == 2
+    assert len(eng.tables["t"].fences) == 1
+    g0 = M.merge_gc_objects.get()
+    assert eng.gc_fences() == {"released": 0, "objects_deleted": 0}
+    assert eng.tables["t"].fences          # snapshot-pinned
+    assert all(fs.exists(p) for p in old_paths)
+    # AS OF still reads the pre-merge objects through the fence
+    assert _rows(s, "select id from t as of snapshot 'pin' "
+                    "order by id") == [(1,), (2,), (3,)]
+    eng.drop_snapshot("pin")
+    gc = eng.gc_fences()
+    assert gc["released"] == 1 and gc["objects_deleted"] >= 1
+    assert not eng.tables["t"].fences
+    assert eng.tables["t"].delta_floor > 0
+    assert M.merge_gc_objects.get() == g0 + gc["objects_deleted"]
+    assert not any(fs.exists(p) for p in old_paths)
+    assert _rows(s, "select id, v from t order by id") == \
+        [(1, 10), (3, 30)]
+    # and the released state survives a restart
+    s2 = Session(catalog=Engine.open(fs))
+    assert _rows(s2, "select id, v from t order by id") == \
+        [(1, 10), (3, 30)]
+
+
+def test_gc_holds_fence_for_registered_consumer_watermark():
+    """A registered delta-consumer watermark below the merge pins the
+    fence exactly like a snapshot; once the consumer catches up (or
+    unregisters) the fence releases."""
+    eng = Engine()
+    s = Session(catalog=eng)
+    s.execute("create table t (id bigint)")
+    s.execute("insert into t values (1)")
+    wm = {"ts": 1}
+    eng.register_watermark("test:consumer", "t", lambda: wm["ts"])
+    s.execute("insert into t values (2)")
+    assert eng.merge_table("t", min_segments=1, checkpoint=False) == 2
+    assert eng.gc_fences()["released"] == 0      # consumer below merge
+    assert eng.min_watermark("t") == 1
+    wm["ts"] = eng.committed_ts                  # consumer caught up
+    assert eng.gc_fences()["released"] == 1
+    eng.unregister_watermark("test:consumer")
+    assert eng.min_watermark("t") is None
+
+
+# =============================================== the delta economy rides
+def test_incremental_mview_stays_incremental_across_merge():
+    """An eagerly-maintained materialized view never rebuilds because a
+    background merge compacted its source: maintenance is exact across
+    the swap (mo_mview init tier untouched)."""
+    eng = Engine()
+    s = Session(catalog=eng)
+    s.execute("create table li (k varchar(4), v bigint)")
+    s.execute("insert into li values ('a', 1), ('b', 2)")
+    s.execute("create materialized view mv as select k, sum(v) sv, "
+              "count(*) c from li group by k")
+    i0 = M.mview_apply.get(tier="init")
+    s.execute("insert into li values ('a', 10)")
+    sched = MergeScheduler(eng)
+    sched.min_segments = 2
+    summary = sched.run_cycle()
+    assert any(m["table"] == "li" for m in summary["merged"])
+    s.execute("insert into li values ('b', 20), ('c', 5)")
+    s.execute("delete from li where k = 'a'")
+    assert sorted(_rows(s, "select k, sv, c from mv")) == sorted(
+        _rows(s, "select k, sum(v), count(*) from li group by k"))
+    assert M.mview_apply.get(tier="init") == i0
+
+
+def test_cdc_mirror_catches_up_across_scheduler_merge():
+    """A CDC mirror whose task is LIVE (registered watermark) across a
+    scheduler cycle: the merge fences below the watermark, the mirror
+    converges exactly-once, and GC waits for the watermark."""
+    src, dst = Session(), Session()
+    src.execute("create table m (id bigint primary key, v bigint)")
+    dst.execute("create table m (id bigint primary key, v bigint)")
+    task = CdcTask(src.catalog, "m", SQLSink(dst)).start()
+    src.execute("insert into m values (1, 10), (2, 20)")
+    task.stop()                       # watermark registration dropped
+    wm = task.watermark
+    src.execute("delete from m where id = 1")
+    src.execute("insert into m values (3, 30)")
+    task2 = CdcTask(src.catalog, "m", SQLSink(dst), from_ts=wm)
+    task2.start()          # registered watermark = wm pins the fence
+    try:
+        sched = MergeScheduler(src.catalog)
+        sched.min_segments = 2
+        summary = sched.run_cycle()
+        assert any(m["table"] == "m" for m in summary["merged"])
+        # the cycle's GC leg held the fence for the lagging consumer
+        assert src.catalog.tables["m"].fences
+        assert summary["gc"]["released"] == 0
+        f0 = M.cdc_backfills.get(outcome="fenced")
+        task2.backfill()              # fenced catch-up, not a re-seed
+        assert M.cdc_backfills.get(outcome="fenced") == f0 + 1
+        assert sorted(_rows(dst, "select id, v from m")) == \
+            sorted(_rows(src, "select id, v from m")) == \
+            [(2, 20), (3, 30)]
+        src.execute("insert into m values (4, 40)")
+        assert sorted(_rows(dst, "select id, v from m")) == \
+            [(2, 20), (3, 30), (4, 40)]
+        # consumer caught up: the next GC leg releases the fence
+        assert src.catalog.gc_fences()["released"] == 1
+    finally:
+        task2.stop()
+
+
+# ========================================================= the scheduler
+def test_scheduler_policy_candidates():
+    eng = Engine()
+    s = Session(catalog=eng)
+    s.execute("create table segs (id bigint)")
+    for i in range(4):
+        s.execute(f"insert into segs values ({i})")
+    s.execute("create table tombs (id bigint)")
+    s.execute("insert into tombs values (1), (2), (3), (4)")
+    s.execute("insert into tombs values (5)")
+    s.execute("delete from tombs where id in (1, 2)")
+    s.execute("create table quiet (id bigint)")
+    s.execute("insert into quiet values (1)")
+    sched = MergeScheduler(eng)
+    assert sched.min_segments == 4               # env defaults
+    assert sched.tombstone_ratio == pytest.approx(0.2)
+    cands = {c["table"]: c for c in sched.candidates()}
+    assert cands["segs"]["reason"] == "segments"
+    assert cands["tombs"]["reason"] == "tombstones"
+    assert cands["tombs"]["dead_ratio"] == pytest.approx(0.4)
+    assert "quiet" not in cands
+    assert "system_async_task" not in cands
+
+
+def test_scheduler_isolates_rewrite_fault_and_backs_off():
+    """An injected crash in the off-lock rewrite phase never escapes
+    run_cycle: the failure is accounted, the table backs off with the
+    PR-2 exponential-backoff curve, foreground commits proceed, and the
+    retry succeeds once the fault clears."""
+    eng = Engine()
+    s = Session(catalog=eng)
+    s.execute("create table t (id bigint)")
+    s.execute("insert into t values (1)")
+    s.execute("insert into t values (2)")
+    sched = MergeScheduler(eng)
+    sched.min_segments = 2
+    f0 = M.merge_tasks.get(kind="compact", outcome="failed")
+    ok0 = M.merge_tasks.get(kind="compact", outcome="ok")
+    INJECTOR.add("merge.rewrite", "panic", times=1)
+    try:
+        summary = sched.run_cycle()
+    finally:
+        INJECTOR.clear()
+    assert summary["failed"] == [
+        {"table": "t", "error": "RuntimeError: fault point "
+         "'merge.rewrite' panic", "attempt": 1}]
+    assert M.merge_tasks.get(kind="compact", outcome="failed") == f0 + 1
+    assert sched._next_try["t"] > 0
+    # foreground commit proceeds while the table is backing off
+    s.execute("insert into t values (3)")
+    # still inside the backoff window: the candidate is skipped
+    sched._next_try["t"] = time.monotonic() + 60
+    assert "t" in sched.run_cycle()["skipped"]
+    # window over: the retry merges and clears the failure state
+    sched._next_try["t"] = 0.0
+    summary = sched.run_cycle()
+    assert any(m["table"] == "t" and m["kept"] == 3
+               for m in summary["merged"])
+    assert M.merge_tasks.get(kind="compact", outcome="ok") == ok0 + 1
+    assert "t" not in sched._fails and "t" not in sched._last_errors
+    assert _rows(s, "select id from t order by id") == \
+        [(1,), (2,), (3,)]
+
+
+def test_merge_swap_fault_under_concurrent_writers():
+    """Chaos: kill the merge at the swap decision point while writers
+    hammer the table — no foreground commit ever fails, the scheduler
+    retries, and every acked row is present at the end."""
+    eng = Engine()
+    s = Session(catalog=eng)
+    s.execute("create table t (id bigint)")
+    s.execute("insert into t values (-1)")
+    s.execute("insert into t values (-2)")
+    sched = MergeScheduler(eng)
+    sched.min_segments = 2
+    errors = []
+
+    def writer():
+        ws = Session(catalog=eng)
+        try:
+            for i in range(30):
+                ws.execute(f"insert into t values ({i})")
+                time.sleep(0.001)
+        except Exception as e:   # noqa: BLE001 — the assertion below
+            errors.append(e)     # is exactly "no writer ever fails"
+
+    f0 = M.merge_tasks.get(kind="compact", outcome="failed")
+    INJECTOR.add("merge.swap", "panic", times=1)
+    th = threading.Thread(target=writer)
+    th.start()
+    try:
+        merged = False
+        deadline = time.monotonic() + 20
+        while not merged and time.monotonic() < deadline:
+            sched._next_try.pop("t", None)       # no wall-clock waits
+            merged = bool(sched.run_cycle()["merged"])
+            time.sleep(0.002)
+    finally:
+        th.join()
+        INJECTOR.clear()
+    assert not errors
+    assert merged, "scheduler never recovered from the swap fault"
+    assert M.merge_tasks.get(kind="compact", outcome="failed") == f0 + 1
+    got = sorted(r[0] for r in _rows(s, "select id from t"))
+    assert got == sorted([-1, -2] + list(range(30)))
+
+
+def test_scheduler_thread_lifecycle_pause_and_status(monkeypatch):
+    eng = Engine()
+    s = Session(catalog=eng)
+    s.execute("create table t (id bigint)")
+    s.execute("insert into t values (1)")
+    sched = MergeScheduler(eng, interval_s=0.005)
+    st = sched.status()
+    assert st["running"] is False and st["cycles"] == 0
+    sched.start()
+    try:
+        deadline = time.monotonic() + 5
+        while sched.cycles == 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert sched.cycles > 0
+        assert sched.status()["running"] is True
+        sched.pause()
+        time.sleep(0.02)
+        frozen = sched.cycles
+        time.sleep(0.05)
+        assert sched.cycles == frozen          # paused loop idles
+        sched.resume()
+    finally:
+        sched.stop()
+    assert sched.status()["running"] is False
+    # start() is idempotent per instance; stop() again is a no-op
+    sched.stop()
+    # env-gated autostart: off by default, on under MO_MERGE_SCHED=1
+    assert maybe_start(eng) is None
+    monkeypatch.setenv("MO_MERGE_SCHED", "1")
+    auto = maybe_start(eng)
+    try:
+        assert auto is sched or auto._thread is not None
+        assert scheduler_for(eng) is auto      # per-engine singleton
+    finally:
+        auto.stop()
+
+
+def test_taskservice_merge_cycle_executor():
+    """The durable-cron path: one merge_cycle execution compacts and
+    checkpoints without a dedicated scheduler thread."""
+    eng = Engine(MemoryFS())
+    s = Session(catalog=eng)
+    s.execute("create table t (id bigint)")
+    for i in range(4):
+        s.execute(f"insert into t values ({i})")
+    merge_cycle_executor(eng, "")
+    assert len(eng.tables["t"].segments) == 1
+    assert scheduler_for(eng).cycles == 1
+    assert scheduler_for(eng).last_cycle["checkpoint"] is True
+
+
+# =========================================================== ops surface
+def test_mo_ctl_merge_scheduler_surface():
+    s = Session()
+    s.execute("create table t (id bigint)")
+    for i in range(4):
+        s.execute(f"insert into t values ({i})")
+    s.execute("create snapshot pin")      # holds the fence past 'run'
+    st = json.loads(_rows(s, "select mo_ctl('merge','status')")[0][0])
+    assert st["running"] is False
+    assert {"min_segments", "tombstone_ratio", "ckpt_cycles",
+            "interval_ms", "candidates", "fences"} <= set(st)
+    assert any(c["table"] == "t" for c in st["candidates"])
+    run = json.loads(_rows(s, "select mo_ctl('merge','run')")[0][0])
+    assert any(m["table"] == "t" for m in run["merged"])
+    st2 = json.loads(_rows(s, "select mo_ctl('merge','status')")[0][0])
+    assert st2["cycles"] >= 1 and "t" in st2["fences"]
+    s.execute("drop snapshot pin")
+    gc = json.loads(_rows(s, "select mo_ctl('merge','gc')")[0][0])
+    assert gc["released"] == 1
+    (out,), = _rows(s, "select mo_ctl('merge','pause')")
+    assert "paused" in out
+    (out,), = _rows(s, "select mo_ctl('merge','resume')")
+    assert "resumed" in out
+    # the legacy forms stay intact
+    (out,), = _rows(s, "select mo_ctl('merge')")
+    assert "merge" in out or "nothing" in out
+    (out,), = _rows(s, "select mo_ctl('merge', 't')")
+    assert out.startswith("merge t:")

@@ -273,8 +273,10 @@ def test_dense_tier_is_one_compiled_dispatch():
 
 def test_dynamic_table_delta_refresh_upgrade():
     """Maintainable dynamic tables silently upgrade from DELETE+INSERT
-    to delta refresh; a merge that compacts history below the watermark
-    forces a rebuild instead of double-counting replayed segments."""
+    to delta refresh; a merge below the watermark snapshot-fences the
+    replayed history so the refresh stays incremental (exactly-once
+    fenced catch-up, no rebuild). Rebuild is the degrade rung: only
+    after the fence is GC'd out from under a lapsed consumer."""
     eng = Engine()
     s = Session(catalog=eng)
     s.execute("create table ticks (sym varchar(8), px bigint)")
@@ -289,15 +291,33 @@ def test_dynamic_table_delta_refresh_upgrade():
     assert sorted(_rows(s, "select * from px")) == \
         [("A", 2, 30), ("B", 2, 20), ("C", 1, 1)]
     assert M.mview_apply.get(tier="init") == i0    # delta, not rebuild
-    # merge compacts tombstones/segments away: refresh must detect the
-    # watermark is no longer replayable and rebuild
+    # a merge below the watermark fences the pre-merge history: the
+    # refresh replays the fenced deltas exactly-once — still no rebuild
     s.execute("delete from ticks where sym = 'A'")
     eng.merge_table("ticks", min_segments=1, checkpoint=False)
     s.execute("insert into ticks values ('D',2)")
     s.execute("refresh dynamic table px")
     assert sorted(_rows(s, "select * from px")) == \
         [("B", 2, 20), ("C", 1, 1), ("D", 1, 2)]
-    assert M.mview_apply.get(tier="init") > i0
+    assert M.mview_apply.get(tier="init") == i0    # fenced catch-up
+    # the runtime's watermark passed the fence, so GC may release it
+    assert eng.gc_fences()["released"] >= 1
+    # DEGRADE RUNG: drop the consumer pin (an evicted/lapsed runtime no
+    # longer registers a watermark), merge + GC again — the floor rises
+    # past the runtime's watermark and the next refresh must rebuild
+    eng.unregister_watermark("dyn:px")
+    s.execute("delete from ticks where sym = 'B'")
+    eng.merge_table("ticks", min_segments=1, checkpoint=False)
+    eng.gc_fences()
+    floor = eng.tables["ticks"].delta_floor
+    assert floor > 0
+    from matrixone_tpu.mview.maintain import service_for
+    assert service_for(eng)._dynamic["px"].watermark < floor
+    s.execute("insert into ticks values ('E',7)")
+    s.execute("refresh dynamic table px")
+    assert sorted(_rows(s, "select * from px")) == \
+        [("C", 1, 1), ("D", 1, 2), ("E", 1, 7)]
+    assert M.mview_apply.get(tier="init") > i0     # rebuilt from scratch
 
 
 def test_mo_ctl_mview_surface():

@@ -207,11 +207,13 @@ def test_cdc_watermark_resume_survives_mid_stream_kill():
     task2.stop()
 
 
-def test_cdc_backfill_refuses_resume_below_a_merge():
-    """merge_table compacts the deltas a resume would need (tombstones
-    dropped, live rows rewritten): resuming below the merge must stop
-    loudly instead of silently diverging the sink — and a fresh seed
-    (from_ts=0) must still work."""
+def test_cdc_backfill_resumes_below_a_merge_via_fence():
+    """A merge below a consumer watermark snapshot-fences the pre-merge
+    history: the resume catches up from the fenced deltas exactly-once
+    (no re-seed, no divergence). Only after gc_fences releases the fence
+    (no snapshot / no registered watermark pins it) does a resume below
+    the floor refuse loudly — the degrade rung, not the default."""
+    from matrixone_tpu.utils import metrics as M
     src = Session()
     dst = Session()
     src.execute("create table mg (id bigint primary key, v varchar(4))")
@@ -222,16 +224,30 @@ def test_cdc_backfill_refuses_resume_below_a_merge():
     task.stop()
     src.execute("delete from mg where id = 1")      # unshipped delta...
     src.catalog.merge_table("mg", min_segments=1,
-                            checkpoint=False)       # ...compacted away
+                            checkpoint=False)       # ...now behind a fence
+    fenced_before = M.cdc_backfills.get(outcome="fenced")
     task2 = CdcTask(src.catalog, "mg", SQLSink(dst), from_ts=wm)
+    task2.backfill()                   # fenced catch-up, not a re-seed
+    assert M.cdc_backfills.get(outcome="fenced") == fenced_before + 1
+    assert [(int(a), b) for a, b in
+            dst.execute("select id, v from mg order by id").rows()] \
+        == [(2, "b")]
+    assert task2.watermark > wm
+    # release the fence: nothing pins it (task2 not started -> no
+    # registered watermark, no named snapshot) — the floor rises and a
+    # resume below it now refuses instead of silently diverging
+    gc = src.catalog.gc_fences()
+    assert gc["released"] >= 1
+    assert src.catalog.tables["mg"].delta_floor > 0
+    task3 = CdcTask(src.catalog, "mg", SQLSink(dst), from_ts=wm)
     with pytest.raises(ValueError, match="compacted"):
-        task2.backfill()
-    # a fresh sink seeds fine from the merged live state
+        task3.backfill()
+    # a fresh sink still seeds fine from the merged live state
     dst2 = Session()
     dst2.execute("create table mg (id bigint primary key,"
                  " v varchar(4))")
-    task3 = CdcTask(src.catalog, "mg", SQLSink(dst2))
-    task3.backfill()
+    task4 = CdcTask(src.catalog, "mg", SQLSink(dst2))
+    task4.backfill()
     assert [(int(a), b) for a, b in
             dst2.execute("select id, v from mg order by id").rows()] \
         == [(2, "b")]

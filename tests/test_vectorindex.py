@@ -245,13 +245,13 @@ def test_hnsw_native_walker_matches_python_oracle():
     the pure-Python oracle's recall on clustered data."""
     from matrixone_tpu.vectorindex import hnsw
     from matrixone_tpu.vectorindex.recall import recall_at_k
-    # 2000 pts, not 4000: the pure-python oracle build is O(n*ef*M) and
+    # 1400 pts, not 4000: the pure-python oracle build is O(n*ef*M) and
     # was alone ~50s of every tier-1 run — the native-vs-oracle recall
-    # comparison this guards is just as discriminating at half the size
+    # comparison this guards is just as discriminating at this size
     rng = np.random.default_rng(11)
     centers = rng.normal(size=(16, 24)).astype(np.float32)
-    lab = rng.integers(0, 16, 2000)
-    data = centers[lab] + rng.normal(size=(2000, 24)).astype(np.float32) * 0.15
+    lab = rng.integers(0, 16, 1400)
+    data = centers[lab] + rng.normal(size=(1400, 24)).astype(np.float32) * 0.15
     q = centers[rng.integers(0, 16, 64)] + \
         rng.normal(size=(64, 24)).astype(np.float32) * 0.15
 

@@ -9,11 +9,14 @@ crash-hoped.  In the ALICE tradition:
   * a `RecordingFileService` (storage/fileservice.py) journals every
     write/append/fsync/replace as an ordered event log
     (utils/crash.CrashJournal);
-  * a seeded workload (tools/mocrash/workload.py) runs commits, DDL,
+  * seeded workloads (tools/mocrash/workload.py) run commits, DDL,
     snapshots, a maintained materialized view, CDC mirroring with a
     durable watermark, checkpoint, merge and quorum appends over
     recording file services, logging which operations were ACKED at
-    which journal position;
+    which journal position; the `merge` scenario drives background
+    MergeScheduler cycles under traffic so every scheduler decision
+    point (candidate pick / off-lock rewrite / catalog swap / fence
+    GC / checkpoint truncate) gets crashed;
   * the sweep "crashes" at every journal event under torn-tail and
     fsync-loss variants, materializes the surviving on-disk prefix,
     reopens the engine / replica set from it, and checks the recovery
@@ -22,9 +25,10 @@ crash-hoped.  In the ALICE tradition:
     the mview and CDC mirror reconverge exactly-once from their
     watermarks, orphan tmp files are GC'd, quorum-acked entries are in
     every majority union;
-  * three planted violations (tools/mocrash/plants.py) prove the net
+  * five planted violations (tools/mocrash/plants.py) prove the net
     catches: rename-before-fsync, WAL-truncate-before-checkpoint-
-    durable, watermark-advance-before-backing-commit.
+    durable, watermark-advance-before-backing-commit, object-GC-before-
+    fence-release-durable, merge-swap-before-rewrite-durable.
 
 Gates: tests/test_mocrash.py runs a quick seeded sweep in tier-1 (zero
 findings fails the build); `python -m tools.precheck --crash-smoke` is
@@ -118,6 +122,18 @@ def _plant_points(name: str, journal) -> List[int]:
         elif name == "watermark-early" and e.op == "write_tmp" \
                 and e.path.endswith(".wm.tmp"):
             idxs.update(range(i, min(i + 30, len(evs))))
+        elif name == "gc-early" and e.tag == "tn" \
+                and e.op == "delete" and e.path.startswith("objects/"):
+            # planted: old objects deleted BEFORE the fence-free
+            # manifest replace — the violation window sits between
+            idxs.update(range(i, min(i + 15, len(evs))))
+        elif name == "swap-early" and e.tag == "tn" \
+                and e.op == "write_tmp" and "/merge" in e.path:
+            # planted: the unsynced merged object stays vulnerable from
+            # its write through the checkpoint that references it (a 40-
+            # event window keeps the drill fast; the violation fires
+            # across the whole stretch)
+            idxs.update(range(i, min(i + 40, len(evs))))
     return sorted(idxs)
 
 
@@ -144,6 +160,14 @@ def run_sweep(seed: Optional[int] = None, points: Optional[int] = None,
                    if plant is not None
                    else _pick_points(len(world.journal), points))
             _sweep_world(world, invariants.check_engine, vlist, pts,
+                         findings, counts)
+        if scenario in ("merge", "all"):
+            mw = workload.run_merge_workload(seed)
+            counts["events"] += len(mw.journal)
+            pts = (_plant_points(plant, mw.journal)
+                   if plant is not None
+                   else _pick_points(len(mw.journal), points))
+            _sweep_world(mw, invariants.check_engine, vlist, pts,
                          findings, counts)
         if scenario in ("quorum", "all") and plant is None:
             qw = workload.run_quorum_workload(seed)
@@ -175,8 +199,8 @@ def run_sweep(seed: Optional[int] = None, points: Optional[int] = None,
 
 
 def run_smoke(seed: Optional[int] = None) -> dict:
-    """The precheck one-shot: one clean capped sweep + one planted
-    drill; <30s on the tier-1 box."""
+    """The precheck one-shot: one clean capped sweep (engine + merge +
+    quorum) + two planted drills; <60s on the tier-1 box."""
     seed = sweep_seed() if seed is None else seed
     rep = run_sweep(seed=seed, points=60, scenario="all")
     planted = run_sweep(seed=seed, scenario="engine",
@@ -185,6 +209,12 @@ def run_smoke(seed: Optional[int] = None) -> dict:
         f["invariant"] == "acked-commit-lost"
         for f in planted["findings"])
     rep["plant_findings"] = len(planted["findings"])
+    merge_planted = run_sweep(seed=seed, scenario="merge",
+                              plant="gc-early")
+    rep["merge_plant_caught"] = any(
+        f["invariant"] == "gc-reachable-object-deleted"
+        for f in merge_planted["findings"])
+    rep["merge_plant_findings"] = len(merge_planted["findings"])
     return rep
 
 
@@ -212,7 +242,8 @@ def main(argv=None) -> int:
                          "MO_CRASH_POINTS or all)")
     ap.add_argument("--variants", choices=("quick", "full"),
                     default="quick")
-    ap.add_argument("--scenario", choices=("engine", "quorum", "all"),
+    ap.add_argument("--scenario",
+                    choices=("engine", "merge", "quorum", "all"),
                     default="all")
     ap.add_argument("--plant", default=None,
                     choices=plants.plant_names(),
@@ -228,10 +259,12 @@ def main(argv=None) -> int:
         rep = run_smoke(args.seed)
         print(json.dumps({k: rep[k] for k in
                           ("seed", "events", "points", "recoveries",
-                           "seconds", "plant_caught")}, sort_keys=True))
+                           "seconds", "plant_caught",
+                           "merge_plant_caught")}, sort_keys=True))
         for line in rep["findings_formatted"]:
             print(line)
-        return 0 if not rep["findings"] and rep["plant_caught"] else 1
+        return 0 if not rep["findings"] and rep["plant_caught"] \
+            and rep["merge_plant_caught"] else 1
 
     rep = run_sweep(seed=args.seed, points=args.points,
                     variants=args.variants, scenario=args.scenario,

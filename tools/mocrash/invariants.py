@@ -24,8 +24,18 @@ Engine scenario (per crash point x torn/lossy variant):
   * cdc-exactly-once      — resuming the mirror from its durable
                             watermark via cdc.delta_events converges
                             the mirror to the source exactly once
-                            (re-seeding from 0 when a merge compacted
-                            the deltas away, per the CdcTask contract).
+                            (re-seeding from 0 only when the delta
+                            floor passed the watermark — a fence still
+                            covering the resume must serve it);
+  * asof-read             — an acked named snapshot reads bit-identical
+                            to the view pinned at its creation, across
+                            background merges (the merge fence serves
+                            the pre-merge history);
+  * gc-reachable-object-deleted — every object file referenced by a
+                            live segment or a held merge fence exists:
+                            fence GC goes manifest-durable-first, so a
+                            crash leaks unreferenced files but never
+                            deletes reachable ones.
 
 Quorum scenario:
 
@@ -70,13 +80,13 @@ def variant_name(torn: float, lossy: bool) -> str:
     return f"torn{int(torn * 100)}" + ("+lossy" if lossy else "")
 
 
-def _read_main(eng: Engine, table: str = "t_main"
-               ) -> Dict[int, tuple]:
-    """id -> (batch, v, s) of the visible rows."""
+def _read_main(eng: Engine, table: str = "t_main",
+               snapshot_ts: Optional[int] = None) -> Dict[int, tuple]:
+    """id -> (batch, v, s) of the visible rows (or the AS OF view)."""
     t = eng.get_table(table)
     out: Dict[int, tuple] = {}
     for arrays, validity, dicts, n in t.iter_chunks(
-            ["id", "batch", "v", "s"], 1 << 20):
+            ["id", "batch", "v", "s"], 1 << 20, snapshot_ts=snapshot_ts):
         for i in range(n):
             s = (dicts["s"][int(arrays["s"][i])]
                  if validity["s"][i] else None)
@@ -149,7 +159,10 @@ def check_engine(world: "W.EngineWorld", k: int, torn: float,
 
     # ---- acked DDL survives
     for name in sorted(ddl):
-        if name == "snap_wk":
+        if inflight is not None and inflight.op == "snapdrop" \
+                and inflight.table == name:
+            continue       # the in-flight drop may have applied
+        if name.startswith("snap"):
             if name not in eng.snapshots:
                 findings.append(F("ddl-lost",
                                   f"acked snapshot {name} missing"))
@@ -158,9 +171,55 @@ def check_engine(world: "W.EngineWorld", k: int, torn: float,
     if "t_main" not in ddl or "t_main" not in eng.tables:
         return findings          # nothing further can be checked
 
+    # ---- every object a live segment or a held merge fence references
+    # must still exist: fence GC must go manifest-durable-first, so a
+    # crash can only leak unreferenced files, never delete reachable ones
+    missing = sorted({
+        s.obj_path for t2 in eng.tables.values()
+        for s in list(t2.segments) + [fs_ for f2 in
+                                      getattr(t2, "fences", [])
+                                      for fs_ in f2.segments]
+        if s.obj_path is not None and not tn_fs.exists(s.obj_path)})
+    if missing:
+        findings.append(F(
+            "gc-reachable-object-deleted",
+            f"{len(missing)} reachable object file(s) gone: "
+            f"{missing[:4]}"))
+        return findings     # reads below would just raise on them
+
     # ---- acked commits visible, in-flight commit all-or-nothing
-    actual = _read_main(eng)
-    actual_pair = _read_pair(eng) if "t_pair" in eng.tables else set()
+    try:
+        actual = _read_main(eng)
+        actual_pair = (_read_pair(eng) if "t_pair" in eng.tables
+                       else set())
+    except Exception as e:   # noqa: BLE001 — an unreadable recovered
+        # table (torn object bytes behind a durable manifest) IS the
+        # durability finding, not a sweep error
+        findings.append(F("acked-commit-lost",
+                          f"recovered table unreadable: "
+                          f"{type(e).__name__}: {e}"))
+        return findings
+
+    # ---- AS OF reads through a surviving snapshot stay bit-exact
+    # across background merges (the fence serves the pre-merge view)
+    for a in world.acks:
+        if a.op != "snapshot" or a.event_hi > k or not a.rows \
+                or a.table not in eng.snapshots:
+            continue
+        try:
+            got = _read_main(eng, snapshot_ts=eng.snapshots[a.table])
+        except Exception as e:   # noqa: BLE001 — same rung as above
+            findings.append(F("asof-read",
+                              f"AS OF {a.table} raised "
+                              f"{type(e).__name__}: {e}"))
+            continue
+        if got != a.rows:
+            miss = sorted(set(a.rows) - set(got))[:6]
+            extra = sorted(set(got) - set(a.rows))[:6]
+            findings.append(F(
+                "asof-read",
+                f"AS OF {a.table} diverged from its pinned view "
+                f"(missing ids {miss}, extra {extra})"))
     candidates: List[Tuple[Dict[int, tuple], set]] = [
         (expected, pair_exp)]
     if inflight is not None:
@@ -207,9 +266,16 @@ def _check_cdc(world, F, u, eng) -> List[Finding]:
                        from_ts=wm.load())
         try:
             task.backfill(from_ts=task.watermark)
-        except ValueError:
-            # a merge compacted deltas below the watermark: the
-            # documented recovery is a re-seed from scratch
+        except ValueError as e:
+            # only a GC'd fence may refuse: below-or-at the delta floor
+            # the re-seed is the documented degrade rung; a refusal
+            # ABOVE the floor means the fence failed to serve a resume
+            # it still covers — that's the finding, not a fallback
+            floor = getattr(eng.get_table("t_main"), "delta_floor", 0)
+            if task.watermark > floor:
+                return [F("cdc-exactly-once",
+                          f"fenced resume refused above the delta "
+                          f"floor ({task.watermark} > {floor}): {e}")]
             W._clear_table(meng, "t_main")
             task.watermark = 0
             task.backfill(from_ts=0)

@@ -19,6 +19,16 @@ assert the net catches it, restore).
                         the deliveries it covers are durable
                         downstream: a crash in between makes the resume
                         skip history — a silent gap in the mirror.
+  * gc-early          — fence GC deletes the pre-merge object files
+                        BEFORE the fence-free manifest is durable: a
+                        crash in between leaves a manifest whose merge
+                        fences reference vanished objects — AS OF and
+                        fenced delta reads hit missing files.
+  * swap-early        — the merge swap publishes the merged segment
+                        while its object write skipped fsync (rewrite
+                        not durable before the swap): a crash after the
+                        referencing checkpoint can lose the only copy
+                        of every merged row.
 
 Each must be caught by the sweep with the point-of-crash and the
 violated invariant named in the finding (tests/test_mocrash.py).
@@ -33,7 +43,8 @@ from matrixone_tpu.storage.fileservice import RecordingFileService
 
 from tools.mocrash import workload
 
-_PLANTS = ("fsync-skip", "truncate-early", "watermark-early")
+_PLANTS = ("fsync-skip", "truncate-early", "watermark-early",
+           "gc-early", "swap-early")
 
 
 def plant_names():
@@ -71,5 +82,31 @@ def plant(name: str):
             yield
         finally:
             workload.WM_EARLY = prev
+    elif name == "gc-early":
+        prev = Engine.GC_DELETE_BEFORE_FENCE_RELEASE
+        Engine.GC_DELETE_BEFORE_FENCE_RELEASE = True
+        try:
+            yield
+        finally:
+            Engine.GC_DELETE_BEFORE_FENCE_RELEASE = prev
+    elif name == "swap-early":
+        orig = Engine._merge_write_object
+
+        def unsynced(self, name_, arrays, validity):
+            # the violation: the merged object lands via rename with NO
+            # fsync — the swap (and the checkpoint that references it)
+            # proceed against a write the disk may not hold
+            prev = RecordingFileService.SKIP_WRITE_FSYNC
+            RecordingFileService.SKIP_WRITE_FSYNC = True
+            try:
+                return orig(self, name_, arrays, validity)
+            finally:
+                RecordingFileService.SKIP_WRITE_FSYNC = prev
+
+        Engine._merge_write_object = unsynced
+        try:
+            yield
+        finally:
+            Engine._merge_write_object = orig
     else:
         raise ValueError(f"unknown plant {name!r}; use {_PLANTS}")

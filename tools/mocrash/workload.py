@@ -49,8 +49,9 @@ _STRINGS = ["ash", "birch", "cedar", "fir", "oak", "pine", "teak"]
 class Ack:
     """One acknowledged operation: everything it did is journaled at
     indices < event_hi (recorded AFTER the call returned)."""
-    op: str                 # insert|delete|txn2|ddl|snapshot|mview|
-    #                         checkpoint|merge|cdc_sync|qappend|qtruncate
+    op: str                 # insert|delete|txn2|ddl|snapshot|snapdrop|
+    #                         mview|checkpoint|merge|gc|cdc_sync|
+    #                         qappend|qtruncate
     event_lo: int           # journal position just before the op started
     event_hi: int           # journal position right after it returned
     table: str = ""
@@ -60,6 +61,7 @@ class Ack:
     seq: int = 0            # quorum scenario
     payload: bytes = b""
     upto: int = 0
+    ts: int = 0             # snapshot acks: the pinned timestamp
 
 
 @dataclasses.dataclass
@@ -93,6 +95,8 @@ class EngineWorld:
                 pair.update(a.pair_ids)
             elif a.op in ("ddl", "snapshot", "mview"):
                 ddl.add(a.table)
+            elif a.op == "snapdrop":
+                ddl.discard(a.table)
         return main, pair, ddl, inflight
 
 
@@ -326,6 +330,147 @@ def run_engine_workload(seed: int = 2026) -> EngineWorld:
     insert_batch(int(rng.integers(3, 6)))
     cdc_sync()
 
+    sess.close()
+    return EngineWorld(journal=journal, acks=acks, seed=seed)
+
+
+def run_merge_workload(seed: int = 2026) -> EngineWorld:
+    """Merge-under-traffic scenario: MergeScheduler cycles (candidate
+    pick -> off-lock rewrite -> catalog swap -> fence GC -> checkpoint)
+    interleave with foreground commits, a pinned named snapshot, and
+    CDC fenced resumes — so the sweep crashes at every scheduler
+    decision point and checks acked data survives, AS OF reads stay
+    exact across the swap, deltas replay exactly-once, and no object is
+    GC'd while a snapshot or fence can still reach it."""
+    from matrixone_tpu.frontend import Session
+    from matrixone_tpu.storage.merge_sched import MergeScheduler
+    rng = np.random.default_rng(seed)
+    journal = CrashJournal()
+    fs = RecordingFileService(MemoryFS(), journal, "tn")
+    mfs = RecordingFileService(MemoryFS(), journal, "mirror")
+    eng = Engine(fs)
+    sess = Session(catalog=eng)
+    meng = mirror_engine(mfs)
+    wm = FileWatermark(mfs, "cdc/t_main.wm")
+    sched = MergeScheduler(eng)
+    sched.min_segments = 2           # small history: compact eagerly
+    acks: List[Ack] = []
+    next_id = [0]
+    batch_no = [0]
+    live: Dict[int, tuple] = {}
+
+    def ack(op: str, lo: int, **kw) -> Ack:
+        a = Ack(op=op, event_lo=lo, event_hi=journal.position(), **kw)
+        acks.append(a)
+        return a
+
+    def insert_batch(n: int):
+        batch_no[0] += 1
+        b = batch_no[0]
+        ids = list(range(next_id[0], next_id[0] + n))
+        next_id[0] += n
+        rows = {}
+        vals = []
+        for i in ids:
+            v = int(rng.integers(0, 1000))
+            s = (None if rng.random() < 0.15
+                 else _STRINGS[int(rng.integers(len(_STRINGS)))])
+            rows[i] = (b, v, s)
+            vals.append(f"({i}, {b}, {v}, "
+                        + ("null" if s is None else f"'{s}'") + ")")
+        lo = journal.position()
+        sess.execute("insert into t_main (id, batch, v, s) values "
+                     + ", ".join(vals))
+        live.update(rows)
+        ack("insert", lo, table="t_main", ids=tuple(ids), rows=rows)
+
+    def delete_some(k: int):
+        if not live:
+            return
+        ids = sorted(live)
+        pick = tuple(int(ids[j]) for j in
+                     sorted(rng.choice(len(ids), size=min(k, len(ids)),
+                                       replace=False)))
+        lo = journal.position()
+        sess.execute("delete from t_main where id in ("
+                     + ", ".join(str(i) for i in pick) + ")")
+        for i in pick:
+            live.pop(i, None)
+        ack("delete", lo, table="t_main", ids=pick)
+
+    def cdc_sync():
+        """Resume the mirror from its durable watermark.  Below a held
+        fence this is the exactly-once fenced catch-up; only when the
+        fence was GC'd (floor above the watermark) does the documented
+        degrade rung re-seed from scratch."""
+        lo = journal.position()
+        task = CdcTask(eng, "t_main", EngineSink(meng, "t_main"),
+                       from_ts=wm.load())
+        try:
+            task.backfill(from_ts=task.watermark)
+        except ValueError:
+            _clear_table(meng, "t_main")
+            task.watermark = 0
+            task.backfill(from_ts=0)
+        wm.store(task.watermark)
+        ack("cdc_sync", lo)
+
+    def merge_cycle(op: str):
+        lo = journal.position()
+        sched.run_cycle()       # merge + fence GC + checkpoint cadence
+        ack(op, lo)
+
+    # ---- the script
+    lo = journal.position()
+    sess.execute("create table t_main (id bigint primary key, "
+                 "batch bigint, v bigint, s varchar(64))")
+    ack("ddl", lo, table="t_main")
+
+    insert_batch(int(rng.integers(4, 8)))
+    lo = journal.position()
+    sess.execute("create materialized view mv1 as select s, sum(v) sv, "
+                 "count(*) c from t_main group by s")
+    ack("mview", lo, table="mv1")
+    insert_batch(int(rng.integers(3, 7)))
+    delete_some(2)
+    cdc_sync()
+    insert_batch(int(rng.integers(3, 6)))
+
+    # pin the pre-merge history with a named snapshot, remembering
+    # exactly what an AS OF read of it must return forever after
+    lo = journal.position()
+    snap_ts = eng.create_snapshot("snap_mg")
+    ack("snapshot", lo, table="snap_mg", rows=dict(live), ts=snap_ts)
+
+    lo = journal.position()
+    sess.execute("select mo_ctl('checkpoint')")
+    ack("checkpoint", lo)      # pre-merge segments now object-backed
+
+    insert_batch(int(rng.integers(3, 6)))
+    delete_some(2)
+
+    # scheduler cycle 1: compacts below BOTH the snapshot and the CDC
+    # watermark — the fence pins the pre-merge view, GC must hold
+    merge_cycle("merge")
+
+    insert_batch(int(rng.integers(3, 6)))
+    cdc_sync()                 # fenced resume: watermark < merge_ts
+    delete_some(1)
+    insert_batch(int(rng.integers(2, 5)))
+
+    # scheduler cycle 2: a second merge stacks a second fence
+    merge_cycle("merge")
+    cdc_sync()
+
+    # release: drop the pin — the next cycle's gc_fences releases the
+    # fences (manifest durable FIRST) and deletes the pre-merge objects
+    lo = journal.position()
+    eng.drop_snapshot("snap_mg")
+    ack("snapdrop", lo, table="snap_mg")
+    merge_cycle("gc")
+
+    insert_batch(int(rng.integers(2, 5)))
+    cdc_sync()
     sess.close()
     return EngineWorld(journal=journal, acks=acks, seed=seed)
 

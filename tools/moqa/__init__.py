@@ -50,8 +50,12 @@ def corpus_seed(default: int = 2026) -> int:
         return default
 
 
-def corpus_queries(default: int = 110) -> int:
-    """MO_QA_QUERIES: generated queries per (non-vector) scenario."""
+def corpus_queries(default: int = 85) -> int:
+    """MO_QA_QUERIES: generated queries per (non-vector) scenario.
+    85 keeps the tier-1 gate above its 300-query floor (3 mixed
+    scenarios x 85 + join 42 + vector 17 = 314) while fitting the
+    suite in the single-core tier-1 time budget; raise via env for
+    deeper sweeps."""
     try:
         return int(os.environ.get("MO_QA_QUERIES", "") or default)
     except ValueError:

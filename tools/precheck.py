@@ -232,6 +232,11 @@ def _crash_leg():
         else:
             print("crash-smoke: planted early-truncate NOT caught")
             rc = 1
+        if rep["merge_plant_caught"]:
+            print("crash-smoke: planted merge gc-early caught ok")
+        else:
+            print("crash-smoke: planted merge gc-early NOT caught")
+            rc = 1
         return rc
     return run
 
