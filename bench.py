@@ -136,6 +136,19 @@ def bench_q1(n: int = None) -> dict:
         best = max(best, n / (time.time() - t0))
     fused_dispatches = M.fusion_dispatch.get(kind="step") - disp0
     trace_seconds = M.fusion_trace_seconds.get() - trace0
+    # ---- warm-RESIDENT loop: after the reps above the blockcache's
+    # device tier holds every decoded column as a ready device array,
+    # so this window measures pure device residency — the tentpole
+    # claim is device_cache_hit_rate >= 0.99 with ~0 re-upload bytes
+    # (every byte staged host->device during the window is counted)
+    blockcache.CACHE.reset_stats()
+    best_res = 0.0
+    for _ in range(2):
+        t0 = time.time()
+        s.execute(tpch.Q1_SQL)
+        best_res = max(best_res, n / (time.time() - t0))
+    cache_res = blockcache.CACHE.stats()
+    dev_tier = cache_res["device_tier"]
     # ---- per-stage device vs host split: one diagnostic re-execution
     # with the fragment's profile hooks armed (block_until_ready around
     # the compiled step, host bookkeeping timed separately)
@@ -203,8 +216,16 @@ def bench_q1(n: int = None) -> dict:
     # returnflag/linestatus codes, shipdate) — effective scan bandwidth
     # is the honest "how close to HBM" number for a bandwidth-bound query
     q1_bytes = n * (4 * 8 + 2 * 4 + 4)
+    # analytic flop count per row: 7 agg lanes (sum/avg inputs, the
+    # disc_price/charge products, predicates and the group scatter) —
+    # ~40 flops/row is the honest order of magnitude for Q1's arithmetic
+    q1_flops = n * 40
     from matrixone_tpu.utils import roofline as _rf
     pb = _rf.peak_bytes_per_s()
+    # roofline promotion: achieved bytes/s + flops/s for the fused
+    # family vs MO_PEAK_TFLOPS / MO_PEAK_GBPS (utilizations stay null
+    # on backends without a declared peak; the achieved rates trend)
+    rf_q1 = _rf.mfu(q1_flops, q1_bytes, 1.0, n / best) if best else {}
     serving = None
     if os.environ.get("MO_BENCH_NO_SERVING") != "1":
         try:
@@ -262,8 +283,23 @@ def bench_q1(n: int = None) -> dict:
         "plan_fusion": 0,
         "backend": jax.default_backend(),
     }
-    extras = [m for m in (unfused_entry, serving, udf_entry,
-                          mview_entry) if m] + q3_entries
+    warmres_entry = {
+        # the device-residency family: same query, measured in the
+        # window where the blockcache's device tier is fully hot —
+        # the floor for it guards the zero-re-upload property, the
+        # hit-rate/upload fields ARE the acceptance evidence
+        "metric": f"tpch_q1_warmres_rows_per_sec_{n}",
+        "value": round(best_res, 1),
+        "unit": "rows/s",
+        "vs_baseline": None,
+        "device_cache_hit_rate": dev_tier["hit_rate"],
+        "upload_bytes": cache_res["uploaded_bytes"],
+        "device_cache_used_bytes": dev_tier["used_bytes"],
+        "device_cache_budget_bytes": dev_tier["budget_bytes"],
+        "backend": jax.default_backend(),
+    }
+    extras = [m for m in (unfused_entry, warmres_entry, serving,
+                          udf_entry, mview_entry) if m] + q3_entries
     return {
         **({"extra_metrics": extras} if extras else {}),
         "metric": f"tpch_q1_fused_rows_per_sec_{n}",
@@ -285,11 +321,14 @@ def bench_q1(n: int = None) -> dict:
         "blockcache_misses": cache["misses"],
         "blockcache_hit_rate": cache["hit_rate"],
         "decode_seconds": cache["decode_seconds"],
+        "device_cache_hit_rate": dev_tier["hit_rate"],
+        "warm_upload_bytes": cache_res["uploaded_bytes"],
         "prefetch_ready": M.scan_prefetch.get(outcome="ready"),
         "prefetch_waited": M.scan_prefetch.get(outcome="waited"),
         "backend": jax.default_backend(),
         "scan_gbps": round(q1_bytes * best / n / 1e9, 2),
         "hbm_util": (round(q1_bytes * best / n / pb, 4) if pb else None),
+        **({"roofline": rf_q1} if rf_q1 else {}),
         **({"trace_artifact": trace_artifact,
             "trace_spans": trace_spans} if trace_artifact else {}),
     }
@@ -347,6 +386,14 @@ def bench_q3(n: int = None) -> dict:
         s.execute(tpch.Q3_SQL)
         best = max(best, n / (time.time() - t0))
     fused_dispatches = M.fusion_dispatch.get(kind="step") - disp0
+    # warm-resident window: device tier is hot after the reps above —
+    # measure the residency evidence (hit rate / re-upload bytes) over
+    # one more fused execution
+    from matrixone_tpu.storage import blockcache
+    blockcache.CACHE.reset_stats()
+    s.execute(tpch.Q3_SQL)
+    cache_res = blockcache.CACHE.stats()
+    dev_tier = cache_res["device_tier"]
     # lineitem streams in ceil(n / 2^20)-row batches; the dim sides add
     # their own (one-batch) builds — per-batch is the honest form of
     # the single-digit-dispatches claim
@@ -368,6 +415,12 @@ def bench_q3(n: int = None) -> dict:
         else:
             os.environ["MO_PLAN_FUSION"] = fusion_was
     s.close()
+    # roofline promotion for the fused-join family: analytic bytes over
+    # the three tables' touched columns (~56B/lineitem row + the
+    # n/4-row dim sides) and ~30 flops/row of join+agg math
+    from matrixone_tpu.utils import roofline as _rf
+    rf_q3 = (_rf.mfu(n * 30, n * 56 + (n // 4) * 32, 1.0, n / best)
+             if best else {})
     return {
         "metric": f"tpch_q3_fused_rows_per_sec_{n}",
         "value": round(best, 1),
@@ -379,10 +432,13 @@ def bench_q3(n: int = None) -> dict:
                                             / n_batches, 2),
         "fused_over_unfused": (round(best / best_unfused, 2)
                                if best_unfused else None),
+        "device_cache_hit_rate": dev_tier["hit_rate"],
+        "warm_upload_bytes": cache_res["uploaded_bytes"],
         "load_seconds": round(t_load, 2),
         "cold_run_seconds": round(t_cold, 2),
         "object_backed": True,
         "backend": jax.default_backend(),
+        **({"roofline": rf_q3} if rf_q3 else {}),
         "extra_metrics": [{
             "metric": f"tpch_q3_rows_per_sec_{n}",
             "value": round(best_unfused, 1),

@@ -137,6 +137,17 @@ class DeviceBatch:
                            n_rows=self.n_rows)
 
 
+def _dtype_ok(have, want: np.dtype) -> bool:
+    """Accept the declared dtype OR a narrower signed int (narrow dict
+    codes from ops/encodings: int8/int16 codes under a declared int32
+    column must survive staging, not silently widen back)."""
+    have = np.dtype(have)
+    if have == want:
+        return True
+    return (have.kind == "i" and want.kind == "i"
+            and have.itemsize < want.itemsize)
+
+
 def from_numpy(arrays: Dict[str, np.ndarray],
                dtypes: Dict[str, DType],
                validity: Optional[Dict[str, np.ndarray]] = None,
@@ -151,7 +162,7 @@ def from_numpy(arrays: Dict[str, np.ndarray],
         dt = dtypes[name]
         val = None if validity is None else validity.get(name)
         if (padded == n_rows and isinstance(arr, jax.Array)
-                and arr.dtype == np.dtype(dt.np_dtype)):
+                and _dtype_ok(arr.dtype, np.dtype(dt.np_dtype))):
             # already device-resident at the right dtype and length (the
             # blockcache hands out ready-to-batch device arrays): skip
             # the host round-trip entirely — this is the warm-scan path
@@ -160,7 +171,9 @@ def from_numpy(arrays: Dict[str, np.ndarray],
                     else jnp.asarray(np.asarray(val, np.bool_)))
             cols[name] = DeviceColumn(data=arr, validity=jval, dtype=dt)
             continue
-        arr = np.asarray(arr, dtype=dt.np_dtype)
+        arr = np.asarray(arr)
+        if not _dtype_ok(arr.dtype, np.dtype(dt.np_dtype)):
+            arr = np.asarray(arr, dtype=dt.np_dtype)
         if val is None:
             val = np.ones(n_rows, dtype=np.bool_)
         else:

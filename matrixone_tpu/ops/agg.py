@@ -77,26 +77,15 @@ def _masked(values: jnp.ndarray, mask: jnp.ndarray, fill) -> jnp.ndarray:
 
 @partial(jax.jit, static_argnames=("max_groups", "use_pallas"))
 def seg_sum(values, gids, mask, max_groups: int, use_pallas: bool = False):
-    """Masked segment sum. With use_pallas=True (session `SET
-    use_pallas = 1`, threaded through AggOp), float32 sums ride the
-    hand-tiled one-hot-matmul kernel (ops/pallas_kernels.py) instead of
-    the XLA scatter; exact int64/decimal and f64 sums always stay on the
-    scatter path (MXU accumulation is float)."""
-    if (use_pallas and values.dtype == jnp.float32
-            and max_groups <= 4096 and values.shape[0] > 0):
-        from matrixone_tpu.ops import pallas_kernels as PK
-        n = values.shape[0]
-        tile = 512
-        padded = ((n + tile - 1) // tile) * tile
-        if padded != n:
-            values = jnp.pad(values, (0, padded - n))
-            gids = jnp.pad(gids, (0, padded - n))
-            mask = jnp.pad(mask, (0, padded - n))   # pads False
-        return PK.segment_sum_pallas(values, gids, mask,
-                                     num_segments=max_groups,
-                                     tile_n=tile)
-    v = _masked(values, mask, 0)
-    return jax.ops.segment_sum(v, gids, num_segments=max_groups)
+    """Masked segment sum, through the hand-kernel dispatch seam
+    (ops/kernels.py). use_pallas (session `SET use_pallas = 1` OR the
+    MO_HAND_KERNELS policy, resolved in vm/compile and threaded through
+    AggOp as a static arg) routes float32 sums to the hand-tiled
+    one-hot-matmul kernel; exact int64/decimal and f64 sums always stay
+    on the XLA scatter path (MXU accumulation is float)."""
+    from matrixone_tpu.ops import kernels as HK
+    return HK.grouped_scatter_add(values, gids, mask, max_groups,
+                                  use_pallas=use_pallas)
 
 
 @partial(jax.jit, static_argnames=("max_groups",))

@@ -16,7 +16,11 @@ Kernels:
     aggregation, riding the MXU instead of scatter units;
   * `adc_score_pallas`          — IVF-PQ asymmetric-distance scoring
     sum_m LUT[g, m, code] as a one-hot matmul per candidate tile
-    (`cgo/cuvs` ivf_pq ADC kernel analogue).
+    (`cgo/cuvs` ivf_pq ADC kernel analogue);
+  * `sorted_search_pallas`      — the hash-join probe's searchsorted
+    over the sorted build hashes as a count-less-than reduction
+    (gather-free, VPU compares + integer sum), bit-identical to
+    `jnp.searchsorted(side='left')` by construction.
 
 All kernels fall back to interpret mode off TPU (tests run on the CPU
 mesh) and are opt-in: sessions enable them with `SET use_pallas = 1`
@@ -206,6 +210,84 @@ def segment_sum_pallas(values: jnp.ndarray, gids: jnp.ndarray,
         interpret=interpret,
     )(v, g)
     return out[0]
+
+
+# --------------------------------------- hash-join probe sorted search
+def _sorted_search_kernel(shi_ref, slo_ref, qhi_ref, qlo_ref, out_ref):
+    j = pl.program_id(1)                            # sorted-tile index
+    shi = shi_ref[:][0][:, None]                    # [TN, 1] int32
+    slo = slo_ref[:][0][:, None]
+    qhi = qhi_ref[:][0][None, :]                    # [1, TQ] int32
+    qlo = qlo_ref[:][0][None, :]
+    # lexicographic (hi, lo) compare == the uint64 compare: both halves
+    # were pre-mapped to sign-flipped int32 so signed order == unsigned
+    less = (shi < qhi) | ((shi == qhi) & (slo < qlo))   # [TN, TQ]
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    # count-less-than accumulates across sorted tiles (the TPU grid is
+    # sequential in its last dimension); the sum is order-free integer
+    # arithmetic, so the result is exactly searchsorted-left
+    out_ref[:] += jnp.sum(less.astype(jnp.int32), axis=0,
+                          dtype=jnp.int32)[None, :]
+
+
+def _sign_flip_halves(x64: jnp.ndarray):
+    """uint64 [n] -> (hi, lo) sign-flipped int32 pairs whose signed
+    lexicographic order equals the unsigned 64-bit order (TPU Pallas
+    has no 64-bit integers in VMEM)."""
+    hi = (x64 >> jnp.uint64(32)).astype(jnp.uint32)
+    lo = x64.astype(jnp.uint32)                     # truncating mod 2^32
+    flip = jnp.uint32(0x80000000)
+    return ((hi ^ flip).astype(jnp.int32),
+            (lo ^ flip).astype(jnp.int32))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("tile_q", "tile_n", "interpret"))
+def sorted_search_pallas(sorted_vals: jnp.ndarray, queries: jnp.ndarray,
+                         tile_q: int = 1024, tile_n: int = 1024,
+                         interpret: bool | None = None) -> jnp.ndarray:
+    """`jnp.searchsorted(sorted_vals, queries, side='left')` for uint64
+    hashes, as a Pallas kernel: insertion-point-left(q) == #{s : s < q},
+    so each (query-tile, sorted-tile) step is a dense VPU compare plus
+    an integer reduction — no per-lane gather, no binary-search control
+    flow, and bit-identical to the XLA path because an integer count has
+    no rounding and no order sensitivity.
+
+    Pads both inputs internally: sorted pads with UINT64_MAX (counted
+    only for queries > MAX — impossible), queries pad with don't-cares
+    sliced off the result.
+    """
+    (n,), (m,) = sorted_vals.shape, queries.shape
+    interpret = _interpret(interpret)
+    s64 = sorted_vals.astype(jnp.uint64)
+    q64 = queries.astype(jnp.uint64)
+    pad_n = (-n) % tile_n
+    pad_m = (-m) % tile_q
+    if pad_n:
+        s64 = jnp.pad(s64, (0, pad_n),
+                      constant_values=jnp.uint64(0xFFFFFFFFFFFFFFFF))
+    if pad_m:
+        q64 = jnp.pad(q64, (0, pad_m))
+    shi, slo = _sign_flip_halves(s64)
+    qhi, qlo = _sign_flip_halves(q64)
+    out = pl.pallas_call(
+        _sorted_search_kernel,
+        grid=(q64.shape[0] // tile_q, s64.shape[0] // tile_n),
+        in_specs=[
+            pl.BlockSpec((1, tile_n), lambda qi, ni: (0, ni)),
+            pl.BlockSpec((1, tile_n), lambda qi, ni: (0, ni)),
+            pl.BlockSpec((1, tile_q), lambda qi, ni: (0, qi)),
+            pl.BlockSpec((1, tile_q), lambda qi, ni: (0, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, tile_q), lambda qi, ni: (0, qi)),
+        out_shape=jax.ShapeDtypeStruct((1, q64.shape[0]), jnp.int32),
+        interpret=interpret,
+    )(shi[None, :], slo[None, :], qhi[None, :], qlo[None, :])
+    return out[0][:m]
 
 
 # ------------------------------------------------- IVF-PQ ADC scoring

@@ -42,6 +42,22 @@ class Parser:
         self.toks = tokens
         self.src = src
         self.i = 0
+        self._qmark_prefix = None   # lazy '?'-op prefix counts (Params)
+
+    def _param_index(self, pos: int) -> int:
+        """Number of '?' op tokens strictly before toks[pos].  Derived
+        from token POSITION (not parse order) so backtracking can't
+        skew it; the prefix table makes it O(1) per placeholder where
+        a rescan would be quadratic in statement size (a templated
+        multi-row INSERT carries tens of thousands of '?')."""
+        if self._qmark_prefix is None:
+            seen, pre = 0, []
+            for tk in self.toks:
+                pre.append(seen)
+                if tk.kind == "op" and tk.value == "?":
+                    seen += 1
+            self._qmark_prefix = pre
+        return self._qmark_prefix[pos]
 
     # ---- token helpers
     def peek(self, ahead: int = 0) -> Token:
@@ -1116,9 +1132,7 @@ class Parser:
             self.next()
             return ast.Literal(t.value, "str")
         if self.accept_op("?"):
-            idx = sum(1 for tk in self.toks[:self.i - 1]
-                      if tk.kind == "op" and tk.value == "?")
-            return ast.Param(idx)
+            return ast.Param(self._param_index(self.i - 1))
         if t.kind == "sysvar":
             self.next()
             name = t.value

@@ -9,17 +9,22 @@ tiered caches, zonemap-pruned before the fetch
 (`/root/reference/pkg/vm/engine/readutil/reader.go:600`,
 `pkg/fileservice/mem_cache.go`, `disk_cache.go`):
 
-  * `BlockCache` — process-wide LRU of DECODED column arrays keyed by
-    (object path, column), capped by MO_BLOCK_CACHE_MB bytes (the
-    reference's fileservice memory-cache role, but holding decoded
-    numpy instead of raw bytes so repeated scans skip the Arrow decode
-    too). All segments of all tables of all engines in the process
-    share one budget, like the reference's per-process fileservice
-    cache.
+  * `BlockCache` — process-wide two-tier LRU of DECODED column arrays
+    keyed by (object path, column): a HOST tier of decoded numpy
+    (capped by MO_BLOCK_CACHE_MB — the reference's fileservice
+    memory-cache role, holding decoded arrays so repeated scans skip
+    the Arrow decode) and a DEVICE tier of ready-to-batch device
+    arrays (capped by MO_DEVICE_CACHE_MB) so warm scans also skip the
+    host->device upload: consecutive queries over the same segments
+    pay zero re-upload.  All segments of all tables of all engines in
+    the process share one budget per tier, like the reference's
+    per-process fileservice cache.
   * `LazyColumns` — a Mapping[str, np.ndarray] facade over one object's
     columns: `seg.arrays[c]` triggers a (cached) column fetch instead
     of holding the bytes forever. Committed objects are immutable, so
-    eviction is always safe — the next access re-fetches.
+    eviction is always safe — the next access re-fetches (device-tier
+    eviction re-uploads from the host tier; host-tier eviction
+    re-decodes).
 
 A `Segment` whose arrays/validity are `LazyColumns` behaves identically
 to a RAM segment everywhere (iter_chunks, fetch_rows, merges, index
@@ -43,17 +48,31 @@ def _budget_bytes() -> int:
     return int(os.environ.get("MO_BLOCK_CACHE_MB", "256")) << 20
 
 
-class BlockCache:
-    """Process-wide decoded-column LRU under a byte budget.
+def _device_budget_bytes() -> int:
+    """Device-tier byte budget.  Defaults to the host budget so one
+    knob sizes the working set; MO_DEVICE_CACHE_MB overrides (0 = no
+    pinned device tier: every warm scan re-uploads from the host
+    tier — the eviction-pressure and upload-accounting tests use it)."""
+    v = os.environ.get("MO_DEVICE_CACHE_MB", "")
+    if v == "":
+        return _budget_bytes()
+    return int(v) << 20
 
-    Keys are (path, column, kind) with kind in {'data', 'validity'};
-    values are immutable READY-TO-BATCH device arrays (jax on the
-    engine's backend): a warm re-scan hands segments straight to
-    `device.from_numpy`'s device fast path with zero header parse, zero
-    Arrow decode, and zero host->device copy per batch. A single column
-    larger than the whole budget is still admitted (the scan must
-    proceed) but evicts everything else — `peak_bytes` records the
-    honest high-water mark.
+
+class BlockCache:
+    """Process-wide decoded-column LRU under per-tier byte budgets.
+
+    Keys are (fs_token, path, column, kind) with kind in {'data',
+    'validity'}.  The HOST tier holds decoded numpy; the DEVICE tier
+    holds the same columns as immutable READY-TO-BATCH device arrays
+    (jax on the engine's backend): a warm re-scan hands segments
+    straight to `device.from_numpy`'s device fast path with zero header
+    parse, zero Arrow decode, and zero host->device copy per batch.  A
+    device-tier miss with a host hit costs one re-upload (counted in
+    `uploaded_bytes`); only a both-tier miss decodes.  A single column
+    larger than a whole tier budget is still admitted (the scan must
+    proceed) but evicts everything else in that tier — `peak_bytes`
+    records the honest high-water mark across both tiers.
 
     `MO_BLOCK_CACHE_DISABLE=1` turns every get into a miss (the perf
     guard tests use it to prove the cache is load-bearing).
@@ -62,86 +81,218 @@ class BlockCache:
     def __init__(self):
         self._lock = san.lock("BlockCache._lock", category="cache")
         san.guard(self, self._lock, name="BlockCache")
-        self._entries: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
-        self._sizes: Dict[tuple, int] = {}
-        self.used_bytes = 0
-        self.peak_bytes = 0
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
+        self._host: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
+        self._host_sizes: Dict[tuple, int] = {}
+        self._dev: "OrderedDict[tuple, object]" = OrderedDict()
+        self._dev_sizes: Dict[tuple, int] = {}
+        self.host_used_bytes = 0
+        self.dev_used_bytes = 0
+        self.host_peak_bytes = 0
+        self.dev_peak_bytes = 0
+        self.peak_bytes = 0           # combined high-water (legacy)
+        self.hits = 0                 # get() served without a decode
+        self.misses = 0               # get() that must decode
+        self.dev_hits = 0             # served with zero upload
+        self.dev_misses = 0
+        self.host_evictions = 0
+        self.dev_evictions = 0
+        self.uploaded_bytes = 0       # host->device staging traffic
         self.decode_seconds = 0.0     # time spent in miss-path decode
         self.bytes_fetched = 0        # decoded bytes brought in on misses
 
-    def get(self, key: tuple, count: bool = True) -> Optional[np.ndarray]:
+    # ------------------------------------------------------------ get
+
+    def get(self, key: tuple, count: bool = True):
+        """Device-ready array for `key`, or None on a both-tier miss.
+        A device hit is upload-free; a host hit re-uploads (counted)."""
         if os.environ.get("MO_BLOCK_CACHE_DISABLE") == "1":
             if count:
                 with self._lock:
                     self.misses += 1
+                    self.dev_misses += 1
                 _metrics_miss()
             return None
+        host_a = None
         with self._lock:
-            a = self._entries.get(key)
+            a = self._dev.get(key)
             if a is not None:
-                self._entries.move_to_end(key)
+                self._dev.move_to_end(key)
                 if count:
+                    self.dev_hits += 1
                     self.hits += 1
-            elif count:
-                self.misses += 1
+            else:
+                if count:
+                    self.dev_misses += 1
+                host_a = self._host.get(key)
+                if host_a is not None:
+                    self._host.move_to_end(key)
+        if a is not None:
+            if count:
+                _metrics_hit()
+                _metrics_dev(outcome="hit")
+            return a
+        if host_a is None:
+            if count:
+                with self._lock:
+                    self.misses += 1
+                _metrics_miss()
+                _metrics_dev(outcome="miss")
+            return None
+        # host hit, device miss: re-upload (outside the lock — staging
+        # a large column must not serialize every other cache access)
+        dev = self._upload_and_admit(key, host_a)
         if count:
-            (_metrics_hit if a is not None else _metrics_miss)()
-        return a
+            with self._lock:
+                self.hits += 1
+            _metrics_hit()
+            _metrics_dev(outcome="upload")
+        return dev
 
-    def put(self, key: tuple, value: np.ndarray) -> None:
+    def contains(self, key: tuple) -> bool:
+        """Either-tier presence probe: no counting, no upload — drives
+        the scan read-ahead decision (LazyColumns.cold_columns)."""
+        if os.environ.get("MO_BLOCK_CACHE_DISABLE") == "1":
+            return False
+        with self._lock:
+            return key in self._dev or key in self._host
+
+    # ------------------------------------------------------------ put
+
+    def put(self, key: tuple, value: np.ndarray):
+        """Admit one decoded host column to both tiers; returns the
+        device-resident array (what the scan hands to from_numpy)."""
+        value = np.asarray(value)
         nb = int(value.nbytes)
         with self._lock:
             san.mutating(self)
-            if key in self._entries:
-                return
-            budget = _budget_bytes()
-            while self._entries and self.used_bytes + nb > budget:
-                k, v = self._entries.popitem(last=False)
-                self.used_bytes -= self._sizes.pop(k)
-                self.evictions += 1
-            self._entries[key] = value
-            self._sizes[key] = nb
-            self.used_bytes += nb
-            self.peak_bytes = max(self.peak_bytes, self.used_bytes)
+            if key not in self._host:
+                budget = _budget_bytes()
+                while self._host and self.host_used_bytes + nb > budget:
+                    k, _v = self._host.popitem(last=False)
+                    self.host_used_bytes -= self._host_sizes.pop(k)
+                    self.host_evictions += 1
+                self._host[key] = value
+                self._host_sizes[key] = nb
+                self.host_used_bytes += nb
+                self.host_peak_bytes = max(self.host_peak_bytes,
+                                           self.host_used_bytes)
+            dev = self._dev.get(key)
+            if dev is not None:
+                self._note_peak_locked()
+                return dev
+        return self._upload_and_admit(key, value)
+
+    def _upload_and_admit(self, key: tuple, host_value):
+        """host array -> device array, admitted to the device tier
+        under its budget (skipped when the budget is 0 — the array is
+        still returned, it just isn't pinned)."""
+        import jax.numpy as jnp
+        dev = jnp.asarray(host_value)
+        nb = int(dev.nbytes)
+        budget = _device_budget_bytes()
+        with self._lock:
+            san.mutating(self)
+            self.uploaded_bytes += nb
+            if budget > 0 and key not in self._dev:
+                while self._dev and self.dev_used_bytes + nb > budget:
+                    k, _v = self._dev.popitem(last=False)
+                    self.dev_used_bytes -= self._dev_sizes.pop(k)
+                    self.dev_evictions += 1
+                self._dev[key] = dev
+                self._dev_sizes[key] = nb
+                self.dev_used_bytes += nb
+                self.dev_peak_bytes = max(self.dev_peak_bytes,
+                                          self.dev_used_bytes)
+            self._note_peak_locked()
+        _metrics_upload(nb)
+        return dev
+
+    def _note_peak_locked(self) -> None:
+        self.peak_bytes = max(self.peak_bytes,
+                              self.host_used_bytes + self.dev_used_bytes)
+
+    # ----------------------------------------------------- maintenance
 
     def drop_path(self, path: str) -> None:
         """Invalidate every column of one object (GC after merge) —
-        across all FS tokens: the path is dead everywhere."""
+        across all FS tokens and BOTH tiers: the path is dead
+        everywhere, and a stale pinned device array would serve deleted
+        rows to the next warm scan."""
         with self._lock:
             san.mutating(self)
-            for k in [k for k in self._entries if k[1] == path]:
-                del self._entries[k]
-                self.used_bytes -= self._sizes.pop(k)
+            for k in [k for k in self._host if k[1] == path]:
+                del self._host[k]
+                self.host_used_bytes -= self._host_sizes.pop(k)
+            for k in [k for k in self._dev if k[1] == path]:
+                del self._dev[k]
+                self.dev_used_bytes -= self._dev_sizes.pop(k)
 
     def clear(self) -> None:
         with self._lock:
             san.mutating(self)
-            self._entries.clear()
-            self._sizes.clear()
-            self.used_bytes = 0
+            self._host.clear()
+            self._host_sizes.clear()
+            self._dev.clear()
+            self._dev_sizes.clear()
+            self.host_used_bytes = 0
+            self.dev_used_bytes = 0
 
     def reset_stats(self) -> None:
-        """Zero the counters (bench warm-loop bookkeeping); entries stay."""
+        """Zero the counters (bench warm-loop bookkeeping); entries
+        stay, so the high-water marks restart at what is still
+        resident — a peak observed before the reset belongs to the
+        previous measurement window, not this one."""
         with self._lock:
-            self.hits = self.misses = self.evictions = 0
+            self.hits = self.misses = 0
+            self.dev_hits = self.dev_misses = 0
+            self.host_evictions = self.dev_evictions = 0
+            self.uploaded_bytes = 0
             self.decode_seconds = 0.0
             self.bytes_fetched = 0
+            self.host_peak_bytes = self.host_used_bytes
+            self.dev_peak_bytes = self.dev_used_bytes
+            self.peak_bytes = self.host_used_bytes + self.dev_used_bytes
+
+    # ----------------------------------------------------------- stats
 
     def stats(self) -> dict:
         with self._lock:
             total = self.hits + self.misses
-            return {"used_bytes": self.used_bytes,
-                    "peak_bytes": self.peak_bytes,
+            dev_total = self.dev_hits + self.dev_misses
+            return {
+                # legacy flat surface (bench history, hot-path tests):
+                # hits/misses are decode-avoidance outcomes — EITHER
+                # tier serving counts as a hit
+                "used_bytes": self.host_used_bytes + self.dev_used_bytes,
+                "peak_bytes": self.peak_bytes,
+                "budget_bytes": _budget_bytes(),
+                "entries": len(self._host),
+                "hits": self.hits, "misses": self.misses,
+                "hit_rate": (self.hits / total) if total else None,
+                "evictions": self.host_evictions + self.dev_evictions,
+                "decode_seconds": round(self.decode_seconds, 4),
+                "bytes_fetched": self.bytes_fetched,
+                # the split the budgets actually enforce
+                "uploaded_bytes": self.uploaded_bytes,
+                "host_tier": {
+                    "used_bytes": self.host_used_bytes,
+                    "peak_bytes": self.host_peak_bytes,
                     "budget_bytes": _budget_bytes(),
-                    "entries": len(self._entries),
-                    "hits": self.hits, "misses": self.misses,
-                    "hit_rate": (self.hits / total) if total else None,
-                    "evictions": self.evictions,
-                    "decode_seconds": round(self.decode_seconds, 4),
-                    "bytes_fetched": self.bytes_fetched}
+                    "entries": len(self._host),
+                    "evictions": self.host_evictions,
+                },
+                "device_tier": {
+                    "used_bytes": self.dev_used_bytes,
+                    "peak_bytes": self.dev_peak_bytes,
+                    "budget_bytes": _device_budget_bytes(),
+                    "entries": len(self._dev),
+                    "evictions": self.dev_evictions,
+                    "hits": self.dev_hits, "misses": self.dev_misses,
+                    "hit_rate": ((self.dev_hits / dev_total)
+                                 if dev_total else None),
+                    "uploaded_bytes": self.uploaded_bytes,
+                },
+            }
 
 
 #: the process-wide cache (reference: one fileservice cache per process)
@@ -158,12 +309,15 @@ def _metrics_miss():
     M.blockcache_ops.inc(outcome="miss")
 
 
-def _to_device(a: np.ndarray):
-    """Decoded numpy -> the backend's array type (ready-to-batch). On
-    the CPU backend this is near-free; on an accelerator it stages the
-    column into device memory ONCE per miss instead of once per scan."""
-    import jax.numpy as jnp
-    return jnp.asarray(a)
+def _metrics_dev(outcome: str):
+    from matrixone_tpu.utils import metrics as M
+    M.blockcache_device_ops.inc(outcome=outcome)
+
+
+def _metrics_upload(nb: int):
+    from matrixone_tpu.utils import metrics as M
+    M.blockcache_upload_bytes.inc(nb)
+
 
 #: cache keys carry a per-FileService identity token: two unrelated
 #: engines in one process (tests, embed clusters) may produce DIFFERENT
@@ -233,9 +387,10 @@ class _ObjectSource:
                         f"column {col!r} not in object {self.path}")
                 out = None
                 for c in a_all:
-                    d, v = _to_device(a_all[c]), _to_device(v_all[c])
-                    CACHE.put((self._tok, self.path, c, "data"), d)
-                    CACHE.put((self._tok, self.path, c, "validity"), v)
+                    d = CACHE.put((self._tok, self.path, c, "data"),
+                                  a_all[c])
+                    v = CACHE.put((self._tok, self.path, c, "validity"),
+                                  v_all[c])
                     if c == col:
                         out = d if kind == "data" else v
                     self._account(d, v)
@@ -246,9 +401,9 @@ class _ObjectSource:
                     f"column {col!r} not in object {self.path}")
             data, valid = objectio.read_column_block(self.fs, self.path,
                                                      raw, col)
-            data, valid = _to_device(data), _to_device(valid)
-            CACHE.put((self._tok, self.path, col, "data"), data)
-            CACHE.put((self._tok, self.path, col, "validity"), valid)
+            data = CACHE.put((self._tok, self.path, col, "data"), data)
+            valid = CACHE.put((self._tok, self.path, col, "validity"),
+                              valid)
             self._account(data, valid)
             self._account_time(t0, M)
             return data if kind == "data" else valid
@@ -295,13 +450,15 @@ class LazyColumns(Mapping):
 
     def cold_columns(self, cols) -> list:
         """Subset of `cols` whose decoded arrays are NOT in the process
-        cache (host-only probe, no fetch) — drives the scan read-ahead
-        decision: warm scans skip the prefetch thread entirely."""
+        cache in EITHER tier (host-only probe, no fetch, no upload) —
+        drives the scan read-ahead decision: warm scans skip the
+        prefetch thread entirely (a host-tier hit still avoids the
+        decode, which is what the prefetcher exists to overlap)."""
         src = self._source
         return [c for c in cols
                 if c in src.columns
-                and CACHE.get((src._tok, src.path, c, self._kind),
-                              count=False) is None]
+                and not CACHE.contains((src._tok, src.path, c,
+                                        self._kind))]
 
 
 def lazy_pair(fs, path: str, columns) -> Tuple[LazyColumns, LazyColumns]:

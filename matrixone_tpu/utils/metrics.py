@@ -264,6 +264,14 @@ blockcache_ops = REGISTRY.counter(
 blockcache_bytes = REGISTRY.counter(
     "mo_blockcache_fetch_bytes_total",
     "decoded bytes brought into the block cache on misses")
+blockcache_device_ops = REGISTRY.counter(
+    "mo_blockcache_device_ops_total",
+    "device-tier cache lookups: hit (zero-upload), upload (host hit, "
+    "re-staged), miss (decode required)")
+blockcache_upload_bytes = REGISTRY.counter(
+    "mo_blockcache_upload_bytes_total",
+    "host->device bytes staged for cached columns (warm loops drive "
+    "this to ~0)")
 decode_seconds = REGISTRY.counter(
     "mo_object_decode_seconds_total",
     "seconds spent fetching+decoding object column blocks (miss path)")

@@ -60,6 +60,8 @@ from matrixone_tpu.container.device import DeviceBatch, DeviceColumn
 from matrixone_tpu.utils import keys as keyaudit
 from matrixone_tpu.container.dtypes import TypeOid
 from matrixone_tpu.ops import agg as A, filter as F, sort as msort
+from matrixone_tpu.ops import encodings as ENC
+from matrixone_tpu.ops import kernels as HK
 from matrixone_tpu.sql.expr import (BoundCase, BoundCast, BoundCol,
                                     BoundExpr, BoundFunc, BoundInList,
                                     BoundIsNull, BoundLike, BoundLiteral,
@@ -1224,14 +1226,20 @@ class FusedFragmentOp(O.Operator):
     # ----------------------------------------------- fused execution
     def _runtime_key(self, ex, envs, rt_sig, rt_baked, sizes):
         cols = ex.batch.columns
-        colsig = tuple((nm, int(c.dtype.oid), tuple(c.data.shape))
+        # colsig carries the ARRAY dtype too (not just the SQL oid):
+        # narrow dict codes (ops/encodings) make int8/int16/int32 all
+        # legal carriers for one oid, and a widened dictionary must
+        # re-trace instead of hitting the narrow executable
+        colsig = tuple((nm, int(c.dtype.oid), str(c.data.dtype),
+                        tuple(c.data.shape))
                        for nm, c in cols.items())
         baked = tuple(_norm_val(lit.value)
                       for lit in self._baked_lits) + rt_baked
         dicts = tuple(_dict_key(_static_dict(e, envs[i]))
                       for i, e in self._dictdeps)
         return (self._plan_sig, rt_sig, colsig,
-                int(ex.mask.shape[0]), baked, dicts, sizes)
+                int(ex.mask.shape[0]), baked, dicts, sizes,
+                ENC.signature(), HK.signature())
 
     def _audit_deps(self, envs, rt_lift, scan_filters, sizes_flags):
         """Capture-relevant content RECOMPUTED FROM SOURCE STATE for
@@ -1256,6 +1264,9 @@ class FusedFragmentOp(O.Operator):
             "sizes_flags": sizes_flags,
             "chain_shape": self.describe(),
             "shard_ctx": self._shard_ctx(),
+            # trace-time dtype policy: bf16 lanes / hand-kernel routing
+            # are baked into the executable, invisible in input dtypes
+            "encoding_policy": (ENC.signature(), HK.signature()),
         }
 
     def _audit_exprs(self) -> list:
@@ -1362,8 +1373,19 @@ class FusedFragmentOp(O.Operator):
                     try:
                         from matrixone_tpu.utils import motrace
                         _fragment_step = fn
+                        # donate the carry (arg 6) on accelerator
+                        # backends: the step returns a new carry each
+                        # dispatch and the old one is dead, so XLA can
+                        # reuse its HBM in place instead of holding two
+                        # copies of the agg/topk state per slot (cpu
+                        # donation is unimplemented in XLA and only
+                        # produces warning spam, so gate it)
+                        donate = ((6,) if jax.default_backend() != "cpu"
+                                  else ())
                         with motrace.span("fusion.compile", slot=slot):
-                            compiled = jax.jit(_fragment_step).lower(
+                            compiled = jax.jit(
+                                _fragment_step,
+                                donate_argnums=donate).lower(
                                 *args).compile()
                     except Exception:   # noqa: BLE001 — whatever the
                         # tracer rejected, the eager path below computes
@@ -1617,7 +1639,12 @@ class FusedFragmentOp(O.Operator):
                             int_masks.append(mval)
                         else:
                             lane = ("float", len(float_vals))
-                            float_vals.append(val)
+                            # narrow-encodings policy: FLOAT32 agg
+                            # inputs round to bf16 here (inside the
+                            # trace); accumulation below stays f64, so
+                            # only element precision narrows — f64
+                            # lanes pass through untouched
+                            float_vals.append(ENC.narrow_lane(val))
                             float_masks.append(mval)
                         lane_of[lk] = lane
                     fieldmap.append(lane)

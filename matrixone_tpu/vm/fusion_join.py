@@ -333,7 +333,10 @@ class FusedJoinProbeOp(FF.FusedFragmentOp):
         for k in node.right_keys:
             FF._analyze_expr(k, binfo)
         lift_lits = list(binfo.lift)
-        colsig = tuple((nm, int(c.dtype.oid), tuple(c.data.shape))
+        # array dtype rides the colsig (narrow dict codes make several
+        # widths legal per oid; a widened dict must re-trace)
+        colsig = tuple((nm, int(c.dtype.oid), str(c.data.dtype),
+                        tuple(c.data.shape))
                        for nm, c in build.batch.columns.items())
         # keyed on the BUILD-side inputs alone (key exprs + runtime-
         # filter eligibility + schema/dicts/shape + baked values): two
@@ -353,7 +356,8 @@ class FusedJoinProbeOp(FF.FusedFragmentOp):
                tuple(FF._norm_val(lit.value) for lit in binfo.baked),
                tuple(FF._dict_key(d) for d in self._bkey_dicts),
                tuple(FF._dict_key(FF._static_dict(e, self._build_dicts))
-                     for _i, e in binfo.dictdep))
+                     for _i, e in binfo.dictdep),
+               FF.ENC.signature(), FF.HK.signature())
         entry = FF.CACHE.entry(key)
         if keyaudit.armed():
             keyaudit.audit("vm/fusion_join.py:joinbuild", key, {
@@ -368,6 +372,8 @@ class FusedJoinProbeOp(FF.FusedFragmentOp):
                                       for lit in binfo.baked),
                 "lift_arity": len(lift_lits),
                 "rf_spec_indexes": tuple(i for i, _lk in specs),
+                "encoding_policy": (FF.ENC.signature(),
+                                    FF.HK.signature()),
             })
         bschema = tuple((nm, c.dtype)
                         for nm, c in build.batch.columns.items())
@@ -437,7 +443,8 @@ class FusedJoinProbeOp(FF.FusedFragmentOp):
 
     def _probe_runtime_key(self, ex, envs, mm, build_key, sizes_flags):
         cols = ex.batch.columns
-        colsig = tuple((nm, int(c.dtype.oid), tuple(c.data.shape))
+        colsig = tuple((nm, int(c.dtype.oid), str(c.data.dtype),
+                        tuple(c.data.shape))
                        for nm, c in cols.items())
         baked = tuple(FF._norm_val(lit.value)
                       for lit in self._baked_lits)
@@ -451,7 +458,8 @@ class FusedJoinProbeOp(FF.FusedFragmentOp):
              if k.dtype.is_varlen else None)
             for k, bd in zip(node.left_keys, self._bkey_dicts))
         return (self._plan_sig, colsig, int(ex.mask.shape[0]), baked,
-                dicts, sizes_flags, mm, build_key, keydicts)
+                dicts, sizes_flags, mm, build_key, keydicts,
+                FF.ENC.signature(), FF.HK.signature())
 
     def _make_probe_step(self, trig_schema, bschema, sizes, flags, envs,
                          mm):

@@ -49,6 +49,7 @@ def chunk_to_execbatch(arrays, validity, table_dicts, n, columns, schema
     the plan's qualified names and tagging varlen columns (used by ScanOp
     and the vector-index scan)."""
     from matrixone_tpu.container import device as dev
+    from matrixone_tpu.ops import encodings as ENC
     qnames = [nm for nm, _ in schema]
     arr2, val2, dicts2, dtypes = {}, {}, {}, {}
     for qn, col, dtype in zip(qnames, columns, [d for _, d in schema]):
@@ -57,6 +58,10 @@ def chunk_to_execbatch(arrays, validity, table_dicts, n, columns, schema
         dtypes[qn] = dt.INT32 if dtype.is_varlen else dtype
         if col in table_dicts:
             dicts2[qn] = table_dicts[col]
+            # narrow dict codes to the smallest signed width the
+            # dictionary fits (lossless — hash/compare/gather are
+            # width-invariant); from_numpy preserves the narrow dtype
+            arr2[qn] = ENC.narrow_codes(arr2[qn], len(table_dicts[col]))
     db = dev.from_numpy(arr2, dtypes, val2, n_rows=n)
     for qn, (_, dtype) in zip(qnames, schema):
         if dtype.is_varlen:

@@ -166,3 +166,80 @@ def test_cli_exit_codes(tmp_path):
                          "--dir", tmp, "--tolerance", "0.95"],
                         capture_output=True, text=True)
     assert r2.returncode == 0
+
+
+def _round_d(tmp, n, metrics):
+    """Round record whose entries carry fused_dispatches counts:
+    (metric, value, unit, dispatches)."""
+    entries = [{"metric": m, "value": v, "unit": u, "backend": "cpu",
+                **({"fused_dispatches": d} if d is not None else {})}
+               for m, v, u, d in metrics]
+    top = dict(entries[0])
+    top["extra_metrics"] = entries[1:]
+    with open(os.path.join(tmp, f"BENCH_r{n:02d}.json"), "w") as f:
+        json.dump({"n": n, "rc": 0,
+                   "tail": "noise\n" + json.dumps(top)}, f)
+
+
+def test_dispatch_budget_over_cap_fails(tmp_path):
+    tmp = str(tmp_path)
+    _round_d(tmp, 1, [("tpch_q1_fused_rows_per_sec_1000", 2e6,
+                       "rows/s", 24)])
+    _round_d(tmp, 2, [("tpch_q1_fused_rows_per_sec_1000", 2e6,
+                       "rows/s", 40)])      # fusion broke: 40 > 24
+    with open(os.path.join(tmp, "BENCH_FLOORS.json"), "w") as f:
+        json.dump({"_dispatch_budgets":
+                   {"tpch_q1_fused_rows_per_sec": {"cpu": 24}}}, f)
+    ok, report = bench_guard.check(tmp)
+    assert not ok
+    assert any("FAIL dispatch budget tpch_q1_fused_rows_per_sec" in ln
+               for ln in report)
+
+
+def test_dispatch_budget_within_cap_passes(tmp_path):
+    tmp = str(tmp_path)
+    _round_d(tmp, 1, [("tpch_q1_fused_rows_per_sec_1000", 2e6,
+                       "rows/s", 30)])      # history had MORE: only the
+    _round_d(tmp, 2, [("tpch_q1_fused_rows_per_sec_1000", 2e6,
+                       "rows/s", 20)])      # latest round is judged
+    with open(os.path.join(tmp, "BENCH_FLOORS.json"), "w") as f:
+        json.dump({"_dispatch_budgets":
+                   {"tpch_q1_fused_rows_per_sec": {"cpu": 24}}}, f)
+    ok, report = bench_guard.check(tmp)
+    assert ok, report
+    assert any("ok   dispatch budget" in ln and "20 <= 24" in ln
+               for ln in report)
+
+
+def test_dispatch_budget_absent_family_warns(tmp_path):
+    tmp = str(tmp_path)
+    _round_d(tmp, 1, [("tpch_q1_fused_rows_per_sec_1000", 2e6,
+                       "rows/s", None)])    # no dispatch counts at all
+    _round_d(tmp, 2, [("tpch_q1_fused_rows_per_sec_1000", 2e6,
+                       "rows/s", None)])
+    with open(os.path.join(tmp, "BENCH_FLOORS.json"), "w") as f:
+        json.dump({"_dispatch_budgets":
+                   {"tpch_q1_fused_rows_per_sec": {"cpu": 24}}}, f)
+    ok, report = bench_guard.check(tmp)
+    assert ok, report
+    assert any("WARN dispatch budget" in ln for ln in report)
+
+
+def test_dispatch_budgets_never_become_floor_families(tmp_path):
+    """The "_"-prefixed sidecar sections must not parse as metric
+    floors (a nested dict would TypeError into a dead guard)."""
+    tmp = str(tmp_path)
+    _round_d(tmp, 1, [("tpch_q1_fused_rows_per_sec_1000", 2e6,
+                       "rows/s", 10)])
+    _round_d(tmp, 2, [("tpch_q1_fused_rows_per_sec_1000", 2e6,
+                       "rows/s", 10)])
+    with open(os.path.join(tmp, "BENCH_FLOORS.json"), "w") as f:
+        json.dump({"_comment": "sidecar",
+                   "_dispatch_budgets":
+                   {"tpch_q1_fused_rows_per_sec": {"cpu": 24}}}, f)
+    ok, report = bench_guard.check(tmp)
+    assert ok, report
+    assert not any("unreadable" in ln and "FLOORS" in ln
+                   for ln in report)
+    assert not any(ln.startswith("FAIL _") or "ok   _" in ln
+                   for ln in report)

@@ -465,3 +465,39 @@ def test_moqa_cli_smoke_flags():
     assert set(plants.plant_names()) == {"pad-leak", "stale-dict-lut"}
     with pytest.raises(ValueError, match="unknown plant"):
         plants.plant("nope")
+
+
+def test_diff_rows_close_semantics():
+    """The narrow-encodings comparer: floats at an explicit tolerance,
+    every other cell exact — a count or decimal that moves at all is a
+    finding even when floats are within tolerance."""
+    close = oracles.diff_rows_close
+    assert close([("g0", 7, 93.308)], [("g0", 7, 93.304)]) is None
+    assert close([("g0", 7, 93.3)], [("g0", 7, 95.0)]) is not None
+    # exact-cell contract: the int moved, floats did not
+    assert close([("g0", 7, 93.3)], [("g0", 8, 93.3)]) is not None
+    import decimal
+    assert close([(decimal.Decimal("1.10"),)],
+                 [(decimal.Decimal("1.1"),)]) is None
+    assert close([(decimal.Decimal("1.10"),)],
+                 [(decimal.Decimal("1.11"),)]) is not None
+    assert close([(1.0,)], [(1.0,), (2.0,)]) is not None
+    assert close([(float("nan"),)], [(float("nan"),)]) is None
+
+
+def test_narrow_f32_drill_gate():
+    """The bf16 compute-lane drill: wide vs narrowed fused aggregates
+    over bf16-inexact f32 data must agree at the documented tolerance
+    (and its exact columns exactly) — zero findings on a clean engine."""
+    findings = []
+    checks = {}
+    counts = {}
+    runner._run_narrow_f32_drill(
+        moqa.corpus_seed(),
+        lambda o: checks.__setitem__(o, checks.get(o, 0) + 1),
+        lambda kind, scenario, pair, sql, detail, q=None,
+        partition=None: findings.append((kind, sql, detail)),
+        counts)
+    assert checks.get("narrow-f32", 0) >= 2, checks
+    assert counts.get("narrow-encodings", 0) >= 2, counts
+    assert not findings, findings

@@ -41,8 +41,9 @@ def family(metric: str) -> str:
     return "_".join(out) or metric
 
 
-def metrics_of(path: str):
-    """-> (round_n, {(family, backend): value}) or None if unreadable."""
+def _entries_of(path: str):
+    """Every metric entry (top-level + extra_metrics) of one round
+    record, or None if unreadable."""
     try:
         with open(path) as f:
             rec = json.load(f)
@@ -56,7 +57,35 @@ def metrics_of(path: str):
         top = json.loads(lines[-1])
     except ValueError:
         return None
-    entries = [top] + list(top.get("extra_metrics") or [])
+    return int(rec.get("n", 0)), [top] + list(top.get("extra_metrics")
+                                              or [])
+
+
+def dispatch_counts_of(path: str) -> dict:
+    """{(family, backend): fused_dispatches} for one round record —
+    the per-family device-dispatch counts the budget check guards
+    (LOWER is better: a fusion regression shows up as more dispatches
+    long before wall-clock moves on a noisy box)."""
+    got = _entries_of(path)
+    out: dict = {}
+    if got is None:
+        return out
+    for m in got[1]:
+        cnt = m.get("fused_dispatches")
+        if not isinstance(cnt, (int, float)) or cnt <= 0:
+            continue
+        key = (family(str(m.get("metric", ""))),
+               str(m.get("backend", "")))
+        out[key] = max(out.get(key, 0.0), float(cnt))
+    return out
+
+
+def metrics_of(path: str):
+    """-> (round_n, {(family, backend): value}) or None if unreadable."""
+    got = _entries_of(path)
+    if got is None:
+        return None
+    rec_n, entries = got
     out = {}
     for m in entries:
         unit = m.get("unit")
@@ -67,7 +96,7 @@ def metrics_of(path: str):
         key = (family(str(m.get("metric", ""))),
                str(m.get("backend", "")))
         out[key] = max(out.get(key, 0.0), float(val))
-    return int(rec.get("n", 0)), out
+    return rec_n, out
 
 
 def check(bench_dir: str, tolerance: float = 0.2):
@@ -104,15 +133,28 @@ def check(bench_dir: str, tolerance: float = 0.2):
     # deliberate methodology change (e.g. r05 rerouted Q1 through the
     # object store: honest numbers dropped, history would mis-flag it)
     floors = {}
+    budgets = {}
     floors_path = os.path.join(bench_dir, "BENCH_FLOORS.json")
     if os.path.exists(floors_path):
         try:
             with open(floors_path) as f:
-                floors = {(fam, be): float(v)
-                          for fam, per_be in json.load(f).items()
-                          if isinstance(per_be, dict)
-                          for be, v in per_be.items()}
-        except (OSError, ValueError) as e:
+                raw = json.load(f)
+            # "_"-prefixed keys are sidecar sections, not floor
+            # families: _comment, and _dispatch_budgets — the
+            # per-family device-dispatch ceilings (LOWER is better;
+            # a broken fusion shows up as dispatch count long before
+            # wall-clock moves on a share-throttled box)
+            floors = {(fam, be): float(v)
+                      for fam, per_be in raw.items()
+                      if isinstance(per_be, dict)
+                      and not fam.startswith("_")
+                      for be, v in per_be.items()}
+            budgets = {(fam, be): float(v)
+                       for fam, per_be in
+                       (raw.get("_dispatch_budgets") or {}).items()
+                       if isinstance(per_be, dict)
+                       for be, v in per_be.items()}
+        except (OSError, ValueError, TypeError) as e:
             report.append(f"WARN unreadable {floors_path}: {e}")
     if len(rounds) < 2 and not floors:
         report.append(f"bench_guard: only {len(rounds)} readable round(s)"
@@ -153,6 +195,32 @@ def check(bench_dir: str, tolerance: float = 0.2):
         else:
             report.append(f"ok   {fam} [{backend}]: {cur:g} vs floor "
                           f"{floor:g} ({src})")
+    # dispatch-count budgets (inverted guard: latest must stay AT OR
+    # UNDER the ceiling) — only the latest round is judged; a family
+    # absent from it is a WARN like the floor case above
+    if budgets:
+        counts = dispatch_counts_of(
+            os.path.join(bench_dir, latest_name))
+        for key in sorted(budgets):
+            fam, backend = key
+            cap = budgets[key]
+            cur = counts.get(key)
+            if cur is None:
+                report.append(
+                    f"WARN dispatch budget {fam} [{backend}]: no "
+                    f"fused_dispatches in {latest_name} (budget "
+                    f"{cap:g})")
+                continue
+            if cur > cap:
+                ok = False
+                report.append(
+                    f"FAIL dispatch budget {fam} [{backend}]: "
+                    f"{cur:g} dispatches in {latest_name} exceeds "
+                    f"budget {cap:g} (fusion regression)")
+            else:
+                report.append(
+                    f"ok   dispatch budget {fam} [{backend}]: "
+                    f"{cur:g} <= {cap:g}")
     return ok, report
 
 

@@ -1,0 +1,111 @@
+"""kernel-smoke: interpret-mode Pallas vs XLA bit-identity drill.
+
+The hand kernels behind ops/kernels.py promise an exact contract:
+`sorted_lookup` (the hash-join probe's searchsorted) is bit-identical
+to `jnp.searchsorted(side='left')` on EVERY backend by construction —
+an integer count has no rounding and no order sensitivity — and the
+grouped-scatter f32 kernel is bit-identical whenever the elements and
+partial sums are exactly representable (the drill uses small integers
+so any deviation is a real kernel bug, not float noise).
+
+This module proves both in interpret mode (<30s on the cpu test mesh),
+plus a teeth-check: a deliberately wrong reference (searchsorted
+side='right' over data WITH duplicates) must be flagged as a mismatch,
+so a comparator bug cannot silently green the drill.
+
+Run via `python -m tools.precheck --kernel-smoke`.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def run_smoke(seed: int = 7) -> dict:
+    t0 = time.perf_counter()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from matrixone_tpu.ops import pallas_kernels as PK
+
+    rng = np.random.default_rng(seed)
+    checks = 0
+    errors: list = []
+
+    # ---- sorted_lookup: uint64 hashes with duplicate runs + the NULL
+    # sentinel, queries mixing present / absent / extremes
+    n, m = 3000, 2100                      # deliberately NOT tile-aligned
+    base = rng.integers(0, 1 << 63, size=n, dtype=np.uint64)
+    base[: n // 4] = base[0]               # a fat duplicate run
+    base[-8:] = np.uint64(0xFFFFFFFFFFFFFFFF)   # the NULL-hash region
+    srt = np.sort(base)
+    queries = np.concatenate([
+        rng.choice(srt, size=m - 4),       # present (lands inside runs)
+        np.array([0, 1, (1 << 64) - 1, srt[n // 2] + 1], dtype=np.uint64),
+    ])
+    s_j = jnp.asarray(srt)
+    q_j = jnp.asarray(queries)
+    got = np.asarray(PK.sorted_search_pallas(s_j, q_j, interpret=True))
+    want = np.asarray(jnp.searchsorted(s_j, q_j)).astype(np.int64)
+    checks += 1
+    if not np.array_equal(got.astype(np.int64), want):
+        bad = int(np.sum(got.astype(np.int64) != want))
+        errors.append(f"sorted_search_pallas != searchsorted on "
+                      f"{bad}/{m} queries")
+
+    # teeth: side='right' differs on duplicate runs — the drill must
+    # see that difference or its comparison proves nothing
+    wrong = np.asarray(jnp.searchsorted(s_j, q_j, side="right"))
+    plant_caught = not np.array_equal(got.astype(np.int64),
+                                      wrong.astype(np.int64))
+
+    # ---- grouped scatter: f32 segment sum over small integers (exact
+    # in f32 at any summation order) vs the XLA scatter
+    nrows, groups = 4096, 37
+    vals = rng.integers(0, 16, size=nrows).astype(np.float32)
+    gids = rng.integers(0, groups, size=nrows).astype(np.int32)
+    mask = rng.random(nrows) < 0.9
+    got_g = np.asarray(PK.segment_sum_pallas(
+        jnp.asarray(vals), jnp.asarray(gids), jnp.asarray(mask),
+        num_segments=groups, tile_n=512, interpret=True))
+    import jax
+    want_g = np.asarray(jax.ops.segment_sum(
+        jnp.where(jnp.asarray(mask), jnp.asarray(vals), 0.0),
+        jnp.asarray(gids), num_segments=groups)).astype(np.float32)
+    checks += 1
+    if not np.array_equal(got_g, want_g):
+        bad = int(np.sum(got_g != want_g))
+        errors.append(f"segment_sum_pallas != segment_sum on "
+                      f"{bad}/{groups} groups")
+
+    # ---- dispatch seam: the kill switch must actually route
+    import os
+
+    from matrixone_tpu.ops import kernels as HK
+    was = os.environ.get("MO_HAND_KERNELS")
+    try:
+        os.environ["MO_HAND_KERNELS"] = "0"
+        off = HK.enabled()
+        os.environ["MO_HAND_KERNELS"] = "1"
+        on = HK.enabled()
+    finally:
+        if was is None:
+            os.environ.pop("MO_HAND_KERNELS", None)
+        else:
+            os.environ["MO_HAND_KERNELS"] = was
+    checks += 1
+    if off or not on:
+        errors.append(f"MO_HAND_KERNELS routing broken: "
+                      f"0->{off}, 1->{on}")
+    # and the seam's XLA fallback answers the same lookup
+    fb = np.asarray(jnp.searchsorted(s_j, q_j)).astype(np.int64)
+    checks += 1
+    if not np.array_equal(fb, got.astype(np.int64)):
+        errors.append("seam XLA fallback disagrees with Pallas path")
+
+    return {
+        "checks": checks,
+        "errors": errors,
+        "plant_caught": plant_caught,
+        "seconds": round(time.perf_counter() - t0, 2),
+    }

@@ -335,7 +335,8 @@ def run_smoke(seed: Optional[int] = None) -> dict:
     seed = corpus_seed() if seed is None else seed
     rep = run_corpus(seed=seed, queries_per_scenario=8,
                      pairs=["fusion", "dense-groups", "plan-cache",
-                            "result-cache", "canary", "cache-stale"],
+                            "result-cache", "canary", "cache-stale",
+                            "narrow-encodings"],
                      reduce_findings=0,
                      oracle_fraction=0.34, stale_fraction=0.25,
                      max_views=2)

@@ -88,6 +88,37 @@ def diff_rows(a: List[tuple], b: List[tuple], ordered: bool,
             f"only-left {only_a[:3]!r}; only-right {only_b[:3]!r}")
 
 
+def diff_rows_close(a: List[tuple], b: List[tuple], rel: float = 1e-2,
+                    abs_tol: float = 1e-2) -> Optional[str]:
+    """Paired-row comparison at an EXPLICIT float tolerance — for
+    lockstep pairs whose variant legally changes float precision (the
+    narrow-encodings bf16 compute lanes: 8 mantissa bits leave ~0.4%
+    relative error per input, far past the sig-digit buckets of
+    diff_rows).  Rows pair positionally — callers keep both sides
+    deterministically ordered (ORDER BY the group key) — and every
+    non-float cell still compares EXACTLY: the int/decimal/string
+    exactness contract survives narrowing by design, so a count or
+    decimal sum that moves at all is a finding, not noise."""
+    if len(a) != len(b):
+        return f"{len(a)} vs {len(b)} rows"
+    for i, (ra, rb) in enumerate(zip(a, b)):
+        if len(ra) != len(rb):
+            return f"row {i}: arity {len(ra)} vs {len(rb)}"
+        for j, (x, y) in enumerate(zip(ra, rb)):
+            if isinstance(x, float) or isinstance(y, float):
+                fx, fy = float(x), float(y)
+                if math.isnan(fx) and math.isnan(fy):
+                    continue
+                if not math.isclose(fx, fy, rel_tol=rel,
+                                    abs_tol=abs_tol):
+                    return (f"row {i} col {j}: {x!r} vs {y!r} beyond "
+                            f"rel={rel} abs={abs_tol}")
+            elif _norm_cell(x, "exact") != _norm_cell(y, "exact"):
+                return (f"row {i} col {j}: {x!r} vs {y!r} "
+                        f"(exact-cell contract)")
+    return None
+
+
 # =====================================================================
 # metamorphic oracles (engine-only)
 # =====================================================================

@@ -15,7 +15,19 @@ diffs the row-sets exactly:
   result-cache    warm result-cache hit vs recompute
   udf-tier        MO_UDF_JIT=0 row loop vs jit tier
   canary          padding canary armed (utils/qa.py poisons padded
-                  tails) vs disarmed — plus the canary audits
+                  tails) vs disarmed — plus the canary audits; the
+                  armed run also forces MO_HAND_KERNELS=1 and
+                  MO_NARROW_ENCODINGS=1 so the poisoned tails sweep
+                  the Pallas sorted-search/group-scatter kernels and
+                  the narrow dict-code path, not just the XLA ops
+  narrow-encodings  MO_NARROW_ENCODINGS=1 fused path (int8/int16 dict
+                  codes, bf16 float lanes) vs the wide baseline, swept
+                  over GROUPED queries (the only shape where the
+                  policy engages); the corpus carries no FLOAT32
+                  column (doubles stay f64, decimals/counts stay
+                  scaled int64) so this pair is EXACT — the bf16
+                  tolerance contract is proven by the dedicated f32
+                  drill (_run_narrow_f32_drill)
   mview           insert-then-query ≡ query-over-materialized-view,
                   incremental maintenance AND full refresh
   shards          SET ivf_shards=2 cluster-sharded vector search vs
@@ -42,7 +54,8 @@ from tools.moqa import oracles as ORC
 #: the baseline lattice point: per-operator execution, default group
 #: path, jit UDF tier, no fusion
 ENV_BASELINE = {"MO_PLAN_FUSION": "0", "MO_DENSE_GROUPS": None,
-                "MO_FUSION_MIN_ROWS": None, "MO_UDF_JIT": None}
+                "MO_FUSION_MIN_ROWS": None, "MO_UDF_JIT": None,
+                "MO_NARROW_ENCODINGS": None, "MO_HAND_KERNELS": None}
 
 #: per-pair env overrides (applied on top of the baseline)
 PAIR_ENV = {
@@ -51,7 +64,15 @@ PAIR_ENV = {
     "plan-cache": {},
     "result-cache": {},
     "udf-tier": {"MO_UDF_JIT": "0"},
-    "canary": {"MO_PLAN_FUSION": "1", "MO_FUSION_MIN_ROWS": "0"},
+    # the armed replay also routes through the hand kernels (interpret
+    # mode off-TPU) and the narrow dict codes: the padding canary is
+    # exactly the instrument that catches a Pallas tile reading its
+    # padded tail
+    "canary": {"MO_PLAN_FUSION": "1", "MO_FUSION_MIN_ROWS": "0",
+               "MO_HAND_KERNELS": "1", "MO_NARROW_ENCODINGS": "1"},
+    "narrow-encodings": {"MO_NARROW_ENCODINGS": "1",
+                         "MO_PLAN_FUSION": "1",
+                         "MO_FUSION_MIN_ROWS": "0"},
     "mview": {},
     "shards": {},
     # device-shard SQL executor (parallel/dist_query.py): the variant
@@ -67,7 +88,7 @@ PAIR_ENV = {
 #: decimal/int sums stay exact everywhere)
 EXACT_PAIRS = frozenset({"fusion", "plan-cache", "result-cache",
                          "udf-tier", "canary", "shards",
-                         "cache-stale"})
+                         "cache-stale", "narrow-encodings"})
 
 PAIR_NAMES = tuple(PAIR_ENV)
 
@@ -178,6 +199,12 @@ def _applicable(pair: str, q: GenQuery) -> bool:
     if pair in ("fusion", "plan-cache", "result-cache", "canary",
                 "cache-stale"):
         return not q.has("vector")
+    if pair == "narrow-encodings":
+        # the policy only bites on fused agg lanes / dict codes — a
+        # grouped-only sweep covers every engaged code path at a
+        # fraction of the lockstep cost (the f32 drill below carries
+        # the precision teeth)
+        return q.has("grouped")
     if pair == "dense-groups":
         return q.has("grouped")
     if pair == "udf-tier":
@@ -278,7 +305,7 @@ def run_corpus(seed: int = 0, queries_per_scenario: int = 80,
 
             # ---- same-session env pairs
             for pair in ("fusion", "dense-groups", "udf-tier",
-                         "shards", "query-shards"):
+                         "narrow-encodings", "shards", "query-shards"):
                 if pair not in pairs:
                     continue
                 if pair == "shards":
@@ -294,10 +321,20 @@ def run_corpus(seed: int = 0, queries_per_scenario: int = 80,
                     live.sess.execute("set dist_min_rows = 0")
                 try:
                     with _pair_scope(pair):
+                        taken = 0
                         for i, q in enumerate(qs):
                             if i in base_err \
                                     or not _applicable(pair, q):
                                 continue
+                            if pair == "narrow-encodings":
+                                # half-stride sample: the pair is a
+                                # config sweep over one policy flip —
+                                # every other grouped query keeps every
+                                # engaged shape in the gate's budget
+                                # (the f32 drill carries the teeth)
+                                taken += 1
+                                if taken % 2 == 0:
+                                    continue
                             _diff_one(live, q, base_rows[i], pair, sc,
                                       note, found, pair_counts)
                 finally:
@@ -344,6 +381,12 @@ def run_corpus(seed: int = 0, queries_per_scenario: int = 80,
         if "cache-stale" in pairs and "vector" not in sc.features:
             _run_stale_pair(sc, qs, base_err, note, found, pair_counts,
                             stale_fraction)
+
+    # ---- narrow-encodings f32 drill (own tables: the corpus carries
+    # no FLOAT32 column, so the bf16 compute-lane tolerance needs its
+    # own deliberately bf16-inexact data)
+    if "narrow-encodings" in pairs:
+        _run_narrow_f32_drill(seed, note, found, pair_counts)
 
     # ---- reduce the first few findings to minimal repros
     reduced = 0
@@ -470,6 +513,76 @@ def _run_canary_pair(sc, qs, base_rows, base_err, note, found,
             live.close()
     for f in probe.findings():
         found(f.rule, sc.name, "canary", "-", f.format())
+
+
+def _run_narrow_f32_drill(seed, note, found, pair_counts):
+    """The documented-tolerance half of the narrow-encodings contract.
+
+    The corpus scenarios carry no FLOAT32 column (doubles stay f64,
+    decimals/counts stay scaled int64), so the lattice pair proves
+    narrowing is LOSSLESS where the engine promises exactness — but
+    never exercises the bf16 compute lane.  This drill builds a small
+    f32 table whose values are deliberately bf16-INEXACT (mantissas
+    longer than 8 bits), runs grouped float aggregates wide vs
+    narrowed under the fused path, and holds the variant to the
+    documented tolerance: group keys, counts and decimal sums compare
+    EXACT; f32 sums/avgs/extrema within bf16 relative error (8
+    mantissa bits -> ~0.4% per input; the drill's same-sign values
+    keep sums from cancelling the error estimate away)."""
+    import random
+
+    rnd = random.Random(seed * 7919 + 13)
+    vals = []
+    for i in range(512):
+        g = f"g{i % 7}"
+        f = rnd.uniform(0.5, 2.0) + 1e-3 * rnd.random()
+        q = rnd.randrange(0, 9999) / 100.0
+        vals.append(f"({i}, '{g}', {f!r}, {q:.2f})")
+    ddl = ("create table qa_nf (k bigint, g varchar(4), f float, "
+           "q decimal(12,2))")
+    ins = "insert into qa_nf values " + ", ".join(vals)
+    sqls = (
+        "select g, count(*) c, sum(q) sq, sum(f) sf, avg(f) af "
+        "from qa_nf group by g order by g",
+        "select g, sum(f) sf, min(f) mn, max(f) mx from qa_nf "
+        "where k < 341 group by g order by g",
+    )
+
+    def run(narrow: bool):
+        from matrixone_tpu.frontend import Session
+        from matrixone_tpu.storage.engine import Engine
+        env = dict(ENV_BASELINE)
+        env.update({"MO_PLAN_FUSION": "1", "MO_FUSION_MIN_ROWS": "0"})
+        if narrow:
+            env["MO_NARROW_ENCODINGS"] = "1"
+        out = []
+        with env_scope(env):
+            sess = Session(catalog=Engine())
+            try:
+                sess.execute(ddl)
+                sess.execute(ins)
+                for s in sqls:
+                    out.append(sess.execute(s).rows())
+            finally:
+                sess.close()
+        return out
+
+    try:
+        wide = run(False)
+        slim = run(True)
+    except Exception as e:  # noqa: BLE001 — an error on one side of a
+        # lockstep pair IS the finding
+        found("error-divergence", "narrow-f32", "narrow-encodings",
+              "qa_nf drill", f"drill raised {e!r}")
+        return
+    for s, a, b in zip(sqls, wide, slim):
+        note("narrow-f32")
+        pair_counts["narrow-encodings"] = \
+            pair_counts.get("narrow-encodings", 0) + 1
+        d = ORC.diff_rows_close(a, b, rel=1e-2, abs_tol=1e-2)
+        if d is not None:
+            found("lockstep-mismatch", "narrow-f32",
+                  "narrow-encodings", s, d)
 
 
 def _run_mview_pair(sc, qs, base_rows, base_err, note, found,
